@@ -1,0 +1,64 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of the reference (maxin8899/Paddle ≈ PaddlePaddle).
+
+Built on JAX/XLA/Pallas/PJRT: eager Tensor API with tape autograd, traced
+compilation via jit, one device mesh for all parallelism (GSPMD), Pallas
+fused kernels. See SURVEY.md for the blueprint and docs/ for design notes.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import flags as _flags_mod
+from .flags import get_flags, set_flags
+
+from .core.tensor import Tensor  # noqa: F401
+from .core import dtypes as _dtypes
+from .core.dtypes import (bfloat16, bool_, complex64, complex128, float16,  # noqa: F401
+                          float32, float64, get_default_dtype, int8, int16,
+                          int32, int64, set_default_dtype, uint8)
+from .core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+
+# the tensor-function surface (also mounts Tensor methods)
+from .tensor import *  # noqa: F401,F403
+from . import tensor as tensor  # noqa: F401
+
+from .framework import (Generator, get_rng_state, seed, set_rng_state)  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+
+from . import device  # noqa: F401
+from .device import get_device, set_device  # noqa: F401
+
+from . import autograd  # noqa: F401
+from . import linalg  # noqa: F401
+from . import metric  # noqa: F401
+
+# nn / optimizer / amp / io / jit land with their build milestones (SURVEY §7.1
+# L2/L3); imported here once present so `import paddle_tpu` exposes them.
+import importlib as _importlib
+
+for _sub in ("nn", "optimizer", "amp", "io", "jit"):
+    try:
+        globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
+    except ModuleNotFoundError:
+        pass
+del _importlib
+
+# grad API at top level (paddle.grad)
+from .core.autograd import grad  # noqa: F401
+
+
+def disable_static():
+    """Eager is the default and only authoring mode; kept for API parity."""
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "the legacy static-graph authoring mode is replaced by tracing: "
+        "use paddle_tpu.jit.to_static / paddle_tpu.jit.jit")
+
+
+def in_dynamic_mode() -> bool:
+    return True

@@ -1,0 +1,112 @@
+"""paddle.autograd parity surface (ref: python/paddle/autograd/).
+
+backward/grad on the tape, PyLayer custom autograd functions, hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ..core import autograd as _engine
+from ..core.autograd import (GradNode, enable_grad, is_grad_enabled, no_grad,
+                             set_grad_enabled)
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    for t, g in zip(tensors, grad_tensors):
+        _engine.backward(t, g, retain_graph)
+
+
+grad = _engine.grad
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def __getattr__(self, k):
+        try:
+            return self.__dict__["attrs"][k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        if k in ("_saved", "attrs"):
+            object.__setattr__(self, k, v)
+        else:
+            self.attrs[k] = v
+
+
+class PyLayer:
+    """Custom autograd function (ref: paddle.autograd.PyLayer).
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx, *grads)``.
+    TPU note: forward/backward run as eager tensor code; under tracing they
+    are traced like any other op chain.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if not needs:
+            return outs
+
+        # one slot per tensor input (vjp returns a grad per slot); grads for
+        # stop_gradient inputs are dropped by marking the slot None
+        parents = [t if not t.stop_gradient else None for t in tensor_inputs]
+
+        def vjp_fn(cots):
+            cots = cots if isinstance(cots, tuple) else (cots,)
+            with no_grad():
+                gin = cls.backward(
+                    ctx, *[Tensor(c, stop_gradient=True) for c in cots])
+            gin = (gin,) if isinstance(gin, Tensor) else tuple(gin)
+            return tuple(g._data if isinstance(g, Tensor) else g for g in gin)
+
+        node = GradNode(
+            vjp_fn, parents,
+            [jax.ShapeDtypeStruct(o._data.shape, o._data.dtype) for o in outs_t],
+            name=cls.__name__)
+        import weakref
+        results = []
+        for o in outs_t:
+            r = Tensor(o._data, stop_gradient=False)
+            r._node = node
+            node.out_refs.append(weakref.ref(r))
+            results.append(r)
+        return results[0] if single else tuple(results)

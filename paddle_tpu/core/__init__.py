@@ -1,0 +1,4 @@
+from . import autograd, dispatch, dtypes
+from .tensor import Tensor, to_tensor
+
+__all__ = ["Tensor", "to_tensor", "autograd", "dispatch", "dtypes"]

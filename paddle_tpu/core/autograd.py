@@ -1,0 +1,268 @@
+"""Tape autograd engine for the eager (dygraph-parity) execution mode.
+
+TPU-native rework of the reference's eager autograd (ref: paddle/fluid/eager/
+backward.cc `RunBackward`, grad_node_info.h `GradNodeBase`, GradTensorHolder).
+Instead of hand-written per-op grad nodes, every differentiable op application
+captures a `jax.vjp` closure — JAX supplies the per-op VJP, the tape supplies
+paddle's define-by-run semantics (`Tensor.backward()`, grad accumulation into
+leaf `.grad`, hooks, `no_grad`).
+
+The performance path is NOT this tape: whole-step training uses functional
+`value_and_grad` under `jit` (see paddle_tpu.jit). The tape exists for eager
+API parity and debugging; it is also fully traceable, so eager-style code works
+under `to_static`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradNode", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "backward"]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _state.enabled = bool(mode)
+
+
+class _GradGuard:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __call__(self, fn: Callable) -> Callable:
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with type(self)():
+                return fn(*args, **kwargs)
+        # instances are constructed per use; rebuild with captured mode
+        wrapper.__wrapped_grad_mode__ = self._mode
+        return wrapper
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class no_grad(_GradGuard):
+    """Context manager / decorator disabling gradient recording (paddle.no_grad)."""
+
+    def __init__(self):
+        super().__init__(False)
+
+
+class enable_grad(_GradGuard):
+    def __init__(self):
+        super().__init__(True)
+
+
+class GradNode:
+    """One recorded op application on the tape.
+
+    Holds the vjp closure, the parent tensors (inputs that may require grad),
+    and the avals of its outputs (so missing cotangents can be zero-filled).
+    """
+
+    __slots__ = ("vjp_fn", "parents", "out_avals", "out_refs", "name", "__weakref__")
+
+    def __init__(self, vjp_fn: Callable, parents: Sequence[Any],
+                 out_avals: List[Any], name: str = "op"):
+        self.vjp_fn = vjp_fn
+        self.parents = list(parents)   # Tensor | None per vjp input slot
+        self.out_avals = out_avals     # jax.ShapeDtypeStruct per output
+        self.out_refs: List[Any] = []  # weakref.ref to each output Tensor
+        self.name = name
+
+    def release(self) -> None:
+        self.vjp_fn = None
+        self.parents = []
+
+
+def _toposort(root_node: "GradNode") -> List["GradNode"]:
+    """Forward-topological order (parents before consumers) via iterative DFS."""
+    order: List[GradNode] = []
+    seen = set()
+    stack: List[tuple] = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for p in node.parents:
+            if p is not None and p._node is not None and id(p._node) not in seen:
+                stack.append((p._node, False))
+    return order
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False,
+             grad_targets=None) -> None:
+    """Run reverse accumulation from ``tensor`` (ref: RunBackward semantics).
+
+    Accumulates into leaf tensors' ``.grad`` (and non-leaves that called
+    ``retain_grads()``). Hooks fire once per tensor, on its *final* cotangent
+    (all consumers processed), matching the reference's hook semantics.
+
+    ``grad_targets``: optional set of tensor ids; when given, ``.grad`` is
+    only written for those tensors (used by the functional grad() API so it
+    doesn't pollute other leaves).
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    if tensor._node is None and tensor.stop_gradient:
+        raise RuntimeError(
+            "backward() called on a tensor that does not require grad")
+
+    if grad_tensor is None:
+        if tensor.size != 1:
+            raise RuntimeError(
+                "grad_tensor must be provided when the root is non-scalar "
+                f"(shape {tensor.shape})")
+        seed = jnp.ones(tensor._data.shape, tensor._data.dtype)
+    else:
+        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # cotangent accumulation keyed by tensor id; keep tensors alive so ids are stable
+    cots: dict = {}
+    keepalive: dict = {}
+
+    def _accum(t, c):
+        if t is None:
+            return
+        tid = id(t)
+        keepalive[tid] = t
+        prev = cots.get(tid)
+        cots[tid] = c if prev is None else prev + c
+
+    def _run_hooks(t):
+        """Apply t's hooks to its (now final) cotangent, in place."""
+        tid = id(t)
+        if tid not in cots or not t._hooks:
+            return
+        c = cots[tid]
+        for hook in t._hooks:
+            out = hook(Tensor(c, stop_gradient=True))
+            if out is not None:
+                c = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        cots[tid] = c
+
+    _accum(tensor, seed)
+
+    if tensor._node is not None:
+        order = _toposort(tensor._node)
+        for node in reversed(order):
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"grad graph for {node.name} was already released; "
+                    "pass retain_graph=True to backward() to reuse it")
+            out_cots = []
+            has_any = False
+            for aval, ref in zip(node.out_avals, node.out_refs):
+                t = ref()
+                # a dead output can't have received a cotangent: anything that
+                # consumed it would hold a strong ref through node.parents
+                c = None
+                if t is not None:
+                    # all consumers of this output ran already → final value
+                    _run_hooks(t)
+                    c = cots.get(id(t))
+                if c is None:
+                    c = jnp.zeros(aval.shape, aval.dtype)
+                else:
+                    has_any = True
+                out_cots.append(c)
+            if not has_any:
+                continue
+            in_cots = node.vjp_fn(tuple(out_cots) if len(out_cots) > 1 else out_cots[0])
+            for parent, c in zip(node.parents, in_cots):
+                if parent is not None and not parent.stop_gradient \
+                        and not isinstance(c, jax.custom_derivatives.SymbolicZero) \
+                        and c.dtype != jax.dtypes.float0:
+                    _accum(parent, c)
+            if not retain_graph:
+                node.release()
+
+    # write .grad on leaves (and retained non-leaves)
+    for tid, t in keepalive.items():
+        is_leaf = t._node is None
+        if t.stop_gradient:
+            continue
+        if grad_targets is not None and tid not in grad_targets:
+            continue
+        if is_leaf or t._retain_grad:
+            if is_leaf:
+                _run_hooks(t)  # leaves finalize here
+            g = cots[tid]
+            if t._grad is None:
+                t._grad = Tensor(g, stop_gradient=True)
+            else:
+                t._grad = Tensor(t._grad._data + g, stop_gradient=True)
+    # note: nodes stay attached (released) so a second backward() without
+    # retain_graph raises the "already released" error instead of no-op
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph: bool = False,
+         allow_unused: bool = False):
+    """paddle.grad parity: returns grads of outputs w.r.t. inputs without
+    touching ``.grad`` fields."""
+    from .tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    # run backward into a scratch space: temporarily mark inputs retain_grad,
+    # snapshot existing .grad, restore after.
+    saved = [(t._grad, t._retain_grad) for t in inputs]
+    targets = {id(t) for t in inputs}
+    for t in inputs:
+        t._grad = None
+        t._retain_grad = True
+    try:
+        for o, go in zip(outputs, grad_outputs):
+            backward(o, go, retain_graph=True, grad_targets=targets)
+        results = []
+        for t in inputs:
+            if t._grad is None and not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs was not used in the graph; pass "
+                    "allow_unused=True to get None for it")
+            results.append(t._grad)
+    finally:
+        for t, (g, r) in zip(inputs, saved):
+            t._grad, t._retain_grad = g, r
+        if not retain_graph:
+            for o in outputs:
+                if o._node is not None:
+                    for n in _toposort(o._node):
+                        n.release()
+                    o._node = None
+    return results

@@ -1,0 +1,157 @@
+"""Single-source-of-truth op registry + eager dispatch.
+
+The reference generates its whole op stack from YAML (ref: paddle/phi/api/yaml/
+ops.yaml driving C++ API, InferMeta binding, eager ad_func + GradNode, PIR op
+def, pybind `_C_ops.*` — SURVEY §1/§2.1). TPU-native rework: one Python
+registry where each op is {name, jax impl, optional custom vjp, tags}; from it
+we get eager dispatch, tape autograd (via jax.vjp of the impl), traceability
+under jit (the impl is jax-traceable by construction), and a hook point for the
+fusion pass / SPMD metadata. No codegen step: JAX's tracing *is* the codegen.
+
+Dispatch path parity (ref call stack §3.2): python op → `_C_ops.xxx` →
+ad_func (AMP cast → GradNode record → kernel). Here: python op → `apply()`
+(AMP cast hook → vjp record → jnp impl, dispatched async by PJRT).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from ..flags import flag
+
+__all__ = ["OpDef", "register_op", "get_op", "apply", "all_ops"]
+
+
+class OpDef:
+    """One op entry. ``impl`` takes raw jax arrays for the differentiable
+    inputs (keyword args are closed over at call time by the API wrapper)."""
+
+    __slots__ = ("name", "impl", "n_outputs", "tags", "spmd_hint")
+
+    def __init__(self, name: str, impl: Callable, n_outputs: int = 1,
+                 tags: Sequence[str] = (), spmd_hint: Optional[Callable] = None):
+        self.name = name
+        self.impl = impl
+        self.n_outputs = n_outputs
+        self.tags = tuple(tags)
+        self.spmd_hint = spmd_hint
+
+
+_registry: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, impl: Callable = None, *, n_outputs: int = 1,
+                tags: Sequence[str] = (), spmd_hint=None):
+    """Register an op. Usable as decorator or direct call."""
+    def _do(fn):
+        if name in _registry:
+            raise ValueError(f"op already registered: {name}")
+        _registry[name] = OpDef(name, fn, n_outputs, tags, spmd_hint)
+        return fn
+    if impl is not None:
+        return _do(impl)
+    return _do
+
+
+def get_op(name: str) -> OpDef:
+    return _registry[name]
+
+
+def all_ops() -> Dict[str, OpDef]:
+    return dict(_registry)
+
+
+# ---------------------------------------------------------------------------
+# AMP hook: installed by paddle_tpu.amp; receives (op_name, arrays) and may
+# cast them. Kept as a module-level slot so dispatch stays branch-cheap.
+# ---------------------------------------------------------------------------
+_amp_cast_hook: Optional[Callable] = None
+
+
+def set_amp_cast_hook(hook: Optional[Callable]) -> None:
+    global _amp_cast_hook
+    _amp_cast_hook = hook
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _check_nan_inf(name: str, arrays) -> None:
+    for a in arrays:
+        if _is_tracer(a) or not (np.issubdtype(a.dtype, np.floating)
+                                 or a.dtype == jnp.bfloat16):
+            continue
+        bad = jnp.logical_not(jnp.all(jnp.isfinite(a)))
+        if bool(bad):
+            raise FloatingPointError(
+                f"NaN/Inf detected in output of op '{name}' "
+                f"(FLAGS_check_nan_inf): shape={a.shape} dtype={a.dtype}")
+
+
+def _differentiable(arr) -> bool:
+    d = arr.dtype
+    return np.issubdtype(d, np.floating) or d == jnp.bfloat16 or \
+        np.issubdtype(d, np.complexfloating)
+
+
+def apply(name: str, fn: Callable, inputs: Sequence[Any], **kwargs):
+    """Apply ``fn`` (a jax-traceable impl) to ``inputs``.
+
+    ``inputs`` is the ordered list of *potentially differentiable* operands;
+    each item is a Tensor or a raw array-like (treated non-diff). Non-tensor
+    parameters must be baked into ``fn`` via closure/partial by the caller.
+    Returns Tensor or tuple of Tensors, recording a GradNode when the tape is
+    active and any input requires grad.
+    """
+    from .tensor import Tensor
+
+    arrs = []
+    tlist = []
+    for t in inputs:
+        if isinstance(t, Tensor):
+            arrs.append(t._data)
+            tlist.append(t)
+        else:
+            arrs.append(jnp.asarray(t))
+            tlist.append(None)
+
+    if _amp_cast_hook is not None:
+        arrs = _amp_cast_hook(name, arrs)
+
+    needs_grad = autograd.is_grad_enabled() and any(
+        t is not None and not t.stop_gradient and _differentiable(a)
+        for t, a in zip(tlist, arrs))
+
+    if needs_grad:
+        out, vjp_fn = jax.vjp(fn, *arrs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        node = autograd.GradNode(
+            vjp_fn,
+            [t if (t is not None and not t.stop_gradient) else None for t in tlist],
+            [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs],
+            name=name)
+        import weakref
+        results = []
+        for o in outs:
+            r = Tensor(o, stop_gradient=False)
+            r._node = node
+            node.out_refs.append(weakref.ref(r))
+            results.append(r)
+        if flag("FLAGS_check_nan_inf"):
+            _check_nan_inf(name, [o._data for o in results])
+        return tuple(results) if multi else results[0]
+
+    out = fn(*arrs)
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+    if flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(name, outs)
+    results = tuple(Tensor(o, stop_gradient=True) for o in outs)
+    return results if multi else results[0]

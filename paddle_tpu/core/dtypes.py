@@ -1,0 +1,114 @@
+"""Dtype table and default-dtype state.
+
+Parity surface: paddle.dtype names (ref: paddle/phi/common/data_type.h upstream
+layout; python surface paddle.set_default_dtype). bfloat16 is first-class on TPU.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "bool_", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64", "complex64", "complex128",
+    "convert_dtype", "set_default_dtype", "get_default_dtype",
+    "is_floating_dtype",
+]
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+
+# 64-bit dtypes demote to 32-bit unless jax x64 is enabled — the TPU-native
+# policy (matches jax; avoids per-call truncation warnings while keeping the
+# reference's "int64"/"float64" dtype names accepted everywhere)
+_DEMOTE_64 = {
+    np.dtype(np.int64): np.dtype(np.int32),
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    np.dtype(np.float64): np.dtype(np.float32),
+    np.dtype(np.complex128): np.dtype(np.complex64),
+}
+
+
+def _x64_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)
+
+
+def long_dtype() -> np.dtype:
+    """The index/long dtype actually in effect (int32 on TPU by default)."""
+    return np.dtype(np.int64) if _x64_enabled() else np.dtype(np.int32)
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize a dtype spec (string, np/jnp dtype, python type) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise TypeError(f"unsupported dtype name: {dtype!r}")
+        d = np.dtype(_NAME_TO_DTYPE[dtype])
+    elif dtype is bool:
+        d = np.dtype(np.bool_)
+    elif dtype is int:
+        d = np.dtype(np.int64)
+    elif dtype is float:
+        d = np.dtype(_state.default)
+    else:
+        d = np.dtype(dtype)
+    if d in _DEMOTE_64 and not _x64_enabled():
+        d = _DEMOTE_64[d]
+    return d
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.default = np.dtype(np.float32)
+
+
+_state = _State()
+
+
+def set_default_dtype(dtype) -> None:
+    d = convert_dtype(dtype)
+    if d not in (np.dtype(np.float16), np.dtype(jnp.bfloat16),
+                 np.dtype(np.float32), np.dtype(np.float64)):
+        raise TypeError(f"default dtype must be a float dtype, got {d}")
+    _state.default = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _state.default
+
+
+def is_floating_dtype(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.floating) or \
+        np.dtype(dtype) == np.dtype(jnp.bfloat16)

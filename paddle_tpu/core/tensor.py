@@ -1,0 +1,262 @@
+"""The eager Tensor: a paddle-parity imperative handle over a jax.Array.
+
+Rework of the reference's eager tensor (ref: paddle/fluid/pybind/eager.cc,
+eager_method.cc; value type paddle/phi/core/dense_tensor.cc). The device
+buffer is an async PJRT `jax.Array` — dispatch returns immediately and only
+`.numpy()` / `.item()` / python bool fence the device, mirroring the
+stream-async semantics of the reference's GPU path.
+
+Tensor is registered as a jax pytree node, so eager code is directly traceable
+by `jax.jit` — this is what makes `to_static` a thin bridge instead of a
+bytecode interpreter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .dtypes import convert_dtype, get_default_dtype
+
+__all__ = ["Tensor", "to_tensor"]
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "_grad", "_node", "name",
+                 "persistable", "_retain_grad", "_hooks", "trainable",
+                 "__weakref__", "__dict__")
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            dt = convert_dtype(dtype)
+            if dt is None and isinstance(data, (float, list)) \
+                    and _is_float_data(data):
+                dt = get_default_dtype()
+            data = jnp.asarray(data, dtype=dt)
+        elif dtype is not None and np.dtype(convert_dtype(dtype)) != data.dtype:
+            data = data.astype(convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._node: Optional[autograd.GradNode] = None
+        self.name = name
+        self.persistable = False
+        self._retain_grad = False
+        self._hooks: List[Any] = []
+        self.trainable = not stop_gradient
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self) -> str:
+        if isinstance(self._data, jax.core.Tracer):
+            return "traced"
+        d = list(self._data.devices())[0]
+        return f"{d.platform}:{d.id}"
+
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    # -- grad --------------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else (
+            value if isinstance(value, Tensor) else Tensor(value))
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False) -> None:
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self) -> None:
+        self._grad = None
+
+    def clear_gradient(self) -> None:  # paddle alias
+        self._grad = None
+
+    def retain_grads(self) -> None:
+        self._retain_grad = True
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def clone(self) -> "Tensor":
+        from . import dispatch
+        return dispatch.apply("clone", lambda x: x + jnp.zeros((), x.dtype), [self])
+
+    # -- host transfer (these FENCE the async device stream) ---------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._data
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- in-place-style mutation (functional underneath) -------------------
+    def set_value(self, value) -> None:
+        arr = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._data.shape}")
+        self._data = arr.astype(self._data.dtype)
+
+    def copy_(self, other) -> "Tensor":
+        self.set_value(other)
+        return self
+
+    def zero_(self) -> "Tensor":
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def _snapshot(self) -> "Tensor":
+        """Freeze the current value/graph-position into a fresh Tensor so this
+        one can be mutated in place: the producing node's out_ref is repointed
+        to the snapshot, which becomes the autograd parent of the new value."""
+        import weakref
+        t = Tensor.__new__(Tensor)
+        t._data = self._data
+        t.stop_gradient = self.stop_gradient
+        t._grad = None
+        t._node = self._node
+        t.name = self.name
+        t.persistable = False
+        t._retain_grad = False
+        t._hooks = []
+        t.trainable = self.trainable
+        if t._node is not None:
+            for i, ref in enumerate(t._node.out_refs):
+                if ref() is self:
+                    t._node.out_refs[i] = weakref.ref(t)
+                    break
+        return t
+
+    def _inplace_from(self, result: "Tensor") -> "Tensor":
+        """Adopt ``result`` as this tensor's new value, keeping autograd intact:
+        the producing GradNode's output slot is repointed from ``result`` to
+        ``self`` so cotangents land here during backward."""
+        import weakref
+        self._data = result._data
+        self.stop_gradient = result.stop_gradient
+        node = result._node
+        self._node = node
+        if node is not None:
+            for i, ref in enumerate(node.out_refs):
+                if ref() is result:
+                    node.out_refs[i] = weakref.ref(self)
+                    break
+        return self
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if isinstance(self._data, jax.core.Tracer):
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype}, traced, "
+                    f"stop_gradient={sg})")
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"place={self.place}, stop_gradient={sg},\n"
+                f"       {np.asarray(self._data)!r})")
+
+
+def _is_float_data(data) -> bool:
+    if isinstance(data, float):
+        return True
+    if isinstance(data, (list, tuple)):
+        return any(_is_float_data(x) for x in data)
+    return False
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity. ``place`` accepted for API compatibility;
+    device placement on TPU is owned by shardings (see paddle_tpu.distributed)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data if dtype is None else data._data.astype(convert_dtype(dtype)),
+                   stop_gradient=stop_gradient)
+        return t
+    if isinstance(data, np.ndarray) and data.dtype == np.float64 and dtype is None:
+        # numpy float defaults to f64; paddle/tpu default is f32-family
+        data = data.astype(get_default_dtype())
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+# -- pytree registration: lets jax.jit/vmap/grad consume Tensors directly ---
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor.__new__(Tensor)
+    t._data = children[0]
+    t.stop_gradient = aux[0]
+    t._grad = None
+    t._node = None
+    t.name = None
+    t.persistable = False
+    t._retain_grad = False
+    t._hooks = []
+    t.trainable = not aux[0]
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

@@ -1,0 +1,145 @@
+"""Runtime flag registry with environment override.
+
+TPU-native equivalent of the reference's in-house gflags clone
+(ref: paddle/common/flags.cc, macros PHI_DEFINE_EXPORTED_*; python surface
+paddle.set_flags / paddle.get_flags). Three properties preserved:
+
+1. every flag is overridable by env ``FLAGS_<name>`` at import time,
+2. flags are get/set-able at runtime via :func:`set_flags` / :func:`get_flags`,
+3. unknown flags raise instead of silently no-op.
+
+Flags here are plain Python (typed, validated); performance-critical consumers
+read them once per trace, not per op.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Iterable, Mapping, Optional
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag", "flags_guard"]
+
+_lock = threading.RLock()
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help", "validator")
+
+    def __init__(self, name: str, default: Any, help: str = "",
+                 validator: Optional[Callable[[Any], bool]] = None):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.help = help
+        self.validator = validator
+        self.value = self._from_env(default)
+
+    def _from_env(self, default: Any) -> Any:
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return default
+        return _parse(raw, self.type)
+
+    def set(self, value: Any) -> None:
+        if self.type is bool and isinstance(value, str):
+            value = _parse(value, bool)
+        elif not isinstance(value, self.type):
+            try:
+                value = self.type(value)
+            except (TypeError, ValueError):
+                raise TypeError(
+                    f"flag {self.name} expects {self.type.__name__}, got "
+                    f"{type(value).__name__}: {value!r}")
+        if self.validator is not None and not self.validator(value):
+            raise ValueError(f"invalid value for flag {self.name}: {value!r}")
+        self.value = value
+
+
+def _parse(raw: str, ty: type) -> Any:
+    if ty is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    return raw
+
+
+_registry: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                validator: Optional[Callable[[Any], bool]] = None) -> None:
+    """Register a flag. ``name`` must start with ``FLAGS_``."""
+    if not name.startswith("FLAGS_"):
+        raise ValueError(f"flag name must start with FLAGS_: {name}")
+    with _lock:
+        if name in _registry:
+            raise ValueError(f"flag already defined: {name}")
+        _registry[name] = _Flag(name, default, help, validator)
+
+
+def flag(name: str) -> Any:
+    """Fast read of a single flag value."""
+    try:
+        return _registry[name].value
+    except KeyError:
+        raise KeyError(f"unknown flag: {name}") from None
+
+
+def get_flags(names: Optional[Iterable[str] | str] = None) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    with _lock:
+        if names is None:
+            names = list(_registry)
+        out = {}
+        for n in names:
+            if n not in _registry:
+                raise KeyError(f"unknown flag: {n}")
+            out[n] = _registry[n].value
+        return out
+
+
+def set_flags(flags: Mapping[str, Any]) -> None:
+    with _lock:
+        for n, v in flags.items():
+            if n not in _registry:
+                raise KeyError(f"unknown flag: {n}")
+            _registry[n].set(v)
+
+
+class flags_guard:
+    """Context manager that temporarily overrides flags."""
+
+    def __init__(self, **overrides: Any):
+        self._overrides = {k if k.startswith("FLAGS_") else "FLAGS_" + k: v
+                           for k, v in overrides.items()}
+        self._saved: Dict[str, Any] = {}
+
+    def __enter__(self):
+        self._saved = get_flags(list(self._overrides))
+        set_flags(self._overrides)
+        return self
+
+    def __exit__(self, *exc):
+        set_flags(self._saved)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Core flags (parity with the reference's canonical set where meaningful on TPU;
+# CUDA-specific flags documented as unsupported in docs/UNSUPPORTED.md).
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_check_nan_inf", False,
+            "post-op NaN/Inf scan with op-level blame (debug mode)")
+define_flag("FLAGS_deterministic", False,
+            "force deterministic lowering choices (parity: FLAGS_cudnn_deterministic)")
+define_flag("FLAGS_use_fusion_compiler", False,
+            "enable the CINN-parity fusion pass pipeline (parity: FLAGS_use_cinn)")
+define_flag("FLAGS_eager_op_cache_size", 4096,
+            "max entries in the per-op jitted computation cache")
+define_flag("FLAGS_log_level", 0, "VLOG-style verbosity (higher = chattier)")
+define_flag("FLAGS_allocator_strategy", "pjrt",
+            "memory allocator strategy; TPU memory is owned by PJRT")

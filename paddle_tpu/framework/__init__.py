@@ -1,0 +1,5 @@
+from .random import (Generator, default_generator, get_rng_state, next_key,
+                     rng_key_guard, seed, set_rng_state)
+
+__all__ = ["Generator", "default_generator", "seed", "next_key",
+           "get_rng_state", "set_rng_state", "rng_key_guard"]

@@ -1,0 +1,88 @@
+"""paddle.save / paddle.load parity (ref: python/paddle/framework/io.py).
+
+Pickle-protocol state dicts with tensors converted to numpy on save and
+restored as device tensors on load; nested containers and >4GB tensors are
+handled by pickle protocol 4. Sharding-aware distributed checkpointing lives
+in paddle_tpu.distributed.checkpoint (orbax/tensorstore-backed).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    """Tag wrapper so load() knows which ndarrays were Tensors."""
+
+    __slots__ = ("array", "stop_gradient")
+
+    def __init__(self, array: np.ndarray, stop_gradient: bool):
+        self.array = array
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        a = np.asarray(obj._data)
+        # bfloat16 has no numpy pickle support everywhere; view as uint16
+        if obj._data.dtype == jnp.bfloat16:
+            return _TensorPayload(a.view(np.uint16), obj.stop_gradient), "bf16"
+        return _TensorPayload(a, obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any) -> Any:
+    if isinstance(obj, tuple) and len(obj) == 2 and isinstance(obj[0], _TensorPayload) \
+            and obj[1] == "bf16":
+        payload = obj[0]
+        return Tensor(jnp.asarray(payload.array).view(jnp.bfloat16),
+                      stop_gradient=payload.stop_gradient)
+    if isinstance(obj, _TensorPayload):
+        return Tensor(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    out = _unpack(obj)
+    if return_numpy:
+        def to_np(o):
+            if isinstance(o, Tensor):
+                return o.numpy()
+            if isinstance(o, dict):
+                return {k: to_np(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return type(o)(to_np(v) for v in o)
+            return o
+        return to_np(out)
+    return out

@@ -1,0 +1,7 @@
+"""paddle.linalg namespace (re-export of tensor.linalg, ref parity)."""
+
+from .tensor.linalg import *  # noqa: F401,F403
+from .tensor.math import matmul  # noqa: F401
+from .tensor.linalg import __all__ as _lin_all
+
+__all__ = list(_lin_all) + ["matmul"]

@@ -1,0 +1,193 @@
+"""paddle_tpu.tensor — the ~tensor-function surface, and the glue that mounts
+it onto Tensor as methods/dunders (ref parity: python/paddle/tensor/__init__.py
+which monkey-patches the generated methods onto the eager tensor)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtypes import convert_dtype
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .attribute import *  # noqa: F401,F403
+
+from . import (attribute, creation, einsum as _einsum_mod, linalg, logic,
+               manipulation, math, random, search, stat)
+
+
+# ---------------------------------------------------------------------------
+# indexing with autograd
+# ---------------------------------------------------------------------------
+def _norm_index(item):
+    """Convert Tensor indices to raw arrays; reject traced boolean masks."""
+    def conv(i):
+        if isinstance(i, Tensor):
+            if i.dtype == jnp.bool_ and isinstance(i._data, jax.core.Tracer):
+                raise NotImplementedError(
+                    "boolean-mask indexing is dynamic-shape; not supported "
+                    "under tracing — use paddle_tpu.where/masked_fill")
+            return i._data
+        return i
+    if isinstance(item, tuple):
+        return tuple(conv(i) for i in item)
+    return conv(item)
+
+
+import builtins as _builtins
+
+
+def _getitem(self, item):
+    idx = _norm_index(item)
+    has_bool = _builtins.any(
+        isinstance(i, (jax.Array, np.ndarray)) and i.dtype == np.bool_
+        for i in (idx if isinstance(idx, tuple) else (idx,))) or (
+        isinstance(idx, (jax.Array, np.ndarray)) and idx.dtype == np.bool_)
+    if has_bool and not isinstance(self._data, jax.core.Tracer):
+        # dynamic-shape: eager host path, no grad
+        return Tensor(jnp.asarray(np.asarray(self._data)[
+            tuple(np.asarray(i) if isinstance(i, jax.Array) else i for i in idx)
+            if isinstance(idx, tuple) else np.asarray(idx)]))
+    return apply("getitem", lambda a: a[idx], [self])
+
+
+def _setitem(self, item, value):
+    idx = _norm_index(item)
+    old = self._snapshot()
+    if isinstance(value, Tensor):
+        self._inplace_from(apply("setitem", lambda a, v: a.at[idx].set(v),
+                                 [old, value]))
+    else:
+        self._inplace_from(apply("setitem", lambda a: a.at[idx].set(value),
+                                 [old]))
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+
+# ---------------------------------------------------------------------------
+# dunders
+# ---------------------------------------------------------------------------
+Tensor.__add__ = lambda s, o: math.add(s, o)
+Tensor.__radd__ = lambda s, o: math.add(o, s)
+Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+Tensor.__mod__ = lambda s, o: math.remainder(s, o)
+Tensor.__pow__ = lambda s, o: math.pow(s, o)
+Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+Tensor.__matmul__ = lambda s, o: math.matmul(s, o)
+Tensor.__rmatmul__ = lambda s, o: math.matmul(o, s)
+Tensor.__neg__ = lambda s: math.neg(s)
+Tensor.__abs__ = lambda s: math.abs(s)
+Tensor.__invert__ = lambda s: logic.logical_not(s) if s.dtype == jnp.bool_ \
+    else logic.bitwise_not(s)
+Tensor.__and__ = lambda s, o: logic.logical_and(s, o) if s.dtype == jnp.bool_ \
+    else logic.bitwise_and(s, o)
+Tensor.__or__ = lambda s, o: logic.logical_or(s, o) if s.dtype == jnp.bool_ \
+    else logic.bitwise_or(s, o)
+Tensor.__xor__ = lambda s, o: logic.logical_xor(s, o) if s.dtype == jnp.bool_ \
+    else logic.bitwise_xor(s, o)
+Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+
+
+# ---------------------------------------------------------------------------
+# methods
+# ---------------------------------------------------------------------------
+_METHODS = dict(
+    # math
+    add=math.add, subtract=math.subtract, multiply=math.multiply,
+    divide=math.divide, floor_divide=math.floor_divide, mod=math.remainder,
+    remainder=math.remainder, pow=math.pow, matmul=math.matmul, mm=math.matmul,
+    dot=math.dot, maximum=math.maximum, minimum=math.minimum,
+    exp=math.exp, log=math.log, log2=math.log2, log10=math.log10,
+    log1p=math.log1p, sqrt=math.sqrt, rsqrt=math.rsqrt, square=math.square,
+    abs=math.abs, sign=math.sign, neg=math.neg, reciprocal=math.reciprocal,
+    floor=math.floor, ceil=math.ceil, round=math.round, trunc=math.trunc,
+    sin=math.sin, cos=math.cos, tan=math.tan, tanh=math.tanh, erf=math.erf,
+    sigmoid=lambda x, name=None: apply("sigmoid", jax.nn.sigmoid, [x]),
+    clip=math.clip, clip_=math.clip_, sum=math.sum, mean=math.mean,
+    prod=math.prod, max=math.max, min=math.min, amax=math.amax,
+    amin=math.amin, cumsum=math.cumsum, cumprod=math.cumprod,
+    logsumexp=math.logsumexp, isnan=math.isnan, isinf=math.isinf,
+    isfinite=math.isfinite, scale=math.scale, lerp=math.lerp,
+    add_=math.add_, subtract_=math.subtract_, multiply_=math.multiply_,
+    scale_=math.scale_, trace=math.trace, kron=math.kron, outer=math.outer,
+    inner=math.inner, diff=math.diff, logit=math.logit,
+    nan_to_num=math.nan_to_num,
+    # manipulation
+    reshape=manipulation.reshape, reshape_=manipulation.reshape_,
+    flatten=manipulation.flatten, squeeze=manipulation.squeeze,
+    squeeze_=manipulation.squeeze_, unsqueeze=manipulation.unsqueeze,
+    unsqueeze_=manipulation.unsqueeze_, split=manipulation.split,
+    chunk=manipulation.chunk, unbind=manipulation.unbind,
+    transpose=manipulation.transpose, moveaxis=manipulation.moveaxis,
+    tile=manipulation.tile, expand=manipulation.expand,
+    expand_as=manipulation.expand_as, broadcast_to=manipulation.broadcast_to,
+    cast=manipulation.cast, astype=manipulation.cast,
+    gather=manipulation.gather, gather_nd=manipulation.gather_nd,
+    scatter=manipulation.scatter, scatter_nd_add=manipulation.scatter_nd_add,
+    index_select=manipulation.index_select, index_add=manipulation.index_add,
+    take_along_axis=manipulation.take_along_axis,
+    put_along_axis=manipulation.put_along_axis, roll=manipulation.roll,
+    flip=manipulation.flip, rot90=manipulation.rot90,
+    repeat_interleave=manipulation.repeat_interleave,
+    masked_select=manipulation.masked_select,
+    masked_fill=manipulation.masked_fill, nonzero=manipulation.nonzero,
+    unique=manipulation.unique, where=manipulation.where,
+    tensor_split=manipulation.tensor_split, view=manipulation.view,
+    # logic
+    equal=logic.equal, not_equal=logic.not_equal,
+    greater_than=logic.greater_than, greater_equal=logic.greater_equal,
+    less_than=logic.less_than, less_equal=logic.less_equal,
+    logical_and=logic.logical_and, logical_or=logic.logical_or,
+    logical_xor=logic.logical_xor, logical_not=logic.logical_not,
+    bitwise_and=logic.bitwise_and, bitwise_or=logic.bitwise_or,
+    bitwise_xor=logic.bitwise_xor, bitwise_not=logic.bitwise_not,
+    equal_all=logic.equal_all, allclose=logic.allclose, isclose=logic.isclose,
+    all=logic.all, any=logic.any,
+    # linalg
+    t=linalg.t, norm=linalg.norm, dist=linalg.dist, cross=linalg.cross,
+    cholesky=linalg.cholesky, inv=linalg.inv,
+    matrix_power=linalg.matrix_power,
+    # search/stat
+    argmax=search.argmax, argmin=search.argmin, argsort=search.argsort,
+    sort=search.sort, topk=search.topk, kthvalue=search.kthvalue,
+    std=stat.std, var=stat.var, median=stat.median, quantile=stat.quantile,
+    numel=stat.numel, bincount=stat.bincount,
+    # random inplace
+    uniform_=random.uniform_, normal_=random.normal_,
+    exponential_=random.exponential_,
+    # attribute
+    real=attribute.real, imag=attribute.imag,
+)
+
+for _name, _fn in _METHODS.items():
+    setattr(Tensor, _name, _fn)
+
+Tensor.T = property(lambda s: manipulation.transpose(
+    s, list(range(s.ndim))[::-1]))
+Tensor.mT = property(lambda s: manipulation.transpose(
+    s, list(range(s.ndim - 2)) + [s.ndim - 1, s.ndim - 2]))

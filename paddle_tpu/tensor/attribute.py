@@ -1,0 +1,40 @@
+"""Tensor attribute helpers (ref: python/paddle/tensor/attribute.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["rank", "shape", "real", "imag", "is_complex", "is_integer",
+           "is_floating_point"]
+
+
+def rank(x) -> Tensor:
+    return Tensor(jnp.asarray(x.ndim, jnp.int32))
+
+
+def shape(x) -> Tensor:
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+def real(x, name=None) -> Tensor:
+    return apply("real", jnp.real, [x])
+
+
+def imag(x, name=None) -> Tensor:
+    return apply("imag", jnp.imag, [x])
+
+
+def is_complex(x) -> bool:
+    return np.issubdtype(x.dtype, np.complexfloating)
+
+
+def is_integer(x) -> bool:
+    return np.issubdtype(x.dtype, np.integer)
+
+
+def is_floating_point(x) -> bool:
+    return np.issubdtype(x.dtype, np.floating) or x.dtype == jnp.bfloat16

@@ -1,0 +1,162 @@
+"""Tensor creation ops (ref surface: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "meshgrid", "diag", "diagflat", "tril", "triu", "assign", "clone",
+    "tril_indices", "triu_indices", "complex",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(x) for x in np.asarray(shape._data))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else get_default_dtype()
+    return d
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._data
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    # XLA has no uninitialized alloc; zeros is the TPU-native equivalent
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _v(v):
+        return v._data if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        vals = [v for v in (start, end, step)]
+        dtype = "float32" if any(isinstance(v, float) for v in vals) else "int64"
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(
+        start._data if isinstance(start, Tensor) else start,
+        stop._data if isinstance(stop, Tensor) else stop,
+        int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(
+        start._data if isinstance(start, Tensor) else start,
+        stop._data if isinstance(stop, Tensor) else stop,
+        int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[t._data for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    def impl(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, a.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply("diag", impl, [x])
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset), [x])
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply("tril", lambda a: jnp.tril(a, k=diagonal), [x])
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply("triu", lambda a: jnp.triu(a, k=diagonal), [x])
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None) -> Tensor:
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None) -> Tensor:
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def assign(x, output=None) -> Tensor:
+    src = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if output is None:
+        return apply("assign", lambda a: a + jnp.zeros((), a.dtype), [src])
+    output.set_value(src)
+    return output
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone()
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply("complex", lambda r, i: jax.lax.complex(r, i), [real, imag])

@@ -1,0 +1,17 @@
+"""Einsum (ref: python/paddle/tensor/einsum.py — paddle ships its own planner;
+on TPU we delegate to jnp.einsum, whose contractions XLA maps onto the MXU)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    ops = [o for o in operands]
+    return apply("einsum",
+                 lambda *arrs: jnp.einsum(equation, *arrs), list(ops))

@@ -1,0 +1,235 @@
+"""Linear algebra (ref surface: python/paddle/tensor/linalg.py, paddle.linalg).
+
+Decompositions lower to XLA's native QR/SVD/Cholesky/Eigh — the cuSOLVER/
+LAPACK dynload layer of the reference (paddle/phi/backends/dynload/cusolver.h)
+has no TPU analog to build: XLA ships these.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "t", "norm", "dist", "cross", "cholesky", "qr", "svd", "eigh",
+    "eigvalsh", "inv", "pinv", "solve", "triangular_solve", "matrix_power",
+    "det", "slogdet", "matrix_rank", "cond", "cov", "corrcoef", "lu",
+    "cholesky_solve", "lstsq", "multi_dot", "householder_product", "pca_lowrank",
+]
+
+
+def t(x, name=None) -> Tensor:
+    if x.ndim > 2:
+        raise ValueError("paddle.t expects ndim <= 2; use transpose")
+    return apply("t", lambda a: a.T, [x])
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None) -> Tensor:
+    """paddle.linalg.norm parity: default (p=None) is Frobenius over the
+    reduced axes; p=2 over two axes is also Frobenius (paddle semantics —
+    spectral norm is not what paddle's norm computes)."""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    def impl(a):
+        if ax is None or (isinstance(ax, tuple) and len(ax) == 2):
+            axes = ax  # None → all
+            if p in (None, "fro", 2):
+                sq = jnp.sum(jnp.square(jnp.abs(a)), axis=axes, keepdims=keepdim)
+                return jnp.sqrt(sq)
+            if p == "nuc":
+                if axes is None:
+                    raise ValueError("nuclear norm requires a 2-axis tuple")
+                return jnp.linalg.norm(a, ord="nuc", axis=axes, keepdims=keepdim)
+            if p == np.inf:
+                return jnp.max(jnp.abs(a), axis=axes, keepdims=keepdim)
+            if p == -np.inf:
+                return jnp.min(jnp.abs(a), axis=axes, keepdims=keepdim)
+            if p == 0:
+                return jnp.sum((a != 0).astype(a.dtype), axis=axes,
+                               keepdims=keepdim)
+            if p == 1:
+                return jnp.sum(jnp.abs(a), axis=axes, keepdims=keepdim)
+            return jnp.sum(jnp.abs(a) ** p, axis=axes,
+                           keepdims=keepdim) ** (1.0 / p)
+        axi = ax[0] if isinstance(ax, tuple) else ax
+        q = 2 if p in (None, "fro") else p
+        if q == np.inf:
+            return jnp.max(jnp.abs(a), axis=axi, keepdims=keepdim)
+        if q == -np.inf:
+            return jnp.min(jnp.abs(a), axis=axi, keepdims=keepdim)
+        if q == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axi, keepdims=keepdim)
+        if q == 2:
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a)), axis=axi,
+                                    keepdims=keepdim))
+        return jnp.sum(jnp.abs(a) ** q, axis=axi, keepdims=keepdim) ** (1.0 / q)
+    return apply("norm", impl, [x])
+
+
+def dist(x, y, p=2, name=None) -> Tensor:
+    def impl(a, b):
+        d = jnp.abs(a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == np.inf:
+            return jnp.max(d)
+        if p == -np.inf:
+            return jnp.min(d)
+        return jnp.sum(d ** p) ** (1.0 / p)
+    return apply("dist", impl, [x, y])
+
+
+def cross(x, y, axis=9, name=None) -> Tensor:
+    ax = axis
+    if ax == 9:  # paddle default: first axis of size 3
+        ax = next(i for i, s in enumerate(x.shape) if s == 3)
+    return apply("cross", lambda a, b: jnp.cross(a, b, axis=ax), [x, y])
+
+
+def cholesky(x, upper=False, name=None) -> Tensor:
+    def impl(a):
+        low = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(low, -1, -2) if upper else low
+    return apply("cholesky", impl, [x])
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [x]) \
+        if mode != "r" else None
+    if mode == "r":
+        return apply("qr_r", lambda a: jnp.linalg.qr(a, mode="r"), [x])
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd",
+                 lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                 [x])
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), [x])
+
+
+def eigvalsh(x, UPLO="L", name=None) -> Tensor:
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), [x])
+
+
+def inv(x, name=None) -> Tensor:
+    return apply("inv", jnp.linalg.inv, [x])
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None) -> Tensor:
+    return apply("pinv",
+                 lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                 [x])
+
+
+def solve(x, y, name=None) -> Tensor:
+    return apply("solve", jnp.linalg.solve, [x, y])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None) -> Tensor:
+    def impl(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply("triangular_solve", impl, [x, y])
+
+
+def cholesky_solve(x, y, upper=False, name=None) -> Tensor:
+    def impl(b, l):
+        z = jax.scipy.linalg.solve_triangular(l, b, lower=not upper)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(l, -1, -2), z, lower=upper)
+    return apply("cholesky_solve", impl, [x, y])
+
+
+def matrix_power(x, n, name=None) -> Tensor:
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), [x])
+
+
+def det(x, name=None) -> Tensor:
+    return apply("det", jnp.linalg.det, [x])
+
+
+def slogdet(x, name=None):
+    def impl(a):
+        s, l = jnp.linalg.slogdet(a)
+        return jnp.stack([s, l]) if s.ndim == 0 else jnp.stack([s, l])
+    return apply("slogdet", impl, [x])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None) -> Tensor:
+    return Tensor(jnp.linalg.matrix_rank(x._data, rtol=tol))
+
+
+def cond(x, p=None, name=None) -> Tensor:
+    return apply("cond", lambda a: jnp.linalg.cond(a, p=p), [x])
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None) -> Tensor:
+    fw = fweights._data if isinstance(fweights, Tensor) else fweights
+    aw = aweights._data if isinstance(aweights, Tensor) else aweights
+    return apply("cov",
+                 lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), [x])
+
+
+def corrcoef(x, rowvar=True, name=None) -> Tensor:
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), [x])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = apply("lu", lambda a: tuple(jax.scipy.linalg.lu_factor(a)), [x])
+    if get_infos:
+        info = Tensor(jnp.zeros((), jnp.int32))
+        return lu_, piv, info
+    return lu_, piv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def impl(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply("lstsq", impl, [x, y])
+
+
+def multi_dot(tensors, name=None) -> Tensor:
+    return apply("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs),
+                 list(tensors))
+
+
+def householder_product(x, tau, name=None) -> Tensor:
+    def impl2d(a, t_):
+        m, n = a.shape
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype),
+                                 a[i + 1:, i]])
+            h = jnp.eye(m, dtype=a.dtype) - t_[i] * jnp.outer(v, v)
+            q = q @ h
+        return q[:, :n]
+
+    def impl(a, t_):
+        if a.ndim == 2:
+            return impl2d(a, t_)
+        batch = a.shape[:-2]
+        af = a.reshape((-1,) + a.shape[-2:])
+        tf = t_.reshape((-1, t_.shape[-1]))
+        out = jax.vmap(impl2d)(af, tf)
+        return out.reshape(batch + out.shape[-2:])
+    return apply("householder_product", impl, [x, tau])
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def impl(a):
+        b = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        k = q if q is not None else min(6, *b.shape[-2:])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+    return apply("pca_lowrank", impl, [x])

@@ -1,0 +1,75 @@
+"""Comparison / logical / bitwise ops (ref: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all",
+    "allclose", "isclose", "all", "any", "is_tensor",
+]
+
+
+def _cmp(opname, jfn):
+    def op(x, y, name=None):
+        xv = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(jfn(xv, yv))
+    op.__name__ = opname
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None) -> Tensor:
+    return Tensor(jnp.logical_not(x._data))
+
+
+def bitwise_not(x, name=None) -> Tensor:
+    return Tensor(jnp.bitwise_not(x._data))
+
+
+def equal_all(x, y, name=None) -> Tensor:
+    return Tensor(jnp.array_equal(x._data, y._data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    return Tensor(jnp.allclose(x._data, y._data, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    return Tensor(jnp.isclose(x._data, y._data, rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def all(x, axis=None, keepdim=False, name=None) -> Tensor:
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return Tensor(jnp.all(x._data, axis=ax, keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None) -> Tensor:
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return Tensor(jnp.any(x._data, axis=ax, keepdims=keepdim))
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

@@ -1,0 +1,460 @@
+"""Shape/layout manipulation ops (ref surface: python/paddle/tensor/manipulation.py).
+
+XLA note: everything here is static-shape by construction; the few genuinely
+dynamic-shape APIs (masked_select, nonzero, unique) execute eagerly on host
+values and raise under tracing, matching SURVEY §7.2's bucketing stance.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "concat", "stack", "split", "chunk", "unbind", "unstack",
+    "transpose", "moveaxis", "tile", "expand", "expand_as", "broadcast_to",
+    "cast", "slice", "gather", "gather_nd", "scatter", "scatter_nd_add",
+    "index_select", "index_add", "index_put", "take_along_axis",
+    "put_along_axis", "roll", "flip", "rot90", "repeat_interleave", "where",
+    "masked_select", "masked_fill", "nonzero", "unique", "strided_slice",
+    "as_strided", "view", "tensor_split", "atleast_1d", "atleast_2d",
+    "atleast_3d", "broadcast_tensors", "crop", "pad_nd",
+]
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x._data, jax.core.Tracer)
+
+
+def reshape(x, shape, name=None) -> Tensor:
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in np.asarray(shape._data)]
+    shape = [int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape]
+    return apply("reshape", lambda a: jnp.reshape(a, shape), [x])
+
+
+def reshape_(x, shape, name=None) -> Tensor:
+    return x._inplace_from(reshape(x._snapshot(), shape))
+
+
+def view(x, shape_or_dtype, name=None) -> Tensor:
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply("view_dtype",
+                 lambda a: jax.lax.bitcast_convert_type(
+                     a, convert_dtype(shape_or_dtype)), [x])
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shape = x.shape
+    new_shape = shape[:s] + [int(np.prod(shape[s:e + 1]) or 1)] + shape[e + 1:]
+    return reshape(x, new_shape)
+
+
+def squeeze(x, axis=None, name=None) -> Tensor:
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return apply("squeeze", lambda a: jnp.squeeze(a, axis=ax), [x])
+
+
+def squeeze_(x, axis=None, name=None) -> Tensor:
+    return x._inplace_from(squeeze(x._snapshot(), axis))
+
+
+def unsqueeze(x, axis, name=None) -> Tensor:
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes]
+    def impl(a):
+        out = a
+        for ax in sorted(ax_ % (out.ndim + 1) for ax_ in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply("unsqueeze", impl, [x])
+
+
+def unsqueeze_(x, axis, name=None) -> Tensor:
+    return x._inplace_from(unsqueeze(x._snapshot(), axis))
+
+
+def concat(x: Sequence[Tensor], axis=0, name=None) -> Tensor:
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply("concat", lambda *arrs: jnp.concatenate(arrs, axis=axis), list(x))
+
+
+def stack(x: Sequence[Tensor], axis=0, name=None) -> Tensor:
+    return apply("stack", lambda *arrs: jnp.stack(arrs, axis=axis), list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {axis} length {dim} is not divisible by "
+                f"{num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_minus = sizes.count(-1)
+        if n_minus:
+            rest = dim - builtins.sum(s for s in sizes if s != -1)
+            sizes = [rest // n_minus if s == -1 else s for s in sizes]
+        if builtins.sum(sizes) != dim:
+            raise ValueError(
+                f"split: sections {sizes} do not sum to axis length {dim}")
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    def impl(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sizes))
+    outs = apply("split", impl, [x])
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    dim = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, extra = divmod(dim, n)
+        sizes = [base + (1 if i < extra else 0) for i in range(n)]
+        return split(x, sizes, axis)
+    idxs = [0] + list(num_or_indices) + [dim]
+    sizes = [idxs[i + 1] - idxs[i] for i in range(len(idxs) - 1)]
+    return split(x, sizes, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    def impl(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(apply("unbind", impl, [x]))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def transpose(x, perm, name=None) -> Tensor:
+    perm = [int(p) for p in perm]
+    return apply("transpose", lambda a: jnp.transpose(a, perm), [x])
+
+
+def moveaxis(x, source, destination, name=None) -> Tensor:
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, source, destination), [x])
+
+
+def tile(x, repeat_times, name=None) -> Tensor:
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(v) for v in np.asarray(repeat_times._data)]
+    return apply("tile", lambda a: jnp.tile(a, repeat_times), [x])
+
+
+def expand(x, shape, name=None) -> Tensor:
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape._data)]
+    tgt = []
+    xs = x.shape
+    pad = len(shape) - len(xs)
+    for i, s in enumerate(shape):
+        if s == -1:
+            tgt.append(xs[i - pad] if i >= pad else 1)
+        else:
+            tgt.append(int(s))
+    return apply("expand", lambda a: jnp.broadcast_to(a, tgt), [x])
+
+
+def expand_as(x, y, name=None) -> Tensor:
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None) -> Tensor:
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [expand(t, list(out_shape)) for t in inputs]
+
+
+def atleast_1d(*xs, name=None):
+    outs = [x if x.ndim >= 1 else reshape(x, [1]) for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs, name=None):
+    outs = []
+    for x in xs:
+        while x.ndim < 2:
+            x = unsqueeze(x, 0)
+        outs.append(x)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs, name=None):
+    outs = []
+    for x in xs:
+        while x.ndim < 3:
+            x = unsqueeze(x, x.ndim)
+        outs.append(x)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def cast(x, dtype) -> Tensor:
+    dt = convert_dtype(dtype)
+    return apply("cast", lambda a: a.astype(dt), [x])
+
+
+def slice(x, axes, starts, ends, name=None) -> Tensor:
+    def _v(v):
+        return int(v.item()) if isinstance(v, Tensor) else int(v)
+    axes = [int(a) for a in axes]
+    starts = [_v(s) for s in (starts if isinstance(starts, (list, tuple)) else [starts])]
+    ends = [_v(e) for e in (ends if isinstance(ends, (list, tuple)) else [ends])]
+    def impl(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = slice_builtin(s, e)
+        return a[tuple(idx)]
+    return apply("slice", impl, [x])
+
+
+slice_builtin = __import__("builtins").slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None) -> Tensor:
+    def impl(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(ax)] = slice_builtin(int(s), int(e), int(st))
+        return a[tuple(idx)]
+    return apply("strided_slice", impl, [x])
+
+
+def as_strided(x, shape, stride, offset=0, name=None) -> Tensor:
+    def impl(a):
+        flat = a.reshape(-1)
+        idx = np.zeros(shape, dtype=np.int64) + offset
+        for dim, (sz, st) in enumerate(zip(shape, stride)):
+            r = np.arange(sz) * st
+            idx += r.reshape([-1 if i == dim else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+    return apply("as_strided", impl, [x])
+
+
+def gather(x, index, axis=0, name=None) -> Tensor:
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    if idx.ndim == 0:
+        idx = idx[None]
+    return apply("gather", lambda a: jnp.take(a, idx, axis=axis), [x])
+
+
+def gather_nd(x, index, name=None) -> Tensor:
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    def impl(a):
+        k = idx.shape[-1]
+        return a[tuple(jnp.moveaxis(idx, -1, 0))] if k > 0 else a
+    return apply("gather_nd", impl, [x])
+
+
+def scatter(x, index, updates, overwrite=True, name=None) -> Tensor:
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    if overwrite:
+        return apply("scatter",
+                     lambda a, u: a.at[idx].set(u), [x, updates])
+    return apply("scatter_add",
+                 lambda a, u: a.at[idx].add(u), [x, updates])
+
+
+def scatter_nd_add(x, index, updates, name=None) -> Tensor:
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    def impl(a, u):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return apply("scatter_nd_add", impl, [x, updates])
+
+
+def index_select(x, index, axis=0, name=None) -> Tensor:
+    return gather(x, index, axis)
+
+
+def index_add(x, index, axis, value, name=None) -> Tensor:
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    def impl(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_add", impl, [x, value])
+
+
+def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
+    idx = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in indices)
+    def impl(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+    return apply("index_put", impl, [x, value])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None) -> Tensor:
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply("take_along_axis",
+                 lambda a: jnp.take_along_axis(a, idx, axis=axis), [arr])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None) -> Tensor:
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    def impl(a, v):
+        v = jnp.broadcast_to(v, idx.shape) if np.ndim(v) else jnp.full(idx.shape, v, a.dtype)
+        ix = _along_axis_index(idx, axis % a.ndim, a.ndim)
+        if reduce == "assign":
+            return a.at[ix].set(v)
+        if reduce in ("add",):
+            return a.at[ix].add(v)
+        if reduce in ("multiply", "mul"):
+            return a.at[ix].multiply(v)
+        raise ValueError(f"unsupported reduce mode: {reduce}")
+    vt = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    return apply("put_along_axis", impl, [arr, vt])
+
+
+def _along_axis_index(idx, axis, ndim):
+    ix = []
+    for d in range(ndim):
+        if d == axis % ndim:
+            ix.append(idx)
+        else:
+            shape = [1] * ndim
+            shape[d] = idx.shape[d]
+            ix.append(jnp.arange(idx.shape[d]).reshape(shape))
+    return tuple(ix)
+
+
+def roll(x, shifts, axis=None, name=None) -> Tensor:
+    return apply("roll", lambda a: jnp.roll(a, shifts, axis=axis), [x])
+
+
+def flip(x, axis, name=None) -> Tensor:
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda a: jnp.flip(a, axis=tuple(ax)), [x])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None) -> Tensor:
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [x])
+
+
+def repeat_interleave(x, repeats, axis=None, name=None) -> Tensor:
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    if not isinstance(r, int):
+        # per-element repeats give dynamic shapes; require host execution
+        if _is_traced(x):
+            raise NotImplementedError(
+                "repeat_interleave with tensor repeats is dynamic-shape; "
+                "not supported under tracing (XLA static shapes)")
+        total = int(np.asarray(r).sum())
+        out = np.repeat(np.asarray(x._data), np.asarray(r), axis=axis)
+        return Tensor(jnp.asarray(out))
+    return apply("repeat_interleave",
+                 lambda a: jnp.repeat(a, r, axis=axis), [x])
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = condition._data if isinstance(condition, Tensor) else jnp.asarray(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    def impl(a, b):
+        return jnp.where(cond, a, b)
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+    return apply("where", impl, [xt, yt])
+
+
+def masked_fill(x, mask, value, name=None) -> Tensor:
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    v = value._data if isinstance(value, Tensor) else value
+    return apply("masked_fill",
+                 lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a), [x])
+
+
+def masked_select(x, mask, name=None) -> Tensor:
+    """Dynamic-shape: eager-only (host fallback); raises under tracing."""
+    if _is_traced(x):
+        raise NotImplementedError(
+            "masked_select has data-dependent output shape; not supported "
+            "under tracing — use where()/masked_fill for traced code")
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(np.asarray(x._data)[m]))
+
+
+def nonzero(x, as_tuple=False):
+    if _is_traced(x):
+        raise NotImplementedError(
+            "nonzero has data-dependent output shape; not supported under "
+            "tracing")
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n)[:, None]) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    if _is_traced(x):
+        raise NotImplementedError(
+            "unique has data-dependent output shape; not supported under "
+            "tracing")
+    res = np.unique(np.asarray(x._data), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def crop(x, shape=None, offsets=None, name=None) -> Tensor:
+    offs = offsets or [0] * x.ndim
+    shp = shape or x.shape
+    def impl(a):
+        idx = tuple(slice_builtin(int(o), int(o) + int(s))
+                    for o, s in zip(offs, shp))
+        return a[idx]
+    return apply("crop", impl, [x])
+
+
+def pad_nd(x, pad, mode="constant", value=0.0, name=None) -> Tensor:
+    """N-d pad with paddle's flat pad list convention (last dim first)."""
+    nd = x.ndim
+    pairs = [(0, 0)] * nd
+    half = len(pad) // 2
+    for i in range(half):
+        d = nd - 1 - i
+        pairs[d] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    def impl(a):
+        if mode == "constant":
+            return jnp.pad(a, pairs, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(a, pairs, mode=jmode)
+    return apply("pad", impl, [x])

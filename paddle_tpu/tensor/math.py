@@ -1,0 +1,331 @@
+"""Math ops (ref surface: python/paddle/tensor/math.py, ops.py).
+
+Every op dispatches through core.dispatch.apply — one registry-visible hop —
+and bottoms out in jnp/lax, which XLA fuses and tiles onto the MXU/VPU.
+Scalar operands are closed over (non-differentiable) rather than materialized.
+"""
+
+from __future__ import annotations
+
+import math as _pymath
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "matmul", "dot", "maximum", "minimum", "fmax", "fmin",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "abs", "sign", "neg", "reciprocal", "floor", "ceil", "round",
+    "trunc", "frac", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv",
+    "clip", "sum", "nansum", "mean", "nanmean", "prod", "max", "min",
+    "amax", "amin", "cumsum", "cumprod", "cummax", "cummin", "logsumexp",
+    "isnan", "isinf", "isfinite", "scale", "increment", "add_n", "lerp",
+    "kron", "outer", "inner", "trace", "diff", "heaviside", "rad2deg",
+    "deg2rad", "gcd", "lcm", "logit", "multiply_", "add_", "subtract_",
+    "clip_", "scale_", "stanh", "softplus_math", "nan_to_num",
+]
+
+
+def _wrap_scalar(x):
+    """Tensor passes through; python scalar / ndarray becomes a closure arg."""
+    return x if isinstance(x, Tensor) else None
+
+
+def _binary(opname, jfn):
+    def op(x, y, name=None):
+        xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xt and yt:
+            return apply(opname, jfn, [x, y])
+        if xt:
+            yv = jnp.asarray(y)
+            return apply(opname, lambda a: jfn(a, yv), [x])
+        if yt:
+            xv = jnp.asarray(x)
+            return apply(opname, lambda b: jfn(xv, b), [y])
+        return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
+    op.__name__ = opname
+    return op
+
+
+def _unary(opname, jfn):
+    def op(x, name=None):
+        return apply(opname, jfn, [x])
+    op.__name__ = opname
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+
+
+def pow(x, y, name=None):
+    return _binary("pow", jnp.power)(x, y)
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), [x])
+
+
+def softplus_math(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus",
+                 lambda a: jnp.where(beta * a > threshold, a,
+                                     jnp.log1p(jnp.exp(beta * a)) / beta), [x])
+
+
+def logit(x, eps=None, name=None):
+    def impl(a):
+        b = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(b / (1.0 - b))
+    return apply("logit", impl, [x])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num",
+                 lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), [x])
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply("matmul", impl, [x, y])
+
+
+def dot(x, y, name=None):
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), [x, y])
+
+
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a, b), [x, y])
+
+
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, [x, y])
+
+
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, [x, y])
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda a: jnp.trace(a, offset, axis1, axis2), [x])
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min._data if isinstance(min, Tensor) else min
+    mx = max._data if isinstance(max, Tensor) else max
+    return apply("clip", lambda a: jnp.clip(a, mn, mx), [x])
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = np.asarray(axis._data).tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(opname, jfn):
+    def op(x, axis=None, keepdim=False, name=None):
+        ax = _axis(axis)
+        return apply(opname, lambda a: jfn(a, axis=ax, keepdims=keepdim), [x])
+    op.__name__ = opname
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+nansum = _reduce("nansum", jnp.nansum)
+mean = _reduce("mean", jnp.mean)
+nanmean = _reduce("nanmean", jnp.nanmean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("logsumexp",
+                 lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                 [x])
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def impl(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        out = jnp.cumsum(a, axis=ax)
+        return out.astype(convert_dtype(dtype)) if dtype is not None else out
+    return apply("cumsum", impl, [x])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def impl(a):
+        out = jnp.cumprod(a, axis=dim)
+        return out.astype(convert_dtype(dtype)) if dtype is not None else out
+    return apply("cumprod", impl, [x])
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def impl(a):
+        ax = 0 if axis is None else axis
+        b = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, b, axis=ax)
+        return vals
+    vals = apply("cummax", impl, [x])
+    # indices: argmax of running max == current position where value increases
+    a = x._data.reshape(-1) if axis is None else x._data
+    ax = 0 if axis is None else axis
+    idx = jnp.where(a == vals._data, jnp.arange(a.shape[ax]).reshape(
+        [-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)]), 0)
+    idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+    return vals, Tensor(idx.astype(convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def impl(a):
+        ax = 0 if axis is None else axis
+        b = a.reshape(-1) if axis is None else a
+        return jax.lax.associative_scan(jnp.minimum, b, axis=ax)
+    vals = apply("cummin", impl, [x])
+    a = x._data.reshape(-1) if axis is None else x._data
+    ax = 0 if axis is None else axis
+    idx = jnp.where(a == vals._data, jnp.arange(a.shape[ax]).reshape(
+        [-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)]), 0)
+    idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+    return vals, Tensor(idx.astype(convert_dtype(dtype)))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    sv = scale._data if isinstance(scale, Tensor) else scale
+    def impl(a):
+        if bias_after_scale:
+            out = a * jnp.asarray(sv, a.dtype) + jnp.asarray(bias, a.dtype)
+        else:
+            out = (a + jnp.asarray(bias, a.dtype)) * jnp.asarray(sv, a.dtype)
+        return out
+    return apply("scale", impl, [x])
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda a: a + jnp.asarray(value, a.dtype),
+                [x._snapshot()])
+    return x._inplace_from(out)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def impl(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return apply("add_n", impl, list(inputs))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), [x, y, weight])
+    return apply("lerp", lambda a, b: a + weight * (b - a), [x, y])
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return apply("diff",
+                 lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                 [x])
+
+
+# -- inplace variants (autograd-participating) ------------------------------
+# The op is applied to a snapshot of the old value so the tape parent is the
+# pre-mutation tensor, not the mutated one (see Tensor._snapshot).
+def add_(x, y, name=None):
+    return x._inplace_from(add(x._snapshot(), y))
+
+
+def subtract_(x, y, name=None):
+    return x._inplace_from(subtract(x._snapshot(), y))
+
+
+def multiply_(x, y, name=None):
+    return x._inplace_from(multiply(x._snapshot(), y))
+
+
+def clip_(x, min=None, max=None, name=None):
+    return x._inplace_from(clip(x._snapshot(), min, max))
+
+
+def scale_(x, scale_v=1.0, bias=0.0, bias_after_scale=True, name=None):
+    return x._inplace_from(scale(x._snapshot(), scale_v, bias, bias_after_scale))

@@ -1,0 +1,128 @@
+"""Random sampling ops over the global stateful generator
+(ref surface: python/paddle/tensor/random.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import convert_dtype, get_default_dtype, long_dtype
+from ..core.tensor import Tensor
+from ..framework.random import next_key
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "gaussian", "bernoulli", "multinomial", "randperm",
+    "poisson", "exponential_", "uniform_", "normal_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype):
+    d = convert_dtype(dtype)
+    return d if d is not None else get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    k = jax.random.key(seed) if seed else next_key()
+    return Tensor(mean + std * jax.random.normal(k, _shape(shape), _dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype) if dtype is not None else x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), low, high,
+                                     dt if np.issubdtype(dt, np.integer) else long_dtype()
+                                     ).astype(dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    k = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(k, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = np.broadcast_shapes(np.shape(m), np.shape(s))
+        return Tensor(m + s * jax.random.normal(next_key(), shp,
+                                                get_default_dtype()))
+    return Tensor(mean + std * jax.random.normal(next_key(), _shape(shape),
+                                                 get_default_dtype()))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    return Tensor(jax.random.bernoulli(next_key(), x._data).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    def draw(p):
+        logits = jnp.log(jnp.clip(p, 1e-30, None))
+        if replacement:
+            return jax.random.categorical(next_key(), logits,
+                                          shape=(num_samples,) + logits.shape[:-1]
+                                          ).swapaxes(0, -1) if logits.ndim > 1 else \
+                jax.random.categorical(next_key(), logits, shape=(num_samples,))
+        g = jax.random.gumbel(next_key(), logits.shape) + logits
+        _, idx = jax.lax.top_k(g, num_samples)
+        return idx
+    return Tensor(draw(x._data).astype(long_dtype()))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(next_key(), n).astype(convert_dtype(dtype)))
+
+
+def poisson(x, name=None) -> Tensor:
+    return Tensor(jax.random.poisson(next_key(), x._data).astype(x.dtype))
+
+
+# inplace random fills (paddle Tensor methods)
+def uniform_(x, min=-1.0, max=1.0, name=None) -> Tensor:
+    x._data = jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
+                                 minval=min, maxval=max)
+    x._node = None
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x._data = (mean + std * jax.random.normal(next_key(), tuple(x.shape))
+               ).astype(x.dtype)
+    x._node = None
+    return x
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    x._data = (jax.random.exponential(next_key(), tuple(x.shape)) / lam
+               ).astype(x.dtype)
+    x._node = None
+    return x

@@ -1,0 +1,108 @@
+"""Search / sort ops (ref surface: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtypes import convert_dtype, long_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted",
+    "kthvalue", "mode", "index_sample",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    out = jnp.argmax(x._data if axis is not None else x._data.reshape(-1),
+                     axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return Tensor(out.astype(convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    out = jnp.argmin(x._data if axis is not None else x._data.reshape(-1),
+                     axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return Tensor(out.astype(convert_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    a = x._data
+    idx = jnp.argsort(-a if descending else a, axis=axis, stable=stable)
+    return Tensor(idx.astype(long_dtype()))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    def impl(a):
+        out = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply("sort", impl, [x])
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    def impl(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    vals, idx = apply("topk", impl, [x])
+    return vals, Tensor(idx._data.astype(long_dtype()))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None) -> Tensor:
+    side = "right" if right else "left"
+    def impl(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side)
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+            flat_seq, flat_v)
+        return out.reshape(v.shape)
+    out = impl(sorted_sequence._data, values._data)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def impl(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        vals = jnp.sort(moved, axis=-1)[..., k - 1]
+        idx = jnp.argsort(moved, axis=-1)[..., k - 1]
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, axis), jnp.expand_dims(idx, axis)
+        return vals, idx
+    vals, idx = apply("kthvalue", impl, [x])
+    return vals, Tensor(idx._data.astype(long_dtype()))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = x._data
+    moved = jnp.moveaxis(a, axis, -1)
+    n = moved.shape[-1]
+    s = jnp.sort(moved, axis=-1)
+    si = jnp.argsort(moved, axis=-1)
+    eq = (s[..., :, None] == s[..., None, :])
+    counts = eq.sum(-1)
+    best = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+    idxs = jnp.take_along_axis(si, best[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals, idxs = jnp.expand_dims(vals, axis), jnp.expand_dims(idxs, axis)
+    return Tensor(vals), Tensor(idxs.astype(long_dtype()))
+
+
+def index_sample(x, index, name=None) -> Tensor:
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply("index_sample",
+                 lambda a: jnp.take_along_axis(a, idx, axis=1), [x])

@@ -1,0 +1,98 @@
+"""Statistics ops (ref surface: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "numel", "histogram", "bincount"]
+
+from .math import mean  # re-export the math reduction
+
+
+def _axis(axis):
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None) -> Tensor:
+    return apply("std",
+                 lambda a: jnp.std(a, axis=_axis(axis),
+                                   ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), [x])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None) -> Tensor:
+    return apply("var",
+                 lambda a: jnp.var(a, axis=_axis(axis),
+                                   ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), [x])
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None) -> Tensor:
+    def impl(a):
+        if mode == "avg":
+            return jnp.median(a, axis=axis, keepdims=keepdim)
+        ax = axis if axis is not None else None
+        if ax is None:
+            flat = jnp.sort(a.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        s = jnp.sort(a, axis=ax)
+        k = (a.shape[ax] - 1) // 2
+        out = jnp.take(s, k, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    return apply("median", impl, [x])
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None) -> Tensor:
+    return apply("nanmedian",
+                 lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim),
+                 [x])
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None) -> Tensor:
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    def impl(a):
+        return jnp.quantile(a.astype(jnp.float32), qv, axis=_axis(axis),
+                            keepdims=keepdim, method=interpolation)
+    return apply("quantile", impl, [x])
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None) -> Tensor:
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    def impl(a):
+        return jnp.nanquantile(a.astype(jnp.float32), qv, axis=_axis(axis),
+                               keepdims=keepdim, method=interpolation)
+    return apply("nanquantile", impl, [x])
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None) -> Tensor:
+    a = input._data
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(a), jnp.max(a)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(a.reshape(-1), bins=bins, range=None if min == 0 and max == 0 else (min, max))
+    return Tensor(hist)
+
+
+def bincount(x, weights=None, minlength=0, name=None) -> Tensor:
+    w = weights._data if isinstance(weights, Tensor) else weights
+    import jax
+    if isinstance(x._data, jax.core.Tracer):
+        raise NotImplementedError("bincount is dynamic-shape under tracing; "
+                                  "pass minlength and use one-hot sums instead")
+    n = int(np.asarray(x._data).max()) + 1 if x.size else 0
+    length = max(n, minlength)
+    out = jnp.bincount(x._data.reshape(-1), weights=None if w is None else w.reshape(-1),
+                       length=length)
+    return Tensor(out)
+
+

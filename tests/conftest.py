@@ -1,0 +1,25 @@
+"""Test configuration: run the suite on a simulated 8-device CPU mesh.
+
+SURVEY §4.2 build lesson: the reference tests distributed logic single-host
+(Gloo fake, subprocess ranks); the TPU-native equivalent is
+xla_force_host_platform_device_count so sharding/collective tests execute a
+real 8-way SPMD program without hardware. Must run before jax import.
+"""
+
+import os
+
+# force CPU even though the session profile exports JAX_PLATFORMS=axon (the
+# real chip): the 8-device simulated mesh only exists on the cpu platform
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# keep CI deterministic and quiet
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# numerics tests compare against f32 references; the TPU-idiomatic low default
+# (bf16 MXU passes) is exercised explicitly by the kernel/perf tests instead
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,147 @@
+"""Core Tensor semantics: creation, math, manipulation, async host transfer."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert str(x.dtype) == "float32"
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_dtype():
+    paddle.set_default_dtype("float32")
+    assert paddle.to_tensor(1.5).dtype == np.float32
+    assert paddle.to_tensor(np.array([1.0, 2.0])).dtype == np.float32  # f64 demote
+    assert paddle.to_tensor([1, 2]).dtype in (np.int32, np.int64)
+
+
+def test_arith_dunder_and_broadcast():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    y = paddle.to_tensor([10.0, 20.0])
+    z = x * 2 + y / 2 - 1
+    np.testing.assert_allclose(z.numpy(), np.array([[1, 2], [3, 4]]) * 2
+                               + np.array([10, 20]) / 2 - 1)
+    np.testing.assert_allclose((x @ x.T).numpy(),
+                               np.array([[1., 2], [3, 4]]) @ np.array([[1., 3], [2, 4]]))
+
+
+def test_reductions_and_axis():
+    x = paddle.arange(24, dtype="float32").reshape([2, 3, 4])
+    np.testing.assert_allclose(x.sum(axis=[1, 2]).numpy(),
+                               np.arange(24, dtype=np.float32).reshape(2, 3, 4).sum((1, 2)))
+    assert x.mean().item() == pytest.approx(11.5)
+    assert x.max(axis=0, keepdim=True).shape == [1, 3, 4]
+
+
+def test_manipulation():
+    x = paddle.arange(12).reshape([3, 4])
+    assert paddle.transpose(x, [1, 0]).shape == [4, 3]
+    parts = paddle.split(x, 2, axis=1)
+    assert [p.shape for p in parts] == [[3, 2], [3, 2]]
+    parts = paddle.split(x, [1, -1], axis=0)
+    assert [p.shape for p in parts] == [[1, 4], [2, 4]]
+    s = paddle.stack([x, x], axis=0)
+    assert s.shape == [2, 3, 4]
+    c = paddle.concat([x, x], axis=1)
+    assert c.shape == [3, 8]
+    assert paddle.flatten(x).shape == [12]
+    assert x.unsqueeze([0, 2]).shape == [1, 3, 1, 4]
+
+
+def test_indexing_and_setitem():
+    x = paddle.arange(12, dtype="float32").reshape([3, 4])
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1:3].numpy(),
+                               np.arange(12.).reshape(3, 4)[:, 1:3])
+    x[0, 0] = 100.0
+    assert x.numpy()[0, 0] == 100.0
+    idx = paddle.to_tensor([0, 2])
+    g = paddle.gather(x, idx, axis=0)
+    assert g.shape == [2, 4]
+
+
+def test_gather_scatter_take_along():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    idx = paddle.to_tensor([[0], [1], [0]])
+    t = paddle.take_along_axis(x, idx, axis=1)
+    np.testing.assert_allclose(t.numpy(), [[1], [4], [5]])
+    s = paddle.scatter(paddle.zeros([3, 2]), paddle.to_tensor([0, 2]),
+                       paddle.ones([2, 2]))
+    np.testing.assert_allclose(s.numpy(), [[1, 1], [0, 0], [1, 1]])
+
+
+def test_where_and_compare():
+    x = paddle.to_tensor([1.0, -2.0, 3.0])
+    m = x > 0
+    assert m.dtype == np.bool_
+    w = paddle.where(m, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [1, 0, 3])
+
+
+def test_dynamic_ops_eager_only():
+    x = paddle.to_tensor([1.0, 0.0, 2.0])
+    nz = paddle.nonzero(x)
+    assert nz.shape == [2, 1]
+    ms = paddle.masked_select(x, x > 0)
+    np.testing.assert_allclose(ms.numpy(), [1, 2])
+
+
+def test_sort_topk():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [[3, 2], [9, 8]])
+    np.testing.assert_allclose(i.numpy(), [[0, 2], [0, 2]])
+    s = paddle.sort(x, descending=True)
+    np.testing.assert_allclose(s.numpy(), [[3, 2, 1], [9, 8, 7]])
+
+
+def test_einsum_linalg():
+    a = paddle.rand([4, 5])
+    b = paddle.rand([5, 6])
+    np.testing.assert_allclose(paddle.einsum("ij,jk->ik", a, b).numpy(),
+                               a.numpy() @ b.numpy(), rtol=1e-5)
+    m = paddle.to_tensor([[4.0, 1.0], [1.0, 3.0]])
+    l = paddle.linalg.cholesky(m)
+    np.testing.assert_allclose((l @ l.T).numpy(), m.numpy(), rtol=1e-5)
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = x.astype(paddle.bfloat16)
+    assert str(z.dtype) == "bfloat16"
+
+
+def test_random_determinism():
+    paddle.seed(42)
+    a = paddle.rand([4])
+    paddle.seed(42)
+    b = paddle.rand([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.rand([4])
+    assert not np.allclose(b.numpy(), c.numpy())
+
+
+def test_save_load(tmp_path):
+    sd = {"w": paddle.rand([3, 3]), "step": 7,
+          "nested": [paddle.ones([2]), "tag"]}
+    p = str(tmp_path / "model.pdparams")
+    paddle.save(sd, p)
+    back = paddle.load(p)
+    np.testing.assert_allclose(back["w"].numpy(), sd["w"].numpy())
+    assert back["step"] == 7
+    np.testing.assert_allclose(back["nested"][0].numpy(), [1, 1])
+
+
+def test_bf16_save_load(tmp_path):
+    x = paddle.ones([4], dtype="bfloat16")
+    p = str(tmp_path / "bf16.pdparams")
+    paddle.save({"x": x}, p)
+    back = paddle.load(p)
+    assert str(back["x"].dtype) == "bfloat16"
