@@ -1,0 +1,219 @@
+"""AMP (ref: python/paddle/amp/ — auto_cast O1/O2 list-based casting,
+GradScaler dynamic loss scaling, decorate).
+
+TPU-native notes: bf16 is the native mixed-precision dtype (no scaler needed —
+bf16 has f32's exponent range); fp16 + GradScaler is kept for API parity. The
+cast hook plugs into core.dispatch so every op application sees it, mirroring
+the reference's AmpOperators black/white lists in the generated ad_funcs
+(paddle/fluid/imperative/amp_auto_cast.cc).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "WHITE_LIST", "BLACK_LIST"]
+
+# ops that benefit from low precision (MXU ops)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "einsum", "mm",
+    "bmm", "sdpa", "flash_attention", "addmm",
+}
+# numerically sensitive ops stay f32
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "bce", "bce_logits", "nll_loss",
+    "kl_div", "ctc_loss", "cumsum", "norm", "layer_norm", "batch_norm",
+    "rms_norm", "group_norm", "mean", "sum", "softmax_with_cross_entropy",
+    "erfinv", "pow", "square",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white: set = set()
+        self.custom_black: set = set()
+
+
+_state = _AmpState()
+
+
+def _is_float(a) -> bool:
+    return np.issubdtype(a.dtype, np.floating) or a.dtype == jnp.bfloat16
+
+
+def _cast_hook(op_name: str, arrays: Sequence):
+    if not _state.enabled:
+        return arrays
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    black = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    if _state.level == "O2":
+        if op_name in black:
+            return [a.astype(jnp.float32) if _is_float(a) else a
+                    for a in arrays]
+        return [a.astype(_state.dtype) if _is_float(a) else a for a in arrays]
+    # O1
+    if op_name in white:
+        return [a.astype(_state.dtype) if _is_float(a) else a for a in arrays]
+    if op_name in black:
+        return [a.astype(jnp.float32) if _is_float(a) else a for a in arrays]
+    # promote to the widest float dtype present (paddle: keep-dtype ops)
+    floats = [a.dtype for a in arrays if _is_float(a)]
+    if floats and any(d == jnp.float32 for d in floats):
+        return [a.astype(jnp.float32) if _is_float(a) else a for a in arrays]
+    return arrays
+
+
+class auto_cast:
+    """with paddle.amp.auto_cast(level='O1', dtype='bfloat16'): ..."""
+
+    def __init__(self, enable: bool = True, custom_white_list=None,
+                 custom_black_list=None, level: str = "O1",
+                 dtype: str = "bfloat16", use_promote: bool = True):
+        self.enable = enable
+        self.level = level
+        self.dtype = convert_dtype(dtype)
+        self.white = set(custom_white_list or ())
+        self.black = set(custom_black_list or ())
+
+    def __enter__(self):
+        self._saved = (_state.enabled, _state.dtype, _state.level,
+                       _state.custom_white, _state.custom_black)
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.custom_white = self.white
+        _state.custom_black = self.black
+        dispatch.set_amp_cast_hook(_cast_hook if self.enable else None)
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = self._saved
+        dispatch.set_amp_cast_hook(_cast_hook if _state.enabled else None)
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """Cast model params to the AMP dtype (O2), enabling optimizer master
+    weights (ref: paddle.amp.decorate)."""
+    dt = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dt)
+    if optimizers is not None:
+        opt_single = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if opt_single else list(optimizers)
+        for o in opt_list:
+            if master_weight is not False:
+                o._multi_precision = True
+        if optimizers is not None and not opt_single:
+            return model_list, opt_list
+        if optimizers is not None:
+            return (model_list[0] if single else model_list), opt_list[0]
+    return model_list[0] if single else model_list
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py).
+
+    On bf16 this is a near-no-op passthrough (use_dynamic_loss_scaling=False);
+    kept for fp16 parity: scale → backward → unscale+check-finite → step/skip.
+    """
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 65536.0,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000,
+                 decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer) -> None:
+        if not self._enable:
+            return
+        found = False
+        inv = 1.0 / self._scale
+        for p in optimizer._param_groups:
+            if p.grad is None:
+                continue
+            g = p.grad._data.astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+            p.grad._data = g.astype(p.grad._data.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer) -> None:
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss) -> None:
+        self.step(optimizer)
+
+    def update(self) -> None:
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def state_dict(self) -> dict:
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

@@ -122,7 +122,10 @@ def apply(name: str, fn: Callable, inputs: Sequence[Any], **kwargs):
             tlist.append(None)
 
     if _amp_cast_hook is not None:
-        arrs = _amp_cast_hook(name, arrs)
+        # the cast must live INSIDE the differentiated function so jax.vjp
+        # transposes it (cotangents come back in each input's original dtype)
+        inner_fn, hook = fn, _amp_cast_hook
+        fn = lambda *xs: inner_fn(*hook(name, xs))  # noqa: E731
 
     needs_grad = autograd.is_grad_enabled() and any(
         t is not None and not t.stop_gradient and _differentiable(a)

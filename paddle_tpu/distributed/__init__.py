@@ -1,0 +1,42 @@
+"""paddle_tpu.distributed — the distributed stack (SURVEY §2.3).
+
+Core design: ONE device mesh (jax.sharding.Mesh) carries every parallelism
+axis (pp/dp/sharding/sep/mp); GSPMD inserts the collectives the reference
+issues through NCCL process groups. P1-P5/P10/P11/P13 here; P6 (pipeline),
+P7 (MoE), P9 (ring attention) in their own modules.
+"""
+
+from . import env  # noqa: F401
+from .env import get_rank, get_world_size  # noqa: F401
+
+from .mesh import (AXIS_ORDER, HybridTopology, ProcessMesh,  # noqa: F401
+                   build_hybrid_mesh, get_mesh, mesh_context, set_mesh)
+from .auto_parallel import (Partial, Replicate, Shard, dtensor_from_fn,  # noqa: F401
+                            get_placements, mark_sharding, reshard,
+                            shard_layer, shard_tensor)
+from .collective import (ReduceOp, all_gather, all_reduce, alltoall,  # noqa: F401
+                         barrier, broadcast, get_group, new_group, reduce,
+                         reduce_scatter, stream, wait)
+from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                              RowParallelLinear, VocabParallelEmbedding,
+                              annotate_sequence_parallel)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import fleet  # noqa: F401
+
+
+def init_parallel_env():
+    """ref: paddle.distributed.init_parallel_env — multi-host bring-up.
+    Single-host (this dev environment): no-op beyond returning the env; on
+    pods, jax.distributed.initialize is driven by the launcher (SURVEY §3.1
+    TCPStore rendezvous ⇒ coordination service)."""
+    import jax
+    import os
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    if coord and jax.process_count() == 1 and os.environ.get(
+            "PADDLE_TRAINERS_NUM", "1") != "1":
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    return None

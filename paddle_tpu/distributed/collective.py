@@ -1,0 +1,255 @@
+"""Eager collective communication API (ref: python/paddle/distributed/
+communication/ — all_reduce/all_gather/… over ProcessGroupNCCL; SURVEY §2.3
+P13 and §5.8 altitude (1)).
+
+TPU-native mechanism: each collective is a small jitted shard_map program
+over the current mesh axis — the XLA collective (psum/all_gather/ppermute)
+runs on ICI exactly where NCCL rings ran. On a 1-device (or axis-less) mesh
+they degrade to identity, which is how the reference's tests run single-rank.
+
+In-place semantics preserved: `all_reduce(t)` rewrites t's buffer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..core.tensor import Tensor
+from .mesh import get_mesh
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce_scatter",
+           "broadcast", "scatter", "reduce", "alltoall", "send", "recv",
+           "barrier", "new_group", "get_group", "wait", "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A mesh axis standing in for a comm group (ref: ProcessGroup gid)."""
+
+    def __init__(self, axis: str, mesh: Optional[Mesh] = None):
+        self.axis = axis
+        self.mesh = mesh
+
+    @property
+    def nranks(self) -> int:
+        m = self.mesh or get_mesh()
+        return m.shape.get(self.axis, 1) if m is not None else 1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+_groups = {}
+
+
+def new_group(ranks=None, backend=None, axis: str = "dp") -> Group:
+    g = Group(axis)
+    _groups[axis] = g
+    return g
+
+
+def get_group(axis: str = "dp") -> Group:
+    return _groups.get(axis) or new_group(axis=axis)
+
+
+def _axis_of(group) -> str:
+    if group is None:
+        return "dp"
+    if isinstance(group, Group):
+        return group.axis
+    if isinstance(group, str):
+        return group
+    raise TypeError(f"bad group: {group}")
+
+
+def _active_mesh(axis: str) -> Optional[Mesh]:
+    m = get_mesh()
+    if m is None or axis not in m.axis_names or m.shape[axis] == 1:
+        return None
+    return m
+
+
+def _collective(mesh: Mesh, axis: str, fn, x):
+    """Run fn inside shard_map over `axis`, fully replicated on other axes."""
+    spec = P(axis)
+    # operate on a leading stacked axis: we gather per-device values by
+    # treating the tensor as replicated except along the comm axis.
+    out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * x.ndim)),),
+                    out_specs=P(*([None] * x.ndim)), check_rep=False)(x)
+    return out
+
+
+def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
+               sync_op: bool = True) -> Tensor:
+    axis = _axis_of(group)
+    mesh = _active_mesh(axis)
+    if mesh is None:
+        return tensor
+    red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+           "avg": lambda v, a: jax.lax.pmean(v, a)}[op if isinstance(op, str) else ReduceOp.SUM]
+
+    def fn(x):
+        return red(x, axis)
+
+    nd = tensor.ndim
+    out = shard_map(fn, mesh=mesh,
+                    in_specs=(P(*([None] * nd)),),
+                    out_specs=P(*([None] * nd)), check_rep=False)(tensor._data)
+    tensor._data = out
+    return tensor
+
+
+def all_gather(tensor_list: Optional[List], tensor: Tensor = None, group=None,
+               sync_op: bool = True):
+    """paddle signature: all_gather(out_list, in_tensor). With a 1-axis mesh
+    this returns each rank's replica-view concatenated along dim 0."""
+    if tensor is None:  # also allow functional style: all_gather(t)
+        tensor, tensor_list = tensor_list, None
+    axis = _axis_of(group)
+    mesh = _active_mesh(axis)
+    if mesh is None:
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return tensor_list
+        return Tensor(tensor._data[None])
+    n = mesh.shape[axis]
+
+    def fn(x):
+        return jax.lax.all_gather(x, axis)
+
+    nd = tensor.ndim
+    out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * nd)),),
+                    out_specs=P(*([None] * (nd + 1))), check_rep=False)(
+        tensor._data)
+    if tensor_list is not None:
+        for i in range(n):
+            tensor_list.append(Tensor(out[i]))
+        return tensor_list
+    return Tensor(out)
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True) -> Tensor:
+    axis = _axis_of(group)
+    mesh = _active_mesh(axis)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        src = Tensor(jnp.concatenate([t._data for t in src], axis=0))
+    if mesh is None:
+        tensor._data = src._data
+        return tensor
+    n = mesh.shape[axis]
+
+    def fn(x):
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+    nd = src.ndim
+    out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * nd)),),
+                    out_specs=P(axis, *([None] * (nd - 1))),
+                    check_rep=False)(src._data)
+    # out is sharded along dim0; each rank's shard is this rank's result —
+    # materialize the local view replicated for eager parity
+    tensor._data = out
+    return tensor
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True) -> Tensor:
+    """Within a mesh axis all replicas already hold identical values under
+    SPMD; broadcast selects the src rank's value for all."""
+    axis = _axis_of(group)
+    mesh = _active_mesh(axis)
+    if mesh is None:
+        return tensor
+
+    def fn(x):
+        idx = jax.lax.axis_index(axis)
+        val = jax.lax.all_gather(x, axis)[src]
+        return val
+
+    nd = tensor.ndim
+    out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * nd)),),
+                    out_specs=P(*([None] * nd)), check_rep=False)(tensor._data)
+    tensor._data = out
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
+           sync_op=True) -> Tensor:
+    # SPMD: reduce == all_reduce with the result meaningful on dst
+    return all_reduce(tensor, op if isinstance(op, str) else ReduceOp.SUM,
+                      group, sync_op)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    axis = _axis_of(group)
+    mesh = _active_mesh(axis)
+    if isinstance(in_tensor_list, Tensor):
+        stacked = in_tensor_list._data
+    else:
+        stacked = jnp.stack([t._data for t in in_tensor_list], axis=0)
+    if mesh is None:
+        outs = [Tensor(s) for s in stacked]
+        if out_tensor_list is not None:
+            out_tensor_list.extend(outs)
+            return out_tensor_list
+        return Tensor(stacked)
+
+    def fn(x):
+        return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    nd = stacked.ndim
+    out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * nd)),),
+                    out_specs=P(*([None] * nd)), check_rep=False)(stacked)
+    outs = [Tensor(o) for o in out]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(outs)
+        return out_tensor_list
+    return Tensor(out)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager point-to-point send/recv maps to compiled collective_permute "
+        "on TPU — use distributed.pipeline (SURVEY §5.8: NCCL p2p has no "
+        "eager ICI analog; pipeline schedules compile their permutes)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager point-to-point send/recv maps to compiled collective_permute "
+        "on TPU — use distributed.pipeline")
+
+
+def barrier(group=None):
+    """Fence all outstanding device work (SPMD: program order is the sync)."""
+    for a in jax.live_arrays():
+        a.block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and isinstance(tensor._data, jax.Array):
+        tensor._data.block_until_ready()
+
+
+class stream:
+    """paddle.distributed.stream.* parity: explicit-stream variants are
+    no-ops on TPU (PJRT owns ordering); same functions re-exported."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    alltoall = staticmethod(alltoall)

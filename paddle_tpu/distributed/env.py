@@ -1,0 +1,34 @@
+"""Process-level distributed environment (ref: PADDLE_TRAINER_* env contract
+set by the launcher — python/paddle/distributed/parallel.py env parsing)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_rank", "get_world_size", "is_initialized"]
+
+
+def get_rank() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def is_initialized() -> bool:
+    return True

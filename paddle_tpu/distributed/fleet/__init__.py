@@ -1,0 +1,118 @@
+"""fleet — hybrid-parallel sugar (ref: python/paddle/distributed/fleet/ —
+fleet.init / distributed_model / distributed_optimizer, DistributedStrategy
+hybrid_configs; SURVEY §2.3 P10).
+
+TPU-native: fleet.init builds THE hybrid mesh and installs it as the current
+mesh; distributed_model materializes parameters onto it per their sharding
+specs (TP layers carry theirs; everything else replicates, with optional
+ZeRO-style sharding of the fsdp axis); distributed_optimizer wires
+cross-axis grad clip (trivial under GSPMD: the global norm is already
+global). The user-facing vocabulary (dp_degree/mp_degree/pp_degree/
+sharding_degree/sep_degree) is preserved verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ..mesh import HybridTopology, build_hybrid_mesh, get_mesh, set_mesh
+
+__all__ = ["DistributedStrategy", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_index", "worker_num"]
+
+
+class DistributedStrategy:
+    """ref: fleet/base/distributed_strategy.py (protobuf-backed, ~80 knobs).
+    Dataclass-style with the hybrid_configs vocabulary preserved."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+
+
+_fleet_state = {"topology": None, "strategy": None}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level=None):
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    mesh = build_hybrid_mesh(
+        dp_degree=hc.get("dp_degree", 1), mp_degree=hc.get("mp_degree", 1),
+        pp_degree=hc.get("pp_degree", 1),
+        sharding_degree=hc.get("sharding_degree", 1),
+        sep_degree=hc.get("sep_degree", 1))
+    set_mesh(mesh)
+    _fleet_state["topology"] = HybridTopology(mesh)
+    _fleet_state["strategy"] = strategy
+    return mesh
+
+
+def get_hybrid_communicate_group() -> HybridTopology:
+    return _fleet_state["topology"]
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def distributed_model(model, shard_params_on: Optional[str] = None):
+    """Materialize every parameter/buffer on the hybrid mesh.
+
+    - parameters carrying `_sharding_spec` (TP layers) use it;
+    - `shard_params_on="sharding"` additionally ZeRO-3-shards otherwise-
+      replicated parameters' dim 0 on the sharding axis (P3 parity — on TPU
+      this IS group_sharded_parallel level p_g_os: a spec choice);
+    - everything else replicates.
+    """
+    mesh = get_mesh()
+    if mesh is None:
+        raise RuntimeError("call fleet.init(strategy) first")
+    for name, sub in model.named_sublayers(include_self=True):
+        for pname, p in list(sub.__dict__["_parameters"].items()):
+            if p is None:
+                continue
+            spec = getattr(p, "_sharding_spec", None)
+            if spec is None:
+                if (shard_params_on and mesh.shape.get(shard_params_on, 1) > 1
+                        and p.ndim > 0
+                        and p._data.shape[0] % mesh.shape[shard_params_on] == 0):
+                    spec = P(shard_params_on)
+                else:
+                    spec = P()
+            p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+        for bname, b in sub.__dict__["_buffers"].items():
+            if b is not None:
+                b._data = jax.device_put(b._data, NamedSharding(mesh, P()))
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """ref: HybridParallelOptimizer — on TPU the global-norm clip is already
+    global under GSPMD (grads live on the mesh), so the optimizer passes
+    through; optimizer state inherits each param's sharding lazily on first
+    step (accumulators are created from the param's sharded buffer)."""
+    return optimizer

@@ -1,0 +1,134 @@
+"""Device mesh & hybrid topology (ref: python/paddle/distributed/fleet/base/
+topology.py `HybridCommunicateGroup` + auto_parallel ProcessMesh).
+
+TPU-native design (SURVEY §7.0): ONE `jax.sharding.Mesh` carries every
+parallelism axis. The reference builds a cartesian rank topology and one NCCL
+comm group per axis; here the mesh axes ARE the groups — GSPMD emits the
+collectives. Axis order puts `mp` (tensor parallel) innermost so its
+collectives ride the fastest ICI links, then sep/sharding/dp, with pp
+outermost (pipeline traffic is the thinnest).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ProcessMesh", "HybridTopology", "get_mesh", "set_mesh",
+           "mesh_context", "build_hybrid_mesh", "AXIS_ORDER"]
+
+# outermost → innermost (DCN-most → ICI-most)
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+
+_current_mesh: Optional[Mesh] = None
+
+
+def set_mesh(mesh) -> None:
+    global _current_mesh
+    _current_mesh = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+class mesh_context:
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        self._prev = get_mesh()
+        set_mesh(self._mesh)
+        return self._mesh
+
+    def __exit__(self, *exc):
+        set_mesh(self._prev) if self._prev is not None else None
+        global _current_mesh
+        _current_mesh = self._prev
+        return False
+
+
+class ProcessMesh:
+    """ref: paddle.distributed.ProcessMesh(mesh=[[0,1],[2,3]],
+    dim_names=["x","y"]). Wraps jax.sharding.Mesh; process ids index
+    jax.devices()."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        devices = np.asarray(jax.devices(), dtype=object)
+        dev_arr = np.empty(arr.shape, dtype=object)
+        flat_ids = arr.reshape(-1)
+        id_to_dev = {d.id: d for d in jax.devices()}
+        dev_arr.reshape(-1)[:] = [id_to_dev[int(i)] for i in flat_ids]
+        self.jax_mesh = Mesh(dev_arr, tuple(dim_names))
+        self._ids = arr
+        self.dim_names = list(dim_names)
+        self.shape = list(arr.shape)
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    def get_dim_size(self, name: str) -> int:
+        return self.shape[self.dim_names.index(name)]
+
+    def __enter__(self):
+        self._ctx = mesh_context(self.jax_mesh)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def build_hybrid_mesh(dp_degree=1, mp_degree=1, pp_degree=1,
+                      sharding_degree=1, sep_degree=1,
+                      devices=None) -> Mesh:
+    """Build the 5-axis hybrid mesh (ref: HybridCommunicateGroup's cartesian
+    topology, order [M] knob). Degrees of 1 keep the axis present (size 1) so
+    sharding specs are stable across configurations."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = collections.OrderedDict(
+        pp=pp_degree, dp=dp_degree, sharding=sharding_degree, sep=sep_degree,
+        mp=mp_degree)
+    total = int(np.prod(list(sizes.values())))
+    if total != len(devices):
+        raise ValueError(
+            f"product of degrees {dict(sizes)} = {total} != device count "
+            f"{len(devices)}")
+    dev_arr = np.asarray(devices, dtype=object).reshape(
+        tuple(sizes.values()))
+    return Mesh(dev_arr, tuple(sizes.keys()))
+
+
+class HybridTopology:
+    """ref: fleet/base/topology.py HybridCommunicateGroup — rank/axis
+    bookkeeping over the hybrid mesh (degenerates cleanly on 1 host)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.mesh.shape.get("mp", 1)
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.mesh.shape.get("dp", 1)
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.mesh.shape.get("pp", 1)
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self.mesh.shape.get("sharding", 1)
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape.get(name, 1)

@@ -1,0 +1,159 @@
+"""Tensor/sequence-parallel layers (ref: python/paddle/distributed/fleet/
+meta_parallel/parallel_layers/mp_layers.py — SURVEY §2.3 P4/P5).
+
+TPU-native mechanism: the layers ARE plain Linear/Embedding math; parallelism
+comes from (a) a sharding spec attached to each weight (materialized by
+fleet.distributed_model / shard_layer), and (b) sharding constraints on
+activations. GSPMD then inserts exactly the collectives the reference codes
+by hand (column: no comm fwd, allreduce bwd; row: allreduce fwd; vocab
+embedding: masked lookup + allreduce; vocab-parallel CE: sharded logsumexp).
+Layers degrade gracefully to single-device when no mesh is active.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from .mesh import get_mesh
+from .auto_parallel import mark_sharding
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "annotate_sequence_parallel", "MP_AXIS"]
+
+MP_AXIS = "mp"
+
+
+def _mesh_has(axis: str) -> bool:
+    m = get_mesh()
+    return m is not None and axis in m.axis_names and m.shape[axis] > 1
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Weight [in, out] sharded along out (columns) on the mp axis.
+    gather_output=True adds a constraint forcing replicated output (GSPMD
+    all-gathers); False leaves the activation sharded on its last dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight._sharding_spec = P(None, MP_AXIS)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias._sharding_spec = P(MP_AXIS)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if _mesh_has(MP_AXIS):
+            if self.gather_output:
+                out = mark_sharding(out, *([None] * out.ndim))
+            else:
+                out = mark_sharding(out, *([None] * (out.ndim - 1) + [MP_AXIS]))
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    """Weight [in, out] sharded along in (rows); input expected sharded on
+    its last dim (input_is_parallel) — GSPMD inserts the fwd allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight._sharding_spec = P(MP_AXIS, None)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias._sharding_spec = P()  # replicated (added post-reduce)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if _mesh_has(MP_AXIS) and not self.input_is_parallel:
+            x = mark_sharding(x, *([None] * (x.ndim - 1) + [MP_AXIS]))
+        out = F.linear(x, self.weight, self.bias)
+        if _mesh_has(MP_AXIS):
+            out = mark_sharding(out, *([None] * out.ndim))
+        return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding table sharded along vocab (dim 0) on mp (ref: range mask +
+    allreduce in mp_layers.py; GSPMD derives the same from a gather on a
+    sharded-operand)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.weight._sharding_spec = P(MP_AXIS, None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        if _mesh_has(MP_AXIS):
+            out = mark_sharding(out, *([None] * out.ndim))
+        return out
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Vocab-sharded softmax cross-entropy (ref:
+    c_softmax_with_cross_entropy_op.cu — the TP-CE that never materializes
+    replicated logits). Keeping the logits' vocab dim sharded through
+    logsumexp lets GSPMD reduce over the mp axis in f32."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        from ..core.dispatch import apply
+        lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+        mp_on = _mesh_has(MP_AXIS)
+        mesh = get_mesh()
+
+        def impl(lg):
+            lg32 = lg.astype(jnp.float32)
+            if mp_on:
+                lg32 = jax.lax.with_sharding_constraint(
+                    lg32, NamedSharding(mesh, P(*([None] * (lg.ndim - 1)
+                                                  + [MP_AXIS]))))
+            lse = jax.scipy.special.logsumexp(lg32, axis=-1)
+            lab2 = lab[..., 0] if lab.ndim == lg.ndim else lab
+            picked = jnp.take_along_axis(
+                lg32, lab2[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            loss = lse - picked
+            mask = lab2 != self.ignore_index
+            return jnp.where(mask, loss, jnp.zeros((), loss.dtype))[..., None]
+        return apply("parallel_cross_entropy", impl, [logits])
+
+
+def annotate_sequence_parallel(x: Tensor, axis: str = MP_AXIS) -> Tensor:
+    """Megatron-SP parity (ref: sequence_parallel_utils.py ScatterOp/
+    GatherOp): shard the sequence dim (dim 1 of [B,S,H]) on the mp axis
+    between blocks. One annotation replaces the allreduce→rs/ag rewrite."""
+    if not _mesh_has(axis):
+        return x
+    spec = [None] * x.ndim
+    spec[1] = axis
+    return mark_sharding(x, *spec)

@@ -1,0 +1,136 @@
+"""Pipeline parallelism, compiled (ref: python/paddle/distributed/fleet/
+meta_parallel/pipeline_parallel.py + pp_utils/p2p_communication.py +
+fleet_executor actors — SURVEY §2.3 P6, §7.2.1).
+
+TPU-native rework: NO actor runtime, NO NCCL send/recv. The microbatch
+schedule is COMPILED into one XLA program: a `shard_map` over the `pp` mesh
+axis runs every stage in SPMD; activations rotate stage→stage+1 with
+`lax.ppermute` once per tick; `lax.scan` drives the M+S-1 ticks. Autodiff
+through the scan+ppermute yields the reverse schedule (backward pipeline)
+automatically — the transpose of a ppermute is the reversed ppermute, so
+gradient traffic flows stage s → s-1 exactly like the reference's backward
+p2p. Remat (`jax.checkpoint`) on the stage body keeps the activation
+footprint at GPipe levels; interleaved/1F1B-style memory scheduling is XLA's
+latency-hiding scheduler's job once the program is expressed this way.
+
+Layout contract: the decoder stack must be homogeneous; per-layer params are
+stacked to a leading [num_layers, ...] dim, reshaped [S, L/S, ...], sharded
+on `pp` dim 0. Embedding/head stay outside the pipelined region (they belong
+to first/last stage conceptually; XLA places their compute with dp/mp
+sharding, and the boundary transfers are two ppermutes' worth of traffic).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["spmd_pipeline", "stack_layer_params", "PP_AXIS"]
+
+PP_AXIS = "pp"
+
+
+def _pp_shard_map(f, mesh, in_specs, out_specs):
+    """shard_map manual ONLY over the pp axis; dp/mp/sharding/sep stay
+    'auto' so GSPMD keeps tensor/data parallelism inside each stage body."""
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs,
+                         axis_names=frozenset({PP_AXIS}), check_vma=False)
+
+
+def stack_layer_params(per_layer_states: List[Dict[str, Any]], n_stages: int):
+    """[{name: array} × L] → {name: [S, L/S, ...] array} (stage-stacked)."""
+    L = len(per_layer_states)
+    if L % n_stages != 0:
+        raise ValueError(f"{L} layers not divisible into {n_stages} stages")
+    per_stage = L // n_stages
+    out = {}
+    for k in per_layer_states[0]:
+        stacked = jnp.stack([s[k] for s in per_layer_states], axis=0)
+        out[k] = stacked.reshape((n_stages, per_stage) + stacked.shape[1:])
+    return out
+
+
+def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
+                  microbatches, mesh: Mesh, n_microbatches: int,
+                  extra_args=(), remat: bool = True):
+    """Run the pipelined stack.
+
+    stage_fn(layer_params_slice, x, *extra_args) -> x
+      applies ONE stage's [L/S, ...] params to activation x (typically an
+      inner lax.scan over the L/S layers).
+    stacked_params: {name: [S, L/S, ...]} — dim 0 sharded on pp.
+    microbatches: [M, mb_batch, ...] activations entering stage 0
+      (already embedded); returns [M, mb_batch, ...] outputs of last stage.
+    """
+    S = mesh.shape[PP_AXIS]
+    M = n_microbatches
+    if S == 1:
+        return _no_pp_fallback(stage_fn, stacked_params, microbatches,
+                               extra_args)
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    param_specs = {k: P(PP_AXIS, *([None] * (v.ndim - 1)))
+                   for k, v in stacked_params.items()}
+    mb_spec = P(*([None] * microbatches.ndim))
+
+    def per_device(params, mbs, *extra):
+        # params: {name: [1, L/S, ...]} local stage slice
+        params = {k: v[0] for k, v in params.items()}
+        stage = jax.lax.axis_index(PP_AXIS)
+        mb_shape = mbs.shape[1:]
+        state = jnp.zeros(mb_shape, mbs.dtype)       # activation in flight
+        out_buf = jnp.zeros((M,) + mb_shape, mbs.dtype)
+
+        def tick(carry, t):
+            state, out_buf = carry
+            # stage 0 ingests microbatch t (while valid)
+            feed = jnp.where(t < M, mbs[jnp.minimum(t, M - 1)],
+                             jnp.zeros(mb_shape, mbs.dtype))
+            x = jnp.where(stage == 0, feed, state)
+            y = body(params, x, *extra)
+            # last stage records its result for microbatch t-(S-1)
+            idx = jnp.clip(t - (S - 1), 0, M - 1)
+            take = jnp.logical_and(stage == S - 1, t >= S - 1)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf,
+                jnp.where(take, y, out_buf[idx]), idx, axis=0)
+            # rotate activations to the next stage
+            state = jax.lax.ppermute(y, PP_AXIS, perm)
+            return (state, out_buf), None
+
+        (state, out_buf), _ = jax.lax.scan(
+            tick, (state, out_buf), jnp.arange(M + S - 1))
+        # broadcast last stage's buffer to every pp rank (zeros elsewhere)
+        out = jax.lax.psum(
+            jnp.where(stage == S - 1, out_buf,
+                      jnp.zeros_like(out_buf)), PP_AXIS)
+        return out
+
+    extra_specs = tuple(P(*([None] * jnp.ndim(e))) for e in extra_args)
+    fn = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(param_specs, mb_spec) + extra_specs,
+        out_specs=P(*([None] * microbatches.ndim)),
+        check_rep=False)
+    return fn(stacked_params, microbatches, *extra_args)
+
+
+def _no_pp_fallback(stage_fn, stacked_params, microbatches, extra_args):
+    """pp=1: just scan the layers over each microbatch sequentially."""
+    merged = {k: v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:])
+              for k, v in stacked_params.items()}
+
+    def one_mb(x):
+        return stage_fn(merged, x, *extra_args)
+
+    outs = jax.lax.map(one_mb, microbatches)
+    return outs

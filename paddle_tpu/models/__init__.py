@@ -1,0 +1,5 @@
+"""Model zoo (capability parity with the ecosystem models the baseline
+configs exercise — SURVEY §2.4: BERT, Llama, ERNIE-style, MoE decoders,
+PP-OCR CNNs). Models are written against paddle_tpu.nn and are trace-ready."""
+
+from . import bert  # noqa: F401

@@ -1,0 +1,193 @@
+"""BERT (ref capability: PaddleNLP paddlenlp/transformers/bert/modeling.py —
+BertModel, BertForSequenceClassification; the SST-2 fine-tune baseline).
+
+Architecture is standard post-LN BERT; attention runs through
+nn.functional.scaled_dot_product_attention (flash-kernel routable).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForPretraining", "bert_base_config", "bert_tiny_config"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, layer_norm_eps=1e-12,
+                 pad_token_id=0, num_labels=2):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.pad_token_id = pad_token_id
+        self.num_labels = num_labels
+
+
+def bert_base_config(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def bert_tiny_config(**kw) -> BertConfig:
+    base = dict(hidden_size=128, num_hidden_layers=2, num_attention_heads=2,
+                intermediate_size=512, vocab_size=1024,
+                max_position_embeddings=128)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, c.initializer_range)
+        self.word_embeddings = nn.Embedding(c.vocab_size, c.hidden_size,
+                                            padding_idx=c.pad_token_id)
+        self.word_embeddings.weight._data = init(
+            [c.vocab_size, c.hidden_size], "float32")
+        self.position_embeddings = nn.Embedding(c.max_position_embeddings,
+                                                c.hidden_size)
+        self.token_type_embeddings = nn.Embedding(c.type_vocab_size,
+                                                  c.hidden_size)
+        self.layer_norm = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(S, dtype=jnp.int32)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros_like(input_ids._data))
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.num_heads = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.query = nn.Linear(c.hidden_size, c.hidden_size)
+        self.key = nn.Linear(c.hidden_size, c.hidden_size)
+        self.value = nn.Linear(c.hidden_size, c.hidden_size)
+        self.dropout_p = c.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        B, S, E = x.shape
+        q = self.query(x).reshape([B, S, self.num_heads, self.head_dim])
+        k = self.key(x).reshape([B, S, self.num_heads, self.head_dim])
+        v = self.value(x).reshape([B, S, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.dropout_p, training=self.training)
+        return out.reshape([B, S, E])
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(c)
+        self.attn_out = nn.Linear(c.hidden_size, c.hidden_size)
+        self.attn_norm = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.inter = nn.Linear(c.hidden_size, c.intermediate_size)
+        self.output = nn.Linear(c.intermediate_size, c.hidden_size)
+        self.out_norm = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+        self.act = c.hidden_act
+
+    def forward(self, x, attn_mask=None):
+        a = self.attention(x, attn_mask)
+        x = self.attn_norm(x + self.dropout(self.attn_out(a)))
+        h = getattr(F, self.act)(self.inter(x))
+        x = self.out_norm(x + self.dropout(self.output(h)))
+        return x
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        mask = None
+        if attention_mask is not None:
+            # [B, S] 1/0 → additive [B, 1, 1, S]
+            m = attention_mask._data.astype(jnp.float32)
+            mask = Tensor((1.0 - m)[:, None, None, :] * -1e30)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    """The SST-2 fine-tune head (baseline config 1)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return loss, logits
+        return logits
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = nn.LayerNorm(config.hidden_size)
+        self.nsp = nn.Linear(config.hidden_size, 2)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        # tied decoder: project back through the word embedding matrix
+        emb = self.bert.embeddings.word_embeddings.weight
+        mlm_logits = F.linear(h, emb.T)
+        nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is not None:
+            loss = F.cross_entropy(mlm_logits, masked_lm_labels,
+                                   ignore_index=-100)
+            if next_sentence_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits, next_sentence_labels)
+            return loss, mlm_logits, nsp_logits
+        return mlm_logits, nsp_logits

@@ -1,0 +1,230 @@
+"""Llama model family (ref capability: PaddleNLP
+paddlenlp/transformers/llama/modeling.py — the Llama-3-8B pretrain baseline,
+SURVEY §2.4 config 2).
+
+TPU-first design:
+- weights carry Megatron-pattern sharding specs (qkv/up: column on mp;
+  o/down: row on mp; embeddings: vocab on mp) — GSPMD derives the per-layer
+  collectives the reference's ColumnParallelLinear/RowParallelLinear issue.
+- activations get sequence-parallel constraints between blocks (P5) and a
+  dp/fsdp batch constraint at the top.
+- attention is GQA through scaled_dot_product_attention (flash-routable);
+  rope is fused-ready (paddle_tpu.ops).
+- fsdp (ZeRO-3) is a spec choice on the same weights (dim-0 on "sharding").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed.parallel_layers import MP_AXIS
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "llama3_8b_config", "llama_tiny_config", "apply_rope",
+           "precompute_rope"]
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=128256, hidden_size=4096,
+                 intermediate_size=14336, num_hidden_layers=32,
+                 num_attention_heads=32, num_key_value_heads=8,
+                 max_position_embeddings=8192, rope_theta=500000.0,
+                 rms_norm_eps=1e-5, initializer_range=0.02,
+                 tie_word_embeddings=False, use_flash_attention=True,
+                 sequence_parallel=True, recompute=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rope_theta = rope_theta
+        self.rms_norm_eps = rms_norm_eps
+        self.initializer_range = initializer_range
+        self.tie_word_embeddings = tie_word_embeddings
+        self.use_flash_attention = use_flash_attention
+        self.sequence_parallel = sequence_parallel
+        self.recompute = recompute
+        self.head_dim = hidden_size // num_attention_heads
+
+
+def llama3_8b_config(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama_tiny_config(**kw) -> LlamaConfig:
+    base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=256,
+                rope_theta=10000.0)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def precompute_rope(head_dim: int, max_seq: int, theta: float):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [S, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, S, H, D] raw array; fused-rope parity
+    (ref: fused_rotary_position_embedding / FusedRopeKernel)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :x.shape[1], None, :].astype(x.dtype)
+    s = sin[None, :x.shape[1], None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _mp_linear(in_f, out_f, spec, layer_parent, name):
+    l = nn.Linear(in_f, out_f, bias_attr=False)
+    l.weight._sharding_spec = spec
+    layer_parent.add_sublayer(name, l)
+    return l
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, c: LlamaConfig):
+        super().__init__()
+        self.c = c
+        H, D = c.num_attention_heads, c.head_dim
+        KV = c.num_key_value_heads
+        self.q_proj = nn.Linear(c.hidden_size, H * D, bias_attr=False)
+        self.k_proj = nn.Linear(c.hidden_size, KV * D, bias_attr=False)
+        self.v_proj = nn.Linear(c.hidden_size, KV * D, bias_attr=False)
+        self.o_proj = nn.Linear(H * D, c.hidden_size, bias_attr=False)
+        # Megatron TP: qkv column-sharded, o row-sharded on mp
+        self.q_proj.weight._sharding_spec = P(None, MP_AXIS)
+        self.k_proj.weight._sharding_spec = P(None, MP_AXIS)
+        self.v_proj.weight._sharding_spec = P(None, MP_AXIS)
+        self.o_proj.weight._sharding_spec = P(MP_AXIS, None)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        c = self.c
+        B, S, _ = x.shape
+        from ..core.dispatch import apply as _apply
+
+        def impl(h, wq, wk, wv, wo):
+            q = (h @ wq).reshape(B, S, c.num_attention_heads, c.head_dim)
+            k = (h @ wk).reshape(B, S, c.num_key_value_heads, c.head_dim)
+            v = (h @ wv).reshape(B, S, c.num_key_value_heads, c.head_dim)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            rep = c.num_attention_heads // c.num_key_value_heads
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            from ..ops.flash_attention import sdpa_reference
+            o = sdpa_reference(q, k, v, causal=True)
+            return o.reshape(B, S, -1) @ wo
+        return _apply("llama_attention", impl,
+                      [x, self.q_proj.weight, self.k_proj.weight,
+                       self.v_proj.weight, self.o_proj.weight])
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, c: LlamaConfig):
+        super().__init__()
+        self.gate_proj = nn.Linear(c.hidden_size, c.intermediate_size,
+                                   bias_attr=False)
+        self.up_proj = nn.Linear(c.hidden_size, c.intermediate_size,
+                                 bias_attr=False)
+        self.down_proj = nn.Linear(c.intermediate_size, c.hidden_size,
+                                   bias_attr=False)
+        self.gate_proj.weight._sharding_spec = P(None, MP_AXIS)
+        self.up_proj.weight._sharding_spec = P(None, MP_AXIS)
+        self.down_proj.weight._sharding_spec = P(MP_AXIS, None)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, c: LlamaConfig):
+        super().__init__()
+        self.c = c
+        self.input_layernorm = nn.RMSNorm(c.hidden_size, c.rms_norm_eps)
+        self.self_attn = LlamaAttention(c)
+        self.post_attention_layernorm = nn.RMSNorm(c.hidden_size,
+                                                   c.rms_norm_eps)
+        self.mlp = LlamaMLP(c)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        from ..distributed.parallel_layers import annotate_sequence_parallel
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        if self.c.sequence_parallel:
+            h = annotate_sequence_parallel(h)
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        if self.c.sequence_parallel:
+            out = annotate_sequence_parallel(out)
+        return out
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.embed_tokens.weight._data = init(
+            [config.vocab_size, config.hidden_size], "float32")
+        self.embed_tokens.weight._sharding_spec = P(MP_AXIS, None)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = precompute_rope(config.head_dim,
+                                   config.max_position_embeddings,
+                                   config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        cos, sin = self.rope_cos._data, self.rope_sin._data
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                from ..distributed.recompute import recompute
+                x = recompute(layer, x, cos, sin, attn_mask)
+            else:
+                x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+            self.lm_head.weight._sharding_spec = P(None, MP_AXIS)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = F.linear(h, self.llama.embed_tokens.weight.T)
+        if labels is not None:
+            from ..distributed.parallel_layers import ParallelCrossEntropy
+            loss_fn = ParallelCrossEntropy()
+            tok_loss = loss_fn(logits, labels)
+            return tok_loss.mean(), logits
+        return logits

@@ -1,0 +1,29 @@
+"""paddle_tpu.nn (ref surface: python/paddle/nn/)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import (Layer, LayerDict, LayerList, Parameter,  # noqa: F401
+                           ParameterList, Sequential)
+from .layer.common import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,  # noqa: F401
+                           AlphaDropout, AvgPool1D, AvgPool2D, Bilinear,
+                           Conv1D, Conv2D, Conv2DTranspose, Conv3D,
+                           CosineSimilarity, Dropout, Dropout2D, Embedding,
+                           Flatten, Identity, Linear, MaxPool1D, MaxPool2D,
+                           Pad1D, Pad2D, Pad3D, PixelShuffle, Upsample,
+                           UpsamplingBilinear2D, UpsamplingNearest2D)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,  # noqa: F401
+                         GroupNorm, InstanceNorm2D, LayerNorm,
+                         LocalResponseNorm, RMSNorm, SyncBatchNorm)
+from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,  # noqa: F401
+                               Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+                               LogSoftmax, Mish, PReLU, ReLU, ReLU6, Sigmoid,
+                               SiLU, Softmax, Softplus, Softshrink, Softsign,
+                               Swish, Tanh, Tanhshrink, ThresholdedReLU)
+from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa: F401
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,  # noqa: F401
+                         CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
+                         KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
+                         NLLLoss, SmoothL1Loss)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

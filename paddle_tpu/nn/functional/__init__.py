@@ -1,0 +1,1015 @@
+"""nn.functional (ref surface: python/paddle/nn/functional/).
+
+Compute is expressed in jnp/lax so XLA owns fusion/layout; the fused-kernel
+entry points (flash attention, fused rope, fused rms_norm) route to the Pallas
+implementations in paddle_tpu.ops when available, with an XLA reference
+fallback — mirroring the reference's fused-op dispatch
+(paddle/phi/kernels/fusion/ vs the composite python fallback).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.dtypes import convert_dtype
+from ...core.tensor import Tensor
+from ...framework.random import next_key
+
+__all__ = [
+    # activations
+    "relu", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh", "softmax",
+    "log_softmax", "leaky_relu", "elu", "selu", "celu", "hardswish",
+    "hardsigmoid", "hardtanh", "mish", "softplus", "softsign", "softshrink",
+    "hardshrink", "tanhshrink", "thresholded_relu", "prelu", "glu", "swiglu",
+    "gumbel_softmax",
+    # linear / embedding
+    "linear", "embedding", "one_hot", "bilinear",
+    # norm
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "local_response_norm", "normalize",
+    # conv / pool
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "max_pool1d", "max_pool2d", "avg_pool1d", "avg_pool2d", "max_pool3d",
+    "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_max_pool2d", "unfold", "pixel_shuffle",
+    # attention
+    "scaled_dot_product_attention", "softmax_mask_fuse",
+    # dropout & misc
+    "dropout", "dropout2d", "alpha_dropout", "pad", "interpolate", "upsample",
+    # losses
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "smooth_l1_loss",
+    "nll_loss", "kl_div", "margin_ranking_loss", "cosine_similarity",
+    "cosine_embedding_loss", "ctc_loss", "hinge_embedding_loss",
+    "label_smooth", "square_error_cost", "sequence_mask", "temporal_shift",
+]
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+def relu(x, name=None):
+    return apply("relu", jax.nn.relu, [x])
+
+
+def relu6(x, name=None):
+    return apply("relu6", jax.nn.relu6, [x])
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), [x])
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, [x])
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, [x])
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, [x])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def impl(a):
+        if dtype is not None:
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply("softmax", impl, [x])
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def impl(a):
+        if dtype is not None:
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply("log_softmax", impl, [x])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu",
+                 lambda a: jax.nn.leaky_relu(a, negative_slope), [x])
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), [x])
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu",
+                 lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                 [x])
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), [x])
+
+
+def hardswish(x, name=None):
+    return apply("hardswish", jax.nn.hard_swish, [x])
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply("hardsigmoid",
+                 lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), [x])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), [x])
+
+
+def mish(x, name=None):
+    return apply("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), [x])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus",
+                 lambda a: jnp.where(beta * a > threshold, a,
+                                     jax.nn.softplus(beta * a) / beta), [x])
+
+
+def softsign(x, name=None):
+    return apply("softsign", jax.nn.soft_sign, [x])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink",
+                 lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold,
+                                               jnp.zeros_like(a))), [x])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink",
+                 lambda a: jnp.where(jnp.abs(a) > threshold, a,
+                                     jnp.zeros_like(a)), [x])
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", lambda a: a - jnp.tanh(a), [x])
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply("thresholded_relu",
+                 lambda a: jnp.where(a > threshold, a, jnp.zeros_like(a)), [x])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply("prelu", impl, [x, weight])
+
+
+def glu(x, axis=-1, name=None):
+    def impl(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply("glu", impl, [x])
+
+
+def swiglu(x, y=None, name=None):
+    """ref: paddle.incubate.nn.functional.swiglu — silu(x) * y (or split)."""
+    if y is None:
+        def impl(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return apply("swiglu", impl, [x])
+    return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, [x, y])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(next_key(), tuple(x.shape)) + 1e-20) + 1e-20)
+    def impl(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+    return apply("gumbel_softmax", impl, [x])
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+def linear(x, weight, bias=None, name=None):
+    """paddle convention: weight is [in_features, out_features]."""
+    if bias is None:
+        return apply("linear", lambda a, w: a @ w, [x, weight])
+    return apply("linear", lambda a, w, b: a @ w + b, [x, weight, bias])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    def impl(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return apply("embedding", impl, [weight])
+
+
+def one_hot(x, num_classes, name=None):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(idx, num_classes))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def impl(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply("bilinear", impl, args)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim = len(normalized_shape)
+    def impl(a, *wb):
+        axes = tuple(range(a.ndim - ndim, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(a - mean), axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]; i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("layer_norm", impl, args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """Fused RMSNorm parity (ref: paddle fused_rms_norm / RmsNormKernel)."""
+    def impl(a, *w):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        var = jnp.mean(jnp.square(a32), axis=-1, keepdims=True)
+        out = a32 * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(dt)
+        if w:
+            out = out * w[0]
+        return out
+    args = [x] + ([weight] if weight is not None else [])
+    return apply("rms_norm", impl, args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    if training:
+        def impl(a, *wb):
+            mean = jnp.mean(a, axis=reduce_axes)
+            var = jnp.var(a, axis=reduce_axes)
+            out = (a - mean.reshape(shape)) * jax.lax.rsqrt(
+                var.reshape(shape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(shape); i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(shape)
+            return out, mean, var
+        args = [x] + [t for t in (weight, bias) if t is not None]
+        out, mean, var = apply("batch_norm", impl, args)
+        # update running stats (host-side state, functional underneath)
+        if running_mean is not None and not isinstance(mean._data, jax.core.Tracer):
+            running_mean._data = (momentum * running_mean._data
+                                  + (1 - momentum) * mean._data)
+            running_var._data = (momentum * running_var._data
+                                 + (1 - momentum) * var._data)
+        elif running_mean is not None:
+            running_mean._data = (momentum * running_mean._data
+                                  + (1 - momentum) * mean._data)
+            running_var._data = (momentum * running_var._data
+                                 + (1 - momentum) * var._data)
+        return out
+
+    rm = running_mean._data if isinstance(running_mean, Tensor) else running_mean
+    rv = running_var._data if isinstance(running_var, Tensor) else running_var
+    def impl_eval(a, *wb):
+        out = (a - rm.reshape(shape)) * jax.lax.rsqrt(rv.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("batch_norm_eval", impl_eval, args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    C = x.shape[ch_axis]
+    def impl(a, *wb):
+        if ch_axis != 1:
+            a = jnp.moveaxis(a, ch_axis, 1)
+        n = a.shape[0]
+        grouped = a.reshape((n, num_groups, C // num_groups) + a.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        shape = [1] * out.ndim
+        shape[1] = C
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if ch_axis != 1:
+            out = jnp.moveaxis(out, 1, ch_axis)
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("group_norm", impl, args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def impl(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * a.ndim
+        shape[1] = a.shape[1]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("instance_norm", impl, args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def impl(a):
+        sq = jnp.square(a)
+        half = size // 2
+        ch = a.shape[1]
+        acc = jnp.zeros_like(a)
+        for off in range(-half, half + 1):
+            lo = max(0, -off)
+            hi = min(ch, ch - off)
+            acc = acc.at[:, lo:hi].add(sq[:, lo + off:hi + off])
+        return a / jnp.power(k + alpha * acc / size, beta)
+    return apply("lrn", impl, [x])
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def impl(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply("normalize", impl, [x])
+
+
+# ---------------------------------------------------------------------------
+# conv / pool (paddle weight layout: [out_ch, in_ch/groups, *kernel])
+# ---------------------------------------------------------------------------
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
+             data_format, transpose=False, output_padding=0):
+    strides = _pair(stride, nd)
+    dils = _pair(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()  # "SAME"/"VALID"
+    else:
+        p = _pair(padding, nd) if not (isinstance(padding, (list, tuple))
+                                       and isinstance(padding[0], (list, tuple))) \
+            else padding
+        pad = [(int(pi), int(pi)) for pi in p] if not isinstance(p[0], tuple) \
+            else [tuple(pp) for pp in p]
+
+    if data_format.startswith("NC"):
+        dn_in = "NC" + "DHW"[3 - nd:]
+    else:
+        dn_in = "N" + "DHW"[3 - nd:] + "C"
+    dn_kernel = "OI" + "DHW"[3 - nd:]
+    dn_out = dn_in
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (dn_in, dn_kernel, dn_out))
+
+    def impl(a, w, *b):
+        if transpose:
+            out = jax.lax.conv_transpose(
+                a, w, strides, pad if isinstance(pad, str) else pad,
+                rhs_dilation=dils, dimension_numbers=dn, transpose_kernel=True)
+        else:
+            out = jax.lax.conv_general_dilated(
+                a, w, strides, pad, rhs_dilation=dils, dimension_numbers=dn,
+                feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            ch_axis = 1 if data_format.startswith("NC") else out.ndim - 1
+            shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply("conv%dd" % nd, impl, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    "NCW" if data_format == "NCL" else "NWC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    # weight layout for transpose in paddle: [in, out/groups, k]
+    w = weight.transpose([1, 0, 2]) if isinstance(weight, Tensor) else weight
+    return _conv_nd(x, w, bias, stride, padding, dilation, groups, 1,
+                    "NCW" if data_format == "NCL" else "NWC", transpose=True)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", name=None):
+    w = weight.transpose([1, 0, 2, 3]) if isinstance(weight, Tensor) else weight
+    return _conv_nd(x, w, bias, stride, padding, dilation, groups, 2,
+                    data_format, transpose=True)
+
+
+def _pool_nd(x, kernel, stride, padding, nd, data_format, reducer, init,
+             ceil_mode=False, average=False, exclusive=True):
+    ks = _pair(kernel, nd)
+    st = _pair(stride if stride is not None else kernel, nd)
+    pd = _pair(padding, nd)
+    nc = data_format.startswith("NC")
+    if nc:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+
+    def impl(a):
+        out = jax.lax.reduce_window(a, init(a.dtype), reducer, window,
+                                    strides, pads)
+        if average:
+            if exclusive and any(p for p in pd):
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(
+                    ones, jnp.zeros((), a.dtype), jax.lax.add, window,
+                    strides, pads)
+                out = out / counts
+            else:
+                out = out / np.prod(ks)
+        return out
+    return apply("pool", impl, [x])
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "NCW",
+                    jax.lax.max, lambda dt: jnp.asarray(-jnp.inf, dt))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, data_format,
+                    jax.lax.max, lambda dt: jnp.asarray(-jnp.inf, dt))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format,
+                    jax.lax.max, lambda dt: jnp.asarray(-jnp.inf, dt))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "NCW",
+                    jax.lax.add, lambda dt: jnp.zeros((), dt), average=True,
+                    exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, data_format,
+                    jax.lax.add, lambda dt: jnp.zeros((), dt), average=True,
+                    exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format,
+                    jax.lax.add, lambda dt: jnp.zeros((), dt), average=True,
+                    exclusive=exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max")
+
+
+def _adaptive_pool(x, output_size, nd, mode):
+    out_sizes = _pair(output_size, nd)
+    in_sizes = x.shape[-nd:]
+    def impl(a):
+        out = a
+        for d, (insz, outsz) in enumerate(zip(in_sizes, out_sizes)):
+            axis = a.ndim - nd + d
+            if insz % outsz != 0:
+                raise NotImplementedError(
+                    "adaptive pool requires divisible sizes on TPU "
+                    f"(in={insz}, out={outsz})")
+            k = insz // outsz
+            shape = out.shape[:axis] + (outsz, k) + out.shape[axis + 1:]
+            out = out.reshape(shape)
+            out = jnp.mean(out, axis=axis + 1) if mode == "avg" \
+                else jnp.max(out, axis=axis + 1)
+        return out
+    return apply("adaptive_pool", impl, [x])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes, 2)
+    st = _pair(strides, 2)
+    pd = _pair(paddings, 2)
+    dl = _pair(dilations, 2)
+    def impl(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, (1, 1) + ks, ("NCHW", "OIHW", "NCHW")))
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+    return apply("unfold", impl, [x])
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def impl(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return apply("pixel_shuffle", impl, [x])
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """ref: paddle.nn.functional.scaled_dot_product_attention
+    (python/paddle/nn/functional/flash_attention.py). Layout [B, S, H, D].
+    Routes to the Pallas flash kernel when available (paddle_tpu.ops)."""
+    from ...ops import flash_attention as _fa
+    mask = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+    def impl(q, k, v):
+        return _fa.sdpa_reference(q, k, v, mask=mask, causal=is_causal,
+                                  dropout_p=dropout_p if training else 0.0)
+    return apply("sdpa", impl, [query, key, value])
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    m = mask._data if isinstance(mask, Tensor) else mask
+    return apply("softmax_mask_fuse",
+                 lambda a: jax.nn.softmax(a + m, axis=-1), [x])
+
+
+# ---------------------------------------------------------------------------
+# dropout & shape utilities
+# ---------------------------------------------------------------------------
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    if p == 1.0:
+        return apply("dropout", lambda a: jnp.zeros_like(a), [x])
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, shape)
+    def impl(a):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return apply("dropout", impl, [x])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = -1.7580993408473766
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x.shape))
+    q = 1.0 - p
+    a_scale = (q + alpha ** 2 * q * p) ** -0.5
+    b = -a_scale * p * alpha
+    def impl(t):
+        return a_scale * jnp.where(keep, t, jnp.asarray(alpha, t.dtype)) + b
+    return apply("alpha_dropout", impl, [x])
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...tensor.manipulation import pad_nd
+    if len(pad) == x.ndim * 2:
+        return pad_nd(x, pad, mode, value)
+    # paddle semantics: pad applies to spatial dims per data_format
+    nd = x.ndim
+    if data_format.startswith("NC"):
+        spatial = list(range(2, nd))
+    else:
+        spatial = list(range(1, nd - 1))
+    pairs = [(0, 0)] * nd
+    half = len(pad) // 2
+    for i in range(half):
+        d = spatial[-(i + 1)] if data_format.startswith("NC") else spatial[-(i + 1)]
+        pairs[d] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    def impl(a):
+        if mode == "constant":
+            return jnp.pad(a, pairs, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        return jnp.pad(a, pairs, mode=jmode)
+    return apply("pad", impl, [x])
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW", name=None):
+    nd = x.ndim - 2
+    if size is None:
+        sf = _pair(scale_factor, nd)
+        in_sp = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+        size = [int(s * f) for s, f in zip(in_sp, sf)]
+    size = _pair(size, nd)
+    nc = data_format.startswith("NC")
+    def impl(a):
+        if nc:
+            spatial_shape = a.shape[2:]
+            out_shape = a.shape[:2] + tuple(size)
+        else:
+            out_shape = (a.shape[0],) + tuple(size) + (a.shape[-1],)
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "linear": "linear", "trilinear": "linear",
+                  "bicubic": "cubic", "area": "linear"}[mode]
+        return jax.image.resize(a, out_shape, method=method)
+    return apply("interpolate", impl, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    l = lengths._data if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    m = int(maxlen) if maxlen is not None else int(np.asarray(l).max())
+    mask = jnp.arange(m) < l[..., None]
+    return Tensor(mask.astype(convert_dtype(dtype)))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def impl(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold], jnp.zeros_like(a[:, -1:, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(a[:, :1, fold:2 * fold]),
+                                 a[:, :-1, fold:2 * fold]], 1)
+        rest = a[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        return out.reshape(nt, c, h, w)
+    return apply("temporal_shift", impl, [x])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def impl(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return apply("label_smooth", impl, [label])
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+    w = weight._data if isinstance(weight, Tensor) else weight
+    def impl(logits):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-30, None))
+        if soft_label:
+            target = lab
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                target = (1 - label_smoothing) * target + label_smoothing / k
+            loss = -jnp.sum(target * logp, axis=axis)
+        else:
+            l = lab
+            if l.ndim == logp.ndim:  # trailing 1 dim
+                l = l.squeeze(axis)
+            k = logits.shape[axis]
+            safe = jnp.clip(l, 0, k - 1)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis).astype(jnp.int32),
+                axis=axis).squeeze(axis)
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = -picked
+            mask = (l != ignore_index)
+            loss = jnp.where(mask, loss, jnp.zeros((), loss.dtype))
+            if w is not None:
+                loss = loss * jnp.take(w, safe, axis=0)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0) \
+                    if w is None else jnp.maximum(
+                        jnp.sum(jnp.where(mask, jnp.take(w, safe, 0),
+                                          jnp.zeros((), loss.dtype))), 1e-12)
+                return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+    return apply("cross_entropy", impl, [input])
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100, return_softmax=False,
+                               name=None):
+    loss = cross_entropy(logits, label, soft_label=soft_label, axis=axis,
+                         ignore_index=ignore_index, reduction="none")
+    loss = loss.unsqueeze(axis) if loss.ndim < logits.ndim else loss
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+    w = weight._data if isinstance(weight, Tensor) else weight
+    def impl(p):
+        eps = 1e-12
+        loss = -(lab * jnp.log(jnp.clip(p, eps, None))
+                 + (1 - lab) * jnp.log(jnp.clip(1 - p, eps, None)))
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+    return apply("bce", impl, [input])
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+    w = weight._data if isinstance(weight, Tensor) else weight
+    pw = pos_weight._data if isinstance(pos_weight, Tensor) else pos_weight
+    def impl(z):
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        loss = jnp.maximum(z, 0) - z * lab + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            loss = loss * (lab * (pw - 1) + 1)
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+    return apply("bce_logits", impl, [logit])
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss",
+                 lambda a, b: _reduce_loss(jnp.square(a - b), reduction),
+                 [input, label])
+
+
+def square_error_cost(input, label, name=None):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b),
+                 [input, label])
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss",
+                 lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+                 [input, label])
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def impl(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta,
+                         jnp.abs(d) - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+    return apply("smooth_l1", impl, [input, label])
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+    w = weight._data if isinstance(weight, Tensor) else weight
+    def impl(logp):
+        k = logp.shape[1]
+        safe = jnp.clip(lab, 0, k - 1)
+        picked = jnp.take_along_axis(logp, safe[:, None].astype(jnp.int32),
+                                     axis=1).squeeze(1)
+        loss = -picked
+        mask = lab != ignore_index
+        loss = jnp.where(mask, loss, jnp.zeros((), loss.dtype))
+        if w is not None:
+            loss = loss * jnp.take(w, safe, 0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(mask.astype(loss.dtype)), 1.0)
+        return _reduce_loss(loss, reduction)
+    return apply("nll_loss", impl, [input])
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def impl(logp, t):
+        tt = jnp.exp(t) if log_target else t
+        logt = t if log_target else jnp.log(jnp.clip(t, 1e-12, None))
+        loss = tt * (logt - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+    return apply("kl_div", impl, [input, label])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def impl(a, b, l):
+        loss = jnp.maximum(-l * (a - b) + margin, 0.0)
+        return _reduce_loss(loss, reduction)
+    return apply("margin_ranking", impl, [input, other, label])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def impl(a, l):
+        loss = jnp.where(l == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce_loss(loss, reduction)
+    return apply("hinge_embedding", impl, [input, label])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def impl(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply("cosine_similarity", impl, [x1, x2])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def impl(a, b, l):
+        cos = jnp.sum(a * b, axis=1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=1) * jnp.linalg.norm(b, axis=1), 1e-12)
+        loss = jnp.where(l == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce_loss(loss, reduction)
+    return apply("cosine_embedding", impl, [input1, input2, label])
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (ref: warpctc external in the reference build; here a native
+    XLA forward-algorithm implementation — SURVEY §7.1 L8 warpctc parity).
+
+    log_probs: [T, B, C] (paddle convention), labels: [B, L] padded.
+    """
+    lab = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+    in_len = input_lengths._data if isinstance(input_lengths, Tensor) \
+        else jnp.asarray(input_lengths)
+    lab_len = label_lengths._data if isinstance(label_lengths, Tensor) \
+        else jnp.asarray(label_lengths)
+
+    def impl(lp):
+        lp_btc = jnp.transpose(lp, (1, 0, 2))  # [B, T, C]
+        lp_btc = jax.nn.log_softmax(lp_btc, axis=-1)
+        B, T, C = lp_btc.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        # extended label sequence: blank l1 blank l2 ... blank
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = jnp.asarray(-1e30, lp_btc.dtype)
+
+        # allow-transition mask for skip connections (s-2): only when ext
+        # labels differ and current is not blank
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             (ext[:, 2:] != ext[:, :-2]) & (ext[:, 2:] != blank)], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            a2 = jnp.where(skip_ok, a2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp_btc[:, 0, blank])
+        first_emit = jnp.take_along_axis(lp_btc[:, 0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, first_emit, neg_inf))
+
+        def scan_body(carry, t):
+            alpha, = carry
+            new_alpha, _ = step(alpha, lp_btc[:, t])
+            # freeze past input_length
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return (new_alpha,), None
+
+        (alpha_f,), _ = jax.lax.scan(scan_body, (alpha0,),
+                                     jnp.arange(1, T))
+        end1 = 2 * lab_len  # final blank position
+        end2 = 2 * lab_len - 1
+        g1 = jnp.take_along_axis(alpha_f, end1[:, None].astype(jnp.int32), 1)[:, 0]
+        g2 = jnp.take_along_axis(alpha_f,
+                                 jnp.maximum(end2, 0)[:, None].astype(jnp.int32),
+                                 1)[:, 0]
+        g2 = jnp.where(lab_len > 0, g2, neg_inf)
+        nll = -jnp.logaddexp(g1, g2)
+        if reduction == "mean":
+            return jnp.mean(nll / jnp.maximum(lab_len.astype(nll.dtype), 1.0))
+        return _reduce_loss(nll, reduction)
+    return apply("ctc_loss", impl, [log_probs])

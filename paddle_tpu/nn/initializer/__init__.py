@@ -1,0 +1,149 @@
+"""Weight initializers (ref surface: python/paddle/nn/initializer/).
+
+Each initializer is a callable ``(shape, dtype) -> jax array``; Layer's
+create_parameter threads the global generator key through framework.random.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtypes import convert_dtype, get_default_dtype
+from ...framework.random import next_key
+
+__all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+           "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+           "Assign", "calculate_gain"]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return gains[nonlinearity]
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return jnp.full(tuple(shape), self.value, dt)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        arr = jnp.asarray(np.asarray(self.value), dt)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(f"Assign shape {arr.shape} != parameter {shape}")
+        return arr
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return (self.mean + self.std
+                * jax.random.normal(next_key(), tuple(shape))).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        z = jax.random.truncated_normal(next_key(), self.a, self.b, tuple(shape))
+        return (self.mean + self.std * z).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.uniform(next_key(), tuple(shape), dt,
+                                  minval=self.low, maxval=self.high)
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        return (shape[0] if shape else 1,) * 2
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    # paddle convention: fc weights are [in, out]; conv are [out, in, k...]
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    else:
+        fan_in, fan_out = shape[1] * receptive, shape[0] * receptive
+    return fan_in, fan_out
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        fan_in, fan_out = _fans(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return (std * jax.random.normal(next_key(), tuple(shape))).astype(dt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        fan_in, fan_out = _fans(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(next_key(), tuple(shape), dt,
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        fan_in = self.fan_in if self.fan_in is not None else _fans(shape)[0]
+        std = self.gain / math.sqrt(fan_in)
+        return (std * jax.random.normal(next_key(), tuple(shape))).astype(dt)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        fan_in = self.fan_in if self.fan_in is not None else _fans(shape)[0]
+        limit = self.gain * math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(next_key(), tuple(shape), dt,
+                                  minval=-limit, maxval=limit)

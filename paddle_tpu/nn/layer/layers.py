@@ -1,0 +1,443 @@
+"""nn.Layer — the module base class (ref: python/paddle/nn/layer/layers.py).
+
+Holds Parameters (Tensors with stop_gradient=False) and sublayers; supports
+hooks, train/eval mode, state_dict round-trips, dtype moves. The functional
+bridge (`paddle_tpu.jit.functional_call`) extracts parameters as a pytree and
+re-binds tracers, which is what makes whole-step jit/pjit work on models
+written in this imperative style.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtypes import convert_dtype, get_default_dtype
+from ...core.tensor import Tensor
+from .. import initializer as I
+
+__all__ = ["Layer", "Parameter", "Sequential", "LayerList", "LayerDict",
+           "ParameterList"]
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (ref: paddle eager ParamBase)."""
+
+    def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+
+def _param_flatten(p: Parameter):
+    return (p._data,), (p.stop_gradient,)
+
+
+def _param_unflatten(aux, children):
+    import jax
+    t = Parameter.__new__(Parameter)
+    t._data = children[0]
+    t.stop_gradient = aux[0]
+    t._grad = None
+    t._node = None
+    t.name = None
+    t.persistable = True
+    t._retain_grad = False
+    t._hooks = []
+    t.trainable = not aux[0]
+    return t
+
+
+import jax as _jax  # noqa: E402
+
+_jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)
+
+
+class _HookHandle:
+    _next_id = 0
+
+    def __init__(self, registry: dict):
+        self._registry = registry
+        self._id = _HookHandle._next_id
+        _HookHandle._next_id += 1
+        registry[self._id] = None  # slot reserved by caller
+
+    def remove(self):
+        self._registry.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        # use object.__setattr__: our __setattr__ routes through these dicts
+        d = self.__dict__
+        d["_parameters"] = collections.OrderedDict()
+        d["_sub_layers"] = collections.OrderedDict()
+        d["_buffers"] = collections.OrderedDict()
+        d["_non_persistable_buffer_names"] = set()
+        d["training"] = True
+        d["_dtype"] = convert_dtype(dtype) or get_default_dtype()
+        d["_forward_pre_hooks"] = collections.OrderedDict()
+        d["_forward_post_hooks"] = collections.OrderedDict()
+        d["_name_scope"] = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute routing --------------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        bufs = self.__dict__.get("_buffers")
+        if params is None:
+            object.__setattr__(self, name, value)
+            return
+        for store in (params, subs, bufs):
+            store.pop(name, None)
+        if isinstance(value, Parameter):
+            params[name] = value
+        elif isinstance(value, Layer):
+            subs[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store_name in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(store_name)
+            if store is not None and name in store:
+                return store[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store_name in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(store_name)
+            if store is not None and name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         is_bias: bool = False, attr=None) -> Parameter:
+        dt = convert_dtype(dtype) or self._dtype
+        init = default_initializer
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        return Parameter(init(shape, dt))
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True) -> None:
+        self.__dict__["_buffers"][name] = tensor
+        if not persistable:
+            self.__dict__["_non_persistable_buffer_names"].add(name)
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self.__dict__["_sub_layers"][name] = sublayer
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: Parameter) -> Parameter:
+        self.__dict__["_parameters"][name] = parameter
+        return parameter
+
+    # -- traversal ----------------------------------------------------------
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix):
+            for pname, p in layer.__dict__["_parameters"].items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (layer_prefix + pname, p)
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "") -> Iterator[Tuple[str, Tensor]]:
+        for name, layer_prefix, layer in self._walk(prefix):
+            for bname, b in layer.__dict__["_buffers"].items():
+                if b is not None:
+                    yield (layer_prefix + bname, b)
+
+    def buffers(self) -> List[Tensor]:
+        return [b for _, b in self.named_buffers()]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield (prefix.rstrip("."), self)
+        for name, sub in self.__dict__["_sub_layers"].items():
+            if sub is None:
+                continue
+            p = f"{prefix}{name}"
+            yield (p, sub)
+            yield from sub.named_sublayers(prefix=p + ".")
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for sub in self.__dict__["_sub_layers"].values():
+            if sub is not None:
+                yield sub
+
+    def named_children(self):
+        for name, sub in self.__dict__["_sub_layers"].items():
+            if sub is not None:
+                yield name, sub
+
+    def _walk(self, prefix: str = ""):
+        """Yield (name, dotted_prefix, layer) for self and all sublayers."""
+        yield ("", prefix, self)
+        for name, sub in self.__dict__["_sub_layers"].items():
+            if sub is not None:
+                yield from ((n, p, l) for n, p, l in sub._walk(
+                    f"{prefix}{name}."))
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for sub in self.children():
+            sub.apply(fn)
+        fn(self)
+        return self
+
+    # -- mode / dtype --------------------------------------------------------
+    def train(self) -> "Layer":
+        def set_train(l):
+            l.__dict__["training"] = True
+        return self.apply(set_train)
+
+    def eval(self) -> "Layer":
+        def set_eval(l):
+            l.__dict__["training"] = False
+        return self.apply(set_eval)
+
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            for _, p in self.named_parameters():
+                if _is_float(p.dtype):
+                    p._data = p._data.astype(dt)
+            for _, b in self.named_buffers():
+                if _is_float(b.dtype):
+                    b._data = b._data.astype(dt)
+            def set_dtype(l):
+                l.__dict__["_dtype"] = dt
+            self.apply(set_dtype)
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook=True
+                   ) -> Dict[str, Tensor]:
+        out = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for _, layer_prefix, layer in self._walk(structured_name_prefix):
+            np_set = layer.__dict__["_non_persistable_buffer_names"]
+            for bname, b in layer.__dict__["_buffers"].items():
+                if b is not None and bname not in np_set:
+                    out[layer_prefix + bname] = b
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            tgt = own[k]
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(arr.shape) != tuple(tgt._data.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: checkpoint {tuple(arr.shape)} "
+                    f"vs parameter {tuple(tgt._data.shape)}")
+            tgt._data = arr.astype(tgt._data.dtype)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- hooks ----------------------------------------------------------------
+    def register_forward_pre_hook(self, hook) -> _HookHandle:
+        h = _HookHandle(self.__dict__["_forward_pre_hooks"])
+        self.__dict__["_forward_pre_hooks"][h._id] = hook
+        return h
+
+    def register_forward_post_hook(self, hook) -> _HookHandle:
+        h = _HookHandle(self.__dict__["_forward_post_hooks"])
+        self.__dict__["_forward_post_hooks"][h._id] = hook
+        return h
+
+    # -- call ------------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self.__dict__["_forward_pre_hooks"].values()):
+            if hook is None:
+                continue
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self.__dict__["_forward_post_hooks"].values()):
+            if hook is None:
+                continue
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        lines = []
+        extra = self.extra_repr()
+        for name, sub in self.named_children():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"  ({name}): {sub_repr}")
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+
+def _is_float(dtype) -> bool:
+    return np.issubdtype(dtype, np.floating) or dtype == jnp.bfloat16
+
+
+class Sequential(Layer):
+    """ref: paddle.nn.Sequential (accepts layers or (name, layer) tuples)."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], collections.OrderedDict):
+            for name, l in layers[0].items():
+                self.add_sublayer(name, l)
+        else:
+            for i, l in enumerate(layers):
+                if isinstance(l, tuple):
+                    self.add_sublayer(l[0], l[1])
+                else:
+                    self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for layer in self.children():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self.children())[idx]
+
+    def __len__(self):
+        return len(self.__dict__["_sub_layers"])
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def append(self, layer: Layer) -> "LayerList":
+        self.add_sublayer(str(len(self)), layer)
+        return self
+
+    def extend(self, layers) -> "LayerList":
+        for l in layers:
+            self.append(l)
+        return self
+
+    def insert(self, index: int, layer: Layer) -> None:
+        items = list(self.__dict__["_sub_layers"].values())
+        items.insert(index, layer)
+        self.__dict__["_sub_layers"].clear()
+        for i, l in enumerate(items):
+            self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self.children())[idx]
+        return self.__dict__["_sub_layers"][str(idx % max(len(self), 1))]
+
+    def __setitem__(self, idx, layer):
+        self.__dict__["_sub_layers"][str(idx)] = layer
+
+    def __len__(self):
+        return len(self.__dict__["_sub_layers"])
+
+    def __iter__(self):
+        return iter(self.__dict__["_sub_layers"].values())
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def update(self, sublayers):
+        items = sublayers.items() if isinstance(sublayers, dict) else sublayers
+        for name, l in items:
+            self.add_sublayer(name, l)
+
+    def __getitem__(self, key):
+        return self.__dict__["_sub_layers"][key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __len__(self):
+        return len(self.__dict__["_sub_layers"])
+
+    def keys(self):
+        return self.__dict__["_sub_layers"].keys()
+
+    def items(self):
+        return self.__dict__["_sub_layers"].items()
+
+    def values(self):
+        return self.__dict__["_sub_layers"].values()
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, parameter: Parameter):
+        self.add_parameter(str(len(self.__dict__["_parameters"])), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self.__dict__["_parameters"][str(idx)]
+
+    def __len__(self):
+        return len(self.__dict__["_parameters"])
+
+    def __iter__(self):
+        return iter(self.__dict__["_parameters"].values())

@@ -1,0 +1,5 @@
+"""paddle_tpu.ops — the fused-kernel set (Pallas TPU kernels + XLA reference
+implementations), the TPU-native analog of the reference's
+paddle/phi/kernels/fusion/ + flash-attn integration."""
+
+from . import flash_attention  # noqa: F401

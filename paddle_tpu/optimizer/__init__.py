@@ -1,0 +1,399 @@
+"""Optimizers (ref: python/paddle/optimizer/).
+
+Paddle semantics: per-param accumulators, multi_precision master weights for
+bf16/f16 params, grad_clip objects, LRScheduler integration. All update math
+is jnp (traceable), so Optimizer.step() works both eagerly and inside a
+traced train step. The per-param python loop is amortized: the jitted Trainer
+path traces it once into a single fused XLA update program — the TPU analog
+of the reference's fused/multi-tensor optimizer kernels
+(paddle/phi/kernels/gpu/adamw_kernel.cu, fused multi_tensor paths).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import is_floating_dtype
+from ..core.tensor import Tensor
+from . import lr as lr  # noqa: F401
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "Adamax",
+           "RMSProp", "Adam", "AdamW", "Lamb", "lr"]
+
+
+def _is_low_precision(dtype) -> bool:
+    return dtype in (jnp.float16, jnp.bfloat16) or \
+        np.dtype(dtype) in (np.dtype(np.float16),)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be given (eager mode, ref parity)")
+        self._param_groups = list(parameters)
+        self._lr = learning_rate
+        self._weight_decay = 0.0 if weight_decay is None else (
+            weight_decay if isinstance(weight_decay, float) else weight_decay)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = {}
+        self._master: Dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value: float) -> None:
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- accumulators --------------------------------------------------------
+    def _acc(self, name: str, p: Tensor, init=None):
+        store = self._accumulators.setdefault(name, {})
+        pid = id(p)
+        if pid not in store:
+            dt = jnp.float32 if _is_low_precision(p.dtype) else p.dtype
+            store[pid] = jnp.zeros(p._data.shape, dt) if init is None \
+                else init
+        return store[pid]
+
+    def _set_acc(self, name: str, p: Tensor, value) -> None:
+        self._accumulators[name][id(p)] = value
+
+    def _master_weight(self, p: Tensor):
+        pid = id(p)
+        if self._multi_precision and _is_low_precision(p.dtype):
+            if pid not in self._master:
+                self._master[pid] = p._data.astype(jnp.float32)
+            return self._master[pid]
+        return p._data
+
+    def _write_param(self, p: Tensor, new_value) -> None:
+        pid = id(p)
+        if self._multi_precision and _is_low_precision(p.dtype):
+            self._master[pid] = new_value
+            p._data = new_value.astype(p.dtype)
+        else:
+            p._data = new_value.astype(p.dtype)
+
+    # -- step ----------------------------------------------------------------
+    def step(self) -> None:
+        params_grads = [(p, p.grad) for p in self._param_groups
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr_v = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            self._update_param(p, g._data, lr_v)
+
+    def _update_param(self, p: Tensor, grad, lr_v: float) -> None:
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero: bool = False) -> None:
+        for p in self._param_groups:
+            p._grad = None
+
+    clear_gradients = clear_grad
+
+    def _apply_decoupled_wd(self, w, lr_v):
+        """AdamW-style decoupled weight decay."""
+        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
+        if wd:
+            return w * (1.0 - lr_v * wd)
+        return w
+
+    def _coupled_wd_grad(self, w, grad):
+        """L2-regularization-style decay added to the gradient."""
+        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
+        if wd:
+            return grad + wd * w
+        return grad
+
+    # -- state dict -----------------------------------------------------------
+    def state_dict(self) -> dict:
+        # keyed by parameter position (names may repeat across layers)
+        pid_to_idx = {id(p): i for i, p in enumerate(self._param_groups)}
+        accs = {}
+        for name, store in self._accumulators.items():
+            accs[name] = {str(pid_to_idx[pid]): Tensor(v)
+                          for pid, v in store.items() if pid in pid_to_idx}
+        out = {"accumulators": accs, "step": self._step_count,
+               "master": {str(pid_to_idx[pid]): Tensor(v)
+                          for pid, v in self._master.items()
+                          if pid in pid_to_idx}}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state: dict) -> None:
+        idx_to_pid = {str(i): id(p) for i, p in enumerate(self._param_groups)}
+        self._step_count = state.get("step", 0)
+        for name, store in state.get("accumulators", {}).items():
+            self._accumulators[name] = {
+                idx_to_pid[k]: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                for k, v in store.items()}
+        self._master = {
+            idx_to_pid[k]: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+            for k, v in state.get("master", {}).items()}
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        self._write_param(p, w - lr_v * g)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        v = self._acc("velocity", p)
+        v = self._momentum * v + g
+        self._set_acc("velocity", p, v)
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        self._write_param(p, w - lr_v * upd)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        m = self._acc("moment", p,
+                      init=jnp.full(p._data.shape, self._init_acc,
+                                    jnp.float32 if _is_low_precision(p.dtype)
+                                    else p.dtype))
+        m = m + jnp.square(g)
+        self._set_acc("moment", p, m)
+        self._write_param(p, w - lr_v * g / (jnp.sqrt(m) + self._eps))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_upd = self._acc("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * jnp.square(g)
+        upd = jnp.sqrt(avg_upd + self._eps) / jnp.sqrt(avg_sq + self._eps) * g
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * jnp.square(upd)
+        self._set_acc("avg_squared_grad", p, avg_sq)
+        self._set_acc("avg_squared_update", p, avg_upd)
+        self._write_param(p, w - lr_v * upd)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        ms = self._acc("mean_square", p)
+        ms = self._rho * ms + (1 - self._rho) * jnp.square(g)
+        self._set_acc("mean_square", p, ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_acc("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._acc("momentum", p)
+        mom = self._momentum * mom + lr_v * g / denom
+        self._set_acc("momentum", p, mom)
+        self._write_param(p, w - mom)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._b1 = beta1
+        self._b2 = beta2
+        self._eps = epsilon
+        self._amsgrad = amsgrad
+
+    def _decay(self, w, g, lr_v):
+        # plain Adam: coupled (L2) decay
+        return self._coupled_wd_grad(w, g), w
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = grad.astype(w.dtype)
+        g, w = self._decay(w, g, lr_v)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        t = self._step_count
+        m = self._b1 * m + (1 - self._b1) * g
+        v = self._b2 * v + (1 - self._b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._b1 ** t)
+        vhat = v / (1 - self._b2 ** t)
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", p)
+            vmax = jnp.maximum(vmax, vhat)
+            self._set_acc("moment2_max", p, vmax)
+            vhat = vmax
+        self._write_param(p, w - lr_v * mhat / (jnp.sqrt(vhat) + self._eps))
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad)
+        self._apply_decay_fn = apply_decay_param_fun
+        self._decay_pids = None
+
+    def _update_param(self, p, grad, lr_v):
+        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
+        do_decay = True
+        if self._apply_decay_fn is not None:
+            do_decay = self._apply_decay_fn(p.name) if p.name else True
+        w = self._master_weight(p)
+        if wd and do_decay:
+            w = w * (1.0 - lr_v * wd)
+            pid = id(p)
+            if pid in self._master:
+                self._master[pid] = w
+        g = grad.astype(w.dtype)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        t = self._step_count
+        m = self._b1 * m + (1 - self._b1) * g
+        v = self._b2 * v + (1 - self._b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._b1 ** t)
+        vhat = v / (1 - self._b2 ** t)
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", p)
+            vmax = jnp.maximum(vmax, vhat)
+            self._set_acc("moment2_max", p, vmax)
+            vhat = vmax
+        self._write_param(p, w - lr_v * mhat / (jnp.sqrt(vhat) + self._eps))
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        t = self._step_count
+        m = self._b1 * m + (1 - self._b1) * g
+        u = jnp.maximum(self._b2 * u, jnp.abs(g))
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, u)
+        self._write_param(p, w - lr_v / (1 - self._b1 ** t) * m / (u + self._eps))
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, multi_precision)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = grad.astype(w.dtype)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        t = self._step_count
+        m = self._b1 * m + (1 - self._b1) * g
+        v = self._b2 * v + (1 - self._b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._b1 ** t)
+        vhat = v / (1 - self._b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        upd = r + wd * w
+        w_norm = jnp.linalg.norm(w)
+        u_norm = jnp.linalg.norm(upd)
+        trust = jnp.where(jnp.logical_and(w_norm > 0, u_norm > 0),
+                          w_norm / u_norm, 1.0)
+        self._write_param(p, w - lr_v * trust * upd)

@@ -23,3 +23,9 @@ import jax  # noqa: E402
 # numerics tests compare against f32 references; the TPU-idiomatic low default
 # (bf16 MXU passes) is exercised explicitly by the kernel/perf tests instead
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# persistent compilation cache: the suite is compile-bound; cached XLA
+# executables cut full-suite time from ~20min to a few minutes on reruns
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -1,0 +1,181 @@
+"""to_static / functional_call: traced == eager, compile caching, export."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import jit
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 16)
+        self.fc2 = nn.Linear(16, 2)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.fc1(x)))
+
+
+def test_traced_equals_eager():
+    paddle.seed(0)
+    net = Net()
+    x = paddle.rand([3, 4])
+    eager = net(x).numpy()
+    snet = jit.to_static(net)
+    traced = snet(x).numpy()
+    np.testing.assert_allclose(traced, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_functional_call_pure():
+    net = Net()
+    x = paddle.rand([2, 4])
+    state = jit.extract_state(net)
+    out1 = jit.functional_call(net, state, x)
+    out2 = net(x)
+    np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-6)
+
+
+def test_to_static_fn_with_closure():
+    net = Net()
+    x = paddle.rand([2, 4])
+
+    @jit.to_static
+    def step(inp):
+        return net(inp).sum()
+
+    v1 = step(x)
+    v2 = net(x).sum()
+    assert v1.item() == pytest.approx(v2.item(), rel=1e-5)
+
+
+def test_traced_training_with_tape():
+    """Whole train step (forward+backward+sgd) traced as one XLA program."""
+    paddle.seed(1)
+    net = Net()
+    lr = 0.1
+
+    @jit.to_static
+    def train_step(x, y):
+        out = net(x)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        with paddle.no_grad():
+            for p in net.parameters():
+                p._data = p._data - lr * p.grad._data
+                p._grad = None
+        return loss
+
+    x = paddle.rand([8, 4])
+    y = paddle.rand([8, 2])
+    losses = [train_step(x, y).item() for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_param_update_visible_after_trace():
+    net = Net()
+    before = net.fc1.weight.numpy().copy()
+
+    @jit.to_static
+    def mutate():
+        net.fc1.weight._data = net.fc1.weight._data + 1.0
+        return paddle.to_tensor(0.0)
+
+    mutate()
+    np.testing.assert_allclose(net.fc1.weight.numpy(), before + 1.0, rtol=1e-6)
+
+
+def test_dropout_under_trace_differs_per_call():
+    drop = nn.Dropout(0.5)
+
+    @jit.to_static
+    def f(x):
+        return drop(x)
+
+    x = paddle.ones([1000])
+    a = f(x).numpy()
+    b = f(x).numpy()
+    assert (a == 0).any() and (b == 0).any()
+    assert not np.array_equal(a, b)  # per-call rng folding
+
+
+def test_jit_save_exports_stablehlo(tmp_path):
+    net = Net()
+    net.eval()
+    p = str(tmp_path / "model")
+    jit.save(net, p, input_spec=[([1, 4], np.float32)])
+    import os
+    assert os.path.exists(p + ".pdparams")
+    text = open(p + ".stablehlo.txt").read()
+    assert "stablehlo" in text or "func.func" in text
+
+
+def test_dynamic_shape_op_raises_under_trace():
+    net = Net()
+
+    @jit.to_static
+    def bad(x):
+        return paddle.nonzero(x)
+
+    with pytest.raises(NotImplementedError):
+        bad(paddle.rand([4]))
+
+
+def test_global_layer_discovered(tmp_path):
+    """Layers referenced as module globals (not closures) are found."""
+    import textwrap, subprocess, sys, os
+    script = tmp_path / "g.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import jit
+
+        net = nn.Linear(4, 2)
+
+        @jit.to_static
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            return loss
+
+        step(paddle.rand([3, 4]))
+        import jax
+        assert isinstance(net.weight.grad._data, jax.Array), "grad leaked tracer"
+        print("GLOBAL-OK")
+    """))
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    out = subprocess.run([sys.executable, str(script)], capture_output=True,
+                         text=True, env=env)
+    assert "GLOBAL-OK" in out.stdout, out.stderr
+
+
+def test_mode_switch_retraces():
+    net = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.9))
+    snet = jit.to_static(net)
+    x = paddle.ones([4, 8])
+    net.train()
+    a = snet(x).numpy()
+    net.eval()
+    b = snet(x).numpy()
+    assert (a == 0).any() and not (b == 0).any()
+
+
+def test_grad_accumulation_across_traced_calls():
+    net = Net()
+    snet = jit.to_static(lambda x: _loss(net, x))
+    x = paddle.rand([2, 4])
+    g1 = None
+    snet(x)
+    g1 = net.fc1.weight.grad.numpy().copy()
+    snet(x)  # second call accumulates
+    np.testing.assert_allclose(net.fc1.weight.grad.numpy(), 2 * g1, rtol=1e-4)
+
+
+def _loss(net, x):
+    l = net(x).sum()
+    l.backward()
+    return l

@@ -1,0 +1,231 @@
+"""nn.Layer machinery, layers, functional, losses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def test_layer_params_and_state_dict():
+    paddle.seed(0)
+    m = MLP()
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    sd = m.state_dict()
+    m2 = MLP()
+    missing, unexpected = m2.set_state_dict(sd)
+    assert not missing and not unexpected
+    np.testing.assert_allclose(m2.fc1.weight.numpy(), m.fc1.weight.numpy())
+
+
+def test_layer_forward_backward():
+    paddle.seed(1)
+    m = MLP()
+    x = paddle.rand([3, 4])
+    y = m(x)
+    assert y.shape == [3, 2]
+    loss = y.sum()
+    loss.backward()
+    for p in m.parameters():
+        assert p.grad is not None, p.name
+
+
+def test_train_eval_mode_dropout():
+    m = nn.Dropout(0.5)
+    x = paddle.ones([100])
+    m.eval()
+    np.testing.assert_allclose(m(x).numpy(), x.numpy())
+    m.train()
+    out = m(x)
+    assert (out.numpy() == 0).any()
+
+
+def test_sequential_and_layerlist():
+    m = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+    x = paddle.rand([2, 4])
+    assert m(x).shape == [2, 2]
+    ll = nn.LayerList([nn.Linear(3, 3) for _ in range(4)])
+    assert len(list(ll.parameters())) == 8
+
+
+def test_layer_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h = m.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    m(paddle.rand([1, 2]))
+    assert calls
+    h.remove()
+
+
+def test_layer_to_dtype():
+    m = MLP()
+    m.to(dtype="bfloat16")
+    assert str(m.fc1.weight.dtype) == "bfloat16"
+    m.float()
+    assert m.fc1.weight.dtype == np.float32
+
+
+def test_layernorm_matches_reference():
+    x = paddle.rand([4, 10])
+    ln = nn.LayerNorm(10)
+    out = ln(x).numpy()
+    a = x.numpy()
+    ref = (a - a.mean(-1, keepdims=True)) / np.sqrt(a.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm():
+    x = paddle.rand([2, 8])
+    rn = nn.RMSNorm(8)
+    a = x.numpy()
+    ref = a / np.sqrt((a ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(rn(x).numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.rand([4, 3, 5, 5]) * 2 + 1
+    y = bn(x)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_conv2d_matches_manual():
+    paddle.seed(3)
+    conv = nn.Conv2D(2, 4, 3, padding=1)
+    x = paddle.rand([1, 2, 8, 8])
+    out = conv(x)
+    assert out.shape == [1, 4, 8, 8]
+    # compare against jax.lax reference directly
+    ref = jax.lax.conv_general_dilated(
+        x._data, conv.weight._data, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = ref + conv.bias._data.reshape(1, 4, 1, 1)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_pooling():
+    x = paddle.arange(16, dtype="float32").reshape([1, 1, 4, 4])
+    mp = nn.MaxPool2D(2, 2)
+    np.testing.assert_allclose(mp(x).numpy().reshape(2, 2),
+                               [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2, 2)
+    np.testing.assert_allclose(ap(x).numpy().reshape(2, 2),
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor([[0, 1], [2, 0]], dtype="int32")
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+
+
+def test_cross_entropy_matches_jax():
+    logits_np = np.random.RandomState(0).randn(6, 5).astype(np.float32)
+    labels_np = np.array([0, 1, 2, 3, 4, 0])
+    x = paddle.to_tensor(logits_np, stop_gradient=False)
+    loss = F.cross_entropy(x, paddle.to_tensor(labels_np))
+    lp = jax.nn.log_softmax(logits_np)
+    ref = -lp[np.arange(6), labels_np].mean()
+    assert loss.item() == pytest.approx(float(ref), rel=1e-5)
+    loss.backward()
+    assert x.grad is not None
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.rand([4, 5])
+    labels = paddle.to_tensor([0, -100, 2, -100])
+    loss = F.cross_entropy(logits, labels)
+    l0 = F.cross_entropy(logits[0:1], paddle.to_tensor([0]))
+    l2 = F.cross_entropy(logits[2:3], paddle.to_tensor([2]))
+    assert loss.item() == pytest.approx((l0.item() + l2.item()) / 2, rel=1e-5)
+
+
+def test_bce_with_logits_stable():
+    z = paddle.to_tensor([100.0, -100.0], stop_gradient=False)
+    lab = paddle.to_tensor([1.0, 0.0])
+    loss = F.binary_cross_entropy_with_logits(z, lab)
+    assert np.isfinite(loss.item())
+    assert loss.item() == pytest.approx(0.0, abs=1e-6)
+
+
+def test_multihead_attention():
+    paddle.seed(5)
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.rand([2, 6, 16])
+    out = mha(x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    enc = nn.TransformerEncoder(
+        nn.TransformerEncoderLayer(d_model=16, nhead=2, dim_feedforward=32),
+        num_layers=2)
+    enc.eval()
+    x = paddle.rand([2, 5, 16])
+    assert enc(x).shape == [2, 5, 16]
+
+
+def test_sdpa_causal():
+    q = paddle.rand([1, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+    # first position attends only to itself → equals v[0]
+    np.testing.assert_allclose(out.numpy()[0, 0], q.numpy()[0, 0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_simple():
+    # T=4, B=1, C=3 (blank=0); label "12"
+    logits = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 1, 3).astype(np.float32),
+        stop_gradient=False)
+    labels = paddle.to_tensor(np.array([[1, 2]], np.int32))
+    loss = F.ctc_loss(logits, labels, paddle.to_tensor([4]),
+                      paddle.to_tensor([2]))
+    assert np.isfinite(loss.item()) and loss.item() > 0
+    loss.backward()
+    assert np.isfinite(logits.grad.numpy()).all()
+
+
+def test_ctc_loss_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(1)
+    T, B, C, L = 8, 3, 5, 3
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = rng.randint(1, C, (B, L)).astype(np.int32)
+    in_len = np.array([8, 7, 6])
+    lab_len = np.array([3, 2, 3])
+
+    ours = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                      reduction="none")
+
+    t_logp = torch.log_softmax(torch.tensor(logits), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        t_logp, torch.tensor(labels.astype(np.int64)),
+        torch.tensor(in_len), torch.tensor(lab_len),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(ours.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)

@@ -1,0 +1,208 @@
+"""Optimizers (vs analytic/torch refs), LR schedulers, AMP, DataLoader."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, TensorDataset)
+
+
+def _quad_problem(optimizer_cls, steps=120, **kw):
+    paddle.seed(0)
+    w = nn.Parameter(paddle.to_tensor([5.0, -3.0])._data)
+    o = optimizer_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor([1.0, 2.0])) ** 2).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    return w.numpy()
+
+
+def test_sgd_converges():
+    w = _quad_problem(opt.SGD, learning_rate=0.1)
+    np.testing.assert_allclose(w, [1, 2], atol=1e-3)
+
+
+def test_momentum_converges():
+    w = _quad_problem(opt.Momentum, learning_rate=0.05, momentum=0.9)
+    np.testing.assert_allclose(w, [1, 2], atol=1e-2)
+
+
+def test_adam_converges():
+    w = _quad_problem(opt.Adam, learning_rate=0.3)
+    np.testing.assert_allclose(w, [1, 2], atol=1e-2)
+
+
+def test_adamw_matches_torch():
+    torch = pytest.importorskip("torch")
+    w0 = np.array([[0.5, -0.3], [0.2, 0.8]], np.float32)
+    g = np.array([[0.1, -0.2], [0.3, 0.05]], np.float32)
+
+    p = nn.Parameter(w0.copy())
+    o = opt.AdamW(learning_rate=0.01, parameters=[p], weight_decay=0.1)
+    for _ in range(5):
+        p.grad = paddle.to_tensor(g)
+        o.step()
+        o.clear_grad()
+
+    tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+    to = torch.optim.AdamW([tp], lr=0.01, weight_decay=0.1, eps=1e-8)
+    for _ in range(5):
+        tp.grad = torch.tensor(g)
+        to.step()
+        to.zero_grad()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_multi_precision_master_weights():
+    w = nn.Parameter(paddle.ones([4], dtype="bfloat16")._data)
+    o = opt.AdamW(learning_rate=1e-3, parameters=[w], multi_precision=True)
+    for _ in range(3):
+        w.grad = paddle.full([4], 0.001, dtype="bfloat16")
+        o.step()
+        o.clear_grad()
+    assert str(w.dtype) == "bfloat16"
+    # master weights moved with f32 resolution (updates smaller than bf16 ulp)
+    master = list(o._master.values())[0]
+    assert master.dtype == np.float32
+    assert not np.allclose(np.asarray(master), 1.0)
+
+
+def test_grad_clip_global_norm():
+    w = nn.Parameter(paddle.zeros([2])._data)
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    o = opt.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+    w.grad = paddle.to_tensor([3.0, 4.0])  # norm 5 → scaled to 1
+    o.step()
+    np.testing.assert_allclose(np.linalg.norm(w.numpy()), 1.0, rtol=1e-5)
+
+
+def test_lr_schedulers():
+    s = opt.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    lrs = []
+    for _ in range(12):
+        lrs.append(s())
+        s.step()
+    assert lrs[0] == 0.0 and abs(lrs[5] - 0.05) < 1e-9 and lrs[11] == 0.1
+
+    c = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+    c.step(10)
+    assert c() == pytest.approx(0.0, abs=1e-9)
+
+    w = nn.Parameter(paddle.zeros([1])._data)
+    o = opt.SGD(learning_rate=s, parameters=[w])
+    assert o.get_lr() == s()
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    w = nn.Parameter(paddle.ones([2])._data)
+    o = opt.Adam(learning_rate=0.1, parameters=[w])
+    w.grad = paddle.to_tensor([0.5, 0.5])
+    o.step()
+    sd = o.state_dict()
+    paddle.save(sd, str(tmp_path / "opt.pdopt"))
+    loaded = paddle.load(str(tmp_path / "opt.pdopt"))
+
+    w2 = nn.Parameter(paddle.ones([2])._data)
+    o2 = opt.Adam(learning_rate=0.1, parameters=[w2])
+    o2.set_state_dict(loaded)
+    assert o2._step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(o2._accumulators["moment1"][id(w2)]),
+        np.asarray(o._accumulators["moment1"][id(w)]))
+
+
+def test_amp_auto_cast_o1():
+    import paddle_tpu.amp as amp
+    a = paddle.rand([4, 4])
+    b = paddle.rand([4, 4])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        c = paddle.matmul(a, b)       # white list → bf16
+        d = paddle.exp(a)             # black list → f32
+    assert str(c.dtype) == "bfloat16"
+    assert d.dtype == np.float32
+    e = paddle.matmul(a, b)
+    assert e.dtype == np.float32  # outside context
+
+
+def test_grad_scaler_skips_on_inf():
+    import paddle_tpu.amp as amp
+    w = nn.Parameter(paddle.ones([1])._data)
+    o = opt.SGD(learning_rate=1.0, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=2.0)
+    w.grad = paddle.to_tensor([float("inf")])
+    scaler.step(o)
+    np.testing.assert_allclose(w.numpy(), [1.0])  # skipped
+    assert scaler.get_loss_scaling() == 1.0  # decreased
+
+    w.grad = paddle.to_tensor([2.0])
+    scaler.step(o)
+    np.testing.assert_allclose(w.numpy(), [-1.0])  # applied unscaled (2/1)
+
+
+class _SquareDS(Dataset):
+    def __len__(self):
+        return 20
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+
+def test_dataloader_basic():
+    dl = DataLoader(_SquareDS(), batch_size=4, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4]
+    np.testing.assert_allclose(y.numpy(), x.numpy() ** 2)
+
+
+def test_dataloader_shuffle_and_workers():
+    paddle.seed(7)
+    dl = DataLoader(_SquareDS(), batch_size=5, shuffle=True, num_workers=2)
+    xs = np.concatenate([b[0].numpy() for b in dl])
+    assert sorted(xs.tolist()) == list(range(20))
+    assert xs.tolist() != list(range(20))
+
+
+def test_distributed_batch_sampler_shards():
+    ds = _SquareDS()
+    all_idx = []
+    for rank in range(4):
+        bs = DistributedBatchSampler(ds, batch_size=5, num_replicas=4,
+                                     rank=rank)
+        idx = [i for batch in bs for i in batch]
+        assert len(idx) == 5
+        all_idx.extend(idx)
+    assert sorted(all_idx) == list(range(20))
+
+
+def test_tensor_dataset():
+    x = paddle.rand([10, 3])
+    y = paddle.arange(10)
+    ds = TensorDataset([x, y])
+    dl = DataLoader(ds, batch_size=5)
+    bx, by = next(iter(dl))
+    assert bx.shape == [5, 3] and by.shape == [5]
+
+
+def test_amp_backward_through_cast_boundary():
+    """Regression: cast must be inside the vjp'd fn — bf16 linear feeding an
+    f32 blacklist op must backprop without dtype mismatch."""
+    import paddle_tpu.amp as amp
+    net = nn.Linear(8, 4)
+    net.to(dtype="bfloat16")
+    o = opt.AdamW(learning_rate=1e-2, parameters=net.parameters(),
+                  multi_precision=True)
+    x = paddle.rand([4, 8])
+    with amp.auto_cast(level="O2", dtype="bfloat16"):
+        loss = (net(x) ** 2).mean()   # mean is blacklisted → f32
+    loss.backward()
+    assert str(net.weight.grad.dtype) == "bfloat16"
+    o.step()
+    o.clear_grad()
