@@ -119,17 +119,15 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
     if level == "O2":
         for m in model_list:
             m.to(dtype=dt)
+    out_models = model_list[0] if single else model_list
     if optimizers is not None:
         opt_single = not isinstance(optimizers, (list, tuple))
         opt_list = [optimizers] if opt_single else list(optimizers)
         for o in opt_list:
             if master_weight is not False:
                 o._multi_precision = True
-        if optimizers is not None and not opt_single:
-            return model_list, opt_list
-        if optimizers is not None:
-            return (model_list[0] if single else model_list), opt_list[0]
-    return model_list[0] if single else model_list
+        return out_models, (opt_list[0] if opt_single else opt_list)
+    return out_models
 
 
 class GradScaler:
@@ -154,6 +152,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False  # guards the unscale→clip→step pattern
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
@@ -161,8 +160,9 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer) -> None:
-        if not self._enable:
+        if not self._enable or self._unscaled:
             return
+        self._unscaled = True
         found = False
         inv = 1.0 / self._scale
         for p in optimizer._param_groups:
@@ -187,6 +187,7 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self) -> None:
+        self._unscaled = False
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -87,6 +87,8 @@ def placements_to_spec(mesh: Mesh, placements: Sequence[Placement],
                 dims[pl.dim] = dims[pl.dim] + (axis_name,)
             else:
                 dims[pl.dim] = (dims[pl.dim], axis_name)
+    while dims and dims[-1] is None:  # canonical form: no trailing Nones
+        dims.pop()
     return PartitionSpec(*dims)
 
 

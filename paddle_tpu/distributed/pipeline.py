@@ -36,9 +36,11 @@ PP_AXIS = "pp"
 def _pp_shard_map(f, mesh, in_specs, out_specs):
     """shard_map manual ONLY over the pp axis; dp/mp/sharding/sep stay
     'auto' so GSPMD keeps tensor/data parallelism inside each stage body."""
+    # check_vma=True is load-bearing: jax 0.9's eager partial-manual path
+    # (_unmatch) mis-builds an all-axes dst spec when check_vma=False
     return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs,
-                         axis_names=frozenset({PP_AXIS}), check_vma=False)
+                         axis_names=frozenset({PP_AXIS}), check_vma=True)
 
 
 def stack_layer_params(per_layer_states: List[Dict[str, Any]], n_stages: int):
@@ -87,8 +89,10 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
         params = {k: v[0] for k, v in params.items()}
         stage = jax.lax.axis_index(PP_AXIS)
         mb_shape = mbs.shape[1:]
-        state = jnp.zeros(mb_shape, mbs.dtype)       # activation in flight
-        out_buf = jnp.zeros((M,) + mb_shape, mbs.dtype)
+        # pvary: the carry is device-varying over pp from tick 1 on (ppermute
+        # output), so the initial carry must carry the same vma type
+        state = jax.lax.pvary(jnp.zeros(mb_shape, mbs.dtype), PP_AXIS)
+        out_buf = jax.lax.pvary(jnp.zeros((M,) + mb_shape, mbs.dtype), PP_AXIS)
 
         def tick(carry, t):
             state, out_buf = carry
@@ -116,12 +120,13 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
         return out
 
     extra_specs = tuple(P(*([None] * jnp.ndim(e))) for e in extra_args)
-    fn = shard_map(
-        per_device, mesh=mesh,
+    fn = _pp_shard_map(
+        per_device, mesh,
         in_specs=(param_specs, mb_spec) + extra_specs,
-        out_specs=P(*([None] * microbatches.ndim)),
-        check_rep=False)
-    return fn(stacked_params, microbatches, *extra_args)
+        out_specs=P(*([None] * microbatches.ndim)))
+    # jit: eager shard_map can't evaluate the remat-wrapped scan body
+    # (closed_call); a no-op when already inside an outer trace
+    return jax.jit(fn)(stacked_params, microbatches, *extra_args)
 
 
 def _no_pp_fallback(stage_fn, stacked_params, microbatches, extra_args):

@@ -126,9 +126,24 @@ class StaticFunction:
         self._donate = donate_state
         functools.update_wrapper(self, fn, updated=[])
 
+    @staticmethod
+    def _is_static_leaf(x) -> bool:
+        """Outputs jit can't return (Layers, arbitrary objects) are carried
+        around the trace as static values instead of through it. Containers
+        and registered pytrees (incl. Tensor) must recurse, so only default-
+        registry leaves can be static."""
+        import numpy as _np
+        if not jax.tree_util.all_leaves([x]):
+            return False
+        if isinstance(x, (jnp.ndarray, _np.ndarray, int, float, bool,
+                          complex, bytes)) or x is None:
+            return False
+        return not hasattr(x, "__jax_array__")
+
     def _build(self):
         fn = self._fn
         layers = self._layers
+        aux = self._aux = {}
 
         def pure(mode_sig, states, grads, rng_state, args, kwargs):
             # mode_sig is static: a train()/eval() flip retraces (the guard
@@ -148,7 +163,16 @@ class StaticFunction:
                 # grads created/accumulated inside the trace (loss.backward())
                 # must cross the jit boundary as outputs, or they leak tracers
                 new_grads = [extract_grads(l) for l in layers]
-            return out, new_states, new_grads
+            # split static (non-jax) output leaves out of the traced result;
+            # recorded at trace time, re-inserted in __call__ (structure is
+            # assumed stable across signatures, like SOT's guard assumption)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=StaticFunction._is_static_leaf)
+            statics = {i: v for i, v in enumerate(leaves)
+                       if StaticFunction._is_static_leaf(v)}
+            aux["treedef"], aux["statics"] = treedef, statics
+            dyn = [v for i, v in enumerate(leaves) if i not in statics]
+            return dyn, new_states, new_grads
 
         self._compiled = jax.jit(pure, static_argnums=(0,))
 
@@ -163,7 +187,7 @@ class StaticFunction:
         states = [extract_state(l) for l in self._layers]
         grads = [extract_grads(l) for l in self._layers]
         key = default_generator.next_key()
-        out, new_states, new_grads = self._compiled(
+        dyn, new_states, new_grads = self._compiled(
             self._mode_signature(), states, grads, key, args, kwargs)
         for l, s, g in zip(self._layers, new_states, new_grads):
             bind_state(l, s)  # buffers (e.g. BN running stats) updated in trace
@@ -171,7 +195,12 @@ class StaticFunction:
             for t in sd.values():
                 t._grad = None
             bind_grads(l, g)
-        return out
+        treedef, statics = self._aux["treedef"], self._aux["statics"]
+        n_leaves = treedef.num_leaves
+        leaves, it = [], iter(dyn)
+        for i in range(n_leaves):
+            leaves.append(statics[i] if i in statics else next(it))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
     @property
     def code(self) -> str:

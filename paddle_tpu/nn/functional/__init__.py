@@ -411,7 +411,7 @@ def _pair(v, n):
 
 
 def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
-             data_format, transpose=False, output_padding=0):
+             data_format):
     strides = _pair(stride, nd)
     dils = _pair(dilation, nd)
     if isinstance(padding, str):
@@ -433,14 +433,9 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
         tuple(x.shape), tuple(weight.shape), (dn_in, dn_kernel, dn_out))
 
     def impl(a, w, *b):
-        if transpose:
-            out = jax.lax.conv_transpose(
-                a, w, strides, pad if isinstance(pad, str) else pad,
-                rhs_dilation=dils, dimension_numbers=dn, transpose_kernel=True)
-        else:
-            out = jax.lax.conv_general_dilated(
-                a, w, strides, pad, rhs_dilation=dils, dimension_numbers=dn,
-                feature_group_count=groups)
+        out = jax.lax.conv_general_dilated(
+            a, w, strides, pad, rhs_dilation=dils, dimension_numbers=dn,
+            feature_group_count=groups)
         if b:
             shape = [1] * out.ndim
             ch_axis = 1 if data_format.startswith("NC") else out.ndim - 1
@@ -469,21 +464,94 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
                     data_format)
 
 
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       groups, dilation, nd, data_format):
+    """Transpose conv as an input-dilated forward conv (the gradient trick —
+    supports groups, dilation, and output_padding uniformly; XLA lowers the
+    lhs_dilation form straight onto the MXU).
+
+    Paddle transpose-weight layout: [in, out/groups, *k]. Output size per dim:
+    (in-1)*stride - 2*pad + dilation*(k-1) + 1 + output_padding.
+    """
+    strides = _pair(stride, nd)
+    dils = _pair(dilation, nd)
+    pads = _pair(padding, nd)
+    opads = _pair(output_padding, nd)
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            "string padding for conv_transpose is not supported; pass "
+            "explicit integers")
+    nc = data_format.startswith("NC")
+    dn_in = ("NC" + "DHW"[3 - nd:]) if nc else ("N" + "DHW"[3 - nd:] + "C")
+    dn = (dn_in, "OI" + "DHW"[3 - nd:], dn_in)
+
+    def impl(a, w, *b):
+        g = groups
+        cin = w.shape[0]
+        og = w.shape[1]
+        k = w.shape[2:]
+        # [in, out/g, *k] -> [g, in/g, out/g, *k] -> [g*out/g, in/g, *k]
+        wg = w.reshape((g, cin // g, og) + k)
+        wg = jnp.moveaxis(wg, 2, 1).reshape((g * og, cin // g) + k)
+        wg = jnp.flip(wg, axis=tuple(range(2, 2 + nd)))
+        pad_cfg = []
+        for i in range(nd):
+            k_eff = dils[i] * (k[i] - 1) + 1
+            lo = k_eff - 1 - pads[i]
+            hi = k_eff - 1 - pads[i] + opads[i]
+            pad_cfg.append((lo, hi))
+        dnums = jax.lax.conv_dimension_numbers(
+            tuple(a.shape), tuple(wg.shape), dn)
+        out = jax.lax.conv_general_dilated(
+            a, wg, (1,) * nd, pad_cfg, lhs_dilation=strides,
+            rhs_dilation=dils, dimension_numbers=dnums,
+            feature_group_count=g)
+        if b:
+            shape = [1] * out.ndim
+            ch_axis = 1 if nc else out.ndim - 1
+            shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply("conv%dd_transpose" % nd, impl, args)
+
+
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCL", name=None):
-    # weight layout for transpose in paddle: [in, out/groups, k]
-    w = weight.transpose([1, 0, 2]) if isinstance(weight, Tensor) else weight
-    return _conv_nd(x, w, bias, stride, padding, dilation, groups, 1,
-                    "NCW" if data_format == "NCL" else "NWC", transpose=True)
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 1,
+                              "NCW" if data_format == "NCL" else "NWC")
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCHW", name=None):
-    w = weight.transpose([1, 0, 2, 3]) if isinstance(weight, Tensor) else weight
-    return _conv_nd(x, w, bias, stride, padding, dilation, groups, 2,
-                    data_format, transpose=True)
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 2,
+                              data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 3,
+                              data_format)
+
+
+def _pool_pads(in_sizes, ks, st, pd, ceil_mode):
+    """Per-dim (lo, hi) spatial padding; ceil_mode adds extra hi padding so
+    the window count is ceil((in + 2p - k)/s) + 1 like the reference."""
+    pairs = []
+    for insz, k, s, p in zip(in_sizes, ks, st, pd):
+        hi = p
+        if ceil_mode:
+            n_floor = (insz + 2 * p - k) // s
+            n_ceil = -((insz + 2 * p - k) // -s)
+            hi = p + (n_ceil - n_floor) * s
+        pairs.append((p, hi))
+    return pairs
 
 
 def _pool_nd(x, kernel, stride, padding, nd, data_format, reducer, init,
@@ -492,20 +560,23 @@ def _pool_nd(x, kernel, stride, padding, nd, data_format, reducer, init,
     st = _pair(stride if stride is not None else kernel, nd)
     pd = _pair(padding, nd)
     nc = data_format.startswith("NC")
+    in_sizes = x.shape[2:] if nc else x.shape[1:-1]
+    sp_pairs = _pool_pads(in_sizes, ks, st, pd, ceil_mode)
     if nc:
         window = (1, 1) + ks
         strides = (1, 1) + st
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        pads = ((0, 0), (0, 0)) + tuple(sp_pairs)
     else:
         window = (1,) + ks + (1,)
         strides = (1,) + st + (1,)
-        pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+        pads = ((0, 0),) + tuple(sp_pairs) + ((0, 0),)
+    padded = any(lo or hi for lo, hi in sp_pairs)
 
     def impl(a):
         out = jax.lax.reduce_window(a, init(a.dtype), reducer, window,
                                     strides, pads)
         if average:
-            if exclusive and any(p for p in pd):
+            if exclusive and padded:
                 ones = jnp.ones_like(a)
                 counts = jax.lax.reduce_window(
                     ones, jnp.zeros((), a.dtype), jax.lax.add, window,
@@ -517,29 +588,84 @@ def _pool_nd(x, kernel, stride, padding, nd, data_format, reducer, init,
     return apply("pool", impl, [x])
 
 
+def _max_pool_mask(x, ks, st, pd, nd, ceil_mode):
+    """Global flat spatial argmax index per window (paddle return_mask
+    semantics), via patch extraction — NCHW-family layouts only."""
+    in_sizes = x.shape[2:]
+    sp_pairs = _pool_pads(in_sizes, ks, st, pd, ceil_mode)
+
+    def impl(a):
+        n, c = a.shape[:2]
+        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
+        ap = jnp.pad(a, ((0, 0), (0, 0)) + tuple(sp_pairs),
+                     constant_values=neg)
+        patches = jax.lax.conv_general_dilated_patches(
+            ap, ks, st, [(0, 0)] * nd)
+        # patches: [N, C*prod(ks), *out_spatial]; local argmax per window
+        out_sp = patches.shape[2:]
+        pk = int(np.prod(ks))
+        patches = patches.reshape((n, c, pk) + out_sp)
+        local = jnp.argmax(patches, axis=2)  # [N, C, *out_spatial]
+        # local index -> per-dim kernel offsets -> global padded coords ->
+        # unpadded global flat index over the input spatial plane
+        rem = local
+        coords = []
+        for d in range(nd - 1, -1, -1):
+            coords.insert(0, rem % ks[d])
+            rem = rem // ks[d]
+        flat = jnp.zeros_like(local)
+        for d in range(nd):
+            win_start = (jnp.arange(out_sp[d]) * st[d] - sp_pairs[d][0])
+            shape = [1] * local.ndim
+            shape[2 + d] = out_sp[d]
+            g = coords[d] + win_start.reshape(shape)
+            flat = flat * in_sizes[d] + g
+        return flat.astype(jnp.int32)
+    return apply("max_pool_mask", impl, [x])
+
+
+def _max_pool(x, kernel_size, stride, padding, nd, data_format, ceil_mode,
+              return_mask):
+    out = _pool_nd(x, kernel_size, stride, padding, nd, data_format,
+                   jax.lax.max, lambda dt: jnp.asarray(-jnp.inf, dt)
+                   if jnp.issubdtype(dt, jnp.floating)
+                   else jnp.asarray(jnp.iinfo(dt).min, dt),
+                   ceil_mode=ceil_mode)
+    if not return_mask:
+        return out
+    if not data_format.startswith("NC"):
+        raise NotImplementedError("return_mask requires an NC* data_format")
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pd = _pair(padding, nd)
+    mask = _max_pool_mask(x, ks, st, pd, nd, ceil_mode)
+    return out, mask
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 1, "NCW",
-                    jax.lax.max, lambda dt: jnp.asarray(-jnp.inf, dt))
+    return _max_pool(x, kernel_size, stride, padding, 1, "NCW", ceil_mode,
+                     return_mask)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 2, data_format,
-                    jax.lax.max, lambda dt: jnp.asarray(-jnp.inf, dt))
+    return _max_pool(x, kernel_size, stride, padding, 2, data_format,
+                     ceil_mode, return_mask)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 3, data_format,
-                    jax.lax.max, lambda dt: jnp.asarray(-jnp.inf, dt))
+    return _max_pool(x, kernel_size, stride, padding, 3, data_format,
+                     ceil_mode, return_mask)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, name=None):
     return _pool_nd(x, kernel_size, stride, padding, 1, "NCW",
                     jax.lax.add, lambda dt: jnp.zeros((), dt), average=True,
-                    exclusive=exclusive)
+                    exclusive=exclusive, ceil_mode=ceil_mode)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -547,7 +673,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                name=None):
     return _pool_nd(x, kernel_size, stride, padding, 2, data_format,
                     jax.lax.add, lambda dt: jnp.zeros((), dt), average=True,
-                    exclusive=exclusive)
+                    exclusive=exclusive, ceil_mode=ceil_mode)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -555,7 +681,7 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                name=None):
     return _pool_nd(x, kernel_size, stride, padding, 3, data_format,
                     jax.lax.add, lambda dt: jnp.zeros((), dt), average=True,
-                    exclusive=exclusive)
+                    exclusive=exclusive, ceil_mode=ceil_mode)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -644,6 +770,9 @@ def softmax_mask_fuse(x, mask, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            # legacy paddle mode: train keeps raw mask, infer scales by (1-p)
+            return apply("dropout", lambda a: a * (1.0 - p), [x])
         return x if isinstance(x, Tensor) else Tensor(x)
     if p == 1.0:
         return apply("dropout", lambda a: jnp.zeros_like(a), [x])
@@ -710,15 +839,50 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         size = [int(s * f) for s, f in zip(in_sp, sf)]
     size = _pair(size, nd)
     nc = data_format.startswith("NC")
+    sp_axes = list(range(2, 2 + nd)) if nc else list(range(1, 1 + nd))
+
+    def _axis_linear_align(a, axis, outsz):
+        """Separable linear resize with align_corners=True coordinates."""
+        insz = a.shape[axis]
+        if outsz == 1 or insz == 1:
+            idx = jnp.zeros((outsz,), jnp.int32)
+            return jnp.take(a, idx, axis=axis)
+        pos = jnp.arange(outsz, dtype=jnp.float32) * ((insz - 1) / (outsz - 1))
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, insz - 2)
+        frac = (pos - lo).astype(a.dtype)
+        shape = [1] * a.ndim
+        shape[axis] = outsz
+        frac = frac.reshape(shape)
+        a_lo = jnp.take(a, lo, axis=axis)
+        a_hi = jnp.take(a, lo + 1, axis=axis)
+        return a_lo * (1 - frac) + a_hi * frac
+
     def impl(a):
         if nc:
-            spatial_shape = a.shape[2:]
             out_shape = a.shape[:2] + tuple(size)
         else:
             out_shape = (a.shape[0],) + tuple(size) + (a.shape[-1],)
+        if mode == "area":
+            # area = adaptive average pooling; integer-ratio downscale only
+            out = a
+            for ax, outsz in zip(sp_axes, size):
+                insz = out.shape[ax]
+                if insz % outsz != 0:
+                    raise NotImplementedError(
+                        "mode='area' needs integer downscale ratios on TPU "
+                        f"(in={insz}, out={outsz})")
+                k = insz // outsz
+                shape = out.shape[:ax] + (outsz, k) + out.shape[ax + 1:]
+                out = jnp.mean(out.reshape(shape), axis=ax + 1)
+            return out
+        if align_corners and mode in ("linear", "bilinear", "trilinear"):
+            out = a
+            for ax, outsz in zip(sp_axes, size):
+                out = _axis_linear_align(out, ax, outsz)
+            return out
         method = {"nearest": "nearest", "bilinear": "linear",
                   "linear": "linear", "trilinear": "linear",
-                  "bicubic": "cubic", "area": "linear"}[mode]
+                  "bicubic": "cubic"}[mode]
         return jax.image.resize(a, out_shape, method=method)
     return apply("interpolate", impl, [x])
 

@@ -90,6 +90,12 @@ class Layer:
         if params is None:
             object.__setattr__(self, name, value)
             return
+        # assigning a Tensor to a registered buffer re-binds the buffer
+        # (paddle/torch semantics) rather than unregistering it
+        if (bufs is not None and name in bufs and isinstance(value, Tensor)
+                and not isinstance(value, Parameter)):
+            bufs[name] = value
+            return
         for store in (params, subs, bufs):
             store.pop(name, None)
         if isinstance(value, Parameter):
@@ -380,7 +386,14 @@ class LayerList(Layer):
     def __getitem__(self, idx):
         if isinstance(idx, slice):
             return list(self.children())[idx]
-        return self.__dict__["_sub_layers"][str(idx % max(len(self), 1))]
+        n = len(self)
+        i = int(idx)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(f"index {idx} out of range for LayerList of "
+                             f"length {n}")
+        return self.__dict__["_sub_layers"][str(i)]
 
     def __setitem__(self, idx, layer):
         self.__dict__["_sub_layers"][str(idx)] = layer

@@ -20,6 +20,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
+# env var alone loses to the preinstalled axon PJRT plugin in this image; the
+# config update is authoritative
+jax.config.update("jax_platforms", "cpu")
+
 # numerics tests compare against f32 references; the TPU-idiomatic low default
 # (bf16 MXU passes) is exercised explicitly by the kernel/perf tests instead
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,165 @@
+"""Regression tests for the round-1 code-review findings (torch CPU is the
+numerical reference for the functional ops, mirroring the reference's OpTest
+check_output-vs-reference triangle, SURVEY §4.1)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def t2n(t):
+    return t.detach().numpy()
+
+
+# -- conv transpose: output_padding + groups --------------------------------
+@pytest.mark.parametrize("groups,output_padding,stride,pad,dil", [
+    (1, 0, 2, 1, 1),
+    (1, 1, 2, 1, 1),
+    (2, 0, 2, 0, 1),
+    (2, 1, 3, 1, 2),
+])
+def test_conv2d_transpose_matches_torch(groups, output_padding, stride, pad,
+                                        dil):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    w = rng.randn(4, 6 // groups, 3, 3).astype(np.float32)  # [in, out/g, k, k]
+    b = rng.randn(6).astype(np.float32)
+    ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                              torch.tensor(b), stride=stride, padding=pad,
+                              output_padding=output_padding, groups=groups,
+                              dilation=dil)
+    out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             paddle.to_tensor(b), stride=stride, padding=pad,
+                             output_padding=output_padding, groups=groups,
+                             dilation=dil)
+    assert tuple(out.shape) == tuple(ref.shape)
+    np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_conv1d_transpose_matches_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 9).astype(np.float32)
+    w = rng.randn(4, 3, 5).astype(np.float32)
+    ref = TF.conv_transpose1d(torch.tensor(x), torch.tensor(w), stride=2,
+                              padding=2, output_padding=1)
+    out = F.conv1d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             stride=2, padding=2, output_padding=1)
+    assert tuple(out.shape) == tuple(ref.shape)
+    np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=2e-4, atol=2e-4)
+
+
+# -- max_pool: return_mask + ceil_mode --------------------------------------
+@pytest.mark.parametrize("ceil_mode", [False, True])
+def test_max_pool2d_mask_and_ceil(ceil_mode):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    ref, ref_idx = TF.max_pool2d(torch.tensor(x), 3, stride=2, padding=1,
+                                 ceil_mode=ceil_mode, return_indices=True)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                             ceil_mode=ceil_mode, return_mask=True)
+    assert tuple(out.shape) == tuple(ref.shape)
+    np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), t2n(ref_idx))
+
+
+def test_avg_pool2d_ceil_mode_shape():
+    x = paddle.rand([1, 2, 7, 7])
+    out = F.avg_pool2d(x, 3, stride=2, padding=0, ceil_mode=True)
+    ref = TF.avg_pool2d(torch.tensor(x.numpy()), 3, stride=2, padding=0,
+                        ceil_mode=True)
+    assert tuple(out.shape) == tuple(ref.shape)
+    np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-5)
+
+
+# -- interpolate: align_corners + area --------------------------------------
+def test_interpolate_align_corners_matches_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    ref = TF.interpolate(torch.tensor(x), size=(9, 11), mode="bilinear",
+                         align_corners=True)
+    out = F.interpolate(paddle.to_tensor(x), size=(9, 11), mode="bilinear",
+                        align_corners=True)
+    np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_interpolate_area_matches_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    ref = TF.interpolate(torch.tensor(x), size=(4, 4), mode="area")
+    out = F.interpolate(paddle.to_tensor(x), size=(4, 4), mode="area")
+    np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-5)
+
+
+# -- dropout downscale_in_infer ---------------------------------------------
+def test_dropout_downscale_in_infer():
+    x = paddle.ones([4, 4])
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), 0.5 * np.ones((4, 4)), rtol=1e-6)
+    # train path keeps surviving values unscaled
+    out_t = F.dropout(x, p=0.5, training=True, mode="downscale_in_infer")
+    vals = set(np.unique(out_t.numpy()).tolist())
+    assert vals <= {0.0, 1.0}
+
+
+# -- GradScaler unscale-then-step -------------------------------------------
+def test_grad_scaler_no_double_unscale():
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.optimizer import SGD
+    net = nn.Linear(4, 4)
+    opt = SGD(learning_rate=0.0, parameters=net.parameters())
+    scaler = GradScaler(init_loss_scaling=1024.0)
+    x = paddle.ones([2, 4])
+    loss = net(x).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    g1 = net.weight.grad.numpy().copy()
+    scaler.step(opt)          # must NOT unscale a second time
+    scaler.update()
+    np.testing.assert_allclose(g1, np.full((4, 4), 2.0), rtol=1e-6)
+    # next step unscales again after update() reset
+    for p in net.parameters():
+        p.clear_grad()
+    loss = net(x).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(net.weight.grad.numpy(), g1, rtol=1e-6)
+
+
+# -- amp.decorate single model ----------------------------------------------
+def test_amp_decorate_returns_single_model():
+    from paddle_tpu.amp import decorate
+    from paddle_tpu.optimizer import SGD
+    net = nn.Linear(2, 2)
+    o1 = SGD(learning_rate=0.1, parameters=net.parameters())
+    o2 = SGD(learning_rate=0.1, parameters=net.parameters())
+    m, opts = decorate(net, [o1, o2], level="O1")
+    assert m is net
+    assert opts == [o1, o2]
+    m2, o = decorate(net, o1, level="O1")
+    assert m2 is net and o is o1
+    assert decorate(net, level="O1") is net
+
+
+# -- buffer reassignment stays registered -----------------------------------
+def test_buffer_reassignment_keeps_registration():
+    layer = nn.Linear(2, 2)
+    layer.register_buffer("steps", paddle.to_tensor(np.zeros(1, np.float32)))
+    layer.steps = paddle.to_tensor(np.ones(1, np.float32))
+    assert "steps" in dict(layer.named_buffers())
+    assert "steps" in layer.state_dict()
+    np.testing.assert_allclose(layer.state_dict()["steps"].numpy(), [1.0])
+
+
+# -- LayerList out-of-range raises ------------------------------------------
+def test_layerlist_index_error():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert ll[-1] is ll[2]
+    with pytest.raises(IndexError):
+        ll[5]
+    with pytest.raises(IndexError):
+        ll[-4]
