@@ -1,0 +1,95 @@
+"""Benchmark: Llama pretrain tokens/sec/chip on the local device.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline = measured MFU / 0.40 (the BASELINE.json north-star MFU target;
+see BASELINE.md — no published reference throughput exists, so the
+hardware-derived 40%-MFU bar is the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _peak_flops() -> float:
+    """Per-chip peak bf16 FLOP/s for the local device generation."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    table = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}
+    if gen in table:
+        return table[gen]
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for k, v in table.items():
+        if k in kind or ("v5 lite" in kind and k == "v5e"):
+            return v
+    return 197e12  # conservative default
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.trainer.pretrain import (PretrainConfig,
+                                             build_llama_pretrain_step,
+                                             make_hybrid_mesh_for,
+                                             flops_per_token)
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    # ~350M-param Llama proxy that fits one chip with f32 master + Adam state;
+    # the flagship 8B config needs the multi-chip path (dryrun-validated).
+    if on_tpu:
+        mc = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                         intermediate_size=2816, num_hidden_layers=16,
+                         num_attention_heads=16, num_key_value_heads=8,
+                         max_position_embeddings=2048,
+                         sequence_parallel=False)
+        batch, seq, steps = 8, 2048, 5
+    else:  # CI smoke fallback
+        mc = LlamaConfig(vocab_size=512, hidden_size=128,
+                         intermediate_size=256, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=256,
+                         sequence_parallel=False)
+        batch, seq, steps = 4, 128, 2
+
+    cfg = PretrainConfig(mc, global_batch=batch, seq_len=seq,
+                         n_microbatches=1, param_dtype="bfloat16")
+    mesh = make_hybrid_mesh_for(cfg, devices=jax.devices()[:1])
+    state, train_step, meta = build_llama_pretrain_step(cfg, mesh)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, mc.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, mc.vocab_size, (batch, seq)),
+                         jnp.int32)
+
+    # warmup (compile)
+    state, metrics = train_step(state, ids, labels)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, ids, labels)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_sec = tokens / dt
+    fpt = flops_per_token(mc)  # 6N fwd+bwd weight FLOPs per token
+    mfu = tok_per_sec * fpt / _peak_flops()
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,27 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
         return _no_pp_fallback(stage_fn, stacked_params, microbatches,
                                extra_args)
 
+    # XLA CPU crashes ("Invalid binary instruction opcode copy") on sub-f32
+    # psum under partial-manual sharding — both our output psum and the psums
+    # AD inserts when transposing pvary. On the CPU backend (simulated-mesh
+    # tests / dryrun) run the whole pipelined region in f32; TPU keeps bf16.
+    out_dtype = microbatches.dtype
+    if jax.default_backend() == "cpu" and any(
+            jnp.issubdtype(v.dtype, jnp.floating)
+            and jnp.dtype(v.dtype).itemsize < 4
+            for v in jax.tree_util.tree_leaves(
+                (stacked_params, microbatches, extra_args))):
+        up = lambda v: v.astype(jnp.float32) if (
+            jnp.issubdtype(v.dtype, jnp.floating)
+            and jnp.dtype(v.dtype).itemsize < 4) else v
+        stacked_params = jax.tree_util.tree_map(up, stacked_params)
+        microbatches = up(microbatches)
+        extra_args = tuple(jax.tree_util.tree_map(up, e) for e in extra_args)
+        out = spmd_pipeline(stage_fn, stacked_params, microbatches, mesh,
+                            n_microbatches, extra_args=extra_args,
+                            remat=remat)
+        return out.astype(out_dtype)
+
     body = stage_fn
     if remat:
         body = jax.checkpoint(stage_fn)
@@ -113,10 +134,13 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
 
         (state, out_buf), _ = jax.lax.scan(
             tick, (state, out_buf), jnp.arange(M + S - 1))
-        # broadcast last stage's buffer to every pp rank (zeros elsewhere)
-        out = jax.lax.psum(
-            jnp.where(stage == S - 1, out_buf,
-                      jnp.zeros_like(out_buf)), PP_AXIS)
+        # broadcast last stage's buffer to every pp rank (zeros elsewhere).
+        # psum in f32: XLA CPU crashes on sub-f32 psum under partial-manual
+        # sharding ("Invalid binary instruction opcode copy"); f32 is also
+        # the numerically safe accumulation dtype on TPU
+        masked = jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf))
+        out = jax.lax.psum(masked.astype(jnp.float32),
+                           PP_AXIS).astype(out_buf.dtype)
         return out
 
     extra_specs = tuple(P(*([None] * jnp.ndim(e))) for e in extra_args)

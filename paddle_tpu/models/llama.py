@@ -127,8 +127,11 @@ class LlamaAttention(nn.Layer):
             if rep > 1:
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            from ..ops.flash_attention import sdpa_reference
-            o = sdpa_reference(q, k, v, causal=True)
+            from ..ops.flash_attention import sdpa, sdpa_reference
+            if c.use_flash_attention:
+                o = sdpa(q, k, v, causal=True)
+            else:
+                o = sdpa_reference(q, k, v, causal=True)
             return o.reshape(B, S, -1) @ wo
         return _apply("llama_attention", impl,
                       [x, self.q_proj.weight, self.k_proj.weight,

@@ -753,8 +753,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     from ...ops import flash_attention as _fa
     mask = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
     def impl(q, k, v):
-        return _fa.sdpa_reference(q, k, v, mask=mask, causal=is_causal,
-                                  dropout_p=dropout_p if training else 0.0)
+        return _fa.sdpa(q, k, v, mask=mask, causal=is_causal,
+                        dropout_p=dropout_p if training else 0.0)
     return apply("sdpa", impl, [query, key, value])
 
 

@@ -52,11 +52,63 @@ def sdpa_reference(q, k, v, mask=None, causal: bool = False,
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 
 
+def _tpu_flash_available() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _largest_dividing_block(S: int) -> int:
+    """Largest multiple-of-128 block <= 512 that divides S (kernel contract:
+    seq must be divisible by the chosen block)."""
+    for b in (512, 384, 256, 128):
+        if S % b == 0:
+            return b
+    return 0
+
+
+def _flash_block_sizes(Sq: int, Sk: int):
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+    bq = _largest_dividing_block(Sq)
+    bk = _largest_dividing_block(Sk)
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk,
+        block_k_dkv=bk, block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
+
+
+def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
+         scale: Optional[float] = None):
+    """Routing SDPA on raw [B,S,H,D] arrays: Pallas flash kernel on TPU
+    (ref parity: FlashAttnKernel, paddle/phi/kernels/gpu/flash_attn_kernel.cu
+    — here the fused device kernel is the in-tree Pallas TPU flash attention
+    rather than a .cu file), XLA composite elsewhere. The XLA composite
+    (`sdpa_reference`) is the correctness oracle per SURVEY §4.1."""
+    D = q.shape[-1]
+    if scale is None:
+        scale = D ** -0.5
+    use_flash = (_tpu_flash_available() and mask is None and dropout_p == 0.0
+                 and q.shape[1] == k.shape[1]
+                 and _largest_dividing_block(q.shape[1]) > 0
+                 and ((D <= 128 and D % 64 == 0) or D % 128 == 0))
+    if use_flash:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _pallas_flash)
+        qh = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        out = _pallas_flash(qh, kh, vh, causal=causal, sm_scale=scale,
+                            block_sizes=_flash_block_sizes(q.shape[1],
+                                                           k.shape[1]))
+        return jnp.swapaxes(out, 1, 2)
+    return sdpa_reference(q, k, v, mask=mask, causal=causal,
+                          dropout_p=dropout_p, scale=scale)
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, name=None):
     """paddle.nn.functional.flash_attention.flash_attention parity wrapper."""
     from ..core.dispatch import apply
     def impl(q, k, v):
-        return sdpa_reference(q, k, v, causal=causal, dropout_p=dropout)
+        return sdpa(q, k, v, causal=causal, dropout_p=dropout)
     out = apply("flash_attention", impl, [query, key, value])
     return out, None  # (out, softmax) — softmax only materialized on request

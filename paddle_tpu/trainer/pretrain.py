@@ -175,16 +175,18 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
 
     cos, sin = precompute_rope(mc.head_dim, cfg.seq_len, mc.rope_theta)
 
-    # stage body: apply L/S decoder layers via scan over the local slice
+    # stage body: apply L/S decoder layers via scan over the local slice;
+    # per-layer remat (ref: fleet recompute intervals) keeps scan residuals
+    # at O(hidden) instead of O(attention-scores) per layer
     def stage_fn(params_slice, x, cos_, sin_):
-        def one_layer(h, layer_params):
+        def body(h, layer_params):
             with _StateSwap([tmpl]):
                 bind_state(tmpl, layer_params)
                 from ..core import autograd as ag
                 with ag.no_grad():
                     out = tmpl(Tensor(h), cos_, sin_)
             return out._data, None
-        h, _ = jax.lax.scan(one_layer, x, params_slice)
+        h, _ = jax.lax.scan(jax.checkpoint(body), x, params_slice)
         return h
 
     embed_key = "llama.embed_tokens.weight"

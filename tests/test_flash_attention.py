@@ -1,0 +1,37 @@
+"""Flash-attention routing + kernel parity (SURVEY §4.1: Pallas-vs-XLA
+reference checks; on the CPU suite the routing must fall back cleanly, the
+chip-side parity runs in verify/bench scripts)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.flash_attention import (sdpa, sdpa_reference,
+                                            _largest_dividing_block)
+
+
+def test_block_size_contract():
+    assert _largest_dividing_block(512) == 512
+    assert _largest_dividing_block(640) == 128   # 640 % 512 != 0
+    assert _largest_dividing_block(768) == 384
+    assert _largest_dividing_block(100) == 0
+    assert _largest_dividing_block(2048) == 512
+
+
+def test_sdpa_routes_to_reference_on_cpu():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 128, 4, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 128, 4, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 128, 4, 64), jnp.float32)
+    out = sdpa(q, k, v, causal=True)
+    ref = sdpa_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_f_sdpa_uses_routing():
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(1)
+    q = paddle.to_tensor(rng.randn(1, 64, 2, 32).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    ref = sdpa_reference(q._data, q._data, q._data, causal=True)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=1e-5)
