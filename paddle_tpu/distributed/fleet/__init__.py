@@ -103,6 +103,8 @@ def distributed_model(model, shard_params_on: Optional[str] = None):
                     spec = P(shard_params_on)
                 else:
                     spec = P()
+            from ..mesh import sanitize_spec
+            spec = sanitize_spec(mesh, spec)
             p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
         for bname, b in sub.__dict__["_buffers"].items():
             if b is not None:

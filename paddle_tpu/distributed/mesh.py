@@ -92,15 +92,16 @@ class ProcessMesh:
 
 
 def build_hybrid_mesh(dp_degree=1, mp_degree=1, pp_degree=1,
-                      sharding_degree=1, sep_degree=1,
+                      sharding_degree=1, sep_degree=1, ep_degree=1,
                       devices=None) -> Mesh:
-    """Build the 5-axis hybrid mesh (ref: HybridCommunicateGroup's cartesian
-    topology, order [M] knob). Degrees of 1 keep the axis present (size 1) so
-    sharding specs are stable across configurations."""
+    """Build the 6-axis hybrid mesh (ref: HybridCommunicateGroup's cartesian
+    topology, order [M] knob; ep is the expert-parallel degree PaddleNLP MoE
+    derives inside the hybrid topology). Degrees of 1 keep the axis present
+    (size 1) so sharding specs are stable across configurations."""
     devices = list(devices if devices is not None else jax.devices())
     sizes = collections.OrderedDict(
         pp=pp_degree, dp=dp_degree, sharding=sharding_degree, sep=sep_degree,
-        mp=mp_degree)
+        ep=ep_degree, mp=mp_degree)
     total = int(np.prod(list(sizes.values())))
     if total != len(devices):
         raise ValueError(
@@ -109,6 +110,26 @@ def build_hybrid_mesh(dp_degree=1, mp_degree=1, pp_degree=1,
     dev_arr = np.asarray(devices, dtype=object).reshape(
         tuple(sizes.values()))
     return Mesh(dev_arr, tuple(sizes.keys()))
+
+
+def sanitize_spec(mesh, spec):
+    """Drop axis names a spec references that the given mesh doesn't have
+    (e.g. a P("ep", ...) expert spec used on a mesh without an ep axis) so
+    layer-declared specs stay portable across mesh configurations."""
+    from jax.sharding import PartitionSpec
+    if spec is None:
+        return PartitionSpec()
+    names = set(mesh.axis_names)
+    entries = []
+    for e in spec:
+        if e is None:
+            entries.append(None)
+        elif isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if a in names)
+            entries.append(kept if kept else None)
+        else:
+            entries.append(e if e in names else None)
+    return PartitionSpec(*entries)
 
 
 class HybridTopology:

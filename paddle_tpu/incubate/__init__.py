@@ -1,0 +1,10 @@
+"""paddle.incubate parity package (ref: python/paddle/incubate/).
+
+Hosts the fused-op wrappers and the MoE stack (ref:
+python/paddle/incubate/distributed/models/moe/ — SURVEY §2.2 incubate row,
+§2.3 P7).
+"""
+
+from . import moe  # noqa: F401
+
+__all__ = ["moe"]

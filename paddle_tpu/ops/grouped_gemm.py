@@ -1,0 +1,53 @@
+"""Grouped GEMM for MoE expert compute.
+
+Reference capability: CUTLASS grouped-gemm fused MoE kernels
+(paddle/phi/kernels/fusion/cutlass/ moe/weight-only gemm — SURVEY §2.3 P7).
+
+TPU-native realization: `jax.lax.ragged_dot` — XLA's native ragged matmul
+lowers onto the MXU with one kernel over all expert groups (the megablocks
+"dropless" pattern). A pure-einsum fallback keeps the op correct on backends
+or shapes where ragged_dot is unavailable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grouped_gemm", "sort_by_group", "unsort_by_group"]
+
+
+def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
+    """lhs [M, K] rows grouped contiguously; rhs [G, K, N]; group_sizes [G]
+    (sum == M). Returns [M, N] where row m is multiplied by its group's rhs.
+    """
+    G = rhs.shape[0]
+    if prefer_ragged:
+        try:
+            return jax.lax.ragged_dot(lhs, rhs, group_sizes.astype(jnp.int32))
+        except Exception:  # pragma: no cover - backend-specific gaps
+            pass
+    # fallback: one-hot group membership -> batched einsum (static shapes)
+    M = lhs.shape[0]
+    ends = jnp.cumsum(group_sizes)
+    starts = ends - group_sizes
+    rows = jnp.arange(M)
+    member = (rows[None, :] >= starts[:, None]) & (rows[None, :] < ends[:, None])
+    # [G, M] bool; project lhs per group, matmul, and sum (each row is in
+    # exactly one group so the sum just selects)
+    per_g = jnp.einsum("gm,mk->gmk", member.astype(lhs.dtype), lhs)
+    out_g = jnp.einsum("gmk,gkn->gmn", per_g, rhs)
+    return jnp.sum(out_g, axis=0)
+
+
+def sort_by_group(x, group_ids, num_groups: int):
+    """Stable-sort rows of x by group id. Returns (sorted_x, group_sizes,
+    inverse permutation) — all static-shape, jit-safe."""
+    order = jnp.argsort(group_ids, stable=True)
+    inv = jnp.argsort(order, stable=True)
+    sizes = jnp.bincount(group_ids, length=num_groups)
+    return x[order], sizes.astype(jnp.int32), inv
+
+
+def unsort_by_group(x_sorted, inverse_perm):
+    return x_sorted[inverse_perm]

@@ -75,8 +75,12 @@ def _param_spec_tree(state: Dict[str, jnp.ndarray], model) -> Dict[str, P]:
     sharding (ZeRO) axis composes on dim 0 when divisible)."""
     sd = model.state_dict()
     specs = {}
+    from ..distributed.mesh import get_mesh, sanitize_spec
+    mesh = get_mesh()
     for k, v in state.items():
         spec = getattr(sd[k], "_sharding_spec", None)
+        if mesh is not None:
+            spec = sanitize_spec(mesh, spec)
         specs[k] = spec if spec is not None else P()
     return specs
 

@@ -1,0 +1,176 @@
+"""MoE / expert-parallel tests (SURVEY §2.3 P7; §4.2 simulated-mesh method)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.incubate.moe import (MoELayer, SwitchMoELayer, top_k_gating,
+                                     router_z_loss)
+from paddle_tpu.ops.grouped_gemm import grouped_gemm, sort_by_group, \
+    unsort_by_group
+
+
+def _rand(*shape, seed=0, scale=0.1):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(*shape) * scale).astype(np.float32)
+
+
+class TestGating:
+    def test_topk_dispatch_shapes_and_capacity(self):
+        T, E, k, C = 16, 4, 2, 8
+        gates = jax.nn.softmax(jnp.asarray(_rand(T, E, seed=1, scale=1.0)))
+        dispatch, combine, aux = top_k_gating(gates, k, C)
+        assert dispatch.shape == (T, E, C)
+        # no expert bucket slot used twice
+        per_slot = np.asarray(dispatch).sum(axis=0)  # [E, C]
+        assert per_slot.max() <= 1.0 + 1e-6
+        # every token goes to at most k slots
+        per_tok = np.asarray(dispatch).sum(axis=(1, 2))
+        assert per_tok.max() <= k + 1e-6
+        assert float(aux) > 0
+
+    def test_combine_renormalized_sums_to_one(self):
+        T, E, k = 8, 4, 2
+        C = T  # no drops
+        gates = jax.nn.softmax(jnp.asarray(_rand(T, E, seed=2, scale=1.0)))
+        _, combine, _ = top_k_gating(gates, k, C, renormalize=True)
+        s = np.asarray(combine).sum(axis=(1, 2))
+        np.testing.assert_allclose(s, np.ones(T), rtol=1e-5)
+
+    def test_z_loss_positive(self):
+        logits = jnp.asarray(_rand(8, 4, scale=2.0))
+        assert float(router_z_loss(logits)) > 0
+
+
+class TestGroupedGemm:
+    def test_matches_dense_loop(self):
+        M, K, N, G = 12, 8, 6, 3
+        lhs = jnp.asarray(_rand(M, K, seed=3))
+        rhs = jnp.asarray(_rand(G, K, N, seed=4))
+        sizes = jnp.asarray([5, 4, 3], jnp.int32)
+        out = grouped_gemm(lhs, rhs, sizes)
+        ref = np.zeros((M, N), np.float32)
+        start = 0
+        for g, s in enumerate([5, 4, 3]):
+            ref[start:start + s] = np.asarray(lhs)[start:start + s] @ \
+                np.asarray(rhs)[g]
+            start += s
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fallback_matches_ragged(self):
+        M, K, N, G = 10, 4, 4, 2
+        lhs = jnp.asarray(_rand(M, K, seed=5))
+        rhs = jnp.asarray(_rand(G, K, N, seed=6))
+        sizes = jnp.asarray([7, 3], jnp.int32)
+        a = grouped_gemm(lhs, rhs, sizes, prefer_ragged=True)
+        b = grouped_gemm(lhs, rhs, sizes, prefer_ragged=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sort_unsort_roundtrip(self):
+        x = jnp.asarray(_rand(9, 3, seed=7))
+        gid = jnp.asarray([2, 0, 1, 1, 0, 2, 2, 0, 1])
+        srt, sizes, inv = sort_by_group(x, gid, 3)
+        assert list(np.asarray(sizes)) == [3, 3, 3]
+        np.testing.assert_allclose(np.asarray(unsort_by_group(srt, inv)),
+                                   np.asarray(x))
+
+
+class TestMoELayer:
+    def test_single_expert_equals_dense_ffn(self):
+        """E=1, k=1, ample capacity → exactly a dense swiglu FFN."""
+        H, I = 16, 32
+        layer = MoELayer(H, I, num_experts=1, top_k=1, capacity_factor=64.0)
+        x = Tensor(jnp.asarray(_rand(2, 6, H, seed=8)))
+        out = layer(x)
+        wg = layer.w_gate._data[0]
+        wu = layer.w_up._data[0]
+        wd = layer.w_down._data[0]
+        xa = x._data
+        ref = (jax.nn.silu(xa @ wg) * (xa @ wu)) @ wd
+        np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        assert layer.l_aux is not None
+
+
+class TestMoELayer2:
+    def _mk(self, dropless, seed=11):
+        H, I, E = 8, 16, 4
+        rng = np.random.RandomState(seed)
+        # capacity_factor=E/k makes capacity == T (provably no drops)
+        layer = MoELayer(H, I, E, top_k=2, capacity_factor=E / 2.0,
+                         dropless=dropless, renormalize=True)
+        # deterministic weights shared between instances
+        for p, nm in ((layer.gate_weight, "g"), (layer.w_gate, "wg"),
+                      (layer.w_up, "wu"), (layer.w_down, "wd")):
+            p._data = jnp.asarray(
+                np.random.RandomState(abs(hash(nm)) % 2**31)
+                .randn(*p.shape).astype(np.float32) * 0.1)
+        return layer
+
+    def test_dropless_matches_capacity_when_no_drops(self):
+        a = self._mk(dropless=False)
+        b = self._mk(dropless=True)
+        x = Tensor(jnp.asarray(_rand(2, 4, 8, seed=12)))
+        oa, ob = a(x), b(x)
+        np.testing.assert_allclose(np.asarray(oa._data), np.asarray(ob._data),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_gradients_flow_to_experts(self):
+        layer = self._mk(dropless=False)
+        for p in layer.parameters():
+            p.stop_gradient = False
+        x = Tensor(jnp.asarray(_rand(2, 4, 8, seed=13)))
+        out = layer(x)
+        loss = (out * out).mean() + layer.l_aux * 0.01
+        loss.backward()
+        g = layer.w_up.grad
+        assert g is not None and float(jnp.abs(g._data).max()) > 0
+        assert layer.gate_weight.grad is not None
+
+    def test_switch_layer_runs(self):
+        layer = SwitchMoELayer(8, 16, 4)
+        x = Tensor(jnp.asarray(_rand(2, 4, 8, seed=14)))
+        out = layer(x)
+        assert tuple(out.shape) == (2, 4, 8)
+        assert np.isfinite(np.asarray(out._data)).all()
+
+
+class TestExpertParallel:
+    def test_ep_sharded_forward_matches_single_device(self):
+        from paddle_tpu.distributed.mesh import build_hybrid_mesh, \
+            mesh_context
+        from paddle_tpu.distributed import fleet
+        layer = TestMoELayer2()._mk(dropless=False)
+        x = Tensor(jnp.asarray(_rand(2, 8, 8, seed=15)))
+        ref = np.asarray(layer(x)._data)
+
+        mesh = build_hybrid_mesh(dp_degree=2, ep_degree=4)
+        with mesh_context(mesh):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import sanitize_spec
+            for p in layer.parameters():
+                spec = sanitize_spec(mesh,
+                                     getattr(p, "_sharding_spec", None))
+                p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+            out = layer(x)
+        np.testing.assert_allclose(np.asarray(out._data), ref,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_moe_lm_loss_and_aux(self):
+        from paddle_tpu.models.moe_llm import (MoEForCausalLM,
+                                               qwen2_moe_tiny_config)
+        cfg = qwen2_moe_tiny_config(sequence_parallel=False)
+        model = MoEForCausalLM(cfg)
+        rng = np.random.RandomState(0)
+        ids = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16)),
+                                 jnp.int32))
+        labels = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16)),
+                                    jnp.int32))
+        loss, logits = model(ids, labels=labels)
+        assert np.isfinite(float(loss))
+        aux = model.model.aux_loss()
+        assert aux is not None and float(aux) > 0
