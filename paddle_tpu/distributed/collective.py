@@ -88,7 +88,7 @@ def _collective(mesh: Mesh, axis: str, fn, x):
     # operate on a leading stacked axis: we gather per-device values by
     # treating the tensor as replicated except along the comm axis.
     out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * x.ndim)),),
-                    out_specs=P(*([None] * x.ndim)), check_rep=False)(x)
+                    out_specs=P(*([None] * x.ndim)), check_vma=False)(x)
     return out
 
 
@@ -107,7 +107,7 @@ def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
     nd = tensor.ndim
     out = shard_map(fn, mesh=mesh,
                     in_specs=(P(*([None] * nd)),),
-                    out_specs=P(*([None] * nd)), check_rep=False)(tensor._data)
+                    out_specs=P(*([None] * nd)), check_vma=False)(tensor._data)
     tensor._data = out
     return tensor
 
@@ -132,7 +132,7 @@ def all_gather(tensor_list: Optional[List], tensor: Tensor = None, group=None,
 
     nd = tensor.ndim
     out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * nd)),),
-                    out_specs=P(*([None] * (nd + 1))), check_rep=False)(
+                    out_specs=P(*([None] * (nd + 1))), check_vma=False)(
         tensor._data)
     if tensor_list is not None:
         for i in range(n):
@@ -159,7 +159,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     nd = src.ndim
     out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * nd)),),
                     out_specs=P(axis, *([None] * (nd - 1))),
-                    check_rep=False)(src._data)
+                    check_vma=False)(src._data)
     # out is sharded along dim0; each rank's shard is this rank's result —
     # materialize the local view replicated for eager parity
     tensor._data = out
@@ -181,7 +181,7 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True) -> Tensor:
 
     nd = tensor.ndim
     out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * nd)),),
-                    out_specs=P(*([None] * nd)), check_rep=False)(tensor._data)
+                    out_specs=P(*([None] * nd)), check_vma=False)(tensor._data)
     tensor._data = out
     return tensor
 
@@ -213,7 +213,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
     nd = stacked.ndim
     out = shard_map(fn, mesh=mesh, in_specs=(P(*([None] * nd)),),
-                    out_specs=P(*([None] * nd)), check_rep=False)(stacked)
+                    out_specs=P(*([None] * nd)), check_vma=False)(stacked)
     outs = [Tensor(o) for o in out]
     if out_tensor_list is not None:
         out_tensor_list.extend(outs)
