@@ -40,7 +40,8 @@ class LlamaConfig:
                  max_position_embeddings=8192, rope_theta=500000.0,
                  rms_norm_eps=1e-5, initializer_range=0.02,
                  tie_word_embeddings=False, use_flash_attention=True,
-                 sequence_parallel=True, recompute=False):
+                 sequence_parallel=True, recompute=False,
+                 context_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -55,6 +56,7 @@ class LlamaConfig:
         self.use_flash_attention = use_flash_attention
         self.sequence_parallel = sequence_parallel
         self.recompute = recompute
+        self.context_parallel = context_parallel
         self.head_dim = hidden_size // num_attention_heads
 
 
@@ -128,7 +130,12 @@ class LlamaAttention(nn.Layer):
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
             from ..ops.flash_attention import sdpa, sdpa_reference
-            if c.use_flash_attention:
+            if c.context_parallel:
+                # ring attention over the sep axis (P9): seq stays sharded,
+                # KV blocks rotate via collective-permute
+                from ..distributed.ring_attention import ring_attention_raw
+                o = ring_attention_raw(q, k, v, axis="sep", causal=True)
+            elif c.use_flash_attention:
                 o = sdpa(q, k, v, causal=True)
             else:
                 o = sdpa_reference(q, k, v, causal=True)
