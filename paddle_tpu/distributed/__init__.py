@@ -21,6 +21,11 @@ from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noq
                               RowParallelLinear, VocabParallelEmbedding,
                               annotate_sequence_parallel)
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from .ring_attention import (RingFlashAttention, ring_attention,  # noqa: F401
+                             ulysses_attention)
+from .sharding import (DygraphShardingOptimizer,  # noqa: F401
+                       HybridParallelOptimizer, group_sharded_parallel,
+                       save_group_sharded_model)
 from . import fleet  # noqa: F401
 
 

@@ -67,9 +67,16 @@ class Optimizer:
         pid = id(p)
         if pid not in store:
             dt = jnp.float32 if _is_low_precision(p.dtype) else p.dtype
-            store[pid] = jnp.zeros(p._data.shape, dt) if init is None \
-                else init
+            arr = jnp.zeros(p._data.shape, dt) if init is None else init
+            if self._acc_placement is not None:
+                arr = self._acc_placement(p, arr)
+            store[pid] = arr
         return store[pid]
+
+    # hook: ZeRO optimizer-state sharding installs a placement fn here
+    # (ref: DygraphShardingOptimizer — SURVEY §2.3 P2; on TPU the partition
+    # is a sharding spec on the accumulator arrays)
+    _acc_placement = None
 
     def _set_acc(self, name: str, p: Tensor, value) -> None:
         self._accumulators[name][id(p)] = value
@@ -78,7 +85,10 @@ class Optimizer:
         pid = id(p)
         if self._multi_precision and _is_low_precision(p.dtype):
             if pid not in self._master:
-                self._master[pid] = p._data.astype(jnp.float32)
+                mw = p._data.astype(jnp.float32)
+                if self._acc_placement is not None:
+                    mw = self._acc_placement(p, mw)
+                self._master[pid] = mw
             return self._master[pid]
         return p._data
 
