@@ -1,0 +1,436 @@
+// paddle_tpu native runtime components (C++, built once, loaded via ctypes).
+//
+// TPU-native equivalents of the reference's native subsystems (SURVEY §2.1):
+//  1. Flags registry      — ref: paddle/common/flags.cc (gflags clone with
+//                           FLAGS_* env override, runtime get/set).
+//  2. TCPStore            — ref: paddle/phi/core/distributed/store/
+//                           tcp_store.cc (rendezvous kv: set/get/add/wait
+//                           with timeouts; barriers for multi-host bring-up).
+//                           Here it backs the launcher + jax.distributed
+//                           coordination instead of NCCL unique-id exchange.
+//  3. Host profiler       — ref: paddle/fluid/platform/profiler/
+//                           (host_tracer.cc, chrometracing_logger.cc):
+//                           RecordEvent instrumentation -> chrome-trace JSON.
+//
+// Protocol (TCPStore): length-prefixed binary frames over a blocking socket.
+//   request : u8 op | u32 klen | key | u32 vlen | val
+//   response: u8 ok | u32 vlen | val
+// Ops: 1=SET 2=GET 3=ADD(val=ascii delta; returns new value) 4=WAIT(blocks
+// until key exists or timeout-ms in val) 5=DELETE.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// 1. Flags registry
+// ---------------------------------------------------------------------------
+namespace {
+std::mutex g_flags_mu;
+std::map<std::string, std::string> g_flags;
+
+std::string flag_env_override(const std::string& name) {
+  const char* env = getenv(name.c_str());
+  return env ? std::string(env) : std::string();
+}
+}  // namespace
+
+extern "C" {
+
+void pt_flag_define(const char* name, const char* default_value) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  if (g_flags.count(name)) return;
+  std::string env = flag_env_override(name);
+  g_flags[name] = env.empty() ? default_value : env;
+}
+
+void pt_flag_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  g_flags[name] = value;
+}
+
+// copies into caller buffer; returns needed length (excl. NUL), -1 if absent
+int pt_flag_get(const char* name, char* buf, int buflen) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  auto it = g_flags.find(name);
+  if (it == g_flags.end()) return -1;
+  int n = static_cast<int>(it->second.size());
+  if (buf && buflen > n) {
+    memcpy(buf, it->second.data(), n);
+    buf[n] = 0;
+  }
+  return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// 2. TCPStore
+// ---------------------------------------------------------------------------
+namespace {
+
+struct StoreServer {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_frame(int fd, uint8_t* op, std::string* key, std::string* val) {
+  uint32_t klen, vlen;
+  if (!read_full(fd, op, 1)) return false;
+  if (!read_full(fd, &klen, 4)) return false;
+  key->resize(klen);
+  if (klen && !read_full(fd, &(*key)[0], klen)) return false;
+  if (!read_full(fd, &vlen, 4)) return false;
+  val->resize(vlen);
+  if (vlen && !read_full(fd, &(*val)[0], vlen)) return false;
+  return true;
+}
+
+bool write_resp(int fd, uint8_t ok, const std::string& val) {
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  if (!write_full(fd, &ok, 1)) return false;
+  if (!write_full(fd, &vlen, 4)) return false;
+  if (vlen && !write_full(fd, val.data(), vlen)) return false;
+  return true;
+}
+
+void serve_conn(StoreServer* s, int fd) {
+  uint8_t op;
+  std::string key, val;
+  while (!s->stop.load() && read_frame(fd, &op, &key, &val)) {
+    switch (op) {
+      case 1: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->kv[key] = val;
+        }
+        s->cv.notify_all();
+        if (!write_resp(fd, 1, "")) goto done;
+        break;
+      }
+      case 2: {  // GET
+        std::string out;
+        bool found;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          auto it = s->kv.find(key);
+          found = it != s->kv.end();
+          if (found) out = it->second;
+        }
+        if (!write_resp(fd, found ? 1 : 0, out)) goto done;
+        break;
+      }
+      case 3: {  // ADD
+        long long delta = atoll(val.c_str());
+        std::string out;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          long long cur = 0;
+          auto it = s->kv.find(key);
+          if (it != s->kv.end()) cur = atoll(it->second.c_str());
+          cur += delta;
+          out = std::to_string(cur);
+          s->kv[key] = out;
+        }
+        s->cv.notify_all();
+        if (!write_resp(fd, 1, out)) goto done;
+        break;
+      }
+      case 4: {  // WAIT (val = timeout ms, 0 = forever)
+        long long ms = atoll(val.c_str());
+        std::unique_lock<std::mutex> lk(s->mu);
+        auto pred = [&] { return s->kv.count(key) > 0 || s->stop.load(); };
+        bool ok;
+        if (ms > 0) {
+          ok = s->cv.wait_for(lk, std::chrono::milliseconds(ms), pred);
+        } else {
+          s->cv.wait(lk, pred);
+          ok = true;
+        }
+        std::string out = ok && s->kv.count(key) ? s->kv[key] : "";
+        lk.unlock();
+        if (!write_resp(fd, ok ? 1 : 0, out)) goto done;
+        break;
+      }
+      case 5: {  // DELETE
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->kv.erase(key);
+        }
+        if (!write_resp(fd, 1, "")) goto done;
+        break;
+      }
+      default:
+        goto done;
+    }
+  }
+done:
+  ::close(fd);
+}
+
+void accept_loop(StoreServer* s) {
+  while (!s->stop.load()) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stop.load()) break;
+      continue;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    s->workers.emplace_back(serve_conn, s, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns opaque handle (as int64), binds 127.0.0.1:port (port 0 = ephemeral;
+// actual port written to *out_port). -1 on failure.
+long long pt_store_server_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (out_port) *out_port = ntohs(addr.sin_port);
+  auto* s = new StoreServer();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread(accept_loop, s);
+  return reinterpret_cast<long long>(s);
+}
+
+void pt_store_server_stop(long long handle) {
+  auto* s = reinterpret_cast<StoreServer*>(handle);
+  if (!s) return;
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+// client: returns fd (>=0) or -1
+int pt_store_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    inet_pton(AF_INET, host, &addr.sin_addr);
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void pt_store_close(int fd) { ::close(fd); }
+
+namespace {
+// NOTE: no global client lock — a WAIT may block server-side for seconds and
+// must not serialize other connections in this process. Callers serialize
+// per-connection (the Python TCPStore holds a per-instance lock).
+int store_req(int fd, uint8_t op, const char* key, const char* val, int vlen,
+              char* out, int outlen) {
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  uint32_t vl = static_cast<uint32_t>(vlen);
+  if (!write_full(fd, &op, 1) || !write_full(fd, &klen, 4) ||
+      (klen && !write_full(fd, key, klen)) || !write_full(fd, &vl, 4) ||
+      (vl && !write_full(fd, val, vl)))
+    return -2;
+  uint8_t ok;
+  uint32_t rlen;
+  if (!read_full(fd, &ok, 1) || !read_full(fd, &rlen, 4)) return -2;
+  std::string resp(rlen, 0);
+  if (rlen && !read_full(fd, &resp[0], rlen)) return -2;
+  if (!ok) return -1;
+  int n = static_cast<int>(rlen);
+  if (out && outlen > n) {
+    memcpy(out, resp.data(), n);
+    out[n] = 0;
+  }
+  return n;
+}
+}  // namespace
+
+int pt_store_set(int fd, const char* key, const char* val, int vlen) {
+  return store_req(fd, 1, key, val, vlen, nullptr, 0);
+}
+int pt_store_get(int fd, const char* key, char* out, int outlen) {
+  return store_req(fd, 2, key, nullptr, 0, out, outlen);
+}
+long long pt_store_add(int fd, const char* key, long long delta) {
+  char buf[32], out[32];
+  snprintf(buf, sizeof(buf), "%lld", delta);
+  int r = store_req(fd, 3, key, buf, static_cast<int>(strlen(buf)), out,
+                    sizeof(out));
+  if (r < 0) return -1;
+  return atoll(out);
+}
+int pt_store_wait(int fd, const char* key, int timeout_ms, char* out,
+                  int outlen) {
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%d", timeout_ms);
+  return store_req(fd, 4, key, buf, static_cast<int>(strlen(buf)), out,
+                   outlen);
+}
+int pt_store_delete(int fd, const char* key) {
+  return store_req(fd, 5, key, nullptr, 0, nullptr, 0);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// 3. Host profiler (RecordEvent -> chrome trace)
+// ---------------------------------------------------------------------------
+namespace {
+
+struct ProfEvent {
+  std::string name;
+  uint64_t tid;
+  uint64_t start_us;
+  uint64_t dur_us;
+};
+
+std::mutex g_prof_mu;
+std::vector<ProfEvent> g_prof_events;
+std::atomic<bool> g_prof_on{false};
+
+uint64_t now_us() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+uint64_t this_tid() {
+  return static_cast<uint64_t>(
+      std::hash<std::thread::id>{}(std::this_thread::get_id()) & 0xffffff);
+}
+
+std::string json_escape(const std::string& s) {
+  std::string o;
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      o += '\\';
+      o += c;
+    } else if (c == '\n') {
+      o += "\\n";
+    } else {
+      o += c;
+    }
+  }
+  return o;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_prof_enable(int on) { g_prof_on.store(on != 0); }
+int pt_prof_enabled() { return g_prof_on.load() ? 1 : 0; }
+
+// returns an id to pass to pt_prof_end (the start timestamp)
+unsigned long long pt_prof_begin() { return g_prof_on.load() ? now_us() : 0; }
+
+void pt_prof_end(const char* name, unsigned long long begin_us) {
+  if (!g_prof_on.load() || begin_us == 0) return;
+  uint64_t end = now_us();
+  std::lock_guard<std::mutex> lk(g_prof_mu);
+  g_prof_events.push_back(
+      {name, this_tid(), begin_us, end - begin_us});
+}
+
+void pt_prof_clear() {
+  std::lock_guard<std::mutex> lk(g_prof_mu);
+  g_prof_events.clear();
+}
+
+int pt_prof_event_count() {
+  std::lock_guard<std::mutex> lk(g_prof_mu);
+  return static_cast<int>(g_prof_events.size());
+}
+
+// chrome trace "traceEvents" JSON (complete events, phase X)
+int pt_prof_export(const char* path, int pid) {
+  std::lock_guard<std::mutex> lk(g_prof_mu);
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fprintf(f, "{\"traceEvents\":[");
+  for (size_t i = 0; i < g_prof_events.size(); ++i) {
+    const auto& e = g_prof_events[i];
+    fprintf(f,
+            "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%llu,"
+            "\"ts\":%llu,\"dur\":%llu,\"cat\":\"host\"}",
+            i ? "," : "", json_escape(e.name).c_str(), pid,
+            static_cast<unsigned long long>(e.tid),
+            static_cast<unsigned long long>(e.start_us),
+            static_cast<unsigned long long>(e.dur_us));
+  }
+  fprintf(f, "]}");
+  fclose(f);
+  return static_cast<int>(g_prof_events.size());
+}
+
+}  // extern "C"

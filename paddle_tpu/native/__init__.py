@@ -1,0 +1,325 @@
+"""ctypes bindings for the native C++ runtime components (csrc/native.cc).
+
+Native-code contract (SURVEY §2.1 "TPU-native equivalents ... in C++ where
+the reference is native"): flags registry, TCPStore coordination service,
+host profiler. The shared library is compiled once on first import (g++,
+cached next to the source); every binding has a pure-Python fallback so the
+framework stays importable on machines without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from typing import Optional
+
+__all__ = ["lib", "available", "TCPStore", "RecordEvent", "prof_enable",
+           "prof_export", "native_flag_define", "native_flag_get",
+           "native_flag_set"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "..", "csrc", "native.cc")
+_SO = os.path.join(_DIR, "_native.so")
+
+lib = None
+
+
+def _build() -> Optional[str]:
+    src = os.path.abspath(_SRC)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+             src, "-o", _SO],
+            check=True, capture_output=True, timeout=180)
+        return _SO
+    except Exception:
+        return None
+
+
+def _load():
+    global lib
+    so = _build()
+    if so is None:
+        return
+    try:
+        L = ctypes.CDLL(so)
+    except OSError:
+        return
+    L.pt_flag_define.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    L.pt_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    L.pt_flag_get.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    L.pt_flag_get.restype = ctypes.c_int
+    L.pt_store_server_start.argtypes = [ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int)]
+    L.pt_store_server_start.restype = ctypes.c_longlong
+    L.pt_store_server_stop.argtypes = [ctypes.c_longlong]
+    L.pt_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_int]
+    L.pt_store_connect.restype = ctypes.c_int
+    L.pt_store_close.argtypes = [ctypes.c_int]
+    L.pt_store_set.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_int]
+    L.pt_store_set.restype = ctypes.c_int
+    L.pt_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_int]
+    L.pt_store_get.restype = ctypes.c_int
+    L.pt_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_longlong]
+    L.pt_store_add.restype = ctypes.c_longlong
+    L.pt_store_wait.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_char_p, ctypes.c_int]
+    L.pt_store_wait.restype = ctypes.c_int
+    L.pt_store_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    L.pt_prof_enable.argtypes = [ctypes.c_int]
+    L.pt_prof_enabled.restype = ctypes.c_int
+    L.pt_prof_begin.restype = ctypes.c_ulonglong
+    L.pt_prof_end.argtypes = [ctypes.c_char_p, ctypes.c_ulonglong]
+    L.pt_prof_export.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    L.pt_prof_export.restype = ctypes.c_int
+    L.pt_prof_event_count.restype = ctypes.c_int
+    lib = L
+
+
+_load()
+
+
+def available() -> bool:
+    return lib is not None
+
+
+# ---------------------------------------------------------------------------
+# flags (native registry; paddle_tpu.flags remains the python-facing API)
+# ---------------------------------------------------------------------------
+
+def native_flag_define(name: str, default: str) -> None:
+    if lib is not None:
+        lib.pt_flag_define(name.encode(), str(default).encode())
+
+
+def native_flag_set(name: str, value: str) -> None:
+    if lib is not None:
+        lib.pt_flag_set(name.encode(), str(value).encode())
+
+
+def native_flag_get(name: str) -> Optional[str]:
+    if lib is None:
+        return None
+    buf = ctypes.create_string_buffer(4096)
+    n = lib.pt_flag_get(name.encode(), buf, 4096)
+    if n < 0:
+        return None
+    return buf.value.decode()
+
+
+# ---------------------------------------------------------------------------
+# TCPStore (ref API: paddle.distributed.TCPStore-like kv/barrier)
+# ---------------------------------------------------------------------------
+
+class _PyStoreServer:
+    """Pure-Python fallback store server (same wire-free semantics,
+    in-process only)."""
+
+    def __init__(self):
+        self.kv = {}
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+
+
+class TCPStore:
+    """kv + barrier rendezvous (ref: paddle/phi/core/distributed/store/
+    tcp_store.cc). is_master starts the C++ server thread; every instance is
+    also a client."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 60.0):
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        self._py = None
+        self._lock = threading.Lock()  # serialize requests on this conn
+        if lib is None:
+            # in-process fallback: master-only, no cross-process support
+            self._py = _PyStoreServer()
+            self.host, self.port = host, port
+            return
+        if is_master:
+            actual = ctypes.c_int(0)
+            self._server = lib.pt_store_server_start(port,
+                                                     ctypes.byref(actual))
+            if self._server < 0:
+                raise RuntimeError(f"TCPStore bind failed on port {port}")
+            port = actual.value
+        self.host, self.port = host, port
+        self._fd = lib.pt_store_connect(host.encode(), port,
+                                        int(timeout * 1000))
+        if self._fd < 0:
+            raise TimeoutError(f"TCPStore connect to {host}:{port} failed")
+
+    # -- kv ------------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._py is not None:
+            with self._py.cond:
+                self._py.kv[key] = data
+                self._py.cond.notify_all()
+            return
+        with self._lock:
+            r = lib.pt_store_set(self._fd, key.encode(), data, len(data))
+        if r < 0:
+            raise RuntimeError("TCPStore set failed")
+
+    def get(self, key: str) -> Optional[bytes]:
+        if self._py is not None:
+            with self._py.lock:
+                return self._py.kv.get(key)
+        buf = ctypes.create_string_buffer(1 << 20)
+        with self._lock:
+            n = lib.pt_store_get(self._fd, key.encode(), buf, 1 << 20)
+        if n < 0:
+            return None
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._py is not None:
+            with self._py.cond:
+                cur = int(self._py.kv.get(key, b"0")) + delta
+                self._py.kv[key] = str(cur).encode()
+                self._py.cond.notify_all()
+                return cur
+        with self._lock:
+            r = lib.pt_store_add(self._fd, key.encode(), delta)
+        if r < 0:
+            raise RuntimeError("TCPStore add failed")
+        return int(r)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        tmo = self.timeout if timeout is None else timeout
+        if self._py is not None:
+            with self._py.cond:
+                end = time.monotonic() + tmo
+                while key not in self._py.kv:
+                    left = end - time.monotonic()
+                    if left <= 0:
+                        raise TimeoutError(f"wait({key}) timed out")
+                    self._py.cond.wait(left)
+                return self._py.kv[key]
+        buf = ctypes.create_string_buffer(1 << 20)
+        with self._lock:
+            n = lib.pt_store_wait(self._fd, key.encode(), int(tmo * 1000),
+                                  buf, 1 << 20)
+        if n < 0:
+            raise TimeoutError(f"wait({key}) timed out")
+        return buf.raw[:n]
+
+    def delete(self, key: str) -> None:
+        if self._py is not None:
+            with self._py.lock:
+                self._py.kv.pop(key, None)
+            return
+        with self._lock:
+            lib.pt_store_delete(self._fd, key.encode())
+
+    # -- barrier -------------------------------------------------------------
+    def barrier(self, name: str = "default",
+                timeout: Optional[float] = None) -> None:
+        n = self.add(f"__barrier/{name}/count", 1)
+        if n == self.world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+        self.wait(f"__barrier/{name}/done", timeout)
+
+    def close(self) -> None:
+        if self._py is not None:
+            return
+        if getattr(self, "_fd", -1) >= 0:
+            lib.pt_store_close(self._fd)
+            self._fd = -1
+        if self._server:
+            lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# profiler (RecordEvent + chrome trace export)
+# ---------------------------------------------------------------------------
+
+_py_events = []
+_py_enabled = False
+_py_lock = threading.Lock()
+
+
+def prof_enable(on: bool = True) -> None:
+    global _py_enabled
+    if lib is not None:
+        lib.pt_prof_enable(1 if on else 0)
+    _py_enabled = bool(on)
+
+
+class RecordEvent:
+    """ref: paddle.profiler.RecordEvent / C++ RecordEvent instrumentation.
+    Usable as context manager or decorator; ~no cost when profiling is off."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._begin = 0
+
+    def __enter__(self):
+        if lib is not None:
+            self._begin = lib.pt_prof_begin()
+        elif _py_enabled:
+            self._begin = time.perf_counter_ns() // 1000
+        return self
+
+    def __exit__(self, *exc):
+        if lib is not None:
+            lib.pt_prof_end(self.name.encode(), self._begin)
+        elif _py_enabled and self._begin:
+            end = time.perf_counter_ns() // 1000
+            with _py_lock:
+                _py_events.append((self.name, self._begin,
+                                   end - self._begin))
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **kw):
+            with RecordEvent(self.name):
+                return fn(*a, **kw)
+        return wrapped
+
+
+def prof_export(path: str, pid: int = 0) -> int:
+    """Write chrome://tracing JSON; returns event count."""
+    if lib is not None:
+        return int(lib.pt_prof_export(path.encode(), pid))
+    import json
+    with _py_lock:
+        evs = [{"name": n, "ph": "X", "pid": pid, "tid": 0, "ts": ts,
+                "dur": dur, "cat": "host"} for n, ts, dur in _py_events]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs}, f)
+    return len(evs)
+
+
+def prof_clear() -> None:
+    if lib is not None:
+        lib.pt_prof_clear()
+    with _py_lock:
+        _py_events.clear()
+
+
+def prof_event_count() -> int:
+    if lib is not None:
+        return int(lib.pt_prof_event_count())
+    with _py_lock:
+        return len(_py_events)
