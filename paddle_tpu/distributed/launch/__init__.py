@@ -1,0 +1,8 @@
+"""Distributed launcher (ref: python/paddle/distributed/launch/ — SURVEY
+§2.3 P14, §3.5 CLI, §5.3 failure detection).
+
+`python -m paddle_tpu.distributed.launch [--nproc_per_node N] script.py ...`
+"""
+
+from .main import launch, main  # noqa: F401
+from .controllers import CollectiveController, ElasticManager  # noqa: F401

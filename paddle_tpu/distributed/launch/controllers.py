@@ -1,0 +1,189 @@
+"""Launch controllers: pod construction, watch loop, restart policy, elastic.
+
+Reference mechanism (SURVEY §2.3 P14, §5.3):
+- python/paddle/distributed/launch/controllers/collective.py — master
+  rendezvous (TCPStore/etcd), builds the pod rank table, spawns per-rank
+  subprocesses with PADDLE_* env, writes per-rank `workerlog.N`, watches
+  children and restarts per policy.
+- python/paddle/distributed/fleet/elastic/manager.py — ElasticManager
+  watches membership (etcd TTL keys); on join/leave kills local trainers
+  and relaunches with regenerated rank env.
+
+TPU-native rework: the rendezvous/heartbeat store is our C++ TCPStore
+(paddle_tpu.native); per-host processes get both the PADDLE_* env vars and
+the jax.distributed coordination vars (COORDINATOR_ADDRESS / process id) so
+`init_parallel_env()` can call jax.distributed.initialize on pods. Failure
+detection = child exit codes + store heartbeats; recovery = checkpoint-based
+relaunch (SURVEY §5.3: the TPU-idiomatic elastic story is preemption-aware
+checkpoint + restart, not in-flight reconstruction).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from ...native import TCPStore
+
+__all__ = ["CollectiveController", "ElasticManager"]
+
+
+class _Proc:
+    def __init__(self, popen, rank, log_path, log_file):
+        self.popen = popen
+        self.rank = rank
+        self.log_path = log_path
+        self.log_file = log_file
+
+
+class CollectiveController:
+    """Spawn + watch the local ranks of a collective job."""
+
+    def __init__(self, args):
+        self.args = args
+        self.node_rank = int(args.node_rank)
+        self.nnodes = int(str(args.nnodes).split(":")[0])
+        self.nproc = int(args.nproc_per_node)
+        self.world_size = self.nnodes * self.nproc
+        self.procs: List[_Proc] = []
+        self.store: Optional[TCPStore] = None
+        self._restarts = 0
+
+    # -- rendezvous ----------------------------------------------------------
+    def _master_hostport(self):
+        if self.args.master:
+            host, _, port = self.args.master.rpartition(":")
+            return host or "127.0.0.1", int(port)
+        return "127.0.0.1", 0
+
+    def rendezvous(self):
+        host, port = self._master_hostport()
+        is_master = self.node_rank == 0
+        self.store = TCPStore(host=host, port=port, is_master=is_master,
+                              world_size=self.nnodes,
+                              timeout=self.args.rdzv_timeout)
+        if is_master:
+            port = self.store.port
+        self.master_endpoint = f"{host}:{port}"
+        # publish this node, wait for everyone (ref: pod/rank table build)
+        self.store.set(f"node/{self.node_rank}", os.uname().nodename)
+        self.store.barrier("rendezvous", timeout=self.args.rdzv_timeout)
+
+    # -- env -----------------------------------------------------------------
+    def _rank_env(self, local_rank: int) -> dict:
+        rank = self.node_rank * self.nproc + local_rank
+        endpoints = ",".join(
+            f"{self.master_endpoint.split(':')[0]}:{9000 + r}"
+            for r in range(self.world_size))
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(self.world_size),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{self.master_endpoint.split(':')[0]}:{9000 + rank}",
+            "PADDLE_MASTER": self.master_endpoint,
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_NNODES": str(self.nnodes),
+            # jax.distributed bridge (multi-host TPU bring-up)
+            "COORDINATOR_ADDRESS": self.master_endpoint,
+            "JAX_PROCESS_ID": str(rank),
+            "JAX_NUM_PROCESSES": str(self.world_size),
+        })
+        if self.args.devices:
+            env["TPU_VISIBLE_DEVICES"] = self.args.devices
+        return env
+
+    # -- spawn / watch -------------------------------------------------------
+    def spawn(self):
+        os.makedirs(self.args.log_dir, exist_ok=True)
+        self.procs = []
+        for lr in range(self.nproc):
+            rank = self.node_rank * self.nproc + lr
+            log_path = os.path.join(self.args.log_dir, f"workerlog.{rank}")
+            logf = open(log_path, "ab", buffering=0)
+            cmd = [sys.executable, "-u", self.args.training_script,
+                   *self.args.training_script_args]
+            p = subprocess.Popen(cmd, env=self._rank_env(lr), stdout=logf,
+                                 stderr=subprocess.STDOUT)
+            self.procs.append(_Proc(p, rank, log_path, logf))
+
+    def _kill_all(self, sig=signal.SIGTERM, grace: float = 5.0):
+        for pr in self.procs:
+            if pr.popen.poll() is None:
+                pr.popen.send_signal(sig)
+        deadline = time.time() + grace
+        for pr in self.procs:
+            left = max(0.1, deadline - time.time())
+            try:
+                pr.popen.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                pr.popen.kill()
+        for pr in self.procs:
+            pr.log_file.close()
+
+    def watch(self) -> int:
+        """Poll children; on failure either restart the pod (up to
+        --max_restarts) or tear down and propagate the exit code."""
+        while True:
+            alive = 0
+            restarted = False
+            for pr in self.procs:
+                rc = pr.popen.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0:
+                    if self._restarts < self.args.max_restarts:
+                        self._restarts += 1
+                        self._kill_all()
+                        self.spawn()
+                        restarted = True
+                        break
+                    self._kill_all()
+                    return rc
+            if restarted:
+                continue
+            if alive == 0:
+                for pr in self.procs:
+                    pr.log_file.close()
+                return 0
+            time.sleep(self.args.poll_interval)
+
+    def run(self) -> int:
+        self.rendezvous()
+        self.spawn()
+        try:
+            return self.watch()
+        finally:
+            if self.store is not None:
+                self.store.close()
+
+
+class ElasticManager:
+    """Membership watcher (ref: ElasticManager over etcd): nodes heartbeat
+    TTL keys in the store; scale events trigger relaunch with new ranks."""
+
+    def __init__(self, store: TCPStore, node_rank: int, ttl: float = 10.0):
+        self.store = store
+        self.node_rank = node_rank
+        self.ttl = ttl
+        self._stop = False
+
+    def heartbeat(self) -> None:
+        self.store.set(f"heartbeat/{self.node_rank}", str(time.time()))
+
+    def alive_nodes(self, nnodes: int) -> List[int]:
+        now = time.time()
+        out = []
+        for i in range(nnodes):
+            v = self.store.get(f"heartbeat/{i}")
+            if v is not None and now - float(v) < self.ttl:
+                out.append(i)
+        return out
+
+    def membership_changed(self, expected: int) -> bool:
+        return len(self.alive_nodes(expected)) != expected

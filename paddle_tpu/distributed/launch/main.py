@@ -1,0 +1,48 @@
+"""Launcher CLI entry (ref: python/paddle/distributed/launch/main.py).
+
+Usage parity:
+    python -m paddle_tpu.distributed.launch \
+        [--nnodes N[:M]] [--node_rank R] [--nproc_per_node P] \
+        [--master HOST:PORT] [--log_dir DIR] [--devices 0,1] \
+        [--max_restarts K] training_script [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .controllers import CollectiveController
+
+__all__ = ["launch", "main"]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="paddle-parity multi-host launcher for TPU pods")
+    p.add_argument("--nnodes", default="1",
+                   help="node count, or MIN:MAX for elastic")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (TPU: one per host)")
+    p.add_argument("--master", default=None,
+                   help="HOST:PORT of the rendezvous store (rank-0 host)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--devices", default=None,
+                   help="visible accelerator ids, e.g. '0,1'")
+    p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("--rdzv_timeout", type=float, default=120.0)
+    p.add_argument("--poll_interval", type=float, default=0.2)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    return CollectiveController(args).run()
+
+
+def main() -> None:  # console entry
+    sys.exit(launch())

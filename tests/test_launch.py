@@ -1,0 +1,84 @@
+"""Launcher CLI: spawn, rank env, workerlogs, restart policy (SURVEY P14)."""
+
+import os
+import textwrap
+
+from paddle_tpu.distributed.launch import launch
+
+
+def _write_script(tmp_path, body):
+    p = tmp_path / "trainer.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_spawn_two_ranks_env_and_logs(tmp_path):
+    out = tmp_path / "env"
+    out.mkdir()
+    script = _write_script(tmp_path, f"""
+        import os, json
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        keep = {{k: v for k, v in os.environ.items()
+                if k.startswith(("PADDLE_", "JAX_", "COORDINATOR"))}}
+        with open(os.path.join({str(out)!r}, rank + ".json"), "w") as f:
+            json.dump(keep, f)
+        print("rank", rank, "done")
+    """)
+    rc = launch(["--nproc_per_node", "2", "--log_dir",
+                 str(tmp_path / "log"), script])
+    assert rc == 0
+    import json
+    e0 = json.load(open(out / "0.json"))
+    e1 = json.load(open(out / "1.json"))
+    assert e0["PADDLE_TRAINERS_NUM"] == "2"
+    assert e1["PADDLE_TRAINER_ID"] == "1"
+    assert e0["JAX_NUM_PROCESSES"] == "2"
+    assert e0["COORDINATOR_ADDRESS"] == e1["COORDINATOR_ADDRESS"]
+    assert len(e0["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 2
+    # per-rank logs written (ref: workerlog.N)
+    log0 = (tmp_path / "log" / "workerlog.0").read_text()
+    assert "rank 0 done" in log0
+    assert "rank 1 done" in (tmp_path / "log" / "workerlog.1").read_text()
+
+
+def test_nonzero_exit_propagates(tmp_path):
+    script = _write_script(tmp_path, """
+        import sys
+        sys.exit(3)
+    """)
+    rc = launch(["--nproc_per_node", "1", "--log_dir",
+                 str(tmp_path / "log"), script])
+    assert rc == 3
+
+
+def test_restart_policy_recovers(tmp_path):
+    sentinel = tmp_path / "came_before"
+    script = _write_script(tmp_path, f"""
+        import os, sys
+        s = {str(sentinel)!r}
+        if not os.path.exists(s):
+            open(s, "w").write("x")
+            sys.exit(1)   # first attempt fails
+        print("second attempt ok")
+    """)
+    rc = launch(["--nproc_per_node", "1", "--max_restarts", "1",
+                 "--log_dir", str(tmp_path / "log"), script])
+    assert rc == 0
+    assert "second attempt ok" in (tmp_path / "log" / "workerlog.0").read_text()
+
+
+def test_elastic_manager_membership():
+    from paddle_tpu.native import TCPStore
+    from paddle_tpu.distributed.launch import ElasticManager
+    s = TCPStore(is_master=True, world_size=2)
+    try:
+        m0 = ElasticManager(s, node_rank=0, ttl=5.0)
+        m1 = ElasticManager(s, node_rank=1, ttl=5.0)
+        m0.heartbeat()
+        assert m0.alive_nodes(2) == [0]
+        assert m0.membership_changed(expected=2)
+        m1.heartbeat()
+        assert m0.alive_nodes(2) == [0, 1]
+        assert not m0.membership_changed(expected=2)
+    finally:
+        s.close()
