@@ -6,5 +6,6 @@ python/paddle/incubate/distributed/models/moe/ — SURVEY §2.2 incubate row,
 """
 
 from . import moe  # noqa: F401
+from . import nn  # noqa: F401
 
-__all__ = ["moe"]
+__all__ = ["moe", "nn"]

@@ -1,0 +1,3 @@
+"""paddle.incubate.nn parity (fused-op wrappers)."""
+
+from . import functional  # noqa: F401

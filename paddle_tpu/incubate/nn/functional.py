@@ -1,0 +1,103 @@
+"""paddle.incubate.nn.functional parity surface (ref:
+python/paddle/incubate/nn/functional/ — SURVEY §2.2 incubate row).
+
+Each name maps onto the Pallas/XLA fused op set in paddle_tpu.ops; Tensor
+wrappers go through core.dispatch so autograd/jit see them as single ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...ops.fused import (fused_layer_norm as _ln, fused_rms_norm as _rms,
+                          fused_rope as _rope, swiglu as _swiglu)
+from ...ops.quant import (weight_only_linear as _wol,
+                          weight_quantize as _wq)
+from ...ops.paged_attention import paged_attention as _paged
+
+__all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "swiglu",
+           "weight_only_linear", "weight_quantize",
+           "block_multihead_attention", "fused_linear"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    def impl(xa, w):
+        out = _rms(xa, w, eps=epsilon)
+        if norm_bias is not None:
+            out = out + _arr(norm_bias)
+        return out
+    return apply("fused_rms_norm", impl, [x, norm_weight])
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1):
+    def impl(xa, w, b):
+        return _ln(xa, w, b, eps=epsilon)
+    return apply("fused_layer_norm", impl, [x, norm_weight, norm_bias])
+
+
+def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """ref signature: returns (q, k, v) rotated. cos/sin: [S, D/2] (or
+    [S, D] paddle-style — halved here)."""
+    ca, sa = _arr(cos), _arr(sin)
+    if ca.shape[-1] == _arr(q).shape[-1]:
+        ca, sa = ca[..., ::2], sa[..., ::2]
+
+    def impl(qa, ka):
+        return _rope(qa, ka, ca, sa)
+    qo, ko = apply("fused_rope", impl, [q, k])
+    return (qo, ko, v) if v is not None else (qo, ko, None)
+
+
+def swiglu(x, y=None):
+    if y is None:
+        return apply("swiglu", lambda a: _swiglu(a), [x])
+    return apply("swiglu", lambda a, b: _swiglu(a, b), [x, y])
+
+
+def weight_quantize(x, algo: str = "weight_only_int8"):
+    qw, scale = _wq(_arr(x), algo)
+    return Tensor(qw), Tensor(scale)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype: str = "int8", arch=None):
+    algo = ("weight_only_int4" if "int4" in str(weight_dtype)
+            else "weight_only_int8")
+    qw, sc = _arr(weight), _arr(weight_scale)
+    ba = None if bias is None else _arr(bias)
+
+    def impl(xa):
+        return _wol(xa, qw, sc, bias=ba, algo=algo)
+    return apply("weight_only_linear", impl, [x])
+
+
+def block_multihead_attention(q, k_pages, v_pages, seq_lens, block_tables,
+                              **kw):
+    """ref: block_multihead_attention — paged KV-cache decode attention."""
+    kp, vp = _arr(k_pages), _arr(v_pages)
+    ln, bt = _arr(seq_lens), _arr(block_tables)
+
+    def impl(qa):
+        return _paged(qa, kp, vp, ln, bt)
+    return apply("block_multihead_attention", impl, [q])
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    def impl(xa, wa, *rest):
+        w = wa.T if transpose_weight else wa
+        out = xa @ w
+        if rest:
+            out = out + rest[0]
+        return out
+    ins = [x, weight] + ([bias] if bias is not None else [])
+    return apply("fused_linear", impl, ins)

@@ -23,6 +23,13 @@ def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
     """
     G = rhs.shape[0]
     if prefer_ragged:
+        if jax.default_backend() == "tpu":
+            try:
+                # megablox gmm: the Pallas TPU grouped-GEMM kernel
+                from jax.experimental.pallas.ops.tpu.megablox import gmm
+                return gmm(lhs, rhs, group_sizes.astype(jnp.int32))
+            except Exception:  # pragma: no cover - kernel constraints
+                pass
         try:
             return jax.lax.ragged_dot(lhs, rhs, group_sizes.astype(jnp.int32))
         except Exception:  # pragma: no cover - backend-specific gaps

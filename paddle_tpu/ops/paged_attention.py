@@ -1,0 +1,96 @@
+"""Paged / block KV-cache attention for serving decode.
+
+Reference capability (SURVEY §2.1 fused kernels): BlockMultiheadAttention /
+masked_multihead_attention (paged KV cache decoding kernels,
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention*).
+
+TPU-native: routes to the in-tree Pallas TPU paged-attention kernel
+(jax.experimental.pallas.ops.tpu.paged_attention — the Ragged-Paged-
+Attention lineage from PAPERS.md) on TPU; elsewhere a gather-based XLA
+reference implements identical semantics for tests and CPU serving.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention", "paged_attention_reference", "append_to_cache"]
+
+
+def paged_attention_reference(q, k_pages, v_pages, lengths, page_indices,
+                              scale: Optional[float] = None):
+    """Decode-step attention against a paged KV cache.
+
+    q:            [B, H, D]           (one query token per sequence)
+    k/v_pages:    [num_kv_heads, total_pages, page_size, D]
+    lengths:      [B] int32           current KV length per sequence
+    page_indices: [B, pages_per_seq]  page table
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    B, H, D = q.shape
+    KV = k_pages.shape[0]
+    page_size = k_pages.shape[2]
+    pages_per_seq = page_indices.shape[1]
+    rep = H // KV
+
+    # gather each sequence's pages: [B, KV, pages_per_seq*page_size, D]
+    def per_seq(pi):
+        k = k_pages[:, pi]                      # [KV, pages, psize, D]
+        v = v_pages[:, pi]
+        return (k.reshape(KV, pages_per_seq * page_size, D),
+                v.reshape(KV, pages_per_seq * page_size, D))
+    ks, vs = jax.vmap(per_seq)(page_indices)
+
+    if rep > 1:
+        ks = jnp.repeat(ks, rep, axis=1)
+        vs = jnp.repeat(vs, rep, axis=1)
+
+    s = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
+                   ks.astype(jnp.float32)) * scale
+    pos = jnp.arange(pages_per_seq * page_size)
+    mask = pos[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bhkd->bhd", p, vs.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, lengths, page_indices,
+                    scale: Optional[float] = None):
+    """TPU: Pallas paged-attention kernel; else: XLA reference."""
+    if jax.default_backend() == "tpu":
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention as _kernel)
+            sq = q if scale is None else q * (scale * q.shape[-1] ** 0.5)
+            return _kernel(sq, k_pages, v_pages, lengths.astype(jnp.int32),
+                           page_indices.astype(jnp.int32))
+        except Exception:
+            pass
+    return paged_attention_reference(q, k_pages, v_pages, lengths,
+                                     page_indices, scale)
+
+
+def append_to_cache(k_pages, v_pages, k_new, v_new, lengths, page_indices):
+    """Write one decode step's K/V into the paged cache (functional update).
+
+    k_new/v_new: [B, KV, D]; returns updated (k_pages, v_pages, lengths).
+    """
+    page_size = k_pages.shape[2]
+    B = k_new.shape[0]
+    slot = lengths  # position to write
+    page_of = page_indices[jnp.arange(B), slot // page_size]
+    off = slot % page_size
+
+    def write(pages, new):
+        # pages [KV, P, S, D]; scatter one row per (b, kv head)
+        def body(pages, b):
+            return pages.at[:, page_of[b], off[b], :].set(new[b]), None
+        pages, _ = jax.lax.scan(body, pages, jnp.arange(B))
+        return pages
+
+    return (write(k_pages, k_new), write(v_pages, v_new), lengths + 1)

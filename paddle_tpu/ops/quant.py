@@ -1,0 +1,119 @@
+"""Weight-only quantized linear (int8/int4) for serving.
+
+Reference capability (SURVEY §2.1 fused kernels): WeightOnlyLinearKernel +
+python/paddle/incubate/nn/functional weight_only_linear / weight_quantize.
+
+TPU-native: per-output-channel symmetric int8 (or packed int4) weights
+dequantized in-kernel; a Pallas kernel tiles the matmul onto the MXU with
+dequant fused into the VMEM load (one HBM pass over the quantized weights —
+the bandwidth win is the point of weight-only quant). Interpret mode keeps
+it testable on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear"]
+
+
+def weight_quantize(w, algo: str = "weight_only_int8"):
+    """w [K, N] -> (quantized weight, per-channel scale [N]).
+    int8: symmetric absmax; int4: packed two nibbles per int8 byte."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=0)
+    if algo == "weight_only_int8":
+        scale = absmax / 127.0
+        q = jnp.clip(jnp.round(wf / jnp.maximum(scale, 1e-8)), -127, 127)
+        return q.astype(jnp.int8), scale
+    if algo == "weight_only_int4":
+        scale = absmax / 7.0
+        q = jnp.clip(jnp.round(wf / jnp.maximum(scale, 1e-8)), -7, 7)
+        qi = q.astype(jnp.int8)
+        K = qi.shape[0]
+        if K % 2:
+            raise ValueError("int4 pack needs even K")
+        lo = qi[0::2] & 0xF
+        hi = (qi[1::2] & 0xF) << 4
+        return (lo | hi).astype(jnp.int8), scale
+    raise ValueError(f"unknown algo: {algo}")
+
+
+def weight_dequantize(qw, scale, algo: str = "weight_only_int8"):
+    if algo == "weight_only_int8":
+        return qw.astype(jnp.float32) * scale[None, :]
+    if algo == "weight_only_int4":
+        lo = (qw << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
+        hi = qw.astype(jnp.int8) >> 4
+        K2, N = qw.shape
+        out = jnp.zeros((K2 * 2, N), jnp.int8)
+        out = out.at[0::2].set(lo).at[1::2].set(hi)
+        return out.astype(jnp.float32) * scale[None, :]
+    raise ValueError(f"unknown algo: {algo}")
+
+
+def _wol_kernel(x_ref, qw_ref, s_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    w = qw_ref[:].astype(jnp.float32) * s_ref[:].astype(jnp.float32)[None, :]
+    o_ref[:] = jnp.dot(
+        x, w, preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _wol_int8(x2, qw, scale):
+    return _wol_int8_fwd_impl(x2, qw, scale)
+
+
+def _wol_int8_fwd_impl(x2, qw, scale):
+    M, K = x2.shape
+    N = qw.shape[1]
+    bm = 128 if M % 128 == 0 else (8 if M % 8 == 0 else 1)
+    return pl.pallas_call(
+        _wol_kernel,
+        grid=(M // bm,),
+        in_specs=[pl.BlockSpec((bm, K), lambda i: (i, 0)),
+                  pl.BlockSpec((K, N), lambda i: (0, 0)),
+                  pl.BlockSpec((N,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((bm, N), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x2.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(x2, qw, scale)
+
+
+def _wol_int8_fwd(x2, qw, scale):
+    return _wol_int8_fwd_impl(x2, qw, scale), (qw, scale)
+
+
+def _wol_int8_bwd(res, g):
+    qw, scale = res
+    w = qw.astype(jnp.float32) * scale[None, :]
+    dx = (g.astype(jnp.float32) @ w.T).astype(g.dtype)
+    return dx, None, None
+
+
+_wol_int8.defvjp(_wol_int8_fwd, _wol_int8_bwd)
+
+
+def weight_only_linear(x, qweight, scale, bias=None,
+                       algo: str = "weight_only_int8"):
+    """x [..., K] @ dequant(qweight [K, N]) + bias.
+
+    int8 path runs the fused dequant+matmul Pallas kernel; int4 unpacks via
+    XLA then reuses the same matmul (packing is a memory-format detail).
+    """
+    shape = x.shape
+    K = shape[-1]
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+    if algo == "weight_only_int4":
+        w = weight_dequantize(qweight, scale, algo).astype(x.dtype)
+        out = x2 @ w
+    else:
+        out = _wol_int8(x2, qweight, scale)
+    if bias is not None:
+        out = out + bias
+    return out.reshape(*shape[:-1], out.shape[-1])
