@@ -1,0 +1,199 @@
+"""PP-OCR-style detection + recognition models (SURVEY §2.4 config 4).
+
+Reference capability: PaddleOCR PP-OCRv4 det+rec — MobileNetV3/PP-LCNet
+backbones, DB (Differentiable Binarization) detection head, CTC recognition
+head (SVTR-lite style), warpctc loss (here: optax CTC via
+paddle_tpu.nn.functional.ctc_loss — the XLA path replaces the warpctc
+external). These conv-heavy CNNs are the non-transformer canary for the
+framework (SURVEY §7.2 item 5): NCHW user API, XLA retiles for the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.manipulation import concat
+from ..vision.models import MobileNetV3Small, _make_divisible
+
+__all__ = ["DBHead", "DBFPN", "PPOCRDet", "CTCHead", "PPOCRRec",
+           "db_postprocess"]
+
+
+# ---------------------------------------------------------------------------
+# detection: backbone -> FPN neck -> DB head
+# ---------------------------------------------------------------------------
+
+class DBFPN(nn.Layer):
+    """Lite FPN neck (ref: PaddleOCR ppocr/modeling/necks/db_fpn.py):
+    laterals 1x1 -> top-down upsample+add -> 3x3 smooth -> concat."""
+
+    def __init__(self, in_channels: List[int], out_channels: int = 96):
+        super().__init__()
+        self.out_channels = out_channels
+        self.lat = nn.LayerList([
+            nn.Conv2D(c, out_channels, 1, bias_attr=False)
+            for c in in_channels])
+        self.smooth = nn.LayerList([
+            nn.Conv2D(out_channels, out_channels // 4, 3, padding=1,
+                      bias_attr=False)
+            for _ in in_channels])
+
+    def forward(self, feats):
+        lats = [l(f) for l, f in zip(self.lat, feats)]
+        # top-down pathway
+        for i in range(len(lats) - 1, 0, -1):
+            up = F.interpolate(lats[i], size=lats[i - 1].shape[2:],
+                               mode="nearest")
+            lats[i - 1] = lats[i - 1] + up
+        outs = []
+        target = lats[0].shape[2:]
+        for s, l in zip(self.smooth, lats):
+            o = s(l)
+            if tuple(o.shape[2:]) != tuple(target):
+                o = F.interpolate(o, size=target, mode="nearest")
+            outs.append(o)
+        return concat(outs, axis=1)
+
+
+class DBHead(nn.Layer):
+    """Differentiable Binarization head (ref: ppocr/modeling/heads/
+    det_db_head.py): probability + threshold maps, fused into the binary map
+    b = 1/(1+exp(-k(p-t)))."""
+
+    def __init__(self, in_channels: int, k: int = 50):
+        super().__init__()
+        self.k = k
+        mid = in_channels // 4
+
+        def branch():
+            return nn.Sequential(
+                nn.Conv2D(in_channels, mid, 3, padding=1, bias_attr=False),
+                nn.BatchNorm2D(mid), nn.ReLU(),
+                nn.Conv2DTranspose(mid, mid, 2, stride=2),
+                nn.BatchNorm2D(mid), nn.ReLU(),
+                nn.Conv2DTranspose(mid, 1, 2, stride=2),
+                nn.Sigmoid())
+        self.prob = branch()
+        self.thresh = branch()
+
+    def forward(self, x):
+        p = self.prob(x)
+        if not self.training:
+            return {"maps": p}
+        t = self.thresh(x)
+        from ..core.dispatch import apply
+
+        def bin_map(pa, ta):
+            return 1.0 / (1.0 + jnp.exp(-self.k * (pa - ta)))
+        b = apply("db_binarize", bin_map, [p, t])
+        return {"maps": concat([p, t, b], axis=1)}
+
+
+class PPOCRDet(nn.Layer):
+    """MobileNetV3 backbone + DBFPN + DBHead."""
+
+    def __init__(self, in_channels: int = 3, scale: float = 0.5):
+        super().__init__()
+        self.backbone = MobileNetV3Small(
+            num_classes=0, with_pool=False, in_channels=in_channels,
+            scale=scale, feature_only=True, out_indices=(0, 3, 8, 10))
+        chans = [_make_divisible(16 * scale), _make_divisible(40 * scale),
+                 _make_divisible(96 * scale), _make_divisible(96 * scale)]
+        self.neck = DBFPN(chans, out_channels=96)
+        self.head = DBHead(96)
+
+    def forward(self, x):
+        feats = self.backbone(x)
+        return self.head(self.neck(feats))
+
+
+def db_postprocess(prob_map, thresh: float = 0.3, min_area: int = 4):
+    """Minimal DB postprocess: binarize + connected-component boxes on host
+    (ref: ppocr/postprocess/db_postprocess.py; the reference uses pyclipper —
+    here a numpy flood-fill bounding-box pass keeps it dependency-free)."""
+    import numpy as np
+    pm = np.asarray(prob_map)
+    if pm.ndim == 4:
+        pm = pm[0, 0]
+    binm = (pm > thresh).astype(np.uint8)
+    H, W = binm.shape
+    seen = np.zeros_like(binm, bool)
+    boxes = []
+    for i in range(H):
+        for j in range(W):
+            if binm[i, j] and not seen[i, j]:
+                stack = [(i, j)]
+                seen[i, j] = True
+                ys, xs = [], []
+                while stack:
+                    y, x = stack.pop()
+                    ys.append(y)
+                    xs.append(x)
+                    for dy, dx in ((1, 0), (-1, 0), (0, 1), (0, -1)):
+                        ny, nx = y + dy, x + dx
+                        if 0 <= ny < H and 0 <= nx < W and binm[ny, nx] \
+                                and not seen[ny, nx]:
+                            seen[ny, nx] = True
+                            stack.append((ny, nx))
+                if len(ys) >= min_area:
+                    boxes.append((min(xs), min(ys), max(xs), max(ys)))
+    return boxes
+
+
+# ---------------------------------------------------------------------------
+# recognition: backbone -> seq encoder -> CTC head
+# ---------------------------------------------------------------------------
+
+class CTCHead(nn.Layer):
+    """ref: ppocr/modeling/heads/rec_ctc_head.py — linear projection to the
+    charset, log-softmax over classes; trained with CTC."""
+
+    def __init__(self, in_channels: int, num_classes: int, mid: int = 0):
+        super().__init__()
+        if mid:
+            self.fc = nn.Sequential(nn.Linear(in_channels, mid), nn.ReLU(),
+                                    nn.Linear(mid, num_classes))
+        else:
+            self.fc = nn.Linear(in_channels, num_classes)
+
+    def forward(self, x):
+        return self.fc(x)  # [B, T, num_classes] logits
+
+
+class PPOCRRec(nn.Layer):
+    """Text recognizer: conv backbone squeezing height -> per-column
+    features -> mixer MLP (SVTR-lite flavor) -> CTC head."""
+
+    def __init__(self, num_classes: int = 97, in_channels: int = 3,
+                 scale: float = 0.5, hidden: int = 120):
+        super().__init__()
+        self.backbone = MobileNetV3Small(
+            num_classes=0, with_pool=False, in_channels=in_channels,
+            scale=scale, feature_only=True, out_indices=(10,))
+        cback = _make_divisible(96 * scale)
+        self.squeeze = nn.Conv2D(cback, hidden, 1, bias_attr=False)
+        self.mix = nn.Sequential(nn.Linear(hidden, hidden), nn.GELU(),
+                                 nn.Linear(hidden, hidden))
+        self.head = CTCHead(hidden, num_classes)
+
+    def forward(self, x):
+        f = self.backbone(x)[0]          # [B, C, H', W']
+        f = self.squeeze(f)              # [B, hid, H', W']
+        f = f.mean(axis=2)               # pool height -> [B, hid, W']
+        f = f.transpose([0, 2, 1])       # [B, T=W', hid]
+        f = f + self.mix(f)
+        return self.head(f)              # [B, T, classes]
+
+    def loss(self, logits, labels, label_lengths):
+        """CTC loss (ref: warpctc externals — XLA path via optax)."""
+        B, T, C = logits.shape
+        from ..core.tensor import Tensor
+        input_lens = Tensor(jnp.full((B,), T, jnp.int32))
+        # ctc_loss log-softmaxes internally ([T, B, C] paddle convention)
+        return F.ctc_loss(logits.transpose([1, 0, 2]), labels,
+                          input_lens, label_lengths, blank=0,
+                          reduction="mean")

@@ -1,0 +1,9 @@
+"""paddle.vision parity (ref: python/paddle/vision/ — SURVEY §2.2 vision
+row): model zoo, transforms, datasets."""
+
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from .datasets import Cifar10, FakeData, MNIST  # noqa: F401
+from .models import (LeNet, MobileNetV3Small, ResNet, resnet18,  # noqa: F401
+                     resnet34, resnet50, mobilenet_v3_small)

@@ -1,0 +1,122 @@
+"""paddle.vision.datasets parity (ref: python/paddle/vision/datasets/).
+
+This environment has zero egress, so the download paths the reference uses
+are unavailable; datasets load from local files when present and `FakeData`
+provides deterministic synthetic data for tests/benchmarks (the reference's
+own unit tests use small fake batches the same way).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["FakeData", "MNIST", "Cifar10"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset."""
+
+    def __init__(self, num_samples=64, image_shape=(3, 32, 32),
+                 num_classes=10, transform: Optional[Callable] = None,
+                 seed=0):
+        self.n = num_samples
+        self.shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.RandomState(seed)
+        self.images = rng.rand(num_samples, *self.shape).astype(np.float32)
+        self.labels = rng.randint(0, num_classes, num_samples) \
+            .astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.n
+
+
+class MNIST(Dataset):
+    """Loads the standard IDX files from ``root`` (no download)."""
+
+    FILES = {
+        "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+        "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+    }
+
+    def __init__(self, root: str = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False):
+        self.transform = transform
+        if root is None or not os.path.isdir(root):
+            raise RuntimeError(
+                "MNIST requires local IDX files (zero-egress environment): "
+                "pass root= pointing at train-images-idx3-ubyte.gz etc.")
+        img_f, lab_f = self.FILES["train" if mode == "train" else "test"]
+        self.images = self._read_images(os.path.join(root, img_f))
+        self.labels = self._read_labels(os.path.join(root, lab_f))
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            _, n, h, w = struct.unpack(">IIII", f.read(16))
+            return np.frombuffer(f.read(), np.uint8).reshape(n, h, w)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar10(Dataset):
+    """Loads the python-pickle CIFAR-10 batches from ``root``."""
+
+    def __init__(self, root: str = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False):
+        import pickle
+        self.transform = transform
+        if root is None or not os.path.isdir(root):
+            raise RuntimeError(
+                "Cifar10 requires the local cifar-10-batches-py directory "
+                "(zero-egress environment)")
+        names = [f"data_batch_{i}" for i in range(1, 6)] \
+            if mode == "train" else ["test_batch"]
+        xs, ys = [], []
+        for nm in names:
+            with open(os.path.join(root, nm), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.uint8)
+                      .reshape(-1, 3, 32, 32))
+            ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs)
+        self.labels = np.asarray(ys, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)

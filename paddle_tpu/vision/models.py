@@ -1,0 +1,257 @@
+"""Vision model zoo (ref: python/paddle/vision/models/ — resnet.py,
+mobilenetv3.py, lenet.py). NCHW layouts as in the reference; on TPU, XLA
+re-lays out convs for the MXU, so the user-facing format stays paddle-like.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Type
+
+from .. import nn
+
+__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
+           "MobileNetV3Small", "mobilenet_v3_small"]
+
+
+class LeNet(nn.Layer):
+    """ref: python/paddle/vision/models/lenet.py."""
+
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.Linear(120, 84),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.reshape([x.shape[0], -1])
+        return self.fc(x)
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(planes)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(planes)
+        self.conv3 = nn.Conv2D(planes, planes * 4, 1, bias_attr=False)
+        self.bn3 = nn.BatchNorm2D(planes * 4)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    """ref: python/paddle/vision/models/resnet.py."""
+
+    def __init__(self, block, depth_cfg: List[int], num_classes: int = 1000,
+                 with_pool: bool = True, in_channels: int = 3):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = nn.Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(64)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, depth_cfg[0])
+        self.layer2 = self._make_layer(block, 128, depth_cfg[1], 2)
+        self.layer3 = self._make_layer(block, 256, depth_cfg[2], 2)
+        self.layer4 = self._make_layer(block, 512, depth_cfg[3], 2)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.inplanes, planes * block.expansion, 1,
+                          stride=stride, bias_attr=False),
+                nn.BatchNorm2D(planes * block.expansion))
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(BasicBlock, [2, 2, 2, 2], **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet(BasicBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], **kw)
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        mid = _make_divisible(channels // reduction)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, mid, 1)
+        self.fc2 = nn.Conv2D(mid, channels, 1)
+        self.relu = nn.ReLU()
+        self.hs = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hs(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, cin, cmid, cout, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if cmid != cin:
+            layers += [nn.Conv2D(cin, cmid, 1, bias_attr=False),
+                       nn.BatchNorm2D(cmid), act()]
+        layers += [nn.Conv2D(cmid, cmid, k, stride=stride, padding=k // 2,
+                             groups=cmid, bias_attr=False),
+                   nn.BatchNorm2D(cmid), act()]
+        if use_se:
+            layers.append(SqueezeExcite(cmid))
+        layers += [nn.Conv2D(cmid, cout, 1, bias_attr=False),
+                   nn.BatchNorm2D(cout)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3Small(nn.Layer):
+    """ref: python/paddle/vision/models/mobilenetv3.py (small config) — also
+    the PP-OCR backbone family (PaddleOCR ppocr/modeling/backbones)."""
+
+    # k, exp, out, se, act, stride
+    CFG = [
+        (3, 16, 16, True, nn.ReLU, 2),
+        (3, 72, 24, False, nn.ReLU, 2),
+        (3, 88, 24, False, nn.ReLU, 1),
+        (5, 96, 40, True, nn.Hardswish, 2),
+        (5, 240, 40, True, nn.Hardswish, 1),
+        (5, 240, 40, True, nn.Hardswish, 1),
+        (5, 120, 48, True, nn.Hardswish, 1),
+        (5, 144, 48, True, nn.Hardswish, 1),
+        (5, 288, 96, True, nn.Hardswish, 2),
+        (5, 576, 96, True, nn.Hardswish, 1),
+        (5, 576, 96, True, nn.Hardswish, 1),
+    ]
+
+    def __init__(self, num_classes: int = 1000, scale: float = 1.0,
+                 with_pool: bool = True, in_channels: int = 3,
+                 feature_only: bool = False, out_indices=(0, 3, 8, 10)):
+        super().__init__()
+        self.feature_only = feature_only
+        self.out_indices = set(out_indices)
+        cin = _make_divisible(16 * scale)
+        self.stem = nn.Sequential(
+            nn.Conv2D(in_channels, cin, 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(cin), nn.Hardswish())
+        blocks = []
+        self.feat_channels = []
+        for (k, exp, cout, se, act, s) in self.CFG:
+            cmid = _make_divisible(exp * scale)
+            co = _make_divisible(cout * scale)
+            blocks.append(InvertedResidual(cin, cmid, co, k, s, se, act))
+            cin = co
+        self.blocks = nn.LayerList(blocks)
+        clast = _make_divisible(576 * scale)
+        self.head_conv = nn.Sequential(
+            nn.Conv2D(cin, clast, 1, bias_attr=False),
+            nn.BatchNorm2D(clast), nn.Hardswish())
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(clast, 1024), nn.Hardswish(),
+                nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for i, b in enumerate(self.blocks):
+            x = b(x)
+            if i in self.out_indices:
+                feats.append(x)
+        if self.feature_only:
+            return feats
+        x = self.head_conv(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v3_small(**kw) -> MobileNetV3Small:
+    return MobileNetV3Small(**kw)

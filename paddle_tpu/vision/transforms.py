@@ -1,0 +1,165 @@
+"""paddle.vision.transforms parity (ref: python/paddle/vision/transforms/).
+
+Host-side preprocessing on numpy arrays (HWC uint8/float), emitting CHW
+float arrays for the NCHW model zoo — matching the reference's default
+pipeline. Resize uses jax.image on host CPU.
+"""
+
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad"]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor:
+    """HWC [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.astype(np.float32)
+        if arr.max() > 1.0:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _size2hw(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.h, self.w = _size2hw(size)
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        import jax
+        arr = np.asarray(img, np.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+            arr.shape[0] < arr.shape[-1]
+        if arr.ndim == 2:
+            out = jax.image.resize(arr, (self.h, self.w), self.interpolation)
+        elif chw:
+            out = jax.image.resize(arr, (arr.shape[0], self.h, self.w),
+                                   self.interpolation)
+        else:
+            out = jax.image.resize(arr, (self.h, self.w, arr.shape[2]),
+                                   self.interpolation)
+        return np.asarray(out)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.h, self.w = _size2hw(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        H, W = arr.shape[-3:-1] if arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            else arr.shape[:2]
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+            arr.shape[0] < arr.shape[-1]
+        if chw:
+            H, W = arr.shape[1], arr.shape[2]
+            top = max(0, (H - self.h) // 2)
+            left = max(0, (W - self.w) // 2)
+            return arr[:, top:top + self.h, left:left + self.w]
+        H, W = arr.shape[0], arr.shape[1]
+        top = max(0, (H - self.h) // 2)
+        left = max(0, (W - self.w) // 2)
+        return arr[top:top + self.h, left:left + self.w]
+
+
+class RandomCrop:
+    def __init__(self, size):
+        self.h, self.w = _size2hw(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        H, W = arr.shape[0], arr.shape[1]
+        top = random.randint(0, max(0, H - self.h))
+        left = random.randint(0, max(0, W - self.w))
+        return arr[top:top + self.h, left:left + self.w]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            arr = np.asarray(img)
+            # width axis: 1 for HW/HWC, 2 for CHW
+            waxis = 2 if (arr.ndim == 3 and arr.shape[0] in (1, 3)) else 1
+            return np.flip(arr, axis=waxis).copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return np.asarray(img)[::-1]
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else (padding,) * 4  # l, t, r, b
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        pads = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+        return np.pad(arr, pads, constant_values=self.fill)

@@ -1,0 +1,127 @@
+"""Vision model zoo + PP-OCR det/rec (SURVEY §2.2 vision, §2.4 config 4)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as opt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision import (FakeData, LeNet, MobileNetV3Small, resnet18,
+                               resnet50, transforms)
+from paddle_tpu.models.ocr import PPOCRDet, PPOCRRec, db_postprocess
+
+
+def _img(*shape, seed=0):
+    return Tensor(jnp.asarray(
+        np.random.RandomState(seed).rand(*shape).astype(np.float32)))
+
+
+class TestModels:
+    def test_lenet_forward(self):
+        m = LeNet(num_classes=10)
+        out = m(_img(2, 1, 28, 28))
+        assert tuple(out.shape) == (2, 10)
+
+    def test_resnet18_forward_and_train_step(self):
+        m = resnet18(num_classes=10)
+        x = _img(2, 3, 32, 32, seed=1)
+        y = m(x)
+        assert tuple(y.shape) == (2, 10)
+        labels = Tensor(jnp.asarray([1, 2], jnp.int64))
+        loss = nn.CrossEntropyLoss()(y, labels)
+        loss.backward()
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        o.step()
+        assert np.isfinite(float(loss))
+
+    def test_resnet50_forward(self):
+        m = resnet50(num_classes=4)
+        out = m(_img(1, 3, 64, 64, seed=2))
+        assert tuple(out.shape) == (1, 4)
+
+    def test_mobilenetv3_forward_and_features(self):
+        m = MobileNetV3Small(num_classes=5, scale=0.5)
+        out = m(_img(1, 3, 64, 64, seed=3))
+        assert tuple(out.shape) == (1, 5)
+        fe = MobileNetV3Small(num_classes=0, with_pool=False, scale=0.5,
+                              feature_only=True)
+        feats = fe(_img(1, 3, 64, 64, seed=4))
+        assert len(feats) == 4
+        # strides: 4, 8, 16, 32
+        assert feats[0].shape[2] == 16 and feats[-1].shape[2] == 2
+
+
+class TestTransformsDatasets:
+    def test_pipeline(self):
+        tf = transforms.Compose([
+            transforms.Resize(40),
+            transforms.RandomCrop(32),
+            transforms.RandomHorizontalFlip(0.5),
+            transforms.ToTensor(),
+            transforms.Normalize([0.5] * 3, [0.5] * 3),
+        ])
+        img = (np.random.RandomState(0).rand(48, 48, 3) * 255).astype(
+            np.uint8)
+        out = tf(img)
+        assert out.shape == (3, 32, 32)
+        assert out.dtype == np.float32
+        assert -1.1 <= out.min() and out.max() <= 1.1
+
+    def test_fakedata_with_loader(self):
+        from paddle_tpu.io import DataLoader
+        ds = FakeData(num_samples=16, image_shape=(3, 8, 8), num_classes=3)
+        dl = DataLoader(ds, batch_size=4, shuffle=True)
+        batches = list(dl)
+        assert len(batches) == 4
+        xb, yb = batches[0]
+        assert tuple(np.asarray(xb._data if hasattr(xb, "_data") else xb)
+                     .shape) == (4, 3, 8, 8)
+
+
+class TestOCR:
+    def test_det_train_maps_and_grad(self):
+        det = PPOCRDet(scale=0.5)
+        det.train()
+        x = _img(1, 3, 64, 64, seed=5)
+        out = det(x)["maps"]
+        assert tuple(out.shape) == (1, 3, 64, 64)  # p, t, b maps at input res
+        # BCE on prob map flows gradients to the backbone
+        target = Tensor(jnp.zeros((1, 1, 64, 64), jnp.float32))
+        p = out[:, :1]
+        loss = nn.BCELoss()(p, target)
+        loss.backward()
+        g = det.backbone.stem[0].weight.grad
+        assert g is not None and float(jnp.abs(g._data).max()) > 0
+
+    def test_det_eval_mode_prob_only(self):
+        det = PPOCRDet(scale=0.5)
+        det.eval()
+        out = det(_img(1, 3, 32, 32, seed=6))["maps"]
+        assert tuple(out.shape) == (1, 1, 32, 32)
+
+    def test_db_postprocess_finds_blob(self):
+        pm = np.zeros((32, 32), np.float32)
+        pm[5:10, 6:12] = 0.9
+        boxes = db_postprocess(pm, thresh=0.5)
+        assert len(boxes) == 1
+        x0, y0, x1, y1 = boxes[0]
+        assert (x0, y0, x1, y1) == (6, 5, 11, 9)
+
+    def test_rec_ctc_training_step_reduces_loss(self):
+        rec = PPOCRRec(num_classes=11, scale=0.5)
+        x = _img(2, 3, 32, 256, seed=7)           # T = 8 columns
+        labels = Tensor(jnp.asarray(
+            np.random.RandomState(8).randint(1, 11, (2, 3)), jnp.int32))
+        lens = Tensor(jnp.asarray([3, 3], jnp.int32))
+        o = opt.Adam(learning_rate=3e-3, parameters=rec.parameters())
+        losses = []
+        for _ in range(4):
+            logits = rec(x)
+            loss = rec.loss(logits, labels, lens)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
