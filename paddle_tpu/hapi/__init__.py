@@ -1,0 +1,10 @@
+"""paddle.Model high-level API (ref: python/paddle/hapi/ — SURVEY §2.2).
+
+Model.prepare/fit/evaluate/predict with a callback system (checkpoint,
+early-stop, LR scheduling, logging), plus `summary` and a FLOPs counter.
+"""
+
+from .model import Model  # noqa: F401
+from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
+                        ModelCheckpoint, ProgBarLogger)
+from .summary import flops, summary  # noqa: F401

@@ -1,0 +1,168 @@
+"""paddle.Model (ref: python/paddle/hapi/model.py — Model.prepare/fit/
+evaluate/predict/save/load). Runs the eager train loop over paddle_tpu.io
+DataLoaders; metrics from paddle_tpu.metric."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework import io as fio
+from .callbacks import Callback, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(np.asarray(x)))
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = list(metrics) if metrics is not None else []
+
+    # -- steps ---------------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*[_to_tensor(i) for i in ins])
+        loss = self._compute_loss(outs, labels)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return float(loss)
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core import autograd as ag
+        with ag.no_grad():
+            outs = self.network(*[_to_tensor(i) for i in ins])
+            loss = self._compute_loss(outs, labels)
+            for m in self._metrics:
+                r = m.compute(outs, _to_tensor(labels))
+                m.update(*r) if isinstance(r, tuple) else m.update(r)
+        return float(loss), outs
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core import autograd as ag
+        with ag.no_grad():
+            return self.network(*[_to_tensor(i) for i in ins])
+
+    def _compute_loss(self, outs, labels):
+        if labels is None:
+            return outs if isinstance(outs, Tensor) else outs[0]
+        return self._loss(outs, _to_tensor(labels))
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=1, callbacks: Optional[Sequence[Callback]] = None,
+            shuffle=True, num_workers=0):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_data = DataLoader(train_data, batch_size=batch_size,
+                                    shuffle=shuffle,
+                                    num_workers=num_workers)
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        for cb in cbs:
+            cb.set_model(self)
+            cb.on_train_begin()
+        self.stop_training = False
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            losses = []
+            for step, batch in enumerate(train_data):
+                x, y = batch if isinstance(batch, (list, tuple)) and \
+                    len(batch) == 2 else (batch, None)
+                for cb in cbs:
+                    cb.on_train_batch_begin(step)
+                loss = self.train_batch(x, y)
+                losses.append(loss)
+                for cb in cbs:
+                    cb.on_train_batch_end(step, {"loss": loss})
+            logs = {"loss": float(np.mean(losses))}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                logs.update(self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0))
+            history["loss"].append(logs["loss"])
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            eval_data = DataLoader(eval_data, batch_size=batch_size)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in eval_data:
+            x, y = batch if isinstance(batch, (list, tuple)) and \
+                len(batch) == 2 else (batch, None)
+            loss, _ = self.eval_batch(x, y)
+            losses.append(loss)
+        out = {"eval_loss": float(np.mean(losses))}
+        for m in self._metrics:
+            out[f"eval_{m.name()}"] = m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=0) -> List:
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            test_data = DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        for batch in test_data:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x))
+        return outs
+
+    # -- io ------------------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,105 @@
+"""paddle.summary + FLOPs counter (ref: python/paddle/hapi/model_summary.py,
+hapi/dynamic_flops.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["summary", "flops"]
+
+
+def _num_params(layer) -> int:
+    return sum(int(np.prod(p.shape)) for p in layer.parameters())
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Prints a per-layer table; returns {'total_params', 'trainable_params'}.
+    Uses forward hooks to record output shapes (ref mechanism)."""
+    rows = []
+    hooks = []
+
+    def mk_hook(name, layer):
+        def hook(l, inputs, output):
+            out = output
+            if isinstance(out, (list, tuple)):
+                out = out[0]
+            shape = tuple(out.shape) if hasattr(out, "shape") else ()
+            own = sum(int(np.prod(p.shape))
+                      for p in l.parameters(include_sublayers=False))
+            rows.append((name or l.__class__.__name__,
+                         l.__class__.__name__, shape, own))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(mk_hook(name, sub)))
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        input = [Tensor(jnp.zeros(s, jnp.float32)) for s in sizes]
+        net.eval()
+        out = net(*input)
+    else:
+        net.eval()
+        out = net(input)
+    for h in hooks:
+        h.remove()
+
+    total = _num_params(net)
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    width = 72
+    print("-" * width)
+    print(f"{'Layer (type)':<34}{'Output Shape':<24}{'Param #':<12}")
+    print("=" * width)
+    for nm, cls, shape, n in rows:
+        print(f"{nm + ' (' + cls + ')':<34}{str(shape):<24}{n:<12}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
+    """Per-layer multiply-add count via forward hooks (ref:
+    hapi/dynamic_flops.py count_* table: conv, linear, norms, pools)."""
+    total = [0]
+    hooks = []
+
+    def conv_hook(l, inputs, output):
+        out = output[0] if isinstance(output, (list, tuple)) else output
+        oshape = out.shape          # [B, Cout, *spatial]
+        kernel = int(np.prod(l.weight.shape[2:]))
+        cin_per_group = l.weight.shape[1]
+        macs = int(np.prod(oshape)) * kernel * cin_per_group
+        total[0] += 2 * macs
+
+    def linear_hook(l, inputs, output):
+        out = output[0] if isinstance(output, (list, tuple)) else output
+        total[0] += 2 * int(np.prod(out.shape)) * l.weight.shape[0]
+
+    def norm_hook(l, inputs, output):
+        out = output[0] if isinstance(output, (list, tuple)) else output
+        total[0] += 2 * int(np.prod(out.shape))
+
+    for _, sub in net.named_sublayers():
+        if isinstance(sub, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, nn.Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+        elif isinstance(sub, (nn.BatchNorm2D, nn.LayerNorm, nn.RMSNorm)):
+            hooks.append(sub.register_forward_post_hook(norm_hook))
+
+    net.eval()
+    net(Tensor(jnp.zeros(input_size, jnp.float32)))
+    for h in hooks:
+        h.remove()
+    return total[0]

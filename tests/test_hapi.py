@@ -1,0 +1,90 @@
+"""hapi Model fit/evaluate/predict + callbacks + summary/flops (SURVEY
+§2.2 hapi row)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as opt
+from paddle_tpu.hapi import (EarlyStopping, Model, ModelCheckpoint, flops,
+                             summary)
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision import FakeData
+
+
+def _mk():
+    np.random.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(48, 32), nn.ReLU(),
+                        nn.Linear(32, 3))
+    m = Model(net)
+    m.prepare(optimizer=opt.Adam(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss(),
+              metrics=[Accuracy()])
+    return m
+
+
+def test_fit_reduces_loss_and_evaluates():
+    m = _mk()
+    train = FakeData(num_samples=32, image_shape=(3, 4, 4), num_classes=3,
+                     seed=1)
+    hist = m.fit(train, batch_size=8, epochs=3, verbose=0)
+    assert len(hist["loss"]) == 3
+    assert hist["loss"][-1] < hist["loss"][0]
+    res = m.evaluate(train, batch_size=8)
+    assert "eval_loss" in res and "eval_accuracy" in res
+    assert 0.0 <= res["eval_accuracy"] <= 1.0
+
+
+def test_predict_and_save_load(tmp_path):
+    m = _mk()
+    data = FakeData(num_samples=8, image_shape=(3, 4, 4), num_classes=3,
+                    seed=2)
+    outs = m.predict(data, batch_size=4)
+    assert len(outs) == 2
+    path = str(tmp_path / "ckpt" / "model")
+    m.save(path)
+    m2 = _mk()
+    m2.load(path)
+    w1 = np.asarray(m.network[1].weight._data)
+    w2 = np.asarray(m2.network[1].weight._data)
+    np.testing.assert_allclose(w1, w2)
+
+
+def test_early_stopping_stops():
+    m = _mk()
+    train = FakeData(num_samples=16, image_shape=(3, 4, 4), num_classes=3,
+                     seed=3)
+    es = EarlyStopping(monitor="loss", patience=0, min_delta=1e9)
+    m.fit(train, batch_size=8, epochs=10, verbose=0, callbacks=[es])
+    assert es.stopped_epoch >= 0  # stopped well before 10 epochs
+
+
+def test_checkpoint_callback(tmp_path):
+    m = _mk()
+    train = FakeData(num_samples=8, image_shape=(3, 4, 4), num_classes=3,
+                     seed=4)
+    m.fit(train, batch_size=8, epochs=2, verbose=0,
+          callbacks=[ModelCheckpoint(save_freq=1,
+                                     save_dir=str(tmp_path))])
+    import os
+    assert os.path.exists(str(tmp_path / "0.pdparams"))
+    assert os.path.exists(str(tmp_path / "1.pdparams"))
+
+
+def test_summary_and_flops(capsys):
+    net = nn.Sequential(nn.Flatten(), nn.Linear(48, 32), nn.ReLU(),
+                        nn.Linear(32, 3))
+    info = summary(net, (1, 3, 4, 4))
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    assert info["total_params"] == 48 * 32 + 32 + 32 * 3 + 3
+    f = flops(net, (1, 3, 4, 4))
+    assert f == 2 * (48 * 32 + 32 * 3)
+
+
+def test_flops_counts_convs():
+    from paddle_tpu.vision import LeNet
+    f = flops(LeNet(), (1, 1, 28, 28))
+    # conv1: 2*6*28*28*9*1; conv2: 2*16*12*12*25*6; fcs
+    expected_conv1 = 2 * 6 * 28 * 28 * 9
+    assert f > expected_conv1
