@@ -38,7 +38,10 @@ from . import metric  # noqa: F401
 # L2/L3); imported here once present so `import paddle_tpu` exposes them.
 import importlib as _importlib
 
-for _sub in ("nn", "optimizer", "amp", "io", "jit"):
+for _sub in ("nn", "optimizer", "amp", "io", "jit", "distribution",
+             "sparse", "fft", "signal", "geometric", "audio",
+             "quantization", "profiler", "vision", "hapi", "incubate",
+             "native"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError:

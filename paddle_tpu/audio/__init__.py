@@ -1,0 +1,123 @@
+"""paddle.audio parity (ref: python/paddle/audio/ — Spectrogram/MelSpectrogram
+/MFCC features; SURVEY §2.2 misc numerics)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..signal import stft
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "compute_fbank_matrix",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def hz_to_mel(f, htk: bool = False):
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + jnp.asarray(f) / 700.0)
+    f = jnp.asarray(f, jnp.float32)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(f / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    if htk:
+        return 700.0 * (10.0 ** (jnp.asarray(mel) / 2595.0) - 1.0)
+    mel = jnp.asarray(mel, jnp.float32)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels: int, f_min: float, f_max: float,
+                    htk: bool = False):
+    lo, hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    return mel_to_hz(jnp.linspace(lo, hi, n_mels), htk)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False):
+    f_max = f_max or sr / 2
+    fft_freqs = jnp.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+    return weights * enorm[:, None]
+
+
+class Spectrogram:
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect"):
+        self.n_fft, self.hop = n_fft, hop_length or n_fft // 4
+        self.win_length = win_length
+        self.power, self.center, self.pad_mode = power, center, pad_mode
+
+    def __call__(self, x):
+        spec = stft(x, self.n_fft, self.hop, self.win_length,
+                    center=self.center, pad_mode=self.pad_mode)
+        sa = spec._data if isinstance(spec, Tensor) else spec
+        return Tensor(jnp.abs(sa) ** self.power)
+
+
+class MelSpectrogram:
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None, n_mels: int = 64,
+                 f_min: float = 0.0, f_max: Optional[float] = None,
+                 power: float = 2.0):
+        self.spec = Spectrogram(n_fft, hop_length, power=power)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
+
+    def __call__(self, x):
+        s = self.spec(x)._data                      # [..., freq, T]
+        return Tensor(jnp.einsum("mf,...ft->...mt", self.fbank, s))
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *a, ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, **kw):
+        super().__init__(*a, **kw)
+        self.amin, self.ref, self.top_db = amin, ref_value, top_db
+
+    def __call__(self, x):
+        m = super().__call__(x)._data
+        log_m = 10.0 * jnp.log10(jnp.maximum(m, self.amin) / self.ref)
+        if self.top_db is not None:
+            log_m = jnp.maximum(log_m, jnp.max(log_m) - self.top_db)
+        return Tensor(log_m)
+
+
+def _dct_matrix(n_mfcc: int, n_mels: int):
+    n = jnp.arange(n_mels)
+    k = jnp.arange(n_mfcc)[:, None]
+    dct = jnp.cos(math.pi / n_mels * (n + 0.5) * k) * math.sqrt(2.0 / n_mels)
+    return dct.at[0].multiply(1.0 / math.sqrt(2))
+
+
+class MFCC:
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 n_fft: int = 512, **kw):
+        self.logmel = LogMelSpectrogram(sr, n_fft, n_mels=n_mels, **kw)
+        self.dct = _dct_matrix(n_mfcc, n_mels)
+
+    def __call__(self, x):
+        lm = self.logmel(x)._data                   # [..., mel, T]
+        return Tensor(jnp.einsum("km,...mt->...kt", self.dct, lm))

@@ -1,0 +1,433 @@
+"""paddle.distribution parity (ref: python/paddle/distribution/ — ~25
+distributions + transforms + KL registry; SURVEY §2.2 misc numerics).
+
+Core set implemented natively over jax.random / jax.scipy.stats; sampling
+draws keys from the framework RNG (paddle_tpu.framework.random) so
+`paddle.seed` governs reproducibility exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework.random import next_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Laplace", "Gamma", "Beta", "Dirichlet",
+           "Multinomial", "LogNormal", "Geometric", "Poisson",
+           "kl_divergence", "register_kl"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if not hasattr(x, "dtype") else \
+        jnp.asarray(x)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self.batch_shape = tuple(batch_shape)
+        self.event_shape = tuple(event_shape)
+
+    def sample(self, shape=()):
+        return Tensor(self._sample(tuple(shape)))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        return Tensor(self._log_prob(_arr(value)))
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self._log_prob(_arr(value))))
+
+    def entropy(self):
+        return Tensor(self._entropy())
+
+    @property
+    def mean(self):
+        return Tensor(self._mean())
+
+    @property
+    def variance(self):
+        return Tensor(self._variance())
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return self.loc + self.scale * jax.random.normal(next_key(), shp)
+
+    def _log_prob(self, v):
+        return jax.scipy.stats.norm.logpdf(v, self.loc, self.scale)
+
+    def _entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+            jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self.batch_shape)
+
+
+class LogNormal(Normal):
+    def _sample(self, shape):
+        return jnp.exp(super()._sample(shape))
+
+    def _log_prob(self, v):
+        return jax.scipy.stats.norm.logpdf(jnp.log(v), self.loc,
+                                           self.scale) - jnp.log(v)
+
+    def _mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    def _variance(self):
+        s2 = self.scale ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        u = jax.random.uniform(next_key(), shp)
+        return self.low + (self.high - self.low) * u
+
+    def _log_prob(self, v):
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def _entropy(self):
+        return jnp.log(self.high - self.low)
+
+    def _mean(self):
+        return (self.low + self.high) / 2
+
+    def _variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None:
+            p = _arr(probs)
+            logits = jnp.log(jnp.clip(p, 1e-30))
+        self.logits = _arr(logits) - jax.scipy.special.logsumexp(
+            _arr(logits), axis=-1, keepdims=True)
+        super().__init__(self.logits.shape[:-1])
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return jax.random.categorical(next_key(), self.logits, shape=shp)
+
+    def _log_prob(self, v):
+        return jnp.take_along_axis(
+            self.logits, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def _entropy(self):
+        p = jnp.exp(self.logits)
+        return -jnp.sum(p * self.logits, axis=-1)
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self.logits))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is None:
+            self.p = jax.nn.sigmoid(_arr(logits))
+        else:
+            self.p = _arr(probs)
+        super().__init__(self.p.shape)
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return jax.random.bernoulli(next_key(), self.p, shp).astype(
+            jnp.float32)
+
+    def _log_prob(self, v):
+        p = jnp.clip(self.p, 1e-7, 1 - 1e-7)
+        return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+    def _entropy(self):
+        p = jnp.clip(self.p, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+    def _mean(self):
+        return self.p
+
+    def _variance(self):
+        return self.p * (1 - self.p)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return jax.random.exponential(next_key(), shp) / self.rate
+
+    def _log_prob(self, v):
+        return jnp.log(self.rate) - self.rate * v
+
+    def _entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+    def _mean(self):
+        return 1.0 / self.rate
+
+    def _variance(self):
+        return 1.0 / self.rate ** 2
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return self.loc + self.scale * jax.random.laplace(next_key(), shp)
+
+    def _log_prob(self, v):
+        return -jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale)
+
+    def _entropy(self):
+        return 1 + jnp.log(2 * jnp.broadcast_to(self.scale,
+                                                self.batch_shape))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.conc = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.conc.shape,
+                                              self.rate.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return jax.random.gamma(next_key(), self.conc, shp) / self.rate
+
+    def _log_prob(self, v):
+        return jax.scipy.stats.gamma.logpdf(v * self.rate, self.conc) + \
+            jnp.log(self.rate)
+
+    def _entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        return (self.conc - jnp.log(self.rate) + gammaln(self.conc)
+                + (1 - self.conc) * digamma(self.conc))
+
+    def _mean(self):
+        return self.conc / self.rate
+
+    def _variance(self):
+        return self.conc / self.rate ** 2
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return jax.random.beta(next_key(), self.alpha, self.beta, shp)
+
+    def _log_prob(self, v):
+        return jax.scipy.stats.beta.logpdf(v, self.alpha, self.beta)
+
+    def _mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def _variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+    def _entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return (betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.conc = _arr(concentration)
+        super().__init__(self.conc.shape[:-1], self.conc.shape[-1:])
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return jax.random.dirichlet(next_key(), self.conc, shp)
+
+    def _log_prob(self, v):
+        return jax.scipy.stats.dirichlet.logpdf(
+            jnp.moveaxis(v, -1, 0), self.conc)
+
+    def _mean(self):
+        return self.conc / jnp.sum(self.conc, -1, keepdims=True)
+
+    def _entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a = self.conc
+        a0 = jnp.sum(a, -1)
+        K = a.shape[-1]
+        lnB = jnp.sum(gammaln(a), -1) - gammaln(a0)
+        return (lnB + (a0 - K) * digamma(a0)
+                - jnp.sum((a - 1) * digamma(a), -1))
+
+    def _variance(self):
+        a0 = jnp.sum(self.conc, -1, keepdims=True)
+        m = self.conc / a0
+        return m * (1 - m) / (a0 + 1)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.n = int(total_count)
+        self.p = _arr(probs)
+        super().__init__(self.p.shape[:-1], self.p.shape[-1:])
+
+    def _sample(self, shape):
+        logits = jnp.log(jnp.clip(self.p, 1e-30))
+        draws = jax.random.categorical(
+            next_key(), logits, shape=tuple(shape) + self.batch_shape
+            + (self.n,))
+        K = self.p.shape[-1]
+        return jax.nn.one_hot(draws, K).sum(axis=-2)
+
+    def _log_prob(self, v):
+        from jax.scipy.special import gammaln
+        return (gammaln(self.n + 1.0) - jnp.sum(gammaln(v + 1.0), -1)
+                + jnp.sum(v * jnp.log(jnp.clip(self.p, 1e-30)), -1))
+
+    def _mean(self):
+        return self.n * self.p
+
+    def _variance(self):
+        return self.n * self.p * (1 - self.p)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.p = _arr(probs)
+        super().__init__(self.p.shape)
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        u = jax.random.uniform(next_key(), shp)
+        return jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.p))
+
+    def _log_prob(self, v):
+        return v * jnp.log1p(-self.p) + jnp.log(self.p)
+
+    def _mean(self):
+        return (1 - self.p) / self.p
+
+    def _variance(self):
+        return (1 - self.p) / self.p ** 2
+
+    def _entropy(self):
+        p = self.p
+        return -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return jax.random.poisson(next_key(), self.rate, shp).astype(
+            jnp.float32)
+
+    def _log_prob(self, v):
+        from jax.scipy.special import gammaln
+        return v * jnp.log(self.rate) - self.rate - gammaln(v + 1.0)
+
+    def _mean(self):
+        return self.rate
+
+    def _variance(self):
+        return self.rate
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (ref: python/paddle/distribution/kl.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return Tensor(fn(p, q))
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.exp(p.logits)
+    return jnp.sum(pp * (p.logits - q.logits), axis=-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pa = jnp.clip(p.p, 1e-7, 1 - 1e-7)
+    qa = jnp.clip(q.p, 1e-7, 1 - 1e-7)
+    return pa * (jnp.log(pa) - jnp.log(qa)) + \
+        (1 - pa) * (jnp.log1p(-pa) - jnp.log1p(-qa))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return jnp.log((q.high - q.low) / (p.high - p.low))

@@ -1,0 +1,59 @@
+"""paddle.fft parity (ref: python/paddle/fft.py — cuFFT/pocketfft backends;
+SURVEY §2.2 misc numerics). On TPU, XLA lowers FFTs natively."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftshift",
+           "ifftshift", "fftfreq", "rfftfreq"]
+
+
+def _mk(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return apply(name, lambda a: fn(a, n, axis, norm), [x])
+    op.__name__ = name
+    return op
+
+
+def _mk2(name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
+        return apply(name, lambda a: fn(a, s, axes, norm), [x])
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", lambda a, n, ax, nm: jnp.fft.fft(a, n, ax, nm))
+ifft = _mk("ifft", lambda a, n, ax, nm: jnp.fft.ifft(a, n, ax, nm))
+rfft = _mk("rfft", lambda a, n, ax, nm: jnp.fft.rfft(a, n, ax, nm))
+irfft = _mk("irfft", lambda a, n, ax, nm: jnp.fft.irfft(a, n, ax, nm))
+hfft = _mk("hfft", lambda a, n, ax, nm: jnp.fft.hfft(a, n, ax, nm))
+ihfft = _mk("ihfft", lambda a, n, ax, nm: jnp.fft.ihfft(a, n, ax, nm))
+fft2 = _mk2("fft2", lambda a, s, ax, nm: jnp.fft.fft2(a, s, ax, nm))
+ifft2 = _mk2("ifft2", lambda a, s, ax, nm: jnp.fft.ifft2(a, s, ax, nm))
+rfft2 = _mk2("rfft2", lambda a, s, ax, nm: jnp.fft.rfft2(a, s, ax, nm))
+irfft2 = _mk2("irfft2", lambda a, s, ax, nm: jnp.fft.irfft2(a, s, ax, nm))
+fftn = _mk2("fftn", lambda a, s, ax, nm: jnp.fft.fftn(a, s, ax, nm))
+ifftn = _mk2("ifftn", lambda a, s, ax, nm: jnp.fft.ifftn(a, s, ax, nm))
+rfftn = _mk2("rfftn", lambda a, s, ax, nm: jnp.fft.rfftn(a, s, ax, nm))
+irfftn = _mk2("irfftn", lambda a, s, ax, nm: jnp.fft.irfftn(a, s, ax, nm))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes), [x])
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes), [x])
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))

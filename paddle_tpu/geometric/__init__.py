@@ -1,0 +1,92 @@
+"""paddle.geometric parity (ref: python/paddle/geometric/ — graph segment
+ops + message passing; SURVEY §2.2 misc numerics). XLA segment primitives
+replace the CUDA scatter kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _segment(name, reducer, x, segment_ids, num_segments=None):
+    ids = _arr(segment_ids).astype(jnp.int32)
+    n = int(num_segments) if num_segments is not None else \
+        int(jnp.max(ids)) + 1
+
+    def impl(a):
+        return reducer(a, ids, n)
+    return apply(name, impl, [x])
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", lambda a, i, n:
+                    jax.ops.segment_sum(a, i, n), data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def red(a, i, n):
+        s = jax.ops.segment_sum(a, i, n)
+        c = jax.ops.segment_sum(jnp.ones((a.shape[0],) + (1,) * (a.ndim - 1),
+                                         a.dtype), i, n)
+        return s / jnp.maximum(c, 1)
+    return _segment("segment_mean", red, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", lambda a, i, n:
+                    jax.ops.segment_max(a, i, n), data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", lambda a, i, n:
+                    jax.ops.segment_min(a, i, n), data, segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Graph message passing (ref: paddle.geometric.send_u_recv): gather
+    x[src], segment-reduce onto dst."""
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    xa = _arr(x)
+    n = int(out_size) if out_size is not None else xa.shape[0]
+    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}.get(reduce_op)
+
+    def impl(a):
+        msgs = a[src]
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst, n)
+            c = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0],) + (1,) * (msgs.ndim - 1),
+                         msgs.dtype), dst, n)
+            return s / jnp.maximum(c, 1)
+        return red(msgs, dst, n)
+    return apply("send_u_recv", impl, [x])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Messages combine node features x[src] with edge features y."""
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    xa = _arr(x)
+    n = int(out_size) if out_size is not None else xa.shape[0]
+    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}[reduce_op]
+
+    def impl(a, e):
+        m = a[src]
+        m = m + e if message_op == "add" else m * e
+        return red(m, dst, n)
+    return apply("send_ue_recv", impl, [x, y])

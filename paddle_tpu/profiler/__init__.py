@@ -1,0 +1,173 @@
+"""paddle.profiler parity (ref: python/paddle/profiler/ — SURVEY §5.1).
+
+Host side: the C++ RecordEvent tracer (paddle_tpu.native) with chrome-trace
+export. Device side: jax.profiler (XPlane/PJRT capture — the TPU equivalent
+of the CUPTI tracer) writes TensorBoard-compatible traces. A scheduler
+(wait/warmup/active/repeat) and summary table complete the API."""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Callable, Optional, Sequence
+
+from ..native import (RecordEvent, prof_clear, prof_enable,  # noqa: F401
+                      prof_event_count, prof_export)
+
+__all__ = ["Profiler", "ProfilerTarget", "RecordEvent", "make_scheduler",
+           "export_chrome_tracing", "SummaryView"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1   # accepted for API parity; maps to the device tracer
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class _ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    """ref: paddle.profiler.make_scheduler(closed, ready, record, repeat)."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> _ProfilerState:
+        if step < skip_first:
+            return _ProfilerState.CLOSED
+        s = (step - skip_first) % period
+        if repeat and (step - skip_first) // period >= repeat:
+            return _ProfilerState.CLOSED
+        if s < closed:
+            return _ProfilerState.CLOSED
+        if s < closed + ready:
+            return _ProfilerState.READY
+        if s == period - 1:
+            return _ProfilerState.RECORD_AND_RETURN
+        return _ProfilerState.RECORD
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory writing chrome-trace JSON (host events)."""
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.pt.trace.json")
+        prof_export(path, pid=os.getpid())
+        prof.last_export_path = path
+    return handler
+
+
+class SummaryView(Enum):
+    OpView = 0
+    KernelView = 1
+
+
+class Profiler:
+    """ref: paddle.profiler.Profiler(targets, scheduler, on_trace_ready)."""
+
+    def __init__(self, *, targets: Optional[Sequence] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False):
+        self.targets = list(targets or [ProfilerTarget.CPU])
+        if isinstance(scheduler, tuple):
+            lo, hi = scheduler
+            scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo)
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.last_export_path = None
+        self._device_trace_dir = None
+        self._recording = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        prof_clear()
+        if self.scheduler is None:
+            self._begin_record()
+        return self
+
+    def stop(self):
+        if self._recording:
+            self._end_record()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _begin_record(self):
+        prof_enable(True)
+        self._recording = True
+        if any(t in (ProfilerTarget.GPU, ProfilerTarget.TPU,
+                     ProfilerTarget.CUSTOM_DEVICE) for t in self.targets) \
+                and not self.timer_only:
+            import jax
+            if jax.default_backend() != "cpu":
+                self._device_trace_dir = "/tmp/paddle_tpu_profile"
+                try:
+                    jax.profiler.start_trace(self._device_trace_dir)
+                except Exception:
+                    self._device_trace_dir = None
+
+    def _end_record(self):
+        prof_enable(False)
+        self._recording = False
+        if self._device_trace_dir:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_trace_dir = None
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self):
+        self.step_num += 1
+        if self.scheduler is None:
+            return
+        state = self.scheduler(self.step_num)
+        if state in (_ProfilerState.RECORD,
+                     _ProfilerState.RECORD_AND_RETURN) and \
+                not self._recording:
+            self._begin_record()
+        elif state in (_ProfilerState.CLOSED, _ProfilerState.READY) and \
+                self._recording:
+            self._end_record()
+
+    def export(self, path: str, format: str = "json"):
+        prof_export(path, pid=os.getpid())
+        self.last_export_path = path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        """Aggregate host events into a per-name table (printed + returned)."""
+        import json
+        tmp = f"/tmp/_pt_prof_{os.getpid()}.json"
+        prof_export(tmp, pid=os.getpid())
+        with open(tmp) as f:
+            events = json.load(f)["traceEvents"]
+        agg = defaultdict(lambda: [0, 0.0])
+        for e in events:
+            agg[e["name"]][0] += 1
+            agg[e["name"]][1] += e["dur"] / 1000.0
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+        print(f"{'Name':<40}{'Calls':<8}{'Total(ms)':<12}{'Avg(ms)':<12}")
+        for name, (calls, total) in rows:
+            print(f"{name:<40}{calls:<8}{total:<12.3f}"
+                  f"{total / max(calls, 1):<12.3f}")
+        return {name: {"calls": c, "total_ms": t} for name, (c, t)
+                in rows}

@@ -1,0 +1,182 @@
+"""paddle.quantization parity (ref: python/paddle/quantization/ — QAT/PTQ
+framework with quanter/observer configs; python/paddle/nn/quant weight-only
+layers; SURVEY §2.2 quantization row).
+
+TPU-native: observers collect ranges in plain jax; fake-quant is a
+straight-through estimator; the deploy path converts Linear layers to
+weight-only int8 backed by the Pallas dequant-matmul kernel
+(paddle_tpu.ops.quant)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["AbsmaxObserver", "FakeQuanterWithAbsMax", "QuantConfig", "QAT",
+           "PTQ", "QuantedLinear", "quanted_linear_from"]
+
+
+class AbsmaxObserver:
+    """Tracks running absmax for activation scales (ref: observers/abs_max)."""
+
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self.absmax = 0.0
+
+    def observe(self, x):
+        xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        self.absmax = max(self.absmax, float(jnp.max(jnp.abs(xa))))
+        return x
+
+    def scale(self) -> float:
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return self.absmax / qmax if self.absmax else 1.0
+
+
+class FakeQuanterWithAbsMax(nn.Layer):
+    """QAT fake-quant with straight-through gradients (ref:
+    quanters/abs_max.py FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+
+        def impl(a):
+            scale = jnp.max(jnp.abs(a)) / qmax
+            scale = jnp.maximum(scale, 1e-8)
+            q = jnp.clip(jnp.round(a / scale), -qmax, qmax) * scale
+            # straight-through: forward q, backward identity
+            return a + jax.lax.stop_gradient(q - a)
+        return apply("fake_quant_absmax", impl, [x])
+
+
+class QuantConfig:
+    """ref: paddle.quantization.QuantConfig — maps layer types/names to
+    quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs: Dict[type, dict] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = {"activation": activation,
+                                     "weight": weight}
+
+    def config_for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if self.activation or self.weight:
+            return {"activation": self.activation, "weight": self.weight}
+        return None
+
+
+class _QATLinear(nn.Layer):
+    def __init__(self, inner: nn.Linear, a_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.a_q = a_quanter
+        self.w_q = w_quanter
+
+    def forward(self, x):
+        if self.a_q is not None:
+            x = self.a_q(x)
+        w = self.inner.weight
+        if self.w_q is not None:
+            w = self.w_q(w)
+        from ..nn import functional as F
+        return F.linear(x, w, self.inner.bias)
+
+
+class QAT:
+    """Quantization-aware training flow (ref: paddle.quantization.QAT)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace: bool = False):
+        for name, sub in list(model.named_sublayers()):
+            for cname, child in list(sub.__dict__["_sub_layers"].items()):
+                cfg = self.config.config_for(child)
+                if cfg and isinstance(child, nn.Linear):
+                    a_q = cfg["activation"]() if cfg["activation"] else None
+                    w_q = cfg["weight"]() if cfg["weight"] else None
+                    sub.add_sublayer(cname, _QATLinear(child, a_q, w_q))
+        # top-level children too
+        for cname, child in list(model.__dict__["_sub_layers"].items()):
+            cfg = self.config.config_for(child)
+            if cfg and isinstance(child, nn.Linear):
+                a_q = cfg["activation"]() if cfg["activation"] else None
+                w_q = cfg["weight"]() if cfg["weight"] else None
+                model.add_sublayer(cname, _QATLinear(child, a_q, w_q))
+        return model
+
+
+class QuantedLinear(nn.Layer):
+    """Deployed weight-only int8 linear over the Pallas dequant-matmul."""
+
+    def __init__(self, qweight, scale, bias=None):
+        super().__init__()
+        self.qweight = qweight
+        self.scale = scale
+        self.bias = bias
+
+    def forward(self, x):
+        from ..incubate.nn.functional import weight_only_linear
+        return weight_only_linear(x, self.qweight, bias=self.bias,
+                                  weight_scale=self.scale)
+
+
+def quanted_linear_from(linear: nn.Linear) -> QuantedLinear:
+    from ..ops.quant import weight_quantize
+    qw, sc = weight_quantize(linear.weight._data)
+    return QuantedLinear(Tensor(qw), Tensor(sc), linear.bias)
+
+
+class PTQ:
+    """Post-training quantization flow (ref: paddle.quantization.PTQ):
+    observe activations on calibration batches, then convert Linears to
+    weight-only int8."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+        self.observers: Dict[str, AbsmaxObserver] = {}
+
+    def quantize(self, model, inplace: bool = False):
+        self._hooks = []
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, nn.Linear):
+                obs = AbsmaxObserver()
+                self.observers[name] = obs
+
+                def mk(o):
+                    def hook(layer, inputs):
+                        o.observe(inputs[0])
+                        return None
+                    return hook
+                self._hooks.append(sub.register_forward_pre_hook(mk(obs)))
+        return model
+
+    def convert(self, model, inplace: bool = False):
+        for h in getattr(self, "_hooks", []):
+            h.remove()
+        def convert_children(parent):
+            for cname, child in list(parent.__dict__["_sub_layers"].items()):
+                if isinstance(child, nn.Linear):
+                    parent.add_sublayer(cname, quanted_linear_from(child))
+                else:
+                    convert_children(child)
+        convert_children(model)
+        return model

@@ -1,0 +1,108 @@
+"""paddle.signal parity (ref: python/paddle/signal.py — stft/istft)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def frame(x, frame_length: int, hop_length: int, axis=-1, name=None):
+    """Slide a window of frame_length with hop_length (ref: paddle.signal
+    .frame). Output [..., frame_length, num_frames] (axis=-1 paddle
+    layout)."""
+    def impl(a):
+        n = a.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(num)[None, :])
+        return a[..., idx]
+    return apply("frame", impl, [x])
+
+
+def overlap_add(x, hop_length: int, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, num_frames] -> signal."""
+    def impl(a):
+        fl, num = a.shape[-2], a.shape[-1]
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        for f in range(num):  # static python loop: num is a static shape
+            out = out.at[..., f * hop_length:f * hop_length + fl].add(
+                a[..., f])
+        return out
+    return apply("overlap_add", impl, [x])
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """ref: paddle.signal.stft — output [..., n_fft//2+1, num_frames]."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    wa = _arr(window) if window is not None else jnp.ones(wl, jnp.float32)
+    if wl < n_fft:
+        pad = (n_fft - wl) // 2
+        wa = jnp.pad(wa, (pad, n_fft - wl - pad))
+
+    def impl(a):
+        sig = a
+        if center:
+            pads = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pads, mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        idx = (jnp.arange(n_fft)[:, None] + hop * jnp.arange(num)[None, :])
+        frames = sig[..., idx] * wa[:, None]
+        frames = jnp.moveaxis(frames, -2, -1)      # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.moveaxis(spec, -1, -2)          # [..., freq, num]
+    return apply("stft", impl, [x])
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    wa = _arr(window) if window is not None else jnp.ones(wl, jnp.float32)
+    if wl < n_fft:
+        pad = (n_fft - wl) // 2
+        wa = jnp.pad(wa, (pad, n_fft - wl - pad))
+
+    def impl(s):
+        spec = jnp.moveaxis(s, -2, -1)             # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
+            jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * wa
+        num = frames.shape[-2]
+        n = n_fft + hop * (num - 1)
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        norm = jnp.zeros((n,), frames.dtype)
+        for f in range(num):
+            sl = slice(f * hop, f * hop + n_fft)
+            out = out.at[..., sl].add(frames[..., f, :])
+            norm = norm.at[sl].add(wa * wa)
+        out = out / jnp.maximum(norm, 1e-8)
+        if center:
+            out = out[..., n_fft // 2:-(n_fft // 2) or None]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply("istft", impl, [x])

@@ -1,0 +1,139 @@
+"""paddle.sparse parity (ref: python/paddle/sparse/ over SparseCooTensor/
+SparseCsrTensor — paddle/phi/core/sparse_*_tensor; SURVEY §2.1 sparse row).
+
+TPU-native: COO is backed by jax.experimental.sparse.BCOO (XLA-lowered
+scatter/gather + dot_general); CSR keeps (crows, cols, values) and converts
+through COO for compute. Dense bridges (`to_dense`) keep parity with the
+reference API.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "matmul", "add", "relu", "is_sparse"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return tuple(self._bcoo.shape)
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # paddle layout [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows = _arr(crows).astype(jnp.int32)
+        self.cols = _arr(cols).astype(jnp.int32)
+        self._values = _arr(values)
+        self._shape = tuple(shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    def to_coo(self) -> SparseCooTensor:
+        counts = jnp.diff(self.crows)
+        rows = jnp.repeat(jnp.arange(len(counts)), counts,
+                          total_repeat_length=self.nnz)
+        idx = jnp.stack([rows, self.cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                            shape=self._shape))
+
+    def to_dense(self) -> Tensor:
+        return self.to_coo().to_dense()
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """indices: [ndim, nnz] (paddle layout)."""
+    idx = _arr(indices).T.astype(jnp.int32)
+    vals = _arr(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in jnp.max(idx, axis=0))
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_coo()
+    return x._bcoo
+
+
+def matmul(x, y):
+    """sparse @ dense (ref: paddle.sparse.matmul)."""
+    if is_sparse(x):
+        out = _as_bcoo(x) @ _arr(y)
+        return Tensor(out)
+    raise TypeError("first operand must be sparse")
+
+
+def add(x, y):
+    if is_sparse(x) and is_sparse(y):
+        bx, by = _as_bcoo(x), _as_bcoo(y)
+        idx = jnp.concatenate([bx.indices, by.indices], axis=0)
+        dat = jnp.concatenate([bx.data, by.data], axis=0)
+        return SparseCooTensor(
+            jsparse.BCOO((dat, idx), shape=bx.shape).sum_duplicates())
+    raise TypeError("both operands must be sparse")
+
+
+def relu(x):
+    if is_sparse(x):
+        b = _as_bcoo(x)
+        return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
+                                            shape=b.shape))
+    raise TypeError("operand must be sparse")

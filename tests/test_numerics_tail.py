@@ -1,0 +1,202 @@
+"""Long-tail numerics: distribution, sparse, fft/signal, geometric, audio,
+quantization, profiler (SURVEY §2.2 misc numerics + §5.1)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class TestDistribution:
+    def test_normal_sample_logprob_kl(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        paddle.seed(0)
+        d = Normal(0.0, 1.0)
+        s = d.sample((5000,))
+        assert abs(float(s.mean())) < 0.1
+        lp = d.log_prob(Tensor(jnp.zeros(())))
+        np.testing.assert_allclose(float(lp), -0.5 * np.log(2 * np.pi),
+                                   rtol=1e-5)
+        kl = kl_divergence(Normal(0.0, 1.0), Normal(1.0, 1.0))
+        np.testing.assert_allclose(float(kl), 0.5, rtol=1e-5)
+
+    def test_categorical_and_bernoulli(self):
+        from paddle_tpu.distribution import Bernoulli, Categorical
+        paddle.seed(1)
+        c = Categorical(probs=jnp.asarray([0.2, 0.8]))
+        s = np.asarray(c.sample((2000,))._data)
+        assert abs(s.mean() - 0.8) < 0.05
+        b = Bernoulli(probs=0.3)
+        assert abs(float(b.mean) - 0.3) < 1e-6
+        assert float(b.entropy()) > 0
+
+    @pytest.mark.parametrize("name", ["Exponential", "Laplace", "Gamma",
+                                      "Beta", "Poisson", "Geometric"])
+    def test_moment_sanity(self, name):
+        import paddle_tpu.distribution as D
+        paddle.seed(2)
+        args = {"Exponential": (2.0,), "Laplace": (0.0, 1.0),
+                "Gamma": (2.0, 3.0), "Beta": (2.0, 2.0), "Poisson": (3.0,),
+                "Geometric": (0.4,)}[name]
+        d = getattr(D, name)(*map(jnp.asarray, args))
+        s = np.asarray(d.sample((4000,))._data)
+        assert abs(s.mean() - float(d.mean)) < 4 * np.sqrt(
+            float(d.variance) / 4000) + 0.05
+
+    def test_dirichlet_multinomial(self):
+        from paddle_tpu.distribution import Dirichlet, Multinomial
+        paddle.seed(3)
+        d = Dirichlet(jnp.asarray([2.0, 3.0, 5.0]))
+        s = np.asarray(d.sample((500,))._data)
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        m = Multinomial(10, jnp.asarray([0.3, 0.7]))
+        sm = np.asarray(m.sample((100,))._data)
+        np.testing.assert_allclose(sm.sum(-1), 10.0)
+
+
+class TestSparse:
+    def test_coo_roundtrip_and_matmul(self):
+        import paddle_tpu.sparse as sp
+        idx = jnp.asarray([[0, 1, 2], [1, 0, 2]])   # [ndim, nnz]
+        vals = jnp.asarray([1.0, 2.0, 3.0])
+        s = sp.sparse_coo_tensor(idx, vals, shape=(3, 3))
+        dense = np.asarray(s.to_dense()._data)
+        expect = np.zeros((3, 3), np.float32)
+        expect[0, 1], expect[1, 0], expect[2, 2] = 1, 2, 3
+        np.testing.assert_allclose(dense, expect)
+        y = np.asarray(sp.matmul(s, jnp.eye(3))._data)
+        np.testing.assert_allclose(y, expect)
+
+    def test_csr_and_ops(self):
+        import paddle_tpu.sparse as sp
+        s = sp.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [-1.0, 2.0, -3.0],
+                                 (2, 3))
+        dense = np.asarray(s.to_dense()._data)
+        expect = np.array([[0, -1, 0], [2, 0, -3]], np.float32)
+        np.testing.assert_allclose(dense, expect)
+        r = sp.relu(s.to_coo())
+        np.testing.assert_allclose(np.asarray(r.to_dense()._data),
+                                   np.maximum(expect, 0))
+
+
+class TestFFTSignal:
+    def test_fft_roundtrip(self):
+        import paddle_tpu.fft as fft
+        x = Tensor(jnp.asarray(np.random.RandomState(0).randn(16)
+                               .astype(np.float32)))
+        X = fft.fft(x)
+        back = fft.ifft(X)
+        np.testing.assert_allclose(np.asarray(back._data).real,
+                                   np.asarray(x._data), atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        import paddle_tpu.fft as fft
+        x = np.random.RandomState(1).randn(32).astype(np.float32)
+        X = fft.rfft(Tensor(jnp.asarray(x)))
+        np.testing.assert_allclose(np.asarray(X._data), np.fft.rfft(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        from paddle_tpu.signal import istft, stft
+        x = np.random.RandomState(2).randn(1, 256).astype(np.float32)
+        S = stft(Tensor(jnp.asarray(x)), n_fft=64, hop_length=16)
+        assert S._data.shape == (1, 33, 256 // 16 + 1)
+        back = istft(S, n_fft=64, hop_length=16, length=256)
+        np.testing.assert_allclose(np.asarray(back._data), x, atol=1e-4)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        from paddle_tpu.geometric import segment_max, segment_mean, \
+            segment_sum
+        x = Tensor(jnp.asarray([[1.0], [2.0], [3.0], [4.0]]))
+        ids = jnp.asarray([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            np.asarray(segment_sum(x, ids)._data), [[3.0], [7.0]])
+        np.testing.assert_allclose(
+            np.asarray(segment_mean(x, ids)._data), [[1.5], [3.5]])
+        np.testing.assert_allclose(
+            np.asarray(segment_max(x, ids)._data), [[2.0], [4.0]])
+
+    def test_send_u_recv(self):
+        from paddle_tpu.geometric import send_u_recv
+        x = Tensor(jnp.asarray([[1.0, 0.0], [0.0, 1.0], [2.0, 2.0]]))
+        src = jnp.asarray([0, 1, 2])
+        dst = jnp.asarray([1, 2, 1])
+        out = np.asarray(send_u_recv(x, src, dst, "sum")._data)
+        np.testing.assert_allclose(out, [[0, 0], [3, 2], [0, 1]])
+
+
+class TestAudio:
+    def test_melspectrogram_and_mfcc_shapes(self):
+        from paddle_tpu.audio import LogMelSpectrogram, MFCC
+        x = Tensor(jnp.asarray(np.random.RandomState(3).randn(1, 2048)
+                               .astype(np.float32)))
+        lm = LogMelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
+        assert lm._data.shape[1] == 32
+        mf = MFCC(sr=16000, n_mfcc=13, n_mels=32, n_fft=256)(x)
+        assert mf._data.shape[1] == 13
+        assert np.isfinite(np.asarray(mf._data)).all()
+
+
+class TestQuantization:
+    def test_qat_fake_quant_trains(self):
+        from paddle_tpu import nn, optimizer as opt
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMax, QAT,
+                                             QuantConfig)
+        np.random.seed(4)
+        model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                          weight=FakeQuanterWithAbsMax)
+        model = QAT(cfg).quantize(model)
+        x = Tensor(jnp.asarray(np.random.randn(4, 8).astype(np.float32)))
+        out = model(x)
+        loss = (out * out).mean()
+        loss.backward()
+        params = model.parameters()
+        assert any(p.grad is not None for p in params)
+
+    def test_ptq_convert_runs_close(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import PTQ
+        np.random.seed(5)
+        model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        x = Tensor(jnp.asarray(np.random.randn(4, 16).astype(np.float32)))
+        ref = np.asarray(model(x)._data)
+        ptq = PTQ()
+        model = ptq.quantize(model)
+        model(x)  # calibration
+        model = ptq.convert(model)
+        out = np.asarray(model(x)._data)
+        assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6) < 0.05
+
+
+class TestProfiler:
+    def test_profiler_records_and_summarizes(self, tmp_path, capsys):
+        from paddle_tpu.profiler import (Profiler, ProfilerTarget,
+                                         RecordEvent, export_chrome_tracing)
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     on_trace_ready=export_chrome_tracing(str(tmp_path)))
+        with p:
+            with RecordEvent("myop"):
+                sum(range(10000))
+            stats = p.summary()
+        assert p.last_export_path is not None
+        import os
+        assert os.path.exists(p.last_export_path)
+        assert "myop" in stats
+
+    def test_scheduler_windows(self):
+        from paddle_tpu.profiler import Profiler, make_scheduler, prof_clear
+        sched = make_scheduler(closed=1, ready=0, record=2, repeat=1)
+        p = Profiler(scheduler=sched)
+        p.start()
+        states = []
+        for i in range(4):
+            p.step()
+            states.append(p._recording)
+        p.stop()
+        assert True in states and False in states
