@@ -78,6 +78,20 @@ def set_amp_cast_hook(hook: Optional[Callable]) -> None:
     _amp_cast_hook = hook
 
 
+# ---------------------------------------------------------------------------
+# static-capture hook: paddle_tpu.static.program_guard installs a recorder;
+# every apply() is reported as (name, fn, inputs, outputs) so the Program
+# can replay the op graph with new feeds (SURVEY §3.3 parity: the recorded
+# op list is the Instruction list; replay is the interpreter).
+# ---------------------------------------------------------------------------
+_static_recorder: Optional[Callable] = None
+
+
+def set_static_recorder(rec: Optional[Callable]) -> None:
+    global _static_recorder
+    _static_recorder = rec
+
+
 def _is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
@@ -149,6 +163,8 @@ def apply(name: str, fn: Callable, inputs: Sequence[Any], **kwargs):
             results.append(r)
         if flag("FLAGS_check_nan_inf"):
             _check_nan_inf(name, [o._data for o in results])
+        if _static_recorder is not None:
+            _static_recorder(name, fn, tlist, arrs, results)
         return tuple(results) if multi else results[0]
 
     out = fn(*arrs)
@@ -157,4 +173,6 @@ def apply(name: str, fn: Callable, inputs: Sequence[Any], **kwargs):
     if flag("FLAGS_check_nan_inf"):
         _check_nan_inf(name, outs)
     results = tuple(Tensor(o, stop_gradient=True) for o in outs)
+    if _static_recorder is not None:
+        _static_recorder(name, fn, tlist, arrs, results)
     return results if multi else results[0]

