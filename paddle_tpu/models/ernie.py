@@ -1,0 +1,153 @@
+"""ERNIE model family (SURVEY §2.4 config 3: ERNIE-3.0 encoder /
+ERNIE-4.5-style MoE decoder).
+
+Reference capability: PaddleNLP paddlenlp/transformers/ernie/ — a BERT-style
+encoder with task-type embeddings (the ERNIE 3.0 distinguishing input), and
+the ERNIE 4.5 generation = MoE decoder (built here as a config preset of
+paddle_tpu.models.moe_llm)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from .bert import BertConfig, BertLayer
+from .moe_llm import MoEConfig, MoEForCausalLM
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForMaskedLM", "ernie30_tiny_config", "ernie45_moe_config",
+           "Ernie45MoEForCausalLM"]
+
+
+class ErnieConfig(BertConfig):
+    """BertConfig + task_type_vocab_size (ERNIE task embeddings) +
+    use_task_id switch."""
+
+    def __init__(self, task_type_vocab_size=3, use_task_id=True, **kw):
+        super().__init__(**kw)
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+
+
+def ernie30_tiny_config(**kw) -> ErnieConfig:
+    base = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=128,
+                max_position_embeddings=128)
+    base.update(kw)
+    return ErnieConfig(**base)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, c: ErnieConfig):
+        super().__init__()
+        init = I.Normal(0.0, c.initializer_range)
+        self.word_embeddings = nn.Embedding(c.vocab_size, c.hidden_size,
+                                            padding_idx=c.pad_token_id)
+        self.word_embeddings.weight._data = init(
+            [c.vocab_size, c.hidden_size], "float32")
+        self.position_embeddings = nn.Embedding(c.max_position_embeddings,
+                                                c.hidden_size)
+        self.token_type_embeddings = nn.Embedding(c.type_vocab_size,
+                                                  c.hidden_size)
+        if c.use_task_id:
+            self.task_type_embeddings = nn.Embedding(c.task_type_vocab_size,
+                                                     c.hidden_size)
+        else:
+            self.task_type_embeddings = None
+        self.layer_norm = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(S, dtype=jnp.int32)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros_like(input_ids._data))
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                task_type_ids = Tensor(jnp.zeros_like(input_ids._data))
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask, task_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return loss, logits
+        return logits
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       config.layer_norm_eps)
+        self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None, masked_positions=None):
+        seq, _ = self.ernie(input_ids, token_type_ids,
+                            attention_mask=attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        logits = self.decoder(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]),
+                labels.reshape([-1]), ignore_index=-100)
+            return loss, logits
+        return logits
+
+
+def ernie45_moe_config(**kw) -> MoEConfig:
+    """ERNIE 4.5-style MoE decoder preset (shared expert + fine-grained
+    routed experts, aux-loss routing)."""
+    base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, num_experts=8, top_k=2,
+                moe_intermediate_size=64, shared_expert_intermediate_size=64,
+                first_k_dense_replace=1)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+class Ernie45MoEForCausalLM(MoEForCausalLM):
+    """Alias class so checkpoints/configs can name the family explicitly."""

