@@ -165,7 +165,8 @@ def _no_pp_fallback(stage_fn, stacked_params, microbatches, extra_args):
     if M <= 4:
         # unrolled: avoids the per-iteration while-loop host round-trip
         # (the microbatch count is static, so this is just M copies)
-        outs = jnp.stack([one_mb(microbatches[i]) for i in range(M)])
+        outs = jax.tree.map(lambda *xs: jnp.stack(xs),
+                            *[one_mb(microbatches[i]) for i in range(M)])
     else:
         outs = jax.lax.map(one_mb, microbatches)
     return outs

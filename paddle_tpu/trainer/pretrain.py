@@ -229,13 +229,14 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(("dp", "sharding"), "sep", None)))
         mbs = x.reshape((M, B // M) + x.shape[1:])
-        # stage_fn owns the remat policy (per-layer checkpoint per
-        # cfg.remat); a second stage-level checkpoint in spmd_pipeline
-        # would discard what dots_saveable deliberately saved
+        # remat="full" keeps the stage-level checkpoint (per-tick
+        # residual = stage input only, GPipe footprint); for "dots"/"none"
+        # the stage body owns the policy — an outer checkpoint would
+        # discard what dots_saveable deliberately saved
         outs = spmd_pipeline(stage_fn, compute_params["stacked"], mbs, mesh,
                              M, extra_args=(cos.astype(x.dtype),
                                             sin.astype(x.dtype)),
-                             remat=False)
+                             remat=(cfg.remat == "full"))
         h = outs.reshape((B, S, -1))
         # final norm
         h32 = h.astype(jnp.float32)
@@ -243,15 +244,32 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
             jnp.mean(jnp.square(h32), -1, keepdims=True) + mc.rms_norm_eps)
         ).astype(h.dtype) * compute_params["outer"][norm_key]
         if head_key in compute_params["outer"]:
-            logits = h @ compute_params["outer"][head_key]
+            w_head = compute_params["outer"][head_key]
         else:
-            logits = h @ emb.T
-        logits = jax.lax.with_sharding_constraint(
-            logits, NamedSharding(mesh, P(("dp", "sharding"), None, "mp")))
-        logits32 = logits.astype(jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits32, axis=-1)
-        picked = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
-        loss = (lse - picked).mean()
+            w_head = emb.T
+
+        # Chunked softmax cross-entropy: never materializes the full
+        # [B, S, vocab] f32 logits (the reference's c_softmax_with_
+        # cross_entropy solves the same memory blow-up for TP; here the
+        # lever is chunking + per-chunk remat — bwd recomputes each
+        # chunk's logits instead of keeping 4·B·S·V bytes live).
+        @jax.checkpoint
+        def chunk_loss(h_c, labels_c):
+            logits = h_c @ w_head
+            logits = jax.lax.with_sharding_constraint(
+                logits, NamedSharding(mesh, P(("dp", "sharding"), None, "mp")))
+            logits32 = logits.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits32, axis=-1)
+            picked = jnp.take_along_axis(
+                logits32, labels_c[..., None], axis=-1)[..., 0]
+            return (lse - picked).sum()
+
+        n_chunks = next(c for c in (4, 2, 1) if S % c == 0)
+        total = jnp.zeros((), jnp.float32)
+        for i in range(n_chunks):
+            sl = slice(i * S // n_chunks, (i + 1) * S // n_chunks)
+            total = total + chunk_loss(h[:, sl], labels[:, sl])
+        loss = total / (B * S)
         return loss
 
     def train_step(state: TrainState, ids, labels):
