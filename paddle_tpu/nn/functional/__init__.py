@@ -818,8 +818,15 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         spatial = list(range(1, nd - 1))
     pairs = [(0, 0)] * nd
     half = len(pad) // 2
+    if not spatial:
+        # low-rank input (no batch/channel dims to skip): pad trailing dims
+        spatial = list(range(nd))
+    if len(spatial) < half:
+        raise ValueError(
+            f"pad length {len(pad)} implies {half} spatial dims but input "
+            f"rank {nd} with data_format {data_format!r} has {len(spatial)}")
     for i in range(half):
-        d = spatial[-(i + 1)] if data_format.startswith("NC") else spatial[-(i + 1)]
+        d = spatial[-(i + 1)]
         pairs[d] = (int(pad[2 * i]), int(pad[2 * i + 1]))
     def impl(a):
         if mode == "constant":

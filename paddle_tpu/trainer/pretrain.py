@@ -264,11 +264,13 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
                 logits32, labels_c[..., None], axis=-1)[..., 0]
             return (lse - picked).sum()
 
-        n_chunks = next(c for c in (4, 2, 1) if S % c == 0)
+        # uneven chunking keeps the memory bound for every S (ceil-division
+        # boundaries; each chunk shape is static so XLA compiles ≤2 variants)
+        n_chunks = min(4, S)
+        bounds = [i * S // n_chunks for i in range(n_chunks)] + [S]
         total = jnp.zeros((), jnp.float32)
-        for i in range(n_chunks):
-            sl = slice(i * S // n_chunks, (i + 1) * S // n_chunks)
-            total = total + chunk_loss(h[:, sl], labels[:, sl])
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            total = total + chunk_loss(h[:, lo:hi], labels[:, lo:hi])
         loss = total / (B * S)
         return loss
 

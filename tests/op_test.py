@@ -1,0 +1,152 @@
+"""Declarative per-op test harness — the OpTest triangle of SURVEY §4.1.
+
+The reference's backbone harness (test/legacy_test/op_test.py) checks every
+operator three ways; this is the TPU-native equivalent:
+
+  (a) check_output  — op(Tensors) vs a NumPy reference, across dtypes
+  (b) check_grad    — tape-autograd gradients vs central finite differences
+  (c) check_traced  — eager execution vs the traced/compiled (`jit.to_static`)
+                      program (the reference's dygraph-vs-static sweep)
+
+Usage: declare `OpCase`s and call `run_case` (see tests/test_op_suite.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+@dataclasses.dataclass
+class OpCase:
+    name: str
+    op: Callable          # takes Tensors (+ attrs), returns Tensor(s)
+    ref: Callable         # same signature over np arrays
+    inputs: Sequence[np.ndarray]
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # indices of `inputs` whose gradient is checked (None = all float inputs)
+    grad_inputs: Optional[Sequence[int]] = None
+    rtol: float = 1e-5
+    atol: float = 1e-6
+    grad_rtol: float = 5e-2
+    grad_atol: float = 5e-3
+    check_grad: bool = True
+    check_traced: bool = True
+    # per-dtype sweeps: check_output re-run with inputs cast to these
+    extra_dtypes: Sequence[str] = ()
+
+
+def _as_tuple(x):
+    return x if isinstance(x, (tuple, list)) else (x,)
+
+
+def check_output(case: OpCase):
+    outs = _as_tuple(case.op(*[paddle.to_tensor(i) for i in case.inputs],
+                             **case.attrs))
+    refs = _as_tuple(case.ref(*case.inputs, **case.attrs))
+    assert len(outs) == len(refs), f"{case.name}: arity mismatch"
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), r, rtol=case.rtol,
+                                   atol=case.atol, err_msg=case.name)
+    for dt in case.extra_dtypes:
+        cast = [i.astype(dt) if np.issubdtype(i.dtype, np.floating) else i
+                for i in case.inputs]
+        outs = _as_tuple(case.op(*[paddle.to_tensor(i) for i in cast],
+                                 **case.attrs))
+        refs = _as_tuple(case.ref(*[c.astype(np.float32) for c in cast],
+                                  **case.attrs))
+        # reduced-precision pass: compare against f32 reference loosely
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(
+                o.numpy().astype(np.float32), r, rtol=2e-2, atol=2e-2,
+                err_msg=f"{case.name}[{dt}]")
+
+
+def _scalarize(op, inputs_np, attrs, weights):
+    """loss(inputs) = sum_k sum(op_out_k * w_k) — a fixed random projection
+    so gradients of every output element are exercised."""
+    def loss_np(*arrs):
+        outs = _as_tuple(op(*[paddle.to_tensor(a) for a in arrs], **attrs))
+        total = None
+        for o, w in zip(outs, weights):
+            term = (o * paddle.to_tensor(w)).sum()
+            total = term if total is None else total + term
+        return total
+    return loss_np
+
+
+def check_grad(case: OpCase, eps: float = 1e-3):
+    grad_idx = case.grad_inputs
+    if grad_idx is None:
+        grad_idx = [i for i, a in enumerate(case.inputs)
+                    if np.issubdtype(a.dtype, np.floating)]
+    refs = _as_tuple(case.ref(*case.inputs, **case.attrs))
+    rng = np.random.RandomState(0)
+    weights = [rng.uniform(0.5, 1.5, np.shape(r)).astype(np.float32)
+               for r in refs]
+    loss = _scalarize(case.op, case.inputs, case.attrs, weights)
+
+    # analytic grads via the tape
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in case.inputs]
+    outs = _as_tuple(case.op(*tensors, **case.attrs))
+    total = None
+    for o, w in zip(outs, weights):
+        term = (o * paddle.to_tensor(w)).sum()
+        total = term if total is None else total + term
+    total.backward()
+
+    for i in grad_idx:
+        analytic = tensors[i].grad
+        assert analytic is not None, f"{case.name}: no grad for input {i}"
+        analytic = analytic.numpy()
+        # numeric central differences on a sample of elements (full sweep on
+        # small inputs, random sample on large — OpTest does the same)
+        a = case.inputs[i].astype(np.float64)
+        flat_n = a.size
+        idxs = (range(flat_n) if flat_n <= 64 else
+                rng.choice(flat_n, 24, replace=False))
+        for fi in idxs:
+            pert = case.inputs[i].copy().astype(np.float64)
+            orig = pert.flat[fi]
+            h = max(eps, eps * abs(orig))
+            pert.flat[fi] = orig + h
+            args_p = list(case.inputs); args_p[i] = pert.astype(np.float32)
+            lp = float(loss(*args_p).numpy())
+            pert.flat[fi] = orig - h
+            args_m = list(case.inputs); args_m[i] = pert.astype(np.float32)
+            lm = float(loss(*args_m).numpy())
+            numeric = (lp - lm) / (2 * h)
+            got = analytic.flat[fi]
+            denom = max(abs(numeric), abs(got), 1.0 / case.grad_rtol)
+            assert abs(numeric - got) <= (
+                case.grad_atol + case.grad_rtol * denom), (
+                f"{case.name}: grad input {i} elem {fi}: "
+                f"analytic {got} vs numeric {numeric}")
+
+
+def check_traced(case: OpCase):
+    from paddle_tpu import jit
+
+    def fn(*ts):
+        return case.op(*ts, **case.attrs)
+
+    traced = jit.to_static(fn)
+    tensors = [paddle.to_tensor(a) for a in case.inputs]
+    eager = _as_tuple(fn(*tensors))
+    comp = _as_tuple(traced(*tensors))
+    for e, c in zip(eager, comp):
+        np.testing.assert_allclose(c.numpy(), e.numpy(), rtol=1e-6,
+                                   atol=1e-6,
+                                   err_msg=f"{case.name}: traced != eager")
+
+
+def run_case(case: OpCase):
+    check_output(case)
+    if case.check_grad:
+        check_grad(case)
+    if case.check_traced:
+        check_traced(case)
