@@ -182,6 +182,28 @@ _METHODS = dict(
     exponential_=random.exponential_,
     # attribute
     real=attribute.real, imag=attribute.imag,
+    # long tail
+    hypot=math.hypot, ldexp=math.ldexp, nextafter=math.nextafter,
+    logaddexp=math.logaddexp, floor_mod=math.floor_mod, sinc=math.sinc,
+    signbit=math.signbit, angle=math.angle, conj=math.conj,
+    digamma=math.digamma, lgamma=math.lgamma, i0=math.i0, i1=math.i1,
+    polygamma=math.polygamma, sgn=math.sgn,
+    count_nonzero=math.count_nonzero, trapezoid=math.trapezoid,
+    renorm=math.renorm, logcumsumexp=math.logcumsumexp,
+    bmm=linalg.bmm, mv=linalg.mv, addmm=linalg.addmm,
+    inverse=linalg.inverse, tensordot=linalg.tensordot, cdist=linalg.cdist,
+    pdist=linalg.pdist,
+    diagonal=manipulation.diagonal, diag_embed=manipulation.diag_embed,
+    unflatten=manipulation.unflatten, unfold=manipulation.unfold,
+    select_scatter=manipulation.select_scatter,
+    slice_scatter=manipulation.slice_scatter,
+    masked_scatter=manipulation.masked_scatter,
+    index_fill=manipulation.index_fill, take=manipulation.take,
+    unique_consecutive=manipulation.unique_consecutive,
+    vander=manipulation.vander,
+    bucketize=search.bucketize,
+    is_empty=attribute.is_empty,
+    as_complex=attribute.as_complex, as_real=attribute.as_real,
 )
 
 for _name, _fn in _METHODS.items():

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -38,3 +39,34 @@ def is_integer(x) -> bool:
 
 def is_floating_point(x) -> bool:
     return np.issubdtype(x.dtype, np.floating) or x.dtype == jnp.bfloat16
+
+
+def is_empty(x, name=None):
+    n = 1
+    for s in x.shape:
+        n *= s
+    return Tensor(jnp.asarray(n == 0))
+
+
+def tolist(x):
+    return x.numpy().tolist()
+
+
+def as_complex(x, name=None):
+    """[..., 2] real pairs → complex (ref: as_complex op)."""
+    return apply("as_complex",
+                 lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [x])
+
+
+def as_real(x, name=None):
+    return apply("as_real",
+                 lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), [x])
+
+
+def polar(abs, angle, name=None):
+    return apply("polar",
+                 lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                 [abs, angle])
+
+
+__all__ += ["is_empty", "tolist", "as_complex", "as_real", "polar"]

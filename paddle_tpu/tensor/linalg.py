@@ -233,3 +233,76 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         k = q if q is not None else min(6, *b.shape[-2:])
         return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
     return apply("pca_lowrank", impl, [x])
+
+
+# ---------------------------------------------------------------------------
+# long-tail linalg surface
+# ---------------------------------------------------------------------------
+def mm(x, y, name=None) -> Tensor:
+    return apply("mm", jnp.matmul, [x, y])
+
+
+def bmm(x, y, name=None) -> Tensor:
+    if x.ndim != 3 or y.ndim != 3:
+        raise ValueError("bmm expects 3-D inputs")
+    return apply("bmm", jnp.matmul, [x, y])
+
+
+def mv(x, vec, name=None) -> Tensor:
+    return apply("mv", jnp.matmul, [x, vec])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None) -> Tensor:
+    return apply("addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+                 [input, x, y])
+
+
+inverse = inv
+
+
+def tensordot(x, y, axes=2, name=None) -> Tensor:
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax),
+                 [x, y])
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None) -> Tensor:
+    """Pairwise p-distance between row sets: [..., M, D] × [..., N, D] →
+    [..., M, N]."""
+    def impl(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            sq = jnp.sum(jnp.square(diff), -1)
+            # masked subgradient at coincident rows: d/dx sqrt(0) is inf and
+            # inf*0 = NaN would poison the whole gradient
+            zero = sq == 0
+            return jnp.where(zero, 0.0, jnp.sqrt(jnp.where(zero, 1.0, sq)))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), -1)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+    return apply("cdist", impl, [x, y])
+
+
+def pdist(x, p=2.0, name=None) -> Tensor:
+    """Condensed pairwise distance of rows ([N, D] → [N*(N-1)/2])."""
+    n = x.shape[0]
+    iu = np.triu_indices(n, k=1)
+    def impl(a):
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            sq = jnp.sum(jnp.square(d), -1)
+            zero = sq == 0
+            full = jnp.where(zero, 0.0, jnp.sqrt(jnp.where(zero, 1.0, sq)))
+        elif p == float("inf"):
+            full = jnp.max(jnp.abs(d), -1)
+        else:
+            full = jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+        return full[iu]
+    return apply("pdist", impl, [x])
+
+
+__all__ += ["mm", "bmm", "mv", "addmm", "inverse", "tensordot", "cdist",
+            "pdist"]

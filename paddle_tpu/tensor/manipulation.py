@@ -458,3 +458,195 @@ def pad_nd(x, pad, mode="constant", value=0.0, name=None) -> Tensor:
         jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
         return jnp.pad(a, pairs, mode=jmode)
     return apply("pad", impl, [x])
+
+
+# ---------------------------------------------------------------------------
+# long-tail manipulation surface
+# ---------------------------------------------------------------------------
+def permute(x, perm, name=None) -> Tensor:
+    return transpose(x, perm)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
+    return apply("diagonal",
+                 lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), [x])
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    """Batched diagonal-matrix construction (last dim becomes a diagonal)."""
+    def impl(a):
+        n = a.shape[-1]
+        size = n + builtins.abs(offset)
+        rows = jnp.arange(n) + (-offset if offset < 0 else 0)
+        cols = jnp.arange(n) + (offset if offset > 0 else 0)
+        out = jnp.zeros(a.shape[:-1] + (size, size), a.dtype)
+        out = out.at[..., rows, cols].set(a)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+    return apply("diag_embed", impl, [x])
+
+
+def hsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=2)
+
+
+def unflatten(x, axis, shape, name=None) -> Tensor:
+    ax = axis % x.ndim
+    shape = list(shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = x.shape[ax] // known
+    new_shape = list(x.shape[:ax]) + shape + list(x.shape[ax + 1:])
+    return reshape(x, new_shape)
+
+
+def unfold(x, axis, size, step, name=None) -> Tensor:
+    """Sliding windows along `axis`: axis → num_windows, window size appended
+    as the last dim (torch/paddle Tensor.unfold semantics)."""
+    ax = axis % x.ndim
+    L = x.shape[ax]
+    starts = np.arange(0, L - size + 1, step)
+    idx = jnp.asarray(starts[:, None] + np.arange(size)[None, :])
+    def impl(a):
+        y = jnp.take(a, idx, axis=ax)  # axis expands to (n_win, size)
+        return jnp.moveaxis(y, ax + 1, -1)
+    return apply("unfold", impl, [x])
+
+
+def select_scatter(x, values, axis, index, name=None) -> Tensor:
+    def impl(a, v):
+        m = jnp.moveaxis(a, axis, 0)
+        m = m.at[index].set(v.astype(a.dtype))
+        return jnp.moveaxis(m, 0, axis)
+    return apply("select_scatter", impl, [x, values])
+
+
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None) -> Tensor:
+    strides = strides or [1] * len(axes)
+    # NB: `slice` the builtin is shadowed by paddle's slice() op above
+    sls = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sls[ax] = builtins.slice(st, en, sd)
+    def impl(a, v):
+        return a.at[tuple(sls)].set(v.astype(a.dtype))
+    return apply("slice_scatter", impl, [x, value])
+
+
+def masked_scatter(x, mask, value, name=None) -> Tensor:
+    """Fill True positions of mask with consecutive elements of value.
+    Static-shape formulation (gather by prefix-sum) — traces fine."""
+    def impl(a, m, v):
+        mb = jnp.broadcast_to(m, a.shape)
+        pos = jnp.cumsum(mb.ravel().astype(jnp.int32)) - 1
+        vals = jnp.take(v.ravel(), jnp.clip(pos, 0, v.size - 1))
+        return jnp.where(mb, vals.reshape(a.shape).astype(a.dtype), a)
+    return apply("masked_scatter", impl, [x, mask, value])
+
+
+def index_fill(x, index, axis, fill_value, name=None) -> Tensor:
+    def impl(a, idx):
+        m = jnp.moveaxis(a, axis, 0)
+        m = m.at[idx].set(jnp.asarray(fill_value, a.dtype))
+        return jnp.moveaxis(m, 0, axis)
+    return apply("index_fill", impl, [x, index])
+
+
+def take(x, index, mode="raise", name=None) -> Tensor:
+    """Flat-index take with paddle's out-of-range modes."""
+    if mode == "raise" and not _is_traced(x) and not _is_traced(index):
+        n = int(np.prod(x.shape))
+        idx_host = np.asarray(index._data if isinstance(index, Tensor)
+                              else index)
+        if idx_host.size and (idx_host.min() < -n or idx_host.max() >= n):
+            raise ValueError(
+                f"take index out of range for input with {n} elements")
+    jmode = {"raise": "clip", "wrap": "wrap", "clip": "clip"}[mode]
+    return apply("take",
+                 lambda a, i: jnp.take(a.ravel(), i, mode=jmode), [x, index])
+
+
+def multiplex(inputs, index, name=None) -> Tensor:
+    """out[i] = inputs[index[i, 0]][i] (ref: multiplex op)."""
+    def impl(idx, *arrs):
+        stk = jnp.stack(arrs)  # [K, d0, ...]
+        rows = idx.reshape(-1).astype(jnp.int32)
+        return stk[rows, jnp.arange(stk.shape[1])]
+    return apply("multiplex", impl, [index, *inputs])
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None) -> Tensor:
+    """Vocab-shard label remap (ref: shard_index op, used by
+    VocabParallelEmbedding/ParallelCrossEntropy data prep)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    def impl(a):
+        in_shard = (a // shard_size) == shard_id
+        return jnp.where(in_shard, a % shard_size,
+                         jnp.asarray(ignore_value, a.dtype))
+    return apply("shard_index", impl, [input])
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, name=None):
+    """Dynamic-shape: eager-only (host fallback); raises under tracing."""
+    if _is_traced(x):
+        raise NotImplementedError(
+            "unique_consecutive has data-dependent output shape; not "
+            "supported under tracing")
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if axis is None:
+        a = a.ravel()
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+        out = a[keep]
+    else:
+        moved = np.moveaxis(a, axis, 0)
+        keep = np.concatenate(
+            [[True],
+             np.any(moved[1:] != moved[:-1],
+                    axis=tuple(range(1, moved.ndim)))])
+        out = np.moveaxis(moved[keep], 0, axis)
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(
+            np.diff(np.append(np.nonzero(keep)[0], len(keep))))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def scatter_nd(index, updates, shape, name=None) -> Tensor:
+    def impl(idx, upd):
+        out = jnp.zeros(tuple(shape), upd.dtype)
+        return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply("scatter_nd", impl, [index, updates])
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def vander(x, n=None, increasing=False, name=None) -> Tensor:
+    return apply("vander",
+                 lambda a: jnp.vander(a, N=n, increasing=increasing), [x])
+
+
+__all__ += ["permute", "diagonal", "diag_embed", "hsplit", "vsplit",
+            "dsplit", "unflatten", "unfold", "select_scatter",
+            "slice_scatter", "masked_scatter", "index_fill", "take",
+            "multiplex", "shard_index", "unique_consecutive", "scatter_nd",
+            "broadcast_shape", "vander"]

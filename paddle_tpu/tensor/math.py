@@ -329,3 +329,77 @@ def clip_(x, min=None, max=None, name=None):
 
 def scale_(x, scale_v=1.0, bias=0.0, bias_after_scale=True, name=None):
     return x._inplace_from(scale(x._snapshot(), scale_v, bias, bias_after_scale))
+
+
+# ---------------------------------------------------------------------------
+# long-tail math surface (ref: python/paddle/tensor/math.py special fns)
+# ---------------------------------------------------------------------------
+hypot = _binary("hypot", jnp.hypot)
+ldexp = _binary("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)))
+nextafter = _binary("nextafter", jnp.nextafter)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+floor_mod = remainder
+sinc = _unary("sinc", jnp.sinc)
+signbit = _unary("signbit", jnp.signbit)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+digamma = _unary("digamma", lambda a: jax.scipy.special.digamma(a))
+lgamma = _unary("lgamma", jax.lax.lgamma)
+i0 = _unary("i0", lambda a: jax.scipy.special.i0(a))
+i1 = _unary("i1", lambda a: jax.scipy.special.i1(a))
+
+
+def polygamma(x, n, name=None):
+    return apply("polygamma", lambda a: jax.scipy.special.polygamma(n, a), [x])
+
+
+def sgn(x, name=None):
+    """paddle.sgn: complex → x/|x| (0 for 0); real → sign."""
+    def impl(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, jnp.zeros((), a.dtype), a / mag)
+        return jnp.sign(a)
+    return apply("sgn", impl, [x])
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("count_nonzero",
+                 lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                 [x])
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None and dx is not None:
+        raise ValueError("trapezoid accepts either x or dx, not both")
+    if x is not None:
+        return apply("trapezoid",
+                     lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis), [y, x])
+    d = 1.0 if dx is None else dx
+    return apply("trapezoid", lambda yy: jnp.trapezoid(yy, dx=d, axis=axis),
+                 [y])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (ref: renorm op)."""
+    def impl(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor.astype(a.dtype)
+    return apply("renorm", impl, [x])
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def impl(a):
+        if axis is None:
+            return jax.lax.cumlogsumexp(a.ravel(), axis=0)
+        return jax.lax.cumlogsumexp(a, axis=axis)
+    return apply("logcumsumexp", impl, [x])
+
+
+__all__ += ["hypot", "ldexp", "nextafter", "logaddexp", "floor_mod", "sinc",
+            "signbit", "angle", "conj", "digamma", "lgamma", "i0", "i1",
+            "polygamma", "sgn", "count_nonzero", "trapezoid", "renorm",
+            "logcumsumexp"]

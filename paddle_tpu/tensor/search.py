@@ -106,3 +106,17 @@ def index_sample(x, index, name=None) -> Tensor:
     idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
     return apply("index_sample",
                  lambda a: jnp.take_along_axis(a, idx, axis=1), [x])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Index of the bucket each element falls into (ref: bucketize op)."""
+    side = "right" if right else "left"
+    def impl(a, seq):
+        out = jnp.searchsorted(seq, a, side=side)
+        # int64 only exists under x64; requesting it otherwise just warns
+        # and truncates, so keep the native index dtype unless int32 asked
+        return out.astype(jnp.int32) if out_int32 else out
+    return apply("bucketize", impl, [x, sorted_sequence])
+
+
+__all__ += ["bucketize"]

@@ -1,0 +1,188 @@
+"""Long-tail tensor API sweep (the ~700-function reference surface,
+SURVEY §2.2 'Tensor API' row) — numerics vs NumPy/SciPy references."""
+
+import numpy as np
+import pytest
+from scipy import special as sps
+
+import paddle_tpu as paddle
+
+R = np.random.RandomState(7)
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_special_functions():
+    x = R.uniform(0.5, 3.0, (3, 4)).astype(np.float32)
+    np.testing.assert_allclose(paddle.digamma(T(x)).numpy(), sps.digamma(x),
+                               rtol=1e-4)
+    np.testing.assert_allclose(paddle.lgamma(T(x)).numpy(), sps.gammaln(x),
+                               rtol=1e-4)
+    np.testing.assert_allclose(paddle.i0(T(x)).numpy(), sps.i0(x), rtol=1e-4)
+    np.testing.assert_allclose(paddle.i1(T(x)).numpy(), sps.i1(x), rtol=1e-4)
+    np.testing.assert_allclose(paddle.polygamma(T(x), 1).numpy(),
+                               sps.polygamma(1, x), rtol=1e-3)
+    np.testing.assert_allclose(paddle.sinc(T(x)).numpy(), np.sinc(x),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_binary_math_tail():
+    a = R.randn(3, 4).astype(np.float32)
+    b = R.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    np.testing.assert_allclose(paddle.hypot(T(a), T(b)).numpy(),
+                               np.hypot(a, b), rtol=1e-6)
+    np.testing.assert_allclose(paddle.logaddexp(T(a), T(b)).numpy(),
+                               np.logaddexp(a, b), rtol=1e-5)
+    np.testing.assert_allclose(paddle.nextafter(T(a), T(b)).numpy(),
+                               np.nextafter(a, b))
+    np.testing.assert_allclose(
+        paddle.ldexp(T(a), T(np.full((3, 4), 2, np.int32))).numpy(),
+        np.ldexp(a, np.full((3, 4), 2)))
+    np.testing.assert_allclose(paddle.floor_mod(T(a), T(b)).numpy(),
+                               np.mod(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_reductions_tail():
+    x = R.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.count_nonzero(T(x > 0)).numpy(),
+                               np.count_nonzero(x > 0))
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(T(x), axis=1).numpy(),
+        np.logaddexp.accumulate(x, axis=1), rtol=1e-4)
+    np.testing.assert_allclose(paddle.trapezoid(T(x), axis=1).numpy(),
+                               np.trapezoid(x, axis=1), rtol=1e-5)
+
+
+def test_linalg_tail():
+    a = R.randn(2, 3, 4).astype(np.float32)
+    b = R.randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.bmm(T(a), T(b)).numpy(), a @ b,
+                               rtol=1e-4, atol=1e-5)
+    m = R.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(paddle.inverse(T(m)).numpy(),
+                               np.linalg.inv(m), rtol=1e-3, atol=1e-4)
+    v = R.randn(3).astype(np.float32)
+    np.testing.assert_allclose(paddle.mv(T(m), T(v)).numpy(), m @ v,
+                               rtol=1e-4, atol=1e-5)
+    i = R.randn(2, 5).astype(np.float32)
+    x2 = R.randn(2, 3).astype(np.float32)
+    y2 = R.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.addmm(T(i), T(x2), T(y2), beta=0.5, alpha=2.0).numpy(),
+        0.5 * i + 2.0 * (x2 @ y2), rtol=1e-4, atol=1e-5)
+    # cdist vs scipy-style loop
+    xa = R.randn(4, 3).astype(np.float32)
+    xb = R.randn(5, 3).astype(np.float32)
+    ref = np.sqrt(((xa[:, None] - xb[None]) ** 2).sum(-1))
+    np.testing.assert_allclose(paddle.cdist(T(xa), T(xb)).numpy(), ref,
+                               rtol=1e-4, atol=1e-5)
+    refp = ref if False else np.sqrt(((xa[:, None] - xa[None]) ** 2).sum(-1))
+    iu = np.triu_indices(4, 1)
+    np.testing.assert_allclose(paddle.pdist(T(xa)).numpy(), refp[iu],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.tensordot(T(a), T(b[0]), axes=1).numpy()[0],
+        np.tensordot(a[0], b[0], axes=1), rtol=1e-4, atol=1e-5)
+
+
+def test_manipulation_tail():
+    x = R.randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(paddle.diagonal(T(x)).numpy(), np.diagonal(x))
+    d = R.randn(2, 3).astype(np.float32)
+    de = paddle.diag_embed(T(d))
+    assert de.shape == [2, 3, 3]
+    np.testing.assert_allclose(de.numpy()[1], np.diag(d[1]))
+    de_off = paddle.diag_embed(T(d), offset=1)
+    assert de_off.shape == [2, 4, 4]
+    parts = paddle.hsplit(T(x), 2)
+    assert [p.shape for p in parts] == [[4, 3], [4, 3]]
+    parts = paddle.vsplit(T(x), 2)
+    assert [p.shape for p in parts] == [[2, 6], [2, 6]]
+    uf = paddle.unflatten(T(x), 1, [2, 3])
+    assert uf.shape == [4, 2, 3]
+    w = paddle.unfold(T(np.arange(10, dtype=np.float32)), 0, 4, 2)
+    np.testing.assert_allclose(w.numpy()[1], [2, 3, 4, 5])
+    ss = paddle.select_scatter(T(np.zeros((3, 4), np.float32)),
+                               T(np.ones(4, np.float32)), 0, 1)
+    np.testing.assert_allclose(ss.numpy()[1], np.ones(4))
+    sl = paddle.slice_scatter(T(np.zeros((3, 4), np.float32)),
+                              T(np.ones((3, 2), np.float32)),
+                              axes=[1], starts=[1], ends=[3])
+    np.testing.assert_allclose(sl.numpy()[:, 1:3], np.ones((3, 2)))
+    fi = paddle.index_fill(T(x), T(np.array([0, 2])), 0, -1.0)
+    assert (fi.numpy()[[0, 2]] == -1).all()
+    tk = paddle.take(T(x), T(np.array([0, 7])))
+    np.testing.assert_allclose(tk.numpy(), x.ravel()[[0, 7]])
+    np.testing.assert_allclose(
+        paddle.vander(T(np.array([1., 2., 3.], np.float32)), 3).numpy(),
+        np.vander([1., 2., 3.], 3))
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+def test_scatter_and_sharding_ops():
+    out = paddle.scatter_nd(T(np.array([[0], [2], [0]], np.int64)),
+                            T(np.array([1., 2., 3.], np.float32)), [4])
+    np.testing.assert_allclose(out.numpy(), [4., 0., 2., 0.])
+    si = paddle.shard_index(T(np.array([0, 5, 9, 3], np.int64)), 10, 2, 1)
+    np.testing.assert_allclose(si.numpy(), [-1, 0, 4, -1])
+    mx = paddle.multiplex(
+        [T(np.array([[1., 2.], [3., 4.]], np.float32)),
+         T(np.array([[5., 6.], [7., 8.]], np.float32))],
+        T(np.array([[1], [0]], np.int32)))
+    np.testing.assert_allclose(mx.numpy(), [[5., 6.], [3., 4.]])
+
+
+def test_masked_scatter_and_unique_consecutive():
+    m = paddle.masked_scatter(
+        T(np.zeros((2, 2), np.float32)),
+        T(np.array([[True, False], [True, True]])),
+        T(np.array([1., 2., 3.], np.float32)))
+    np.testing.assert_allclose(m.numpy(), [[1., 0.], [2., 3.]])
+    u = paddle.unique_consecutive(T(np.array([1, 1, 2, 2, 3, 1])))
+    np.testing.assert_allclose(u.numpy(), [1, 2, 3, 1])
+    u, inv, cnt = paddle.unique_consecutive(
+        T(np.array([1, 1, 2, 3, 3])), return_inverse=True,
+        return_counts=True)
+    np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+    np.testing.assert_allclose(inv.numpy(), [0, 0, 1, 2, 2])
+    np.testing.assert_allclose(cnt.numpy(), [2, 1, 2])
+
+
+def test_search_attr_tail():
+    seq = np.array([1., 3., 5., 7.], np.float32)
+    x = np.array([0.5, 3., 6.], np.float32)
+    np.testing.assert_allclose(paddle.bucketize(T(x), T(seq)).numpy(),
+                               np.searchsorted(seq, x))
+    np.testing.assert_allclose(
+        paddle.bucketize(T(x), T(seq), right=True).numpy(),
+        np.searchsorted(seq, x, side="right"))
+    assert not paddle.is_empty(T(np.ones(3))).item()
+    assert paddle.is_empty(paddle.zeros([0, 3])).item()
+    assert paddle.tolist(T(np.array([1, 2]))) == [1, 2]
+
+
+def test_complex_pack_roundtrip():
+    pairs = R.randn(3, 2).astype(np.float32)
+    c = paddle.as_complex(T(pairs))
+    assert paddle.is_complex(c)
+    back = paddle.as_real(c)
+    np.testing.assert_allclose(back.numpy(), pairs)
+    r = np.array([1.0, 2.0], np.float32)
+    th = np.array([0.0, np.pi / 2], np.float32)
+    pol = paddle.polar(T(r), T(th))
+    np.testing.assert_allclose(pol.numpy(), r * np.exp(1j * th), atol=1e-6)
+
+
+def test_tail_grads():
+    x = paddle.to_tensor(np.array([1.5, 2.5], np.float32),
+                         stop_gradient=False)
+    y = paddle.lgamma(x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               sps.digamma([1.5, 2.5]), rtol=1e-4)
+    a = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32),
+                         stop_gradient=False)
+    paddle.diagonal(a).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.eye(2))
