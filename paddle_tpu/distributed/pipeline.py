@@ -43,6 +43,37 @@ def _pp_shard_map(f, mesh, in_specs, out_specs):
                          axis_names=frozenset({PP_AXIS}), check_vma=True)
 
 
+def _cpu_f32_upcast(stacked_params, microbatches, extra_args):
+    """XLA CPU crashes ("Invalid binary instruction opcode copy") on sub-f32
+    psum under partial-manual sharding — both our output psum and the psums
+    AD inserts when transposing pvary. On the CPU backend (simulated-mesh
+    tests / dryrun) run the whole pipelined region in f32; TPU keeps bf16.
+    Returns (params, mbs, extra, restore_fn) when the upcast applies."""
+    if jax.default_backend() != "cpu" or not any(
+            jnp.issubdtype(x.dtype, jnp.floating)
+            and jnp.dtype(x.dtype).itemsize < 4
+            for x in jax.tree_util.tree_leaves(
+                (stacked_params, microbatches, extra_args))):
+        return None
+    out_dtype = microbatches.dtype
+    up = lambda x: x.astype(jnp.float32) if (
+        jnp.issubdtype(x.dtype, jnp.floating)
+        and jnp.dtype(x.dtype).itemsize < 4) else x
+    return (jax.tree_util.tree_map(up, stacked_params),
+            up(microbatches),
+            tuple(jax.tree_util.tree_map(up, e) for e in extra_args),
+            lambda out: out.astype(out_dtype))
+
+
+def _gather_last_stage(out_buf, stage, S):
+    """Broadcast the last stage's output buffer to every pp rank (zeros
+    elsewhere). psum in f32: sub-f32 psum crashes XLA CPU under
+    partial-manual sharding, and f32 is the safe accumulation dtype."""
+    masked = jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf))
+    return jax.lax.psum(masked.astype(jnp.float32),
+                        PP_AXIS).astype(out_buf.dtype)
+
+
 def stack_layer_params(per_layer_states: List[Dict[str, Any]], n_stages: int):
     """[{name: array} × L] → {name: [S, L/S, ...] array} (stage-stacked)."""
     L = len(per_layer_states)
@@ -74,26 +105,13 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
         return _no_pp_fallback(stage_fn, stacked_params, microbatches,
                                extra_args)
 
-    # XLA CPU crashes ("Invalid binary instruction opcode copy") on sub-f32
-    # psum under partial-manual sharding — both our output psum and the psums
-    # AD inserts when transposing pvary. On the CPU backend (simulated-mesh
-    # tests / dryrun) run the whole pipelined region in f32; TPU keeps bf16.
-    out_dtype = microbatches.dtype
-    if jax.default_backend() == "cpu" and any(
-            jnp.issubdtype(v.dtype, jnp.floating)
-            and jnp.dtype(v.dtype).itemsize < 4
-            for v in jax.tree_util.tree_leaves(
-                (stacked_params, microbatches, extra_args))):
-        up = lambda v: v.astype(jnp.float32) if (
-            jnp.issubdtype(v.dtype, jnp.floating)
-            and jnp.dtype(v.dtype).itemsize < 4) else v
-        stacked_params = jax.tree_util.tree_map(up, stacked_params)
-        microbatches = up(microbatches)
-        extra_args = tuple(jax.tree_util.tree_map(up, e) for e in extra_args)
+    upcast = _cpu_f32_upcast(stacked_params, microbatches, extra_args)
+    if upcast is not None:
+        stacked_params, microbatches, extra_args, restore = upcast
         out = spmd_pipeline(stage_fn, stacked_params, microbatches, mesh,
                             n_microbatches, extra_args=extra_args,
                             remat=remat)
-        return out.astype(out_dtype)
+        return restore(out)
 
     body = stage_fn
     if remat:
@@ -134,14 +152,7 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
 
         (state, out_buf), _ = jax.lax.scan(
             tick, (state, out_buf), jnp.arange(M + S - 1))
-        # broadcast last stage's buffer to every pp rank (zeros elsewhere).
-        # psum in f32: XLA CPU crashes on sub-f32 psum under partial-manual
-        # sharding ("Invalid binary instruction opcode copy"); f32 is also
-        # the numerically safe accumulation dtype on TPU
-        masked = jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf))
-        out = jax.lax.psum(masked.astype(jnp.float32),
-                           PP_AXIS).astype(out_buf.dtype)
-        return out
+        return _gather_last_stage(out_buf, stage, S)
 
     extra_specs = tuple(P(*([None] * jnp.ndim(e))) for e in extra_args)
     fn = _pp_shard_map(
@@ -170,3 +181,148 @@ def _no_pp_fallback(stage_fn, stacked_params, microbatches, extra_args):
     else:
         outs = jax.lax.map(one_mb, microbatches)
     return outs
+
+
+# ---------------------------------------------------------------------------
+# Interleaved VPP (ref: PipelineParallelWithInterleave, virtual_pp_degree —
+# SURVEY §2.3 P6). Compiled formulation: V = S*v virtual stages laid out
+# round-robin over S devices; every activation hops device→device once per
+# tick via ppermute, carrying its virtual-stage counter. Device 0 injects
+# fresh microbatches on a statically precomputed collision-free schedule
+# (returning activations have priority), which is exactly what shrinks the
+# bubble from (S-1)/(M+S-1) to ~(S-1)/(M*v+S-1): the drain of chunk column
+# j overlaps the fill of column j+1. Zero-bubble (ZBH1) splitting of
+# backward into dgrad/wgrad is owned by XLA's latency-hiding scheduler in
+# this compiled formulation (documented in docs/PARITY.md).
+# ---------------------------------------------------------------------------
+def _vpp_injection_schedule(S: int, v: int, M: int):
+    """Greedy static schedule: inject[t] = microbatch entering at tick t
+    (-1 = none; returning activations occupy device 0 that tick)."""
+    V = S * v
+    entries = []
+    busy = set()  # ticks when a returning activation reaches device 0
+    t = 0
+    for m in range(M):
+        while t in busy:
+            t += 1
+        entries.append(t)
+        for k in range(1, v):
+            busy.add(t + k * S)
+        t += 1
+    total = entries[-1] + V
+    inject = [-1] * total
+    for m, e in enumerate(entries):
+        inject[e] = m
+    return inject, total
+
+
+def spmd_pipeline_interleaved(stage_fn, stacked_params: Dict[str, Any],
+                              microbatches, mesh: Mesh, n_microbatches: int,
+                              v: int, extra_args=(), remat: bool = True):
+    """Interleaved-VPP pipelined stack.
+
+    stacked_params: {name: [S, v, L/(S*v), ...]} — dim 0 sharded on pp,
+      dim 1 indexes the v chunk columns hosted by each device.
+    stage_fn(layer_params_slice, x, *extra) applies one [L/(S*v), ...] chunk.
+    """
+    S = mesh.shape[PP_AXIS]
+    M = n_microbatches
+    chunk_dim = next(iter(stacked_params.values())).shape[1]
+    if chunk_dim != v:
+        raise ValueError(
+            f"stacked_params chunk dim {chunk_dim} != v={v}; stack with "
+            f"stack_layer_params_interleaved(layers, {S}, {v})")
+    if S == 1:
+        merged = {k: x.reshape((1, x.shape[1] * x.shape[2]) + x.shape[3:])
+                  for k, x in stacked_params.items()}
+        return _no_pp_fallback(stage_fn, merged, microbatches, extra_args)
+    V = S * v
+
+    upcast = _cpu_f32_upcast(stacked_params, microbatches, extra_args)
+    if upcast is not None:
+        stacked_params, microbatches, extra_args, restore = upcast
+        out = spmd_pipeline_interleaved(
+            stage_fn, stacked_params, microbatches, mesh, M, v,
+            extra_args=extra_args, remat=remat)
+        return restore(out)
+
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    inject, total = _vpp_injection_schedule(S, v, M)
+    inject_t = jnp.asarray(inject, jnp.int32)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    param_specs = {k: P(PP_AXIS, *([None] * (x.ndim - 1)))
+                   for k, x in stacked_params.items()}
+    mb_spec = P(*([None] * microbatches.ndim))
+
+    def per_device(params, mbs, *extra):
+        params = {k: x[0] for k, x in params.items()}  # [v, L/V, ...]
+        stage = jax.lax.axis_index(PP_AXIS)
+        mb_shape = mbs.shape[1:]
+        zero = jnp.zeros(mb_shape, mbs.dtype)
+        state = jax.lax.pvary(zero, PP_AXIS)
+        h0 = jax.lax.pvary(jnp.zeros((), jnp.int32), PP_AXIS)
+        m0 = jax.lax.pvary(jnp.zeros((), jnp.int32), PP_AXIS)
+        out_buf = jax.lax.pvary(jnp.zeros((M,) + mb_shape, mbs.dtype),
+                                PP_AXIS)
+
+        def tick(carry, t):
+            state, h, m, out_buf = carry
+            inj = inject_t[t]
+            fresh = jnp.logical_and(stage == 0, inj >= 0)
+            x = jnp.where(fresh, mbs[jnp.maximum(inj, 0)], state)
+            h = jnp.where(fresh, 0, h)
+            m = jnp.where(fresh, jnp.maximum(inj, 0), m)
+            chunk = jnp.clip(h // S, 0, v - 1)
+            cp = {k: jax.lax.dynamic_index_in_dim(x_, chunk, 0,
+                                                  keepdims=False)
+                  for k, x_ in params.items()}
+            # live = this device holds a real activation whose virtual
+            # stage belongs to it this tick
+            live = jnp.logical_and(h % S == stage, h < V)
+            y = body(cp, x, *extra)
+            y = jnp.where(live, y, x)
+            done = jnp.logical_and(jnp.logical_and(stage == S - 1,
+                                                   h == V - 1), live)
+            idx = jnp.clip(m, 0, M - 1)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(done, y, out_buf[idx]), idx, axis=0)
+            state = jax.lax.ppermute(y, PP_AXIS, perm)
+            h = jax.lax.ppermute(h + 1, PP_AXIS, perm)
+            m = jax.lax.ppermute(m, PP_AXIS, perm)
+            return (state, h, m, out_buf), None
+
+        (state, h, m, out_buf), _ = jax.lax.scan(
+            tick, (state, h0, m0, out_buf), jnp.arange(total))
+        return _gather_last_stage(out_buf, stage, S)
+
+    extra_specs = tuple(P(*([None] * jnp.ndim(e))) for e in extra_args)
+    fn = _pp_shard_map(
+        per_device, mesh,
+        in_specs=(param_specs, mb_spec) + extra_specs,
+        out_specs=P(*([None] * microbatches.ndim)))
+    return jax.jit(fn)(stacked_params, microbatches, *extra_args)
+
+
+def stack_layer_params_interleaved(per_layer_states: List[Dict[str, Any]],
+                                   n_stages: int, v: int):
+    """[{name: arr} × L] → {name: [S, v, L/(S*v), ...]} with the VPP
+    round-robin layout: virtual stage j = chunk (j // S) on device (j % S),
+    so device s hosts layers [s, s+S, s+2S, ...] grouped into v chunks —
+    the reference's interleave assignment (pp_layers round robin)."""
+    L = len(per_layer_states)
+    V = n_stages * v
+    if L % V != 0:
+        raise ValueError(f"{L} layers not divisible into {V} virtual stages")
+    per_chunk = L // V
+    out = {}
+    for k in per_layer_states[0]:
+        stacked = jnp.stack([s[k] for s in per_layer_states], axis=0)
+        # layer index l = (chunk*S + stage)*per_chunk + i
+        stacked = stacked.reshape((v, n_stages, per_chunk)
+                                  + stacked.shape[1:])
+        out[k] = jnp.swapaxes(stacked, 0, 1)
+    return out
+
+
+__all__ += ["spmd_pipeline_interleaved", "stack_layer_params_interleaved"]

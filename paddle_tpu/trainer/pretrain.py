@@ -25,7 +25,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..distributed.mesh import build_hybrid_mesh, mesh_context
-from ..distributed.pipeline import PP_AXIS, spmd_pipeline, stack_layer_params
+from ..distributed.pipeline import (PP_AXIS, spmd_pipeline,
+                                    spmd_pipeline_interleaved,
+                                    stack_layer_params,
+                                    stack_layer_params_interleaved)
 from ..models.llama import (LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM,
                             precompute_rope)
 from ..jit import _StateSwap, bind_state, extract_state
@@ -38,7 +41,7 @@ class PretrainConfig:
     def __init__(self, model: LlamaConfig, global_batch=8, seq_len=512,
                  n_microbatches=1, lr=3e-4, weight_decay=0.1,
                  param_dtype="bfloat16", grad_clip=1.0,
-                 dp=1, mp=1, pp=1, sharding=1, sep=1,
+                 dp=1, mp=1, pp=1, sharding=1, sep=1, vpp=1,
                  scan_layers: bool = True, remat: str = "full"):
         self.model = model
         self.global_batch = global_batch
@@ -50,6 +53,9 @@ class PretrainConfig:
         self.grad_clip = grad_clip
         self.dp, self.mp, self.pp = dp, mp, pp
         self.sharding, self.sep = sharding, sep
+        # vpp > 1 = interleaved virtual-pipeline schedule (ref:
+        # virtual_pp_degree / PipelineParallelWithInterleave)
+        self.vpp = vpp
         # scan_layers=False unrolls the per-stage layer loop. On this
         # device generation each while-loop iteration costs ~2ms of host
         # round-trip, so unrolling 16 layers saves ~60ms/step fwd+bwd at
@@ -145,16 +151,20 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
             outer[k] = v
 
     n_stages = mesh.shape[PP_AXIS]
-    stacked = stack_layer_params(per_layer, n_stages)
+    if cfg.vpp > 1:
+        stacked = stack_layer_params_interleaved(per_layer, n_stages, cfg.vpp)
+    else:
+        stacked = stack_layer_params(per_layer, n_stages)
 
     # sharding specs
     tmpl = LlamaDecoderLayer(mc)
     tmpl_sd = tmpl.state_dict()
     stacked_specs = {}
+    n_lead = 3 if cfg.vpp > 1 else 2  # [S, (v,) L/stage, ...param dims]
     for k in stacked:
         base = getattr(tmpl_sd[k], "_sharding_spec", None) or P()
-        entries = [PP_AXIS, None] + list(base) \
-            + [None] * (stacked[k].ndim - 2 - len(base))
+        entries = [PP_AXIS] + [None] * (n_lead - 1) + list(base) \
+            + [None] * (stacked[k].ndim - n_lead - len(base))
         spec = P(*entries)
         stacked_specs[k] = spec
     model_sd = model.state_dict()
@@ -233,10 +243,17 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
         # residual = stage input only, GPipe footprint); for "dots"/"none"
         # the stage body owns the policy — an outer checkpoint would
         # discard what dots_saveable deliberately saved
-        outs = spmd_pipeline(stage_fn, compute_params["stacked"], mbs, mesh,
-                             M, extra_args=(cos.astype(x.dtype),
-                                            sin.astype(x.dtype)),
-                             remat=(cfg.remat == "full"))
+        if cfg.vpp > 1:
+            outs = spmd_pipeline_interleaved(
+                stage_fn, compute_params["stacked"], mbs, mesh, M, cfg.vpp,
+                extra_args=(cos.astype(x.dtype), sin.astype(x.dtype)),
+                remat=(cfg.remat == "full"))
+        else:
+            outs = spmd_pipeline(stage_fn, compute_params["stacked"], mbs,
+                                 mesh, M,
+                                 extra_args=(cos.astype(x.dtype),
+                                             sin.astype(x.dtype)),
+                                 remat=(cfg.remat == "full"))
         h = outs.reshape((B, S, -1))
         # final norm
         h32 = h.astype(jnp.float32)
