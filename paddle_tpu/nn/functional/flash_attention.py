@@ -1,0 +1,28 @@
+"""paddle.nn.functional.flash_attention namespace parity
+(ref: python/paddle/nn/functional/flash_attention.py).
+
+All entry points route to paddle_tpu.ops.flash_attention: the Pallas TPU
+flash kernel (with segment-ID varlen) where eligible, the f32-softmax XLA
+composite otherwise.
+"""
+
+from __future__ import annotations
+
+from ...ops.flash_attention import (flash_attention, flash_attn_unpadded,
+                                    flashmask_attention, sdpa,
+                                    sdpa_segmented)
+from . import scaled_dot_product_attention
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, name=None):
+    """[B, S, 3, H, D] packed qkv → flash_attention on the unpacked views."""
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax)
+
+
+__all__ = ["flash_attention", "flash_attn_unpadded", "flash_attn_qkvpacked",
+           "flashmask_attention", "scaled_dot_product_attention", "sdpa",
+           "sdpa_segmented"]

@@ -76,6 +76,15 @@ def _flash_block_sizes(Sq: int, Sk: int):
         block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
 
 
+def _flash_eligible(q, k) -> bool:
+    """Shared Pallas-kernel eligibility gate: TPU backend, block-divisible
+    equal seq lengths, MXU-friendly head dim."""
+    D = q.shape[-1]
+    return (_tpu_flash_available() and q.shape[1] == k.shape[1]
+            and _largest_dividing_block(q.shape[1]) > 0
+            and ((D <= 128 and D % 64 == 0) or D % 128 == 0))
+
+
 def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
          scale: Optional[float] = None):
     """Routing SDPA on raw [B,S,H,D] arrays: Pallas flash kernel on TPU
@@ -86,10 +95,7 @@ def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
     D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
-    use_flash = (_tpu_flash_available() and mask is None and dropout_p == 0.0
-                 and q.shape[1] == k.shape[1]
-                 and _largest_dividing_block(q.shape[1]) > 0
-                 and ((D <= 128 and D % 64 == 0) or D % 128 == 0))
+    use_flash = mask is None and dropout_p == 0.0 and _flash_eligible(q, k)
     if use_flash:
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _pallas_flash)
@@ -112,3 +118,125 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         return sdpa(q, k, v, causal=causal, dropout_p=dropout)
     out = apply("flash_attention", impl, [query, key, value])
     return out, None  # (out, softmax) — softmax only materialized on request
+
+
+# ---------------------------------------------------------------------------
+# varlen (packed / unpadded) attention — ref parity:
+# FlashAttnUnpaddedKernel (paddle/phi/kernels/gpu/flash_attn_kernel.cu) and
+# paddle.nn.functional.flash_attention.flash_attn_unpadded. TPU-native
+# mechanism: segment IDs into the Pallas flash kernel (same-segment
+# blocks attend, cross-segment blocks are skipped) instead of cu_seqlens
+# pointer arithmetic into a varlen CUDA kernel.
+# ---------------------------------------------------------------------------
+def sdpa_segmented(q, k, v, segment_ids, kv_segment_ids=None, causal=True,
+                   scale=None, dropout_p: float = 0.0):
+    """[B,S,H,D] with [B,S] int32 segment ids; rows attend only within
+    their segment. kv_segment_ids defaults to segment_ids (self-attention).
+    Pallas path on TPU, masked XLA composite elsewhere."""
+    D = q.shape[-1]
+    if scale is None:
+        scale = D ** -0.5
+    seg_q = segment_ids.astype(jnp.int32)
+    seg_kv = (seg_q if kv_segment_ids is None
+              else kv_segment_ids.astype(jnp.int32))
+    if dropout_p == 0.0 and _flash_eligible(q, k):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _pallas_flash, SegmentIds)
+        out = _pallas_flash(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2),
+            segment_ids=SegmentIds(q=seg_q, kv=seg_kv),
+            causal=causal, sm_scale=scale,
+            block_sizes=_flash_block_sizes(q.shape[1], k.shape[1]))
+        return jnp.swapaxes(out, 1, 2)
+    same = seg_q[:, :, None] == seg_kv[:, None, :]  # [B,Sq,Sk]
+    mask = same[:, None, :, :]
+    return sdpa_reference(q, k, v, mask=mask, causal=causal, scale=scale,
+                          dropout_p=dropout_p)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        name=None):
+    """paddle.nn.functional.flash_attention.flash_attn_unpadded parity:
+    packed [total_tokens, H, D] + cu_seqlens → per-sequence attention.
+    cu_seqlens are converted to segment IDs (static total length)."""
+    from ..core.dispatch import apply as _apply
+
+    def impl(q, k, v, cu_q, cu_k):
+        # segment id of token t = number of sequence starts <= t
+        seg_q = jnp.searchsorted(cu_q, jnp.arange(q.shape[0]),
+                                 side="right").astype(jnp.int32)
+        seg_k = jnp.searchsorted(cu_k, jnp.arange(k.shape[0]),
+                                 side="right").astype(jnp.int32)
+        out = sdpa_segmented(q[None], k[None], v[None], seg_q[None],
+                             kv_segment_ids=seg_k[None], causal=causal,
+                             scale=scale, dropout_p=dropout)
+        return out[0]
+    out = _apply("flash_attn_unpadded", impl,
+                 [query, key, value, cu_seqlens_q, cu_seqlens_k])
+    return out, None
+
+
+# ---------------------------------------------------------------------------
+# FlashMask — ref parity: FlashMask sparse-mask attention (flashmask_
+# attention in paddle.nn.functional.flash_attention; SURVEY §5.7 item 1).
+# The mask is described per key column by start/end row indices instead of
+# a dense [S,S] bool tensor; memory is O(S) not O(S^2).
+# ---------------------------------------------------------------------------
+def flashmask_attention(query, key, value, startend_row_indices,
+                        dropout=0.0, causal=False, name=None):
+    """startend_row_indices: [B, Hm, S_k, C] int32, Hm in {1, H}
+    (paddle's FlashMask column encoding):
+      causal, C=1: LTS — key j masked for query rows i >= start[j].
+      causal, C=2: [LTStart, LTEnd] — masked for start[j] <= i < end[j].
+      non-causal, C=2: [LTStart, UTEnd] — masked for i >= lt_start[j]
+        (lower triangle) OR i < ut_end[j] (upper triangle).
+      non-causal, C=4: [LTStart, LTEnd, UTStart, UTEnd] — masked inside
+        either band.
+    Built as a row-index comparison mask into the f32-softmax composite
+    (the O(S) index encoding is preserved; the dense mask exists only as
+    an XLA fusion intermediate, never in HBM as a user tensor).
+    """
+    from ..core.dispatch import apply as _apply
+
+    def impl(q, k, v, se):
+        B, Sq, H, D = q.shape
+        Sk = k.shape[1]
+        rows = jnp.arange(Sq, dtype=jnp.int32)[:, None]      # [Sq,1]
+        C = se.shape[-1]
+        se_b = se  # [B,Hm,Sk,C]
+        def band(lo, hi):
+            # masked-out where lo[j] <= i < hi[j]
+            return jnp.logical_and(rows >= lo[..., None, :],
+                                   rows < hi[..., None, :])
+        if C == 1:
+            if not causal:
+                raise ValueError("C=1 FlashMask (LTS) requires causal=True")
+            masked = rows >= se_b[..., 0][..., None, :]
+        elif C == 2 and causal:
+            masked = band(se_b[..., 0], se_b[..., 1])
+        elif C == 2:
+            # [LTStart, UTEnd]: lower triangle from lt_start down, upper
+            # triangle above ut_end
+            masked = jnp.logical_or(
+                rows >= se_b[..., 0][..., None, :],
+                rows < se_b[..., 1][..., None, :])
+        elif C == 4:
+            if causal:
+                raise ValueError("C=4 FlashMask requires causal=False")
+            masked = jnp.logical_or(band(se_b[..., 0], se_b[..., 1]),
+                                    band(se_b[..., 2], se_b[..., 3]))
+        else:
+            raise ValueError(f"startend_row_indices last dim must be "
+                             f"1, 2 or 4, got {C}")
+        allow = jnp.logical_not(masked)  # [B,Hm,Sq,Sk]
+        return sdpa_reference(q, k, v, mask=allow, causal=causal,
+                              dropout_p=dropout)
+    out = _apply("flashmask_attention", impl,
+                 [query, key, value, startend_row_indices])
+    return out, None
+
+
+__all__ += ["sdpa_segmented", "flash_attn_unpadded", "flashmask_attention"]

@@ -1,0 +1,147 @@
+"""Varlen (segment-id / cu_seqlens) and FlashMask attention
+(SURVEY §5.7 item 1: FlashAttn varlen/unpadded + FlashMask parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.flash_attention import (sdpa_reference, sdpa_segmented)
+from paddle_tpu.nn.functional.flash_attention import (
+    flash_attn_unpadded, flash_attn_qkvpacked, flashmask_attention)
+
+R = np.random.RandomState(3)
+B, S, H, D = 2, 16, 2, 8
+
+
+def _rand(*shape):
+    return jnp.asarray(R.randn(*shape).astype(np.float32) * 0.3)
+
+
+def test_segmented_equals_blockdiag_reference():
+    q, k, v = _rand(B, S, H, D), _rand(B, S, H, D), _rand(B, S, H, D)
+    seg = jnp.asarray(np.repeat([[0, 1], [0, 2]], S // 2, axis=1))
+    out = sdpa_segmented(q, k, v, seg, causal=True)
+    same = seg[:, :, None] == seg[:, None, :]
+    ref = sdpa_reference(q, k, v, mask=same[:, None], causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_segmented_isolates_segments():
+    """Tokens of segment 1 must be unaffected by segment-0 contents."""
+    q, k, v = _rand(1, S, H, D), _rand(1, S, H, D), _rand(1, S, H, D)
+    seg = jnp.asarray(np.repeat([[0, 1]], S // 2, axis=1))
+    out1 = sdpa_segmented(q, k, v, seg, causal=True)
+    k2 = k.at[:, : S // 2].set(999.0)  # corrupt segment 0 keys
+    v2 = v.at[:, : S // 2].set(-999.0)
+    out2 = sdpa_segmented(q, k2, v2, seg, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, S // 2:]),
+                               np.asarray(out2[:, S // 2:]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attn_unpadded_matches_per_sequence():
+    lens = [6, 10]
+    T = sum(lens)
+    q, k, v = _rand(T, H, D), _rand(T, H, D), _rand(T, H, D)
+    cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+    out, _ = flash_attn_unpadded(
+        paddle.Tensor(q), paddle.Tensor(k), paddle.Tensor(v),
+        paddle.Tensor(cu), paddle.Tensor(cu), causal=True)
+    out = np.asarray(out._data)
+    # reference: run each sequence separately
+    o0 = sdpa_reference(q[None, :6], k[None, :6], v[None, :6], causal=True)
+    o1 = sdpa_reference(q[None, 6:], k[None, 6:], v[None, 6:], causal=True)
+    np.testing.assert_allclose(out[:6], np.asarray(o0[0]), rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(out[6:], np.asarray(o1[0]), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_qkvpacked():
+    qkv = _rand(B, S, 3, H, D)
+    out, _ = flash_attn_qkvpacked(paddle.Tensor(qkv), causal=True)
+    ref = sdpa_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                         causal=True)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flashmask_lts_matches_dense_mask():
+    """C=1 LTS: key j invisible to query rows i >= start[j]."""
+    q, k, v = _rand(B, S, H, D), _rand(B, S, H, D), _rand(B, S, H, D)
+    start = np.full((B, 1, S, 1), S, np.int32)
+    start[:, :, S // 2:, 0] = 3 * S // 4  # late keys masked from row 12 on
+    out, _ = flashmask_attention(
+        paddle.Tensor(q), paddle.Tensor(k), paddle.Tensor(v),
+        paddle.Tensor(jnp.asarray(start)), causal=True)
+    # allow[b, 0, i, j] = i < start[b, 0, j]
+    allow = (np.arange(S).reshape(1, 1, S, 1)
+             < start[:, :, :, 0][:, :, None, :])
+    ref = sdpa_reference(q, k, v, mask=jnp.asarray(allow), causal=True)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flashmask_band():
+    """C=2: keys masked for start[j] <= i < end[j]."""
+    q, k, v = _rand(1, S, H, D), _rand(1, S, H, D), _rand(1, S, H, D)
+    se = np.zeros((1, 1, S, 2), np.int32)
+    se[..., 0] = 4   # rows 4..8 cannot see any key
+    se[..., 1] = 8
+    out, _ = flashmask_attention(
+        paddle.Tensor(q), paddle.Tensor(k), paddle.Tensor(v),
+        paddle.Tensor(jnp.asarray(se)), causal=True)
+    rows = np.arange(S)
+    banned = (rows >= 4) & (rows < 8)
+    allow = np.ones((1, 1, S, S), bool)
+    allow[:, :, banned, :] = False
+    ref = sdpa_reference(q, k, v, mask=jnp.asarray(allow), causal=True)
+    # banned rows have all -inf logits → softmax is uniform over the
+    # causal row; just check the allowed rows match and banned rows are
+    # finite (paddle returns the degenerate uniform average too)
+    np.testing.assert_allclose(np.asarray(out._data)[:, ~banned],
+                               np.asarray(ref)[:, ~banned],
+                               rtol=2e-5, atol=2e-5)
+    assert np.isfinite(np.asarray(out._data)).all()
+
+
+def test_flashmask_noncausal_lt_ut():
+    """non-causal C=2 = [LTStart, UTEnd]: masked for i >= lt_start[j] or
+    i < ut_end[j] (paddle FlashMask encoding)."""
+    q, k, v = _rand(1, S, H, D), _rand(1, S, H, D), _rand(1, S, H, D)
+    se = np.zeros((1, 1, S, 2), np.int32)
+    se[..., 0] = 12  # lower triangle masked from row 12 down
+    se[..., 1] = 2   # rows 0-1 masked (upper triangle)
+    out, _ = flashmask_attention(
+        paddle.Tensor(q), paddle.Tensor(k), paddle.Tensor(v),
+        paddle.Tensor(jnp.asarray(se)), causal=False)
+    rows = np.arange(S).reshape(1, 1, S, 1)
+    allow = ~((rows >= 12) | (rows < 2))
+    allow = np.broadcast_to(allow, (1, 1, S, S))
+    ref = sdpa_reference(q, k, v, mask=jnp.asarray(allow.copy()))
+    banned = (np.arange(S) >= 12) | (np.arange(S) < 2)
+    np.testing.assert_allclose(np.asarray(out._data)[:, ~banned],
+                               np.asarray(ref)[:, ~banned],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attn_unpadded_cross_lengths():
+    """cu_seqlens_q != cu_seqlens_k (cross-attention varlen) is honored."""
+    lens_q, lens_k = [4, 4], [6, 6]
+    Tq, Tk = sum(lens_q), sum(lens_k)
+    q, k, v = _rand(Tq, H, D), _rand(Tk, H, D), _rand(Tk, H, D)
+    cu_q = jnp.asarray(np.cumsum([0] + lens_q), jnp.int32)
+    cu_k = jnp.asarray(np.cumsum([0] + lens_k), jnp.int32)
+    out, _ = flash_attn_unpadded(
+        paddle.Tensor(q), paddle.Tensor(k), paddle.Tensor(v),
+        paddle.Tensor(cu_q), paddle.Tensor(cu_k), causal=False)
+    out = np.asarray(out._data)
+    o0 = sdpa_reference(q[None, :4], k[None, :6], v[None, :6])
+    o1 = sdpa_reference(q[None, 4:], k[None, 6:], v[None, 6:])
+    np.testing.assert_allclose(out[:4], np.asarray(o0[0]), rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(out[4:], np.asarray(o1[0]), rtol=2e-5,
+                               atol=2e-5)
