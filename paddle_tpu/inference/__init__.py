@@ -1,0 +1,192 @@
+"""Inference engine — paddle_infer parity (ref: paddle/fluid/inference/
+api/analysis_predictor.cc + paddle/fluid/inference/api/paddle_inference_api.h,
+SURVEY §2.1 'Inference engine' row and §3.6).
+
+TPU-native substitution: the reference's AnalysisPredictor loads a
+ProgramDesc, runs ~200 IR fusion passes, and optionally offloads subgraphs
+to TensorRT. Here the saved artifact is a `jax.export` serialized program
+(StableHLO under the hood): XLA IS the analysis/fusion pipeline, and the
+compiled executable is cached by PJRT. The Config/Predictor/Tensor-handle
+API surface is preserved so deployment code ports directly.
+
+Artifact format (written by `paddle_tpu.jit.save(layer, path, input_spec)`):
+  path.pdparams       — weights (paddle.save format)
+  path.jaxexport      — serialized jax.export program (weights baked in)
+  path.stablehlo.txt  — human-readable StableHLO (debug / judge parity)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """paddle_infer.Config parity (the knobs that are meaningful on TPU;
+    GPU/TensorRT/oneDNN toggles are accepted and recorded but are no-ops —
+    XLA owns graph optimization)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # paddle accepts Config(model_dir) or Config(prog, params); we take
+        # the artifact prefix written by jit.save
+        self._model_prefix = prog_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._switches: Dict[str, bool] = {}
+
+    def set_prog_file(self, path: str):
+        self._model_prefix = path
+
+    def prog_file(self) -> Optional[str]:
+        return self._model_prefix
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # accepted for API compat; the device is whatever jax.devices() is
+        self._device = "gpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **k):
+        self._device = "xpu"
+
+    def use_gpu(self) -> bool:
+        return self._device == "gpu"
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._switches["ir_optim"] = flag
+
+    def enable_tensorrt_engine(self, *a, **k):
+        # documented non-goal: TensorRT is NVIDIA tech; XLA compiles the
+        # whole program on TPU (docs/PARITY.md inference row)
+        self._switches["tensorrt"] = False
+
+    def summary(self) -> str:
+        return (f"Config(model={self._model_prefix!r}, device={self._device},"
+                f" switches={self._switches})")
+
+
+class PredictorTensor:
+    """Zero-copy-style IO handle (paddle_infer.Tensor parity):
+    copy_from_cpu / copy_to_cpu / shape / reshape."""
+
+    def __init__(self, name: str, spec: jax.ShapeDtypeStruct):
+        self.name = name
+        self._spec = spec
+        self._value: Optional[jnp.ndarray] = None
+
+    def reshape(self, shape: Sequence[int]):
+        self._spec = jax.ShapeDtypeStruct(tuple(shape), self._spec.dtype)
+
+    def shape(self) -> List[int]:
+        src = self._value if self._value is not None else self._spec
+        return list(src.shape)
+
+    def copy_from_cpu(self, data: np.ndarray):
+        arr = jnp.asarray(data)
+        if arr.dtype != self._spec.dtype:
+            arr = arr.astype(self._spec.dtype)
+        self._value = arr
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"output {self.name!r} not computed yet — "
+                               f"call predictor.run() first")
+        return np.asarray(self._value)
+
+    # numpy-protocol sugar
+    def numpy(self) -> np.ndarray:
+        return self.copy_to_cpu()
+
+
+class Predictor:
+    """paddle_infer.Predictor parity over a jax.export artifact."""
+
+    def __init__(self, config: Config):
+        prefix = config.prog_file()
+        if prefix is None:
+            raise ValueError("Config needs the jit.save artifact prefix")
+        path = prefix + ".jaxexport"
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"{path} not found — export with paddle_tpu.jit.save("
+                f"layer, {prefix!r}, input_spec=[...])")
+        from ..jit import _deserialize_exported
+        self._exported = _deserialize_exported(path)
+        self._in_specs = [jax.ShapeDtypeStruct(s.shape, s.dtype)
+                          for s in self._exported.in_avals]
+        self._input_names = [f"x{i}" for i in range(len(self._in_specs))]
+        self._inputs = {n: PredictorTensor(n, s)
+                        for n, s in zip(self._input_names, self._in_specs)}
+        n_out = len(self._exported.out_avals)
+        self._output_names = [f"out{i}" for i in range(n_out)]
+        self._outputs = {
+            n: PredictorTensor(n, jax.ShapeDtypeStruct(s.shape, s.dtype))
+            for n, s in zip(self._output_names, self._exported.out_avals)}
+        self._call = jax.jit(self._exported.call)
+
+    # --- paddle_infer API surface ---
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute. Either feed via get_input_handle().copy_from_cpu()
+        then run(), or pass arrays positionally (newer paddle_infer.run)."""
+        if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs; the exported program "
+                    f"takes {len(self._input_names)}")
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        args = []
+        for n in self._input_names:
+            v = self._inputs[n]._value
+            if v is None:
+                raise RuntimeError(f"input {n!r} not set")
+            args.append(v)
+        outs = self._call(*args)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        flat = jax.tree_util.tree_leaves(outs)
+        for n, o in zip(self._output_names, flat):
+            self._outputs[n]._value = o
+        return [np.asarray(o) for o in flat] if inputs is not None else None
+
+    def clone(self) -> "Predictor":
+        """Independent predictor over the same compiled program (the
+        paddle_infer pattern for per-thread serving): shares the executable,
+        gets fresh input AND output handles."""
+        new = object.__new__(Predictor)
+        new.__dict__ = dict(self.__dict__)
+        new._inputs = {n: PredictorTensor(n, s) for n, s in
+                       zip(self._input_names, self._in_specs)}
+        new._outputs = {
+            n: PredictorTensor(n, jax.ShapeDtypeStruct(s.shape, s.dtype))
+            for n, s in zip(self._output_names, self._exported.out_avals)}
+        return new
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -254,28 +254,88 @@ class TracedLayer:
         return self._fn(*args, **kwargs)
 
 
+def _make_infer_fn(layer: Layer):
+    """Pure inference fn (weights baked as constants) for export — the
+    TPU-native 'inference program' (ref: the pruned forward ProgramDesc
+    paddle.jit.save writes)."""
+    state = extract_state(layer)
+
+    def infer(*xs):
+        from ..core import autograd as ag
+        with _StateSwap([layer]):
+            bind_state(layer, state)
+            with ag.no_grad():
+                out = layer.forward(*[Tensor(x) for x in xs])
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    return infer
+
+
 def save(layer: Layer, path: str, input_spec=None, **config) -> None:
-    """Export: weights (paddle.save format) + StableHLO program text when an
-    input_spec is given (ref: paddle.jit.save producing the inference
-    program; the serving runtime consumes StableHLO instead of ProgramDesc).
+    """Export three artifacts (ref: paddle.jit.save producing the inference
+    program consumed by AnalysisPredictor):
+      path.pdparams      — weights (paddle.save format)
+      path.jaxexport     — serialized jax.export program, weights baked in
+                           (the servable; paddle_tpu.inference loads this)
+      path.stablehlo.txt — readable StableHLO program text (debugging)
     """
     from ..framework.io import save as _save
     _save(layer.state_dict(), path + ".pdparams")
     if input_spec:
-        sf = StaticFunction(layer.forward, layers=[layer])
         specs = []
         for s in input_spec:
             if isinstance(s, Tensor):
                 specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
             else:
                 specs.append(jax.ShapeDtypeStruct(tuple(s[0]), s[1]))
-        args = tuple(Tensor(jnp.zeros(sp.shape, sp.dtype)) for sp in specs)
-        hlo = sf.lower_text(*args)
-        with open(path + ".stablehlo.txt", "w") as f:
-            f.write(hlo)
+        # remember EVERY sublayer's mode: a blanket layer.train() on restore
+        # would clobber deliberately-frozen sublayers (e.g. frozen BN)
+        modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
+        layer.eval()
+        try:
+            from jax import export as jexport
+            infer = jax.jit(_make_infer_fn(layer))
+            exported = jexport.export(infer)(*specs)
+            with open(path + ".jaxexport", "wb") as f:
+                f.write(exported.serialize())
+            with open(path + ".stablehlo.txt", "w") as f:
+                f.write(str(exported.mlir_module()))
+        finally:
+            for l, was in modes:
+                l.training = was
 
 
-def load(path: str, **config):
-    raise NotImplementedError(
-        "jit.load requires the serving runtime (SURVEY §7.1 L8); load weights "
-        "with paddle_tpu.load + Layer.set_state_dict for now")
+class TranslatedLayer:
+    """paddle.jit.load result parity: a callable inference layer backed by
+    the deserialized exported program."""
+
+    def __init__(self, exported):
+        self._exported = exported
+        self._call = jax.jit(exported.call)
+
+    def __call__(self, *args):
+        raw = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+               for a in args]
+        out = self._call(*raw)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only (the exported "
+                           "program has no training graph)")
+
+
+def _deserialize_exported(path: str):
+    """Single loader for .jaxexport artifacts (shared by jit.load and
+    inference.Predictor so format changes live in one place)."""
+    from jax import export as jexport
+    with open(path, "rb") as f:
+        return jexport.deserialize(f.read())
+
+
+def load(path: str, **config) -> TranslatedLayer:
+    """Load a jit.save artifact as an inference-only callable."""
+    return TranslatedLayer(_deserialize_exported(path + ".jaxexport"))

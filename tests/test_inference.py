@@ -1,0 +1,90 @@
+"""Inference engine (SURVEY §2.1 'Inference engine', §3.6): jit.save →
+jax.export artifact → jit.load / paddle_infer-parity Predictor."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.inference import Config, create_predictor
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+        self.bn = nn.BatchNorm1D(16)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.bn(self.fc1(x))))
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path_factory.mktemp("export") / "model")
+    jit.save(net, prefix, input_spec=[((2, 8), "float32")])
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    return prefix, x, ref
+
+
+def test_save_writes_all_artifacts(artifact):
+    import os
+    prefix, _, _ = artifact
+    assert os.path.exists(prefix + ".pdparams")
+    assert os.path.exists(prefix + ".jaxexport")
+    assert os.path.exists(prefix + ".stablehlo.txt")
+    with open(prefix + ".stablehlo.txt") as f:
+        text = f.read()
+    assert "stablehlo" in text or "module" in text
+
+
+def test_jit_load_roundtrip(artifact):
+    prefix, x, ref = artifact
+    translated = jit.load(prefix)
+    out = translated(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+    with pytest.raises(RuntimeError):
+        translated.train()
+
+
+def test_predictor_handle_api(artifact):
+    prefix, x, ref = artifact
+    cfg = Config(prefix)
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    assert h.shape() == [2, 8]
+    h.copy_from_cpu(x)
+    pred.run()
+    out_h = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out_h.copy_to_cpu(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_predictor_positional_run(artifact):
+    prefix, x, ref = artifact
+    pred = create_predictor(Config(prefix))
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_missing_artifact(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        create_predictor(Config(str(tmp_path / "nope")))
+
+
+def test_bn_uses_running_stats_in_export(artifact):
+    """Export must bake eval-mode BN (running stats), not batch stats."""
+    prefix, x, ref = artifact
+    pred = create_predictor(Config(prefix))
+    # different batch with same first row: same first-row output only if
+    # BN used running stats (batch stats would couple the rows)
+    x2 = x.copy()
+    x2[1] += 100.0
+    out2 = pred.run([x2])[0]
+    np.testing.assert_allclose(out2[0], ref[0], rtol=1e-4, atol=1e-5)
