@@ -28,7 +28,8 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["to_static", "jit", "functional_call", "extract_state",
-           "bind_state", "save", "load", "TracedLayer", "not_to_static"]
+           "bind_state", "save", "load", "TracedLayer", "TranslatedLayer",
+           "not_to_static"]
 
 
 def extract_state(layer: Layer) -> Dict[str, jnp.ndarray]:
@@ -262,10 +263,8 @@ def _make_infer_fn(layer: Layer):
 
     def infer(*xs):
         from ..core import autograd as ag
-        with _StateSwap([layer]):
-            bind_state(layer, state)
-            with ag.no_grad():
-                out = layer.forward(*[Tensor(x) for x in xs])
+        with ag.no_grad():
+            out = functional_call(layer, state, *[Tensor(x) for x in xs])
         return jax.tree_util.tree_map(
             lambda t: t._data if isinstance(t, Tensor) else t, out,
             is_leaf=lambda t: isinstance(t, Tensor))

@@ -49,6 +49,17 @@ class SparseCooTensor:
     def coalesce(self) -> "SparseCooTensor":
         return SparseCooTensor(self._bcoo.sum_duplicates())
 
+    def to_csr(self) -> "SparseCsrTensor":
+        if len(self.shape) != 2:
+            raise ValueError("to_csr requires a 2-D sparse tensor")
+        b = self._bcoo.sum_duplicates()
+        rows = b.indices[:, 0]
+        crows = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(jnp.bincount(rows, length=self.shape[0]))
+            .astype(jnp.int32)])
+        return SparseCsrTensor(crows, b.indices[:, 1], b.data, self.shape)
+
     def __repr__(self):
         return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz})"
 
@@ -137,3 +148,159 @@ def relu(x):
         return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
                                             shape=b.shape))
     raise TypeError("operand must be sparse")
+
+
+# ---------------------------------------------------------------------------
+# elementwise value-map ops (ref: python/paddle/sparse/unary.py — each op
+# acts on the stored values, zero-preserving, structure unchanged)
+# ---------------------------------------------------------------------------
+def _unary(name, jfn):
+    def op(x, *args):
+        if not is_sparse(x):
+            raise TypeError(f"sparse.{name} operand must be sparse")
+        if isinstance(x, SparseCsrTensor):
+            # structure unchanged: map the values in place, stay CSR
+            return SparseCsrTensor(x.crows, x.cols, jfn(x._values, *args),
+                                   x.shape)
+        b = x._bcoo
+        return SparseCooTensor(jsparse.BCOO((jfn(b.data, *args), b.indices),
+                                            shape=b.shape))
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor):
+    return _unary("pow", lambda d: jnp.power(d, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    b = _as_bcoo(x)
+    idx = b.indices if index_dtype is None else b.indices.astype(index_dtype)
+    dat = b.data if value_dtype is None else b.data.astype(value_dtype)
+    return SparseCooTensor(jsparse.BCOO((dat, idx), shape=b.shape))
+
+
+def _is_scalar(y) -> bool:
+    import numbers
+    return isinstance(y, numbers.Number) or (
+        hasattr(y, "ndim") and getattr(y, "ndim") == 0)
+
+
+def multiply(x, y):
+    """sparse * sparse (pattern intersection) or sparse * scalar."""
+    if is_sparse(x) and not is_sparse(y):
+        if not _is_scalar(y):
+            raise TypeError(
+                "sparse.multiply with a dense operand requires a scalar "
+                "(a non-scalar dense array would broadcast against the "
+                "flat values vector, not the coordinates)")
+        b = _as_bcoo(x)
+        return SparseCooTensor(jsparse.BCOO((b.data * y, b.indices),
+                                            shape=b.shape))
+    if is_sparse(x) and is_sparse(y):
+        out = jsparse.bcoo_multiply_sparse(_as_bcoo(x), _as_bcoo(y))
+        return SparseCooTensor(out)
+    raise TypeError("first operand must be sparse")
+
+
+def subtract(x, y):
+    if is_sparse(x) and is_sparse(y):
+        return add(x, neg(y))  # dtype-preserving (no *-1.0 float promote)
+    raise TypeError("both operands must be sparse")
+
+
+def divide(x, y):
+    if is_sparse(x) and _is_scalar(y):
+        b = _as_bcoo(x)
+        return SparseCooTensor(jsparse.BCOO((b.data / y, b.indices),
+                                            shape=b.shape))
+    raise TypeError("sparse.divide supports sparse / scalar")
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector."""
+    return Tensor(_as_bcoo(x) @ _arr(vec))
+
+
+def transpose(x, perm):
+    b = _as_bcoo(x)
+    out = jsparse.bcoo_transpose(b, permutation=tuple(perm))
+    return SparseCooTensor(out)
+
+
+def masked_matmul(x, y, mask):
+    """(dense @ dense) sampled at mask's sparsity pattern (ref:
+    paddle.sparse.masked_matmul — SDDMM). TPU path: gather rows/cols at the
+    mask's indices and contract per-nonzero (no dense [M,N] intermediate)."""
+    if not is_sparse(mask):
+        raise TypeError("masked_matmul mask must be a sparse tensor")
+    xb = _arr(x); yb = _arr(y)
+    mb = _as_bcoo(mask)
+    rows = mb.indices[:, 0]
+    cols = mb.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xb[rows, :], yb[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals.astype(xb.dtype), mb.indices),
+                                        shape=mb.shape))
+
+
+class _SparseLayerBase:
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class ReLU(_SparseLayerBase):
+    """paddle.sparse.nn.ReLU parity."""
+    def forward(self, x):
+        return relu(x)
+
+
+class Softmax(_SparseLayerBase):
+    """Row softmax over CSR rows (ref: paddle.sparse.nn.Softmax, axis=-1).
+    Computed on the dense bridge with -inf at structural zeros."""
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def forward(self, x):
+        # remove_zeros=False: explicit zeros are structural nonzeros in
+        # paddle semantics and must survive the softmax
+        b = _as_bcoo(x).sum_duplicates(remove_zeros=False)
+        dense = b.todense()
+        mask = jsparse.BCOO((jnp.ones_like(b.data, jnp.int8), b.indices),
+                            shape=b.shape).todense() > 0
+        logits = jnp.where(mask, dense, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=self.axis)
+        # gather back AT the input pattern (preserves structure exactly even
+        # when a probability underflows to 0.0 — fromdense would re-derive
+        # a different pattern)
+        vals = p[tuple(b.indices.T)]
+        return SparseCooTensor(jsparse.BCOO((vals.astype(b.data.dtype),
+                                             b.indices), shape=b.shape))
+
+
+class nn:  # namespace shim: paddle.sparse.nn.<Layer>
+    ReLU = ReLU
+    Softmax = Softmax
+
+
+__all__ += ["sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+            "sqrt", "square", "abs", "log1p", "expm1", "neg", "deg2rad",
+            "rad2deg", "pow", "cast", "multiply", "subtract", "divide",
+            "mv", "transpose", "masked_matmul", "nn"]

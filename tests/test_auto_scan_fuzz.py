@@ -1,0 +1,104 @@
+"""Property/fuzz testing with hypothesis — parity with the reference's
+auto_scan_test.py harness (SURVEY §4.3: random shapes/attrs generated per
+op, result compared against the NumPy reference). Where the reference
+fuzzes TRT converters/oneDNN fusion passes, the TPU-native property under
+test is: for ANY generated shape/dtype/attr combination, the eager op, the
+traced (jit) op, and the NumPy reference agree.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+
+settings.register_profile("ci", max_examples=25, deadline=None)
+settings.load_profile("ci")
+
+
+def shapes(max_rank=4, max_side=6):
+    return st.lists(st.integers(1, max_side), min_size=1,
+                    max_size=max_rank).map(tuple)
+
+
+def _data(shape, seed):
+    return (np.random.RandomState(seed).randn(*shape) * 1.5).astype(
+        np.float32)
+
+
+def _triangle(fn_paddle, fn_np, arrs, rtol=1e-4, atol=1e-5):
+    """eager == numpy reference == traced (the §4.1 triangle, fuzzed)."""
+    ts = [paddle.to_tensor(a) for a in arrs]
+    eager = fn_paddle(*ts)
+    ref = fn_np(*arrs)
+    np.testing.assert_allclose(eager.numpy(), ref, rtol=rtol, atol=atol)
+    traced = jit.to_static(fn_paddle)(*ts)
+    np.testing.assert_allclose(traced.numpy(), eager.numpy(), rtol=1e-6,
+                               atol=1e-6)
+
+
+@given(shape=shapes(), seed=st.integers(0, 2**16))
+def test_fuzz_elementwise_chain(shape, seed):
+    _triangle(lambda x: paddle.tanh(paddle.exp(x * 0.3) + 1.0),
+              lambda x: np.tanh(np.exp(x * 0.3) + 1.0),
+              [_data(shape, seed)])
+
+
+@given(shape=shapes(max_rank=3), seed=st.integers(0, 2**16),
+       axis_frac=st.floats(0, 0.999))
+def test_fuzz_reduction_any_axis(shape, seed, axis_frac):
+    axis = int(axis_frac * len(shape))
+    _triangle(lambda x: paddle.sum(x, axis=axis),
+              lambda x: np.sum(x, axis=axis), [_data(shape, seed)])
+
+
+@given(b=st.integers(1, 3), m=st.integers(1, 6), k=st.integers(1, 6),
+       n=st.integers(1, 6), seed=st.integers(0, 2**16))
+def test_fuzz_matmul_shapes(b, m, k, n, seed):
+    x = _data((b, m, k), seed)
+    y = _data((b, k, n), seed + 1)
+    _triangle(paddle.matmul, np.matmul, [x, y], rtol=1e-3, atol=1e-4)
+
+
+@given(shape=shapes(max_rank=3, max_side=5), seed=st.integers(0, 2**16))
+def test_fuzz_broadcast_binary(shape, seed):
+    x = _data(shape, seed)
+    # broadcastable partner: collapse a random prefix to 1s
+    y_shape = tuple(1 if i % 2 else s for i, s in enumerate(shape))
+    y = _data(y_shape, seed + 1)
+    _triangle(paddle.add, np.add, [x, y])
+    _triangle(paddle.multiply, np.multiply, [x, y])
+
+
+@given(shape=shapes(max_rank=2, max_side=6), seed=st.integers(0, 2**16))
+def test_fuzz_softmax_lastaxis(shape, seed):
+    x = _data(shape, seed)
+    def ref(a):
+        e = np.exp(a - a.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+    _triangle(lambda t: paddle.nn.functional.softmax(t, axis=-1), ref, [x])
+
+
+@given(shape=shapes(max_rank=3), seed=st.integers(0, 2**16),
+       pad_lo=st.integers(0, 3), pad_hi=st.integers(0, 3))
+def test_fuzz_pad_lastdim(shape, seed, pad_lo, pad_hi):
+    x = _data(shape, seed)
+    _triangle(
+        lambda t: paddle.nn.functional.pad(t, [pad_lo, pad_hi], value=0.25,
+                                           data_format="NCL"),
+        lambda a: np.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad_lo, pad_hi)],
+                         constant_values=0.25),
+        [x])
+
+
+@given(n=st.integers(1, 40), seed=st.integers(0, 2**16),
+       descending=st.booleans())
+def test_fuzz_sort_matches_numpy(n, seed, descending):
+    x = _data((n,), seed)
+    def pd(t):
+        return paddle.sort(t, descending=descending)
+    def ref(a):
+        s = np.sort(a)
+        return s[::-1].copy() if descending else s
+    _triangle(pd, ref, [x])

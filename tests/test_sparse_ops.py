@@ -1,0 +1,130 @@
+"""paddle.sparse surface (SURVEY §2.1 sparse row): COO/CSR, value-map
+unary ops, SDDMM masked_matmul, sparse nn layers."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+R = np.random.RandomState(11)
+
+
+def _random_coo(shape=(4, 5), density=0.4):
+    dense = R.randn(*shape).astype(np.float32)
+    dense[R.rand(*shape) > density] = 0.0
+    idx = np.argwhere(dense != 0)
+    vals = dense[dense != 0]
+    return sparse.sparse_coo_tensor(idx.T, vals, shape), dense
+
+
+def test_coo_roundtrip_and_csr():
+    x, dense = _random_coo()
+    np.testing.assert_allclose(x.to_dense().numpy(), dense)
+    assert x.nnz == int((dense != 0).sum())
+
+
+@pytest.mark.parametrize("name", ["sin", "tanh", "square", "abs", "expm1",
+                                  "neg", "log1p"])
+def test_unary_value_maps(name):
+    x, dense = _random_coo()
+    ref = {"sin": np.sin, "tanh": np.tanh, "square": np.square,
+           "abs": np.abs, "expm1": np.expm1, "neg": np.negative,
+           "log1p": lambda a: np.log1p(np.abs(a)) * np.sign(a)}[name]
+    if name == "log1p":
+        x = sparse.abs(x)
+        dense = np.abs(dense)
+        ref = np.log1p
+    out = getattr(sparse, name)(x).to_dense().numpy()
+    expect = np.where(dense != 0, ref(dense), 0.0)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_binary_and_scalar():
+    x, dense = _random_coo()
+    np.testing.assert_allclose(sparse.multiply(x, 2.0).to_dense().numpy(),
+                               dense * 2)
+    np.testing.assert_allclose(sparse.divide(x, 2.0).to_dense().numpy(),
+                               dense / 2, rtol=1e-6)
+    np.testing.assert_allclose(sparse.add(x, x).to_dense().numpy(),
+                               dense * 2)
+    np.testing.assert_allclose(sparse.subtract(x, x).to_dense().numpy(),
+                               np.zeros_like(dense), atol=1e-6)
+    np.testing.assert_allclose(sparse.multiply(x, x).to_dense().numpy(),
+                               dense * dense, rtol=1e-5)
+
+
+def test_matmul_mv_transpose():
+    x, dense = _random_coo((4, 5))
+    y = R.randn(5, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        sparse.matmul(x, paddle.to_tensor(y)).numpy(), dense @ y,
+        rtol=1e-4, atol=1e-5)
+    v = R.randn(5).astype(np.float32)
+    np.testing.assert_allclose(sparse.mv(x, paddle.to_tensor(v)).numpy(),
+                               dense @ v, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        sparse.transpose(x, [1, 0]).to_dense().numpy(), dense.T)
+
+
+def test_masked_matmul_sddmm():
+    x, mask_dense = _random_coo((4, 4))
+    a = R.randn(4, 6).astype(np.float32)
+    b = R.randn(6, 4).astype(np.float32)
+    out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), x)
+    expect = np.where(mask_dense != 0, a @ b, 0.0)
+    np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sparse_nn_layers():
+    x, dense = _random_coo((3, 6))
+    r = sparse.nn.ReLU()(x).to_dense().numpy()
+    np.testing.assert_allclose(r, np.where(dense != 0,
+                                           np.maximum(dense, 0), 0.0))
+    sm = sparse.nn.Softmax()(x).to_dense().numpy()
+    for i in range(3):
+        nz = dense[i] != 0
+        if nz.any():
+            e = np.exp(dense[i][nz] - dense[i][nz].max())
+            np.testing.assert_allclose(sm[i][nz], e / e.sum(), rtol=1e-5)
+            assert (sm[i][~nz] == 0).all()
+
+
+def test_csr_preserved_and_to_csr():
+    crows = np.array([0, 1, 3], np.int32)
+    cols = np.array([1, 0, 2], np.int32)
+    vals = np.array([2., 3., 1.], np.float32)
+    x = sparse.sparse_csr_tensor(crows, cols, vals, (2, 3))
+    y = sparse.sin(x)
+    assert isinstance(y, sparse.SparseCsrTensor)
+    np.testing.assert_allclose(y.values().numpy(), np.sin(vals), rtol=1e-6)
+    # COO → CSR conversion
+    coo = x.to_coo()
+    back = coo.to_csr()
+    np.testing.assert_allclose(np.asarray(back.crows._data if hasattr(back.crows, "_data") else back.crows), crows)
+    np.testing.assert_allclose(back.to_dense().numpy(), x.to_dense().numpy())
+
+
+def test_multiply_rejects_nonscalar_dense():
+    x, _ = _random_coo((3, 3))
+    with pytest.raises(TypeError):
+        sparse.multiply(x, np.array([1., 2., 3.], np.float32))
+
+
+def test_subtract_preserves_int_dtype():
+    idx = np.array([[0, 1], [1, 0]])
+    x = sparse.sparse_coo_tensor(idx, np.array([2, 3], np.int32), (2, 2))
+    z = sparse.subtract(x, x)
+    assert z.values().numpy().dtype == np.int32
+
+
+def test_softmax_preserves_pattern_under_underflow():
+    idx = np.array([[0, 0], [0, 1]])
+    x = sparse.sparse_coo_tensor(idx, np.array([0.0, 200.0], np.float32),
+                                 (1, 2))
+    sm = sparse.nn.Softmax()(x)
+    # pattern preserved even though p[0,0] underflows to 0
+    assert sm.nnz == 2
+    np.testing.assert_allclose(np.sort(np.asarray(sm.indices()._data).ravel()),
+                               np.sort(idx.ravel()))
