@@ -41,7 +41,8 @@ class LlamaConfig:
                  rms_norm_eps=1e-5, initializer_range=0.02,
                  tie_word_embeddings=False, use_flash_attention=True,
                  sequence_parallel=True, recompute=False,
-                 context_parallel=False):
+                 context_parallel=False, fuse_attention_qkv=False,
+                 fuse_attention_ffn=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -57,6 +58,10 @@ class LlamaConfig:
         self.sequence_parallel = sequence_parallel
         self.recompute = recompute
         self.context_parallel = context_parallel
+        # PaddleNLP parity knobs: pack q/k/v (and gate/up) into single
+        # matmuls — fewer kernel launches, one MXU pass over the activations
+        self.fuse_attention_qkv = fuse_attention_qkv
+        self.fuse_attention_ffn = fuse_attention_ffn
         self.head_dim = hidden_size // num_attention_heads
 
 
@@ -91,10 +96,10 @@ def apply_rope(x, cos, sin):
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
-def _mp_linear(in_f, out_f, spec, layer_parent, name):
+def _mp_linear(in_f, out_f, spec):
+    """Bias-free linear with a Megatron TP sharding spec attached."""
     l = nn.Linear(in_f, out_f, bias_attr=False)
     l.weight._sharding_spec = spec
-    layer_parent.add_sublayer(name, l)
     return l
 
 
@@ -104,28 +109,30 @@ class LlamaAttention(nn.Layer):
         self.c = c
         H, D = c.num_attention_heads, c.head_dim
         KV = c.num_key_value_heads
-        self.q_proj = nn.Linear(c.hidden_size, H * D, bias_attr=False)
-        self.k_proj = nn.Linear(c.hidden_size, KV * D, bias_attr=False)
-        self.v_proj = nn.Linear(c.hidden_size, KV * D, bias_attr=False)
-        self.o_proj = nn.Linear(H * D, c.hidden_size, bias_attr=False)
-        # Megatron TP: qkv column-sharded, o row-sharded on mp
-        self.q_proj.weight._sharding_spec = P(None, MP_AXIS)
-        self.k_proj.weight._sharding_spec = P(None, MP_AXIS)
-        self.v_proj.weight._sharding_spec = P(None, MP_AXIS)
-        self.o_proj.weight._sharding_spec = P(MP_AXIS, None)
+        if c.fuse_attention_qkv:
+            # one packed projection, [all-q | all-k | all-v] column layout —
+            # one MXU pass, one kernel launch. Capability parity with
+            # PaddleNLP's fuse_attention_qkv knob; NOTE the column layout
+            # differs from PaddleNLP's per-kv-group interleave, so a
+            # checkpoint converter must re-pack (weights here are framework
+            # -native, not PaddleNLP-binary-compatible).
+            self.qkv_proj = _mp_linear(c.hidden_size, (H + 2 * KV) * D,
+                                       P(None, MP_AXIS))
+        else:
+            # Megatron TP: qkv column-sharded, o row-sharded on mp
+            self.q_proj = _mp_linear(c.hidden_size, H * D, P(None, MP_AXIS))
+            self.k_proj = _mp_linear(c.hidden_size, KV * D, P(None, MP_AXIS))
+            self.v_proj = _mp_linear(c.hidden_size, KV * D, P(None, MP_AXIS))
+        self.o_proj = _mp_linear(H * D, c.hidden_size, P(MP_AXIS, None))
 
     def forward(self, x, cos, sin, attn_mask=None):
         c = self.c
         B, S, _ = x.shape
+        H, KV, D = c.num_attention_heads, c.num_key_value_heads, c.head_dim
         from ..core.dispatch import apply as _apply
 
-        def impl(h, wq, wk, wv, wo):
-            q = (h @ wq).reshape(B, S, c.num_attention_heads, c.head_dim)
-            k = (h @ wk).reshape(B, S, c.num_key_value_heads, c.head_dim)
-            v = (h @ wv).reshape(B, S, c.num_key_value_heads, c.head_dim)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-            rep = c.num_attention_heads // c.num_key_value_heads
+        def attend(q, k, v):
+            rep = H // KV
             if rep > 1:
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
@@ -134,11 +141,30 @@ class LlamaAttention(nn.Layer):
                 # ring attention over the sep axis (P9): seq stays sharded,
                 # KV blocks rotate via collective-permute
                 from ..distributed.ring_attention import ring_attention_raw
-                o = ring_attention_raw(q, k, v, axis="sep", causal=True)
-            elif c.use_flash_attention:
-                o = sdpa(q, k, v, causal=True)
-            else:
-                o = sdpa_reference(q, k, v, causal=True)
+                return ring_attention_raw(q, k, v, axis="sep", causal=True)
+            if c.use_flash_attention:
+                return sdpa(q, k, v, causal=True)
+            return sdpa_reference(q, k, v, causal=True)
+
+        if c.fuse_attention_qkv:
+            def impl(h, wqkv, wo):
+                qkv = (h @ wqkv).reshape(B, S, H + 2 * KV, D)
+                q, k, v = (qkv[:, :, :H], qkv[:, :, H:H + KV],
+                           qkv[:, :, H + KV:])
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+                o = attend(q, k, v)
+                return o.reshape(B, S, -1) @ wo
+            return _apply("llama_attention", impl,
+                          [x, self.qkv_proj.weight, self.o_proj.weight])
+
+        def impl(h, wq, wk, wv, wo):
+            q = (h @ wq).reshape(B, S, H, D)
+            k = (h @ wk).reshape(B, S, KV, D)
+            v = (h @ wv).reshape(B, S, KV, D)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            o = attend(q, k, v)
             return o.reshape(B, S, -1) @ wo
         return _apply("llama_attention", impl,
                       [x, self.q_proj.weight, self.k_proj.weight,
@@ -148,17 +174,26 @@ class LlamaAttention(nn.Layer):
 class LlamaMLP(nn.Layer):
     def __init__(self, c: LlamaConfig):
         super().__init__()
-        self.gate_proj = nn.Linear(c.hidden_size, c.intermediate_size,
-                                   bias_attr=False)
-        self.up_proj = nn.Linear(c.hidden_size, c.intermediate_size,
-                                 bias_attr=False)
-        self.down_proj = nn.Linear(c.intermediate_size, c.hidden_size,
-                                   bias_attr=False)
-        self.gate_proj.weight._sharding_spec = P(None, MP_AXIS)
-        self.up_proj.weight._sharding_spec = P(None, MP_AXIS)
-        self.down_proj.weight._sharding_spec = P(MP_AXIS, None)
+        self.c = c
+        if c.fuse_attention_ffn:
+            # packed [gate | up] (capability parity: PaddleNLP
+            # fuse_attention_ffn; column layout is framework-native)
+            self.gate_up_proj = _mp_linear(c.hidden_size,
+                                           2 * c.intermediate_size,
+                                           P(None, MP_AXIS))
+        else:
+            self.gate_proj = _mp_linear(c.hidden_size, c.intermediate_size,
+                                        P(None, MP_AXIS))
+            self.up_proj = _mp_linear(c.hidden_size, c.intermediate_size,
+                                      P(None, MP_AXIS))
+        self.down_proj = _mp_linear(c.intermediate_size, c.hidden_size,
+                                    P(MP_AXIS, None))
 
     def forward(self, x):
+        if self.c.fuse_attention_ffn:
+            gu = self.gate_up_proj(x)
+            inter = self.c.intermediate_size
+            return self.down_proj(F.swiglu(gu[..., :inter], gu[..., inter:]))
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 

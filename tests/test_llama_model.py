@@ -1,0 +1,25 @@
+"""Llama model family knobs (fuse_attention_qkv / fuse_attention_ffn —
+PaddleNLP parity; column layout is framework-native, see models/llama.py)."""
+
+
+def test_llama_fused_qkv_ffn_trains():
+    """fuse_attention_qkv/fuse_attention_ffn (PaddleNLP parity knobs)
+    produce a trainable model with the same output shapes."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    c = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=32,
+                    sequence_parallel=False, fuse_attention_qkv=True,
+                    fuse_attention_ffn=True)
+    m = LlamaForCausalLM(c)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 64, (2, 16)).astype(np.int32))
+    loss, logits = m(ids, labels=ids)
+    assert logits.shape == [2, 16, 64]
+    loss.backward()
+    g = m.llama.layers[0].self_attn.qkv_proj.weight.grad
+    assert g is not None and float(paddle.abs(g).sum()) > 0
+    g2 = m.llama.layers[0].mlp.gate_up_proj.weight.grad
+    assert g2 is not None and float(paddle.abs(g2).sum()) > 0
