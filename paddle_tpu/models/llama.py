@@ -42,7 +42,7 @@ class LlamaConfig:
                  tie_word_embeddings=False, use_flash_attention=True,
                  sequence_parallel=True, recompute=False,
                  context_parallel=False, fuse_attention_qkv=False,
-                 fuse_attention_ffn=False):
+                 fuse_attention_ffn=False, fuse_pack_groups=1):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -62,6 +62,13 @@ class LlamaConfig:
         # matmuls — fewer kernel launches, one MXU pass over the activations
         self.fuse_attention_qkv = fuse_attention_qkv
         self.fuse_attention_ffn = fuse_attention_ffn
+        # rank-interleave group count for the packed layouts. Set it to the
+        # mp degree when training with TP so the packed q|k|v (and gate|up)
+        # slice boundaries stay shard-local. An EXPLICIT config knob — not
+        # sniffed from the ambient mesh — so rebuilding a model from the
+        # same config always reproduces the same weight layout
+        # (checkpoints are layout-compatible iff fuse_pack_groups matches).
+        self.fuse_pack_groups = fuse_pack_groups
         self.head_dim = hidden_size // num_attention_heads
 
 
@@ -103,6 +110,23 @@ def _mp_linear(in_f, out_f, spec):
     return l
 
 
+def _init_packed_segments(weight, segments):
+    """Re-initialize a packed [in, sum(widths)] weight per column segment.
+    segments: [(width, logical_fan_out)] — each segment gets the Xavier std
+    of the LOGICAL unfused projection it belongs to (q segments use fan
+    H*D regardless of grouping), so flipping the fuse knobs is
+    numerics-neutral at init (a single XavierNormal over the packed width
+    would under-scale every segment)."""
+    import math as _m
+    in_f = weight.shape[0]
+    dt = weight._data.dtype
+    cols = []
+    for w, fan_out in segments:
+        std = _m.sqrt(2.0 / (in_f + fan_out))
+        cols.append(I.Normal(0.0, std)([in_f, w], "float32"))
+    weight._data = jnp.concatenate(cols, axis=1).astype(dt)
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, c: LlamaConfig):
         super().__init__()
@@ -110,14 +134,27 @@ class LlamaAttention(nn.Layer):
         H, D = c.num_attention_heads, c.head_dim
         KV = c.num_key_value_heads
         if c.fuse_attention_qkv:
-            # one packed projection, [all-q | all-k | all-v] column layout —
-            # one MXU pass, one kernel launch. Capability parity with
-            # PaddleNLP's fuse_attention_qkv knob; NOTE the column layout
-            # differs from PaddleNLP's per-kv-group interleave, so a
-            # checkpoint converter must re-pack (weights here are framework
-            # -native, not PaddleNLP-binary-compatible).
+            # One packed projection, RANK-INTERLEAVED layout
+            # [g blocks of (H/g q-heads | KV/g k-heads | KV/g v-heads)]
+            # with g = cfg.fuse_pack_groups (set to the mp degree for TP):
+            # the q|k|v slice boundaries then fall on shard boundaries, so
+            # under tensor parallelism the slices stay shard-local
+            # (Megatron's fused-qkv layout rationale — a column-major
+            # [all-q|all-k|all-v] pack would force GSPMD to reshard
+            # activations at every slice). Weights are framework-native
+            # (not PaddleNLP-binary-compatible; a converter must re-pack).
+            g = c.fuse_pack_groups
+            if H % g or KV % g:
+                raise ValueError(
+                    f"fuse_attention_qkv requires heads divisible by "
+                    f"fuse_pack_groups: H={H}, KV={KV}, groups={g}")
+            self._qkv_groups = g
             self.qkv_proj = _mp_linear(c.hidden_size, (H + 2 * KV) * D,
                                        P(None, MP_AXIS))
+            _init_packed_segments(
+                self.qkv_proj.weight,
+                [(H // g * D, H * D), (KV // g * D, KV * D),
+                 (KV // g * D, KV * D)] * g)
         else:
             # Megatron TP: qkv column-sharded, o row-sharded on mp
             self.q_proj = _mp_linear(c.hidden_size, H * D, P(None, MP_AXIS))
@@ -131,7 +168,10 @@ class LlamaAttention(nn.Layer):
         H, KV, D = c.num_attention_heads, c.num_key_value_heads, c.head_dim
         from ..core.dispatch import apply as _apply
 
-        def attend(q, k, v):
+        def finish(q, k, v, wo):
+            """rope → attention → output projection (shared tail)."""
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
             rep = H // KV
             if rep > 1:
                 k = jnp.repeat(k, rep, axis=2)
@@ -141,20 +181,28 @@ class LlamaAttention(nn.Layer):
                 # ring attention over the sep axis (P9): seq stays sharded,
                 # KV blocks rotate via collective-permute
                 from ..distributed.ring_attention import ring_attention_raw
-                return ring_attention_raw(q, k, v, axis="sep", causal=True)
-            if c.use_flash_attention:
-                return sdpa(q, k, v, causal=True)
-            return sdpa_reference(q, k, v, causal=True)
+                o = ring_attention_raw(q, k, v, axis="sep", causal=True)
+            elif c.use_flash_attention:
+                o = sdpa(q, k, v, causal=True)
+            else:
+                o = sdpa_reference(q, k, v, causal=True)
+            return o.reshape(B, S, -1) @ wo
 
         if c.fuse_attention_qkv:
+            g = self._qkv_groups
+            Hg, KVg = H // g, KV // g
+
             def impl(h, wqkv, wo):
-                qkv = (h @ wqkv).reshape(B, S, H + 2 * KV, D)
-                q, k, v = (qkv[:, :, :H], qkv[:, :, H:H + KV],
-                           qkv[:, :, H + KV:])
-                q = apply_rope(q, cos, sin)
-                k = apply_rope(k, cos, sin)
-                o = attend(q, k, v)
-                return o.reshape(B, S, -1) @ wo
+                # [B,S,g,(Hg+2KVg),D]: dim 2 is the shard (rank) dim, so
+                # the q|k|v slices below are shard-local under mp
+                qkv = (h @ wqkv).reshape(B, S, g, Hg + 2 * KVg, D)
+                q = qkv[:, :, :, :Hg].reshape(B, S, H, D)
+                k = qkv[:, :, :, Hg:Hg + KVg].reshape(B, S, KV, D)
+                v = qkv[:, :, :, Hg + KVg:].reshape(B, S, KV, D)
+                # head order is group-major for q AND kv consistently, and
+                # jnp.repeat on the flat kv axis maps q head (g_i, h_j) to
+                # kv head (g_i, h_j // (Hg/KVg)) — GQA grouping preserved
+                return finish(q, k, v, wo)
             return _apply("llama_attention", impl,
                           [x, self.qkv_proj.weight, self.o_proj.weight])
 
@@ -162,10 +210,7 @@ class LlamaAttention(nn.Layer):
             q = (h @ wq).reshape(B, S, H, D)
             k = (h @ wk).reshape(B, S, KV, D)
             v = (h @ wv).reshape(B, S, KV, D)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-            o = attend(q, k, v)
-            return o.reshape(B, S, -1) @ wo
+            return finish(q, k, v, wo)
         return _apply("llama_attention", impl,
                       [x, self.q_proj.weight, self.k_proj.weight,
                        self.v_proj.weight, self.o_proj.weight])
@@ -176,11 +221,25 @@ class LlamaMLP(nn.Layer):
         super().__init__()
         self.c = c
         if c.fuse_attention_ffn:
-            # packed [gate | up] (capability parity: PaddleNLP
-            # fuse_attention_ffn; column layout is framework-native)
+            # packed rank-interleaved [g blocks of (gate_g | up_g)] — same
+            # grouping rationale as fused qkv: the silu(gate)*up elementwise
+            # product pairs columns within one shard block, so no cross-
+            # shard resharding of the intermediate activation under mp
+            # (capability parity: PaddleNLP fuse_attention_ffn; layout is
+            # framework-native)
+            g = c.fuse_pack_groups
+            if c.intermediate_size % g:
+                raise ValueError(
+                    f"fuse_attention_ffn requires intermediate_size "
+                    f"divisible by fuse_pack_groups={g}")
+            self._ffn_groups = g
             self.gate_up_proj = _mp_linear(c.hidden_size,
                                            2 * c.intermediate_size,
                                            P(None, MP_AXIS))
+            I_ = c.intermediate_size
+            _init_packed_segments(
+                self.gate_up_proj.weight,
+                [(I_ // g, I_), (I_ // g, I_)] * g)
         else:
             self.gate_proj = _mp_linear(c.hidden_size, c.intermediate_size,
                                         P(None, MP_AXIS))
@@ -191,9 +250,18 @@ class LlamaMLP(nn.Layer):
 
     def forward(self, x):
         if self.c.fuse_attention_ffn:
+            c = self.c
+            g, Ig = self._ffn_groups, c.intermediate_size // self._ffn_groups
             gu = self.gate_up_proj(x)
-            inter = self.c.intermediate_size
-            return self.down_proj(F.swiglu(gu[..., :inter], gu[..., inter:]))
+            if g == 1:
+                # single-arg swiglu splits [gate | up] internally
+                return self.down_proj(F.swiglu(gu))
+            # grouped layout: split per block, then flatten back to [.., I]
+            shp = gu.shape[:-1]
+            gu = gu.reshape(list(shp) + [g, 2 * Ig])
+            gate = gu[..., :Ig].reshape(list(shp) + [c.intermediate_size])
+            up = gu[..., Ig:].reshape(list(shp) + [c.intermediate_size])
+            return self.down_proj(F.swiglu(gate, up))
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 

@@ -1,0 +1,295 @@
+"""Trainer — PaddleNLP paddlenlp/trainer parity (SURVEY §2.4: gradient
+accumulation, bf16 autocast, grad clip, LR schedule, checkpoint/resume with
+RNG state, throughput/MFU logging, eval loop).
+
+Eager-first: the loop drives the framework's own Layer/optimizer/autograd
+path (every step exercises dispatch + tape + optimizer exactly as user code
+does). The hybrid-parallel compiled path for LLM pretrain lives in
+trainer/pretrain.py (build_llama_pretrain_step); this class is the
+general-model harness the reference's Trainer API provides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["TrainingArguments", "Trainer"]
+
+
+@dataclasses.dataclass
+class TrainingArguments:
+    """The subset of PaddleNLP TrainingArguments that drives behavior here
+    (unknown extras are accepted via **kwargs at construction)."""
+    output_dir: str = "trainer_output"
+    per_device_train_batch_size: int = 8
+    per_device_eval_batch_size: int = 8
+    gradient_accumulation_steps: int = 1
+    learning_rate: float = 5e-5
+    weight_decay: float = 0.0
+    max_grad_norm: float = 1.0
+    num_train_epochs: int = 1
+    max_steps: int = -1            # >0 overrides epochs
+    warmup_steps: int = 0
+    logging_steps: int = 10
+    save_steps: int = 0            # 0 = only final
+    eval_steps: int = 0            # 0 = eval at epoch end (if eval set)
+    bf16: bool = False
+    seed: int = 42
+    lr_scheduler_type: str = "linear"   # linear | cosine | constant
+    # informational for MFU logging:
+    flops_per_sample: float = 0.0
+
+    def __init__(self, **kwargs):
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, kwargs.pop(f.name, f.default))
+        self._extra = kwargs  # accepted, ignored (parity tolerance)
+
+
+class Trainer:
+    def __init__(self, model=None, args: Optional[TrainingArguments] = None,
+                 train_dataset=None, eval_dataset=None, data_collator=None,
+                 optimizers=(None, None), compute_metrics=None,
+                 criterion=None):
+        import paddle_tpu as paddle
+        self.paddle = paddle
+        self.model = model
+        self.args = args or TrainingArguments()
+        self.train_dataset = train_dataset
+        self.eval_dataset = eval_dataset
+        self.data_collator = data_collator
+        self.compute_metrics = compute_metrics
+        self.criterion = criterion
+        self.optimizer, self.lr_scheduler = optimizers
+        self.state: Dict[str, Any] = {"global_step": 0, "epoch": 0.0,
+                                      "micro_batches": 0,
+                                      "log_history": []}
+        paddle.seed(self.args.seed)
+
+    # -- construction helpers ------------------------------------------------
+    def _total_steps(self, steps_per_epoch: int) -> int:
+        if self.args.max_steps > 0:
+            return self.args.max_steps
+        return max(1, steps_per_epoch * self.args.num_train_epochs
+                   // max(1, self.args.gradient_accumulation_steps))
+
+    def create_optimizer_and_scheduler(self, num_training_steps: int):
+        from ..optimizer import AdamW, lr as lr_mod
+        if self.lr_scheduler is None:
+            base = self.args.learning_rate
+            if self.args.lr_scheduler_type == "cosine":
+                sched = lr_mod.CosineAnnealingDecay(
+                    learning_rate=base, T_max=num_training_steps)
+            elif self.args.lr_scheduler_type == "constant":
+                sched = None
+            else:
+                sched = lr_mod.PolynomialDecay(
+                    learning_rate=base, decay_steps=num_training_steps,
+                    end_lr=0.0)
+            if sched is not None and self.args.warmup_steps > 0:
+                sched = lr_mod.LinearWarmup(
+                    learning_rate=sched, warmup_steps=self.args.warmup_steps,
+                    start_lr=0.0, end_lr=base)
+            self.lr_scheduler = sched
+        if self.optimizer is None:
+            from ..nn.clip import ClipGradByGlobalNorm
+            clip = (ClipGradByGlobalNorm(self.args.max_grad_norm)
+                    if self.args.max_grad_norm and self.args.max_grad_norm > 0
+                    else None)
+            self.optimizer = AdamW(
+                learning_rate=(self.lr_scheduler if self.lr_scheduler
+                               is not None else self.args.learning_rate),
+                parameters=self.model.parameters(),
+                weight_decay=self.args.weight_decay,
+                grad_clip=clip,
+                multi_precision=self.args.bf16)
+        return self.optimizer
+
+    def get_train_dataloader(self):
+        from ..io import DataLoader
+        return DataLoader(self.train_dataset,
+                          batch_size=self.args.per_device_train_batch_size,
+                          shuffle=True, drop_last=True,
+                          collate_fn=self.data_collator)
+
+    def get_eval_dataloader(self):
+        from ..io import DataLoader
+        return DataLoader(self.eval_dataset,
+                          batch_size=self.args.per_device_eval_batch_size,
+                          shuffle=False, collate_fn=self.data_collator)
+
+    # -- core loop -----------------------------------------------------------
+    def compute_loss(self, model, batch):
+        """Override point (ref: Trainer.compute_loss). Default: model(**batch)
+        or model(*batch) returning loss or (loss, ...)."""
+        if self.criterion is not None:
+            *inputs, labels = batch
+            out = model(*inputs)
+            return self.criterion(out, labels)
+        out = model(**batch) if isinstance(batch, dict) else model(*batch)
+        if isinstance(out, (tuple, list)):
+            return out[0]
+        return out
+
+    def training_step(self, batch) -> float:
+        paddle = self.paddle
+        if self.args.bf16:
+            with paddle.amp.auto_cast(dtype="bfloat16"):
+                loss = self.compute_loss(self.model, batch)
+        else:
+            loss = self.compute_loss(self.model, batch)
+        scaled = loss / self.args.gradient_accumulation_steps
+        scaled.backward()
+        return float(loss.numpy())
+
+    def train(self, resume_from_checkpoint: Optional[str] = None):
+        args = self.args
+        loader = self.get_train_dataloader()
+        steps_per_epoch = len(loader)
+        total = self._total_steps(steps_per_epoch)
+        self.create_optimizer_and_scheduler(total)
+        if resume_from_checkpoint:
+            self._load_checkpoint(resume_from_checkpoint)
+        self.model.train()
+
+        accum = 0
+        losses: List[float] = []
+        t0 = time.perf_counter()
+        samples = 0
+        done = False
+        # max_steps is the TOTAL optimizer-step budget (PaddleNLP
+        # semantics): a resumed run continues to global_step == total, it
+        # does not add another `total` steps on top
+        target = (self.args.max_steps if self.args.max_steps > 0
+                  else self.state["global_step"] + total)
+        if self.state["global_step"] >= target:
+            done = True
+        # resume: skip the micro-batches already consumed in the current
+        # epoch so the data stream continues where it stopped (ref:
+        # Trainer's consumed_samples / sampler-state resume)
+        skip = self.state["micro_batches"] % max(1, steps_per_epoch)
+        while not done:
+            for batch in loader:
+                if skip > 0:
+                    skip -= 1
+                    continue
+                losses.append(self.training_step(batch))
+                samples += args.per_device_train_batch_size
+                self.state["micro_batches"] += 1
+                accum += 1
+                if accum < args.gradient_accumulation_steps:
+                    continue
+                accum = 0
+                self.optimizer.step()
+                self.optimizer.clear_grad()
+                if self.lr_scheduler is not None:
+                    self.lr_scheduler.step()
+                self.state["global_step"] += 1
+                gs = self.state["global_step"]
+                self.state["epoch"] = gs / max(
+                    1, steps_per_epoch // max(
+                        1, args.gradient_accumulation_steps))
+                if args.logging_steps and gs % args.logging_steps == 0:
+                    dt = time.perf_counter() - t0
+                    entry = {"step": gs,
+                             "loss": float(np.mean(losses[-args.logging_steps
+                                                          :])),
+                             "lr": self.optimizer.get_lr(),
+                             "samples_per_sec": samples / max(dt, 1e-9)}
+                    if args.flops_per_sample:
+                        entry["tflops"] = (samples * args.flops_per_sample
+                                           / dt / 1e12)
+                    self.state["log_history"].append(entry)
+                if args.save_steps and gs % args.save_steps == 0:
+                    self.save_checkpoint()
+                if args.eval_steps and self.eval_dataset is not None \
+                        and gs % args.eval_steps == 0:
+                    self.evaluate()
+                    self.model.train()
+                if gs >= target:
+                    done = True
+                    break
+        self.save_checkpoint()
+        return self.state
+
+    # -- eval ----------------------------------------------------------------
+    def evaluate(self, eval_dataset=None) -> Dict[str, float]:
+        paddle = self.paddle
+        ds = eval_dataset or self.eval_dataset
+        if ds is None:
+            raise ValueError("no eval_dataset")
+        from ..io import DataLoader
+        loader = DataLoader(ds,
+                            batch_size=self.args.per_device_eval_batch_size,
+                            shuffle=False, collate_fn=self.data_collator)
+        self.model.eval()
+        losses, all_preds, all_labels = [], [], []
+        with paddle.no_grad():
+            for batch in loader:
+                if self.compute_metrics is not None:
+                    *inputs, labels = (list(batch.values())
+                                       if isinstance(batch, dict) else batch)
+                    out = self.model(*inputs)
+                    logits = out[0] if isinstance(out, (tuple, list)) else out
+                    all_preds.append(np.asarray(logits.numpy()))
+                    all_labels.append(np.asarray(labels.numpy()
+                                                 if hasattr(labels, "numpy")
+                                                 else labels))
+                else:
+                    losses.append(float(self.compute_loss(self.model,
+                                                          batch).numpy()))
+        metrics: Dict[str, float] = {}
+        if losses:
+            metrics["eval_loss"] = float(np.mean(losses))
+        if self.compute_metrics is not None and all_preds:
+            metrics.update(self.compute_metrics(
+                np.concatenate(all_preds), np.concatenate(all_labels)))
+        self.state["log_history"].append({"step": self.state["global_step"],
+                                          **metrics})
+        return metrics
+
+    def predict(self, test_dataset):
+        return self.evaluate(test_dataset)
+
+    # -- checkpoint / resume -------------------------------------------------
+    def _ckpt_dir(self) -> str:
+        d = os.path.join(self.args.output_dir,
+                         f"checkpoint-{self.state['global_step']}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def save_checkpoint(self) -> str:
+        paddle = self.paddle
+        d = self._ckpt_dir()
+        paddle.save(self.model.state_dict(),
+                    os.path.join(d, "model_state.pdparams"))
+        paddle.save(self.optimizer.state_dict(),
+                    os.path.join(d, "optimizer.pdopt"))
+        from ..framework import get_rng_state
+        paddle.save({"rng": get_rng_state(),
+                     "lr_last_epoch": getattr(self.lr_scheduler,
+                                              "last_epoch", 0)},
+                    os.path.join(d, "rng_sched.pd"))
+        with open(os.path.join(d, "trainer_state.json"), "w") as f:
+            json.dump({k: v for k, v in self.state.items()}, f)
+        return d
+
+    def _load_checkpoint(self, path: str):
+        paddle = self.paddle
+        self.model.set_state_dict(
+            paddle.load(os.path.join(path, "model_state.pdparams")))
+        self.optimizer.set_state_dict(
+            paddle.load(os.path.join(path, "optimizer.pdopt")))
+        aux = paddle.load(os.path.join(path, "rng_sched.pd"))
+        from ..framework import set_rng_state
+        set_rng_state(aux["rng"])
+        if self.lr_scheduler is not None and "lr_last_epoch" in aux:
+            self.lr_scheduler.last_epoch = aux["lr_last_epoch"]
+        with open(os.path.join(path, "trainer_state.json")) as f:
+            self.state.update(json.load(f))

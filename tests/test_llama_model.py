@@ -1,18 +1,25 @@
 """Llama model family knobs (fuse_attention_qkv / fuse_attention_ffn —
-PaddleNLP parity; column layout is framework-native, see models/llama.py)."""
+PaddleNLP parity; rank-interleaved pack layout is framework-native, see
+models/llama.py)."""
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.mesh import build_hybrid_mesh, mesh_context
+from paddle_tpu.jit import bind_state, extract_state
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+BASE = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            sequence_parallel=False)
 
 
 def test_llama_fused_qkv_ffn_trains():
     """fuse_attention_qkv/fuse_attention_ffn (PaddleNLP parity knobs)
     produce a trainable model with the same output shapes."""
-    import numpy as np
-    import paddle_tpu as paddle
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    c = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
-                    num_hidden_layers=2, num_attention_heads=4,
-                    num_key_value_heads=2, max_position_embeddings=32,
-                    sequence_parallel=False, fuse_attention_qkv=True,
-                    fuse_attention_ffn=True)
+    c = LlamaConfig(**BASE, fuse_attention_qkv=True, fuse_attention_ffn=True)
     m = LlamaForCausalLM(c)
     ids = paddle.to_tensor(
         np.random.RandomState(0).randint(0, 64, (2, 16)).astype(np.int32))
@@ -23,3 +30,72 @@ def test_llama_fused_qkv_ffn_trains():
     assert g is not None and float(paddle.abs(g).sum()) > 0
     g2 = m.llama.layers[0].mlp.gate_up_proj.weight.grad
     assert g2 is not None and float(paddle.abs(g2).sum()) > 0
+
+
+def _repack_qkv(w, H, KV, D, g):
+    """column-major [q|k|v] → rank-interleaved [g × (q_g|k_g|v_g)]."""
+    Hg, KVg = H // g, KV // g
+    q = w[:, :H * D].reshape(-1, H, D)
+    k = w[:, H * D:(H + KV) * D].reshape(-1, KV, D)
+    v = w[:, (H + KV) * D:].reshape(-1, KV, D)
+    groups = []
+    for gi in range(g):
+        groups += [q[:, gi * Hg:(gi + 1) * Hg],
+                   k[:, gi * KVg:(gi + 1) * KVg],
+                   v[:, gi * KVg:(gi + 1) * KVg]]
+    return np.concatenate([x.reshape(x.shape[0], -1) for x in groups],
+                          axis=1)
+
+
+def _repack_gate_up(w, I, g):
+    """[gate|up] → [g × (gate_g|up_g)]."""
+    Ig = I // g
+    gate, up = w[:, :I], w[:, I:]
+    groups = []
+    for gi in range(g):
+        groups += [gate[:, gi * Ig:(gi + 1) * Ig],
+                   up[:, gi * Ig:(gi + 1) * Ig]]
+    return np.concatenate(groups, axis=1)
+
+
+def test_fused_grouped_layout_is_pure_repack():
+    """A g=2 grouped model with weights RE-PACKED from a g=1 model must
+    reproduce the g=1 logits exactly — the grouping is a layout change
+    only (and under mp=2 the slices are shard-local)."""
+    ids = np.random.RandomState(0).randint(0, 64, (2, 16)).astype(np.int32)
+
+    paddle.seed(5)
+    m1 = LlamaForCausalLM(LlamaConfig(
+        **BASE, use_flash_attention=False,
+        fuse_attention_qkv=True, fuse_attention_ffn=True,
+        fuse_pack_groups=1))
+    ref = m1(paddle.to_tensor(ids)).numpy()
+
+    mesh = build_hybrid_mesh(mp_degree=2, dp_degree=4)
+    with mesh_context(mesh):
+        paddle.seed(5)
+        m2 = LlamaForCausalLM(LlamaConfig(
+            **BASE, use_flash_attention=False,
+            fuse_attention_qkv=True, fuse_attention_ffn=True,
+            fuse_pack_groups=2))
+        s1, s2 = extract_state(m1), extract_state(m2)
+        H, KV, D, I = 4, 2, 8, 64
+        for k in s1:
+            w = np.asarray(s1[k])
+            if "qkv_proj" in k:
+                s2[k] = jax.numpy.asarray(_repack_qkv(w, H, KV, D, 2))
+            elif "gate_up_proj" in k:
+                s2[k] = jax.numpy.asarray(_repack_gate_up(w, I, 2))
+            else:
+                s2[k] = s1[k]
+        bind_state(m2, s2)
+        out = m2(paddle.to_tensor(ids)).numpy()
+
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fuse_pack_groups_validation():
+    import pytest
+    with pytest.raises(ValueError):
+        LlamaForCausalLM(LlamaConfig(**BASE, fuse_attention_qkv=True,
+                                     fuse_pack_groups=3))

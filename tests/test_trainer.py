@@ -1,0 +1,136 @@
+"""Trainer (PaddleNLP paddlenlp/trainer parity — SURVEY §2.4): grad
+accumulation, LR schedule with warmup, logging, checkpoint/resume with
+optimizer + RNG state, evaluation with metrics."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import Dataset
+from paddle_tpu.trainer.trainer import Trainer, TrainingArguments
+
+
+class ToyDataset(Dataset):
+    """y = Wx regression with fixed W."""
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = rng.randn(8, 2).astype(np.float32)
+        self.y = self.x @ w
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 2)
+
+    def forward(self, x, y=None):
+        out = self.fc(x)
+        if y is not None:
+            return ((out - y) ** 2).mean(), out
+        return out
+
+
+def _args(tmp_path, **kw):
+    base = dict(output_dir=str(tmp_path), per_device_train_batch_size=8,
+                learning_rate=5e-2, logging_steps=2, max_steps=10,
+                warmup_steps=2, seed=7)
+    base.update(kw)
+    return TrainingArguments(**base)
+
+
+def test_train_reduces_loss_and_logs(tmp_path):
+    t = Trainer(model=Net(), args=_args(tmp_path),
+                train_dataset=ToyDataset())
+    state = t.train()
+    assert state["global_step"] == 10
+    logs = [e for e in state["log_history"] if "loss" in e]
+    assert len(logs) >= 3
+    assert logs[-1]["loss"] < logs[0]["loss"]
+    assert "samples_per_sec" in logs[-1]
+    # warmup then decay
+    lrs = [e["lr"] for e in logs]
+    assert lrs[-1] < max(lrs) + 1e-12
+
+
+def test_grad_accumulation_equivalence(tmp_path):
+    """accum=2 with bs=4 must match accum=1 with bs=8 step-for-step
+    (same data order, same LR schedule)."""
+    def run(accum, bs):
+        paddle.seed(123)
+        net = Net()
+        t = Trainer(model=net,
+                    args=_args(tmp_path, gradient_accumulation_steps=accum,
+                               per_device_train_batch_size=bs, max_steps=4,
+                               warmup_steps=0, logging_steps=1),
+                    train_dataset=ToyDataset(n=32))
+        # deterministic order
+        t.get_train_dataloader = lambda: paddle.io.DataLoader(
+            t.train_dataset, batch_size=bs, shuffle=False, drop_last=True)
+        t.train()
+        return {k: v.numpy().copy() for k, v in net.state_dict().items()}
+
+    w1 = run(1, 8)
+    w2 = run(2, 4)
+    for k in w1:
+        np.testing.assert_allclose(w2[k], w1[k], rtol=2e-4, atol=2e-5)
+
+
+def test_checkpoint_resume_bitwise(tmp_path):
+    """Stop at step 5, resume, and match an uninterrupted 10-step run."""
+    data = ToyDataset(n=64, seed=3)
+
+    def fresh(max_steps):
+        paddle.seed(99)
+        net = Net()
+        t = Trainer(model=net, args=_args(tmp_path / "a", max_steps=max_steps,
+                                          warmup_steps=0, save_steps=5,
+                                          logging_steps=0),
+                    train_dataset=data)
+        t.get_train_dataloader = lambda: paddle.io.DataLoader(
+            data, batch_size=8, shuffle=False, drop_last=True)
+        return net, t
+
+    net_full, t_full = fresh(10)
+    t_full.train()
+
+    net_half, t_half = fresh(5)
+    # an interrupted run shares the FULL run's schedule horizon (the crash
+    # is external; max_steps stays 10) — build the 10-step schedule first
+    t_half.create_optimizer_and_scheduler(10)
+    t_half.train()
+    ckpt = t_half.save_checkpoint()
+
+    paddle.seed(1234)  # resume must restore RNG, not depend on ambient seed
+    net_res, t_res = fresh(10)
+    t_res.train(resume_from_checkpoint=ckpt)
+    assert t_res.state["global_step"] == 10
+
+    for k, v in net_full.state_dict().items():
+        np.testing.assert_allclose(net_res.state_dict()[k].numpy(),
+                                   v.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_evaluate_with_metrics(tmp_path):
+    def acc(preds, labels):
+        return {"mse": float(((preds - labels) ** 2).mean())}
+    t = Trainer(model=Net(), args=_args(tmp_path, max_steps=5),
+                train_dataset=ToyDataset(), eval_dataset=ToyDataset(seed=5),
+                compute_metrics=acc)
+    t.train()
+    m = t.evaluate()
+    assert "mse" in m and np.isfinite(m["mse"])
+
+
+def test_bf16_autocast_path(tmp_path):
+    t = Trainer(model=Net(), args=_args(tmp_path, bf16=True, max_steps=4),
+                train_dataset=ToyDataset())
+    state = t.train()
+    assert state["global_step"] == 4
