@@ -61,9 +61,12 @@ def main() -> None:
                          sequence_parallel=False)
         batch, seq, steps = 4, 128, 2
 
+    # remat="none": at this size all residuals fit in HBM (flash attention
+    # saves only q/k/v/o/lse, never the S×S probs), so skipping recompute
+    # is a free ~10% step-time win over remat="dots"
     cfg = PretrainConfig(mc, global_batch=batch, seq_len=seq,
                          n_microbatches=1, param_dtype="bfloat16",
-                         scan_layers=False, remat="dots")
+                         scan_layers=False, remat="none")
     mesh = make_hybrid_mesh_for(cfg, devices=jax.devices()[:1])
     state, train_step, meta = build_llama_pretrain_step(cfg, mesh)
 
