@@ -175,6 +175,19 @@ class StaticFunction:
             dyn = [v for i, v in enumerate(leaves) if i not in statics]
             return dyn, new_states, new_grads
 
+        # CINN-parity pass (FLAGS_use_cinn analog): rewrite SDPA chains in
+        # the traced program into the fused attention kernel. The flag is
+        # re-read at every retrace (flags contract: "read once per trace"),
+        # so toggling it takes effect on the next recompile.
+        pure_dyn = pure
+
+        def pure(mode_sig, *rest):
+            from ..flags import get_flags
+            if get_flags("FLAGS_use_fusion_compiler")[
+                    "FLAGS_use_fusion_compiler"]:
+                from .fusion import fuse
+                return fuse(functools.partial(pure_dyn, mode_sig))(*rest)
+            return pure_dyn(mode_sig, *rest)
         self._compiled = jax.jit(pure, static_argnums=(0,))
 
     def _mode_signature(self):

@@ -1,0 +1,287 @@
+"""CINN-parity fusion pass (ref: paddle/cinn — ApplyCinnPass marks fusible
+subgraphs, compiles them, and replaces them with JIT-kernel ops; SURVEY §2.1
+'CINN fusion compiler' row and §7.1 L7).
+
+TPU-native substitution: XLA already performs the elementwise/reduction
+fusion CINN provides. The beyond-XLA deliverable is PATTERN fusion — regions
+XLA will not fuse into one kernel on its own. This pass operates on the
+traced jaxpr (the IR of this framework) and rewrites recognized
+scaled-dot-product-attention chains
+
+    dot_general(q, k^T) [* scale] -> softmax(axis=-1) -> dot_general(., v)
+
+into the Pallas TPU flash-attention kernel, exactly as CINN replaces a fused
+group with a compiled kernel op. Gated by FLAGS_use_fusion_compiler
+(parity: FLAGS_use_cinn); `fuse(fn)` is also a standalone transform.
+
+Matching is conservative: only single-consumer chains with the canonical
+[B, H, S, D] dot dimension numbers are rewritten; anything else is left to
+XLA untouched. The matched interior ops are skipped entirely (their values
+are never materialized) unless some other consumer needs them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Set
+
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jcore
+
+__all__ = ["fuse", "match_sdpa_patterns"]
+
+
+def _only_consumer(uses: Dict[Any, List[int]], var, eqn_idx: int) -> bool:
+    return uses.get(var, []) == [eqn_idx]
+
+
+def _build_use_map(jaxpr) -> Dict[Any, List[int]]:
+    uses: Dict[Any, List[int]] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not isinstance(v, jcore.Literal):
+                uses.setdefault(v, []).append(i)
+    for v in jaxpr.outvars:
+        if not isinstance(v, jcore.Literal):
+            uses.setdefault(v, []).append(-1)  # jaxpr output = external use
+    return uses
+
+
+def match_sdpa_patterns(jaxpr) -> List[dict]:
+    """Find non-causal, unmasked SDPA chains. Returns matches with the
+    q/k/v vars, the scale, the producing eqn index of the final dot, and
+    the set of interior eqn indices skippable when fused."""
+    eqns = jaxpr.eqns
+    producer: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+    uses = _build_use_map(jaxpr)
+
+    def prod(v):
+        return eqns[producer[v]] if v in producer else None
+
+    matches = []
+    for i, eqn in enumerate(eqns):
+        if eqn.primitive.name != "dot_general":
+            continue
+        # final dot: [B,H,Sq,Sk] @ [B,H,Sk,D] contracting 3 with 2
+        dn = eqn.params.get("dimension_numbers")
+        if dn != (((3,), (2,)), ((0, 1), (0, 1))):
+            continue
+        probs_var, v_var = eqn.invars
+        if isinstance(probs_var, jcore.Literal):
+            continue
+        chain: Set[int] = set()
+
+        def follow(var):
+            """Skip convert_element_type links (bf16 softmax inserts f32
+            accumulation converts), recording them in the chain."""
+            while True:
+                e = prod(var)
+                if e is None or e.primitive.name != "convert_element_type":
+                    return var
+                chain.add(producer[var])
+                var = e.invars[0]
+
+        def step(var, prim_name):
+            """var's producer if it is `prim_name` (through converts);
+            records the eqn into `chain`."""
+            var = follow(var)
+            e = prod(var)
+            if e is None or e.primitive.name != prim_name:
+                return None
+            chain.add(producer[var])
+            return e
+
+        e_div = step(probs_var, "div")
+        if e_div is None:
+            continue
+        exp_var, denom_var = e_div.invars
+        e_bcast_sum = step(denom_var, "broadcast_in_dim")
+        if e_bcast_sum is None:
+            continue
+        e_sum = step(e_bcast_sum.invars[0], "reduce_sum")
+        if e_sum is None or follow(e_sum.invars[0]) is not follow(exp_var):
+            continue
+        exp_var = follow(exp_var)
+        chain.add(producer[exp_var])
+        e_exp = prod(exp_var)
+        if e_exp is None or e_exp.primitive.name != "exp":
+            continue
+        e_sub = step(e_exp.invars[0], "sub")
+        if e_sub is None:
+            continue
+        logits_var, max_b_var = e_sub.invars
+        # max side: [stop_gradient] <- broadcast <- [max(-inf)] <- reduce_max
+        mv = max_b_var
+        e_sg = prod(mv)
+        if e_sg is not None and e_sg.primitive.name == "stop_gradient":
+            chain.add(producer[mv])
+            mv = e_sg.invars[0]
+        e_bc = step(mv, "broadcast_in_dim")
+        if e_bc is None:
+            continue
+        mv = e_bc.invars[0]
+        e_max = prod(mv)
+        if e_max is not None and e_max.primitive.name == "max":
+            chain.add(producer[mv])
+            ins = [x for x in e_max.invars if not isinstance(x, jcore.Literal)]
+            if len(ins) != 1:
+                continue
+            mv = ins[0]
+        e_rmax = step(mv, "reduce_max")
+        if e_rmax is None or e_rmax.invars[0] is not logits_var:
+            continue
+        # logits: dot_general [* scale]
+        scale = None
+        lv = logits_var
+        e_mul = prod(lv)
+        if e_mul is not None and e_mul.primitive.name == "mul":
+            lits = [x for x in e_mul.invars if isinstance(x, jcore.Literal)]
+            var_ins = [x for x in e_mul.invars
+                       if not isinstance(x, jcore.Literal)]
+            if len(lits) == 1 and len(var_ins) == 1:
+                scale = float(lits[0].val)
+                chain.add(producer[lv])
+                lv = var_ins[0]
+        e_dot1 = prod(lv)
+        if e_dot1 is None or e_dot1.primitive.name != "dot_general":
+            continue
+        dn1 = e_dot1.params.get("dimension_numbers")
+        if dn1 != (((3,), (3,)), ((0, 1), (0, 1))):
+            continue
+        chain.add(producer[lv])
+        q_var, k_var = e_dot1.invars
+        D = q_var.aval.shape[-1]
+
+        # interior eqn outputs used OUTSIDE the chain force those eqns to
+        # stay — and transitively their upstream chain producers, since a
+        # kept eqn still reads its inputs
+        keep: Set[int] = set()
+        for idx in chain:
+            for ov in eqns[idx].outvars:
+                ext = [u for u in uses.get(ov, []) if u != i and u not in chain]
+                if ext:
+                    keep.add(idx)
+        changed = True
+        while changed:
+            changed = False
+            for idx in list(keep):
+                for iv in eqns[idx].invars:
+                    if isinstance(iv, jcore.Literal):
+                        continue
+                    p = producer.get(iv)
+                    if p is not None and p in chain and p not in keep:
+                        keep.add(p)
+                        changed = True
+        if not (chain - keep):
+            # every interior value is consumed elsewhere (typical when the
+            # backward pass was traced into the same jaxpr and reads the
+            # probs): fusing would ADD a kernel on top of the fully
+            # materialized chain — a pessimization, so skip. To fuse
+            # training, apply `fuse` to the forward fn and differentiate
+            # the result (AD then uses the kernel's custom VJP).
+            continue
+        matches.append({
+            "final": i, "chain": chain - keep,
+            "q": q_var, "k": k_var, "v": v_var,
+            "scale": scale if scale is not None else 1.0,
+        })
+    return matches
+
+
+def _flash_eligible_shapes(q_aval, k_aval) -> bool:
+    """Shapes the Pallas kernel accepts. Off-TPU the pass still fuses
+    (substituting the reference composite) so the rewrite is testable on
+    the simulated-mesh CI backend."""
+    from ..ops.flash_attention import (_largest_dividing_block,
+                                       _tpu_flash_available)
+    if len(q_aval.shape) != 4:
+        return False
+    B, H, S, D = q_aval.shape
+    Sk = k_aval.shape[2]
+    if not _tpu_flash_available():
+        return True  # reference-composite substitution path
+    return (S == Sk and _largest_dividing_block(S) > 0
+            and ((D <= 128 and D % 64 == 0) or D % 128 == 0))
+
+
+def _run_fused(closed, matches, consts, *flat_args):
+    """Interpret the jaxpr, executing matched SDPA chains as flash calls
+    and skipping their interior equations."""
+    jaxpr = closed.jaxpr
+    env: Dict[Any, Any] = {}
+
+    def read(v):
+        return v.val if isinstance(v, jcore.Literal) else env[v]
+
+    def write(v, val):
+        env[v] = val
+
+    for v, c in zip(jaxpr.constvars, consts):
+        write(v, c)
+    for v, a in zip(jaxpr.invars, flat_args):
+        write(v, a)
+
+    by_final = {m["final"]: m for m in matches}
+    skip: Set[int] = set()
+    for m in matches:
+        skip |= m["chain"]
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        if i in skip:
+            continue
+        if i in by_final:
+            m = by_final[i]
+            q, k, v = read(m["q"]), read(m["k"]), read(m["v"])
+            from ..ops.flash_attention import (_flash_block_sizes,
+                                               _tpu_flash_available,
+                                               sdpa_reference)
+            if _tpu_flash_available():
+                from jax.experimental.pallas.ops.tpu.flash_attention import (
+                    flash_attention as _pallas_flash)
+                out = _pallas_flash(
+                    q, k, v, causal=False, sm_scale=m["scale"],
+                    block_sizes=_flash_block_sizes(q.shape[2], k.shape[2]))
+            else:
+                out = jnp.swapaxes(sdpa_reference(
+                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), scale=m["scale"]), 1, 2)
+            write(eqn.outvars[0], out.astype(eqn.outvars[0].aval.dtype))
+            continue
+        vals = [read(x) for x in eqn.invars]
+        sub = eqn.primitive.bind(*vals, **eqn.params)
+        if eqn.primitive.multiple_results:
+            for ov, o in zip(eqn.outvars, sub):
+                write(ov, o)
+        else:
+            write(eqn.outvars[0], sub)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def fuse(fn):
+    """Transform: rewrite recognizable SDPA chains in `fn`'s traced program
+    into Pallas flash-attention kernel calls (the CINN 'replace fused group
+    with a JIT kernel op' step). Falls back to `fn` untouched when nothing
+    matches or tracing is not possible."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        try:
+            # one trace gives both the jaxpr and the output pytree
+            closed, out_shape = jax.make_jaxpr(
+                lambda *a: fn(*a, **kwargs), return_shape=True)(*args)
+        except Exception:
+            return fn(*args, **kwargs)
+        matches = [m for m in match_sdpa_patterns(closed.jaxpr)
+                   if _flash_eligible_shapes(m["q"].aval, m["k"].aval)]
+        flat, _ = jax.tree_util.tree_flatten(args)
+        # no-match: interpret the already-traced jaxpr rather than
+        # re-tracing fn a second time
+        outs = _run_fused(closed, matches, closed.consts, *flat)
+        out_tree = jax.tree_util.tree_structure(out_shape)
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    return wrapped

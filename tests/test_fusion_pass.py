@@ -1,0 +1,119 @@
+"""CINN-parity fusion pass (SURVEY §2.1 'CINN fusion compiler' row):
+jaxpr pattern matching + fused-kernel substitution, flag-gated like
+FLAGS_use_cinn."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.fusion import fuse, match_sdpa_patterns
+
+R = np.random.RandomState(0)
+B, H, S, D = 2, 2, 16, 8
+
+
+def naive_sdpa(q, k, v):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _qkv(dtype=np.float32):
+    return tuple(jnp.asarray(R.randn(B, H, S, D).astype(np.float32) * 0.3)
+                 .astype(dtype) for _ in range(3))
+
+
+def test_matcher_finds_sdpa_chain():
+    q, k, v = _qkv()
+    closed = jax.make_jaxpr(naive_sdpa)(q, k, v)
+    ms = match_sdpa_patterns(closed.jaxpr)
+    assert len(ms) == 1
+    assert ms[0]["scale"] == pytest.approx(D ** -0.5)
+    assert len(ms[0]["chain"]) >= 8  # interior softmax chain eliminated
+
+
+def test_matcher_finds_bf16_chain_through_converts():
+    q, k, v = _qkv(jnp.bfloat16)
+    closed = jax.make_jaxpr(naive_sdpa)(q, k, v)
+    assert len(match_sdpa_patterns(closed.jaxpr)) == 1
+
+
+def test_matcher_ignores_non_sdpa():
+    def plain(a, b):
+        return jax.nn.softmax(a @ b, axis=-1).sum()
+    a = jnp.zeros((4, 4))
+    closed = jax.make_jaxpr(plain)(a, a)
+    assert match_sdpa_patterns(closed.jaxpr) == []
+
+
+def test_externally_used_interiors_disable_fusion():
+    """If the probs are ALSO returned, the whole chain must execute anyway
+    — fusing would only ADD work, so the matcher declines (no
+    pessimization) and outputs stay exact."""
+    def sdpa_and_probs(q, k, v):
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.5
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v), p
+    q, k, v = _qkv()
+    closed = jax.make_jaxpr(sdpa_and_probs)(q, k, v)
+    assert match_sdpa_patterns(closed.jaxpr) == []
+    out, probs = fuse(sdpa_and_probs)(q, k, v)
+    ref_out, ref_p = sdpa_and_probs(q, k, v)
+    np.testing.assert_allclose(np.asarray(probs), np.asarray(ref_p),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_matches_naive_numerics():
+    q, k, v = _qkv()
+    ref = naive_sdpa(q, k, v)
+    out = fuse(naive_sdpa)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_under_jit_and_grad():
+    q, k, v = _qkv()
+    out = jax.jit(fuse(naive_sdpa))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(naive_sdpa(q, k, v)),
+                               rtol=1e-4, atol=1e-5)
+    g = jax.grad(lambda q: fuse(naive_sdpa)(q, k, v).sum())(q)
+    gref = jax.grad(lambda q: naive_sdpa(q, k, v).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_surrounding_ops_preserved():
+    """The pass must only touch the matched region."""
+    def model(x, q, k, v):
+        h = jnp.tanh(x)
+        a = naive_sdpa(q, k, v)
+        return (h.sum() + a.sum()) * 2.0
+    q, k, v = _qkv()
+    x = jnp.asarray(R.randn(3, 3).astype(np.float32))
+    np.testing.assert_allclose(float(fuse(model)(x, q, k, v)),
+                               float(model(x, q, k, v)), rtol=1e-5)
+
+
+def test_flag_gated_in_to_static():
+    """FLAGS_use_fusion_compiler routes to_static through the pass
+    (FLAGS_use_cinn parity) without changing results."""
+    from paddle_tpu import jit, nn
+
+    class Attn(nn.Layer):
+        def forward(self, q, k, v):
+            return paddle.Tensor(naive_sdpa(q._data, k._data, v._data))
+
+    q, k, v = (paddle.to_tensor(np.asarray(t)) for t in _qkv())
+    ref = Attn()(q, k, v).numpy()
+    paddle.set_flags({"FLAGS_use_fusion_compiler": True})
+    try:
+        m = jit.to_static(Attn())
+        out = m(q, k, v).numpy()
+    finally:
+        paddle.set_flags({"FLAGS_use_fusion_compiler": False})
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
