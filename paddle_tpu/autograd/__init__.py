@@ -15,7 +15,10 @@ from ..core.autograd import (GradNode, enable_grad, is_grad_enabled, no_grad,
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
+
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "vjp", "jvp", "jacobian", "hessian",
            "set_grad_enabled", "PyLayer", "PyLayerContext"]
 
 

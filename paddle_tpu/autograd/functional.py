@@ -1,0 +1,107 @@
+"""Functional higher-order autodiff — paddle.incubate.autograd /
+paddle.autograd functional surface (ref: python/paddle/autograd/
+{functional,jacobian,hessian} and python/paddle/incubate/autograd/;
+SURVEY §2.2 'autograd py' row).
+
+TPU-native mechanism: these are thin adapters over JAX's functional
+transforms (jax.vjp / jax.jvp / jax.jacfwd / jax.jacrev / composition for
+hessian) — the reference builds them by replaying its tape; here the
+transforms are native and compose with jit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "jacobian", "hessian"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (tuple, list)):
+        return tuple(_unwrap(v) for v in x)
+    return jnp.asarray(x)
+
+
+def _wrap(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(_wrap(v) for v in x)
+    return Tensor(x)
+
+
+def _raw_fn(func):
+    def raw(*arrs):
+        out = func(*[Tensor(a) for a in arrs])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+    return raw
+
+
+def vjp(func: Callable, xs, v=None):
+    """(outputs, input-cotangents) — paddle.incubate.autograd.vjp parity.
+    v defaults to ones like the outputs."""
+    xs_t = xs if isinstance(xs, (tuple, list)) else (xs,)
+    raw = _raw_fn(func)
+    outs, pullback = jax.vjp(raw, *_unwrap(xs_t))
+    if v is None:
+        cots = jax.tree_util.tree_map(jnp.ones_like, outs)
+    else:
+        cots = _unwrap(v if isinstance(v, (tuple, list)) else (v,))
+        if not isinstance(outs, tuple):
+            cots = cots[0]
+        elif len(cots) == 1 and len(outs) != 1:
+            cots = cots[0]
+    grads = pullback(cots)
+    single_in = not isinstance(xs, (tuple, list))
+    return _wrap(outs), (_wrap(grads[0]) if single_in else _wrap(grads))
+
+
+def jvp(func: Callable, xs, v=None):
+    """(outputs, output-tangents) — forward-mode counterpart."""
+    xs_t = xs if isinstance(xs, (tuple, list)) else (xs,)
+    raw = _raw_fn(func)
+    prim = _unwrap(xs_t)
+    if v is None:
+        tang = tuple(jnp.ones_like(p) for p in prim)
+    else:
+        tang = _unwrap(v if isinstance(v, (tuple, list)) else (v,))
+    outs, out_tangents = jax.jvp(raw, prim, tang)
+    return _wrap(outs), _wrap(out_tangents)
+
+
+def jacobian(func: Callable, xs, create_graph: bool = False):
+    """Full Jacobian(s) of func at xs (paddle.autograd.jacobian parity:
+    single input → Jacobian array; tuple input → tuple of Jacobians)."""
+    xs_t = xs if isinstance(xs, (tuple, list)) else (xs,)
+    raw = _raw_fn(func)
+    jac = jax.jacrev(raw, argnums=tuple(range(len(xs_t))))(*_unwrap(xs_t))
+    if not isinstance(xs, (tuple, list)):
+        return _wrap(jac[0])
+    return _wrap(jac)
+
+
+def hessian(func: Callable, xs, create_graph: bool = False):
+    """Hessian of a scalar-output func (forward-over-reverse)."""
+    xs_t = xs if isinstance(xs, (tuple, list)) else (xs,)
+    raw = _raw_fn(func)
+
+    def scalar(*arrs):
+        out = raw(*arrs)
+        if isinstance(out, tuple):
+            out = out[0]
+        if out.ndim != 0:
+            raise ValueError("hessian requires a scalar-output function")
+        return out
+
+    hess = jax.jacfwd(jax.jacrev(scalar, argnums=tuple(range(len(xs_t)))),
+                      argnums=tuple(range(len(xs_t))))(*_unwrap(xs_t))
+    if not isinstance(xs, (tuple, list)):
+        return _wrap(hess[0][0])
+    return _wrap(hess)

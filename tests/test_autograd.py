@@ -164,3 +164,35 @@ def test_grad_under_jit_trace():
 
     out = jax.jit(step)(jnp.asarray([1.0, 2.0]))
     np.testing.assert_allclose(np.asarray(out), [2, 4])
+
+
+def test_functional_jacobian_hessian_vjp_jvp():
+    """autograd.functional surface (jacobian/hessian/vjp/jvp parity)."""
+    from paddle_tpu.autograd import jacobian, hessian, vjp, jvp
+
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    h = hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), 2 * np.eye(3), atol=1e-6)
+
+    def g(x):
+        return x * x
+
+    j = jacobian(g, x)
+    np.testing.assert_allclose(j.numpy(), np.diag([2., 4., 6.]), atol=1e-6)
+
+    outs, grads = vjp(f, x)
+    np.testing.assert_allclose(grads.numpy(), [2., 4., 6.], atol=1e-6)
+    outs, tangents = jvp(g, x, paddle.to_tensor(np.ones(3, np.float32)))
+    np.testing.assert_allclose(tangents.numpy(), [2., 4., 6.], atol=1e-6)
+
+    # two-input jacobian
+    def m(a, b):
+        return a @ b
+
+    a = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    b = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    ja, jb = jacobian(m, (a, b))
+    assert ja.shape == [2, 2, 2] and jb.shape == [2, 2]
