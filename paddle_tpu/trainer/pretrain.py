@@ -42,7 +42,8 @@ class PretrainConfig:
                  n_microbatches=1, lr=3e-4, weight_decay=0.1,
                  param_dtype="bfloat16", grad_clip=1.0,
                  dp=1, mp=1, pp=1, sharding=1, sep=1, vpp=1,
-                 scan_layers: bool = True, remat: str = "full"):
+                 scan_layers: bool = True, remat: str = "full",
+                 ce_chunks: int = 4):
         self.model = model
         self.global_batch = global_batch
         self.seq_len = seq_len
@@ -67,6 +68,11 @@ class PretrainConfig:
         if remat not in ("full", "dots", "none"):
             raise ValueError(f"remat must be full|dots|none, got {remat!r}")
         self.remat = remat
+        # sequence chunks for the softmax-CE loss: bounds peak logits
+        # memory at B*S/ce_chunks*vocab f32 (per-chunk remat)
+        if ce_chunks < 1:
+            raise ValueError(f"ce_chunks must be >= 1, got {ce_chunks}")
+        self.ce_chunks = ce_chunks
 
 
 def make_hybrid_mesh_for(cfg: PretrainConfig, devices=None) -> Mesh:
@@ -283,7 +289,7 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
 
         # uneven chunking keeps the memory bound for every S (ceil-division
         # boundaries; each chunk shape is static so XLA compiles ≤2 variants)
-        n_chunks = min(4, S)
+        n_chunks = min(cfg.ce_chunks, S)
         bounds = [i * S // n_chunks for i in range(n_chunks)] + [S]
         total = jnp.zeros((), jnp.float32)
         for lo, hi in zip(bounds[:-1], bounds[1:]):
