@@ -1,0 +1,120 @@
+"""Conv/pool op sweep vs torch-CPU references (SURVEY §7.2.5: the OCR conv
+path is the non-transformer canary; torch is the independent oracle the
+reference's OpTest uses NumPy for — closer semantics for convs)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+R = np.random.RandomState(9)
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2),
+])
+def test_conv2d_vs_torch(stride, padding, dilation, groups):
+    x = R.randn(2, 4, 11, 9).astype(np.float32)
+    w = R.randn(6, 4 // groups, 3, 3).astype(np.float32)
+    b = R.randn(6).astype(np.float32)
+    out = F.conv2d(_t(x), _t(w), _t(b), stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    ref = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                    stride=stride, padding=padding, dilation=dilation,
+                    groups=groups).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_conv1d_and_conv3d_vs_torch():
+    x1 = R.randn(2, 3, 17).astype(np.float32)
+    w1 = R.randn(5, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        F.conv1d(_t(x1), _t(w1), stride=2, padding=1).numpy(),
+        TF.conv1d(torch.tensor(x1), torch.tensor(w1), stride=2,
+                  padding=1).numpy(), rtol=2e-4, atol=2e-4)
+    x3 = R.randn(1, 2, 5, 6, 7).astype(np.float32)
+    w3 = R.randn(4, 2, 3, 3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        F.conv3d(_t(x3), _t(w3), padding=1).numpy(),
+        TF.conv3d(torch.tensor(x3), torch.tensor(w3), padding=1).numpy(),
+        rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("stride,padding", [(1, 0), (2, 1)])
+def test_conv2d_transpose_vs_torch(stride, padding):
+    x = R.randn(2, 4, 7, 7).astype(np.float32)
+    w = R.randn(4, 5, 3, 3).astype(np.float32)  # [in, out, kh, kw]
+    out = F.conv2d_transpose(_t(x), _t(w), stride=stride, padding=padding)
+    ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                              stride=stride, padding=padding).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_pools_vs_torch():
+    x = R.randn(2, 3, 10, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        F.max_pool2d(_t(x), 2, stride=2).numpy(),
+        TF.max_pool2d(torch.tensor(x), 2, stride=2).numpy(), rtol=1e-6)
+    # paddle's default exclusive=True == torch count_include_pad=False
+    np.testing.assert_allclose(
+        F.avg_pool2d(_t(x), 3, stride=2, padding=1).numpy(),
+        TF.avg_pool2d(torch.tensor(x), 3, stride=2, padding=1,
+                      count_include_pad=False).numpy(),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(_t(x), 1).numpy(),
+        TF.adaptive_avg_pool2d(torch.tensor(x), 1).numpy(),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d_grad_vs_torch():
+    x = R.randn(1, 2, 6, 6).astype(np.float32)
+    w = R.randn(3, 2, 3, 3).astype(np.float32)
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    wt = paddle.to_tensor(w, stop_gradient=False)
+    F.conv2d(xt, wt, padding=1).sum().backward()
+    tx = torch.tensor(x, requires_grad=True)
+    tw = torch.tensor(w, requires_grad=True)
+    TF.conv2d(tx, tw, padding=1).sum().backward()
+    np.testing.assert_allclose(xt.grad.numpy(), tx.grad.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(wt.grad.numpy(), tw.grad.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_batch_norm_train_eval_vs_torch():
+    x = R.randn(4, 3, 5, 5).astype(np.float32)
+    bn = paddle.nn.BatchNorm2D(3)
+    tbn = torch.nn.BatchNorm2d(3)
+    with torch.no_grad():
+        tbn.weight.copy_(torch.tensor(bn.weight.numpy()))
+        tbn.bias.copy_(torch.tensor(bn.bias.numpy()))
+    bn.train(); tbn.train()
+    y = bn(_t(x)).numpy()
+    ty = tbn(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(y, ty, rtol=1e-4, atol=1e-4)
+    # running mean identical; running var differs by the bias correction:
+    # paddle (and this framework) accumulate the BIASED batch variance,
+    # torch the unbiased one (a documented paddle-vs-torch difference)
+    np.testing.assert_allclose(bn._mean.numpy(),
+                               tbn.running_mean.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    n = x.shape[0] * x.shape[2] * x.shape[3]
+    expect_var = 0.9 * 1.0 + 0.1 * (tbn.running_var.numpy() - 0.9) / 0.1 \
+        * (n - 1) / n
+    np.testing.assert_allclose(bn._variance.numpy(), expect_var,
+                               rtol=1e-4, atol=1e-5)
+    # eval mode normalizes with OUR running stats
+    bn.eval()
+    rm = bn._mean.numpy().reshape(1, -1, 1, 1)
+    rv = bn._variance.numpy().reshape(1, -1, 1, 1)
+    ref_eval = (x - rm) / np.sqrt(rv + 1e-5)
+    np.testing.assert_allclose(bn(_t(x)).numpy(), ref_eval, rtol=1e-4,
+                               atol=1e-4)
