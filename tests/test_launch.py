@@ -82,3 +82,35 @@ def test_elastic_manager_membership():
         assert not m0.membership_changed(expected=2)
     finally:
         s.close()
+
+
+def test_fault_injection_sigkill_worker_recovers(tmp_path):
+    """Kill-a-worker fault injection (SURVEY §5.3): rank 1 SIGKILLs itself
+    mid-run on the first attempt; the watch loop must tear the pod down and
+    relaunch it, and the retry completes on all ranks."""
+    sentinel = tmp_path / "already_died"
+    done = tmp_path / "done"
+    done.mkdir()
+    script = _write_script(tmp_path, f"""
+        import os, signal, time
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        s = {str(sentinel)!r}
+        if rank == "1" and not os.path.exists(s):
+            open(s, "w").write("x")
+            os.kill(os.getpid(), signal.SIGKILL)  # simulated host failure
+        if rank == "0" and not os.path.exists(s):
+            time.sleep(30)  # would hang forever if the pod were not torn down
+        open(os.path.join({str(done)!r}, rank + "." +
+                          os.environ.get("PADDLE_RESTART_COUNT", "0")),
+             "w").write("ok")
+        print("rank", rank, "finished")
+    """)
+    import time
+    t0 = time.time()
+    rc = launch(["--nproc_per_node", "2", "--max_restarts", "1",
+                 "--log_dir", str(tmp_path / "log"), script])
+    assert rc == 0
+    # rank 0's first attempt was killed by the controller (not after 30s)
+    assert time.time() - t0 < 25
+    assert "rank 0 finished" in (tmp_path / "log" / "workerlog.0").read_text()
+    assert "rank 1 finished" in (tmp_path / "log" / "workerlog.1").read_text()
