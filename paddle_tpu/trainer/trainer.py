@@ -11,6 +11,7 @@ general-model harness the reference's Trainer API provides.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import math
@@ -174,6 +175,17 @@ class Trainer:
         # epoch so the data stream continues where it stopped (ref:
         # Trainer's consumed_samples / sampler-state resume)
         skip = self.state["micro_batches"] % max(1, steps_per_epoch)
+        with self._sigterm_guard():
+            done = self._run_loop(loader, target, done, skip, accum, losses,
+                                  t0, steps_per_epoch)
+        if not self._preempted:
+            self.save_checkpoint()
+        return self.state
+
+    def _run_loop(self, loader, target, done, skip, accum, losses, t0,
+                  steps_per_epoch):
+        args = self.args
+        samples = 0
         while not done:
             for batch in loader:
                 if skip > 0:
@@ -206,6 +218,14 @@ class Trainer:
                         entry["tflops"] = (samples * args.flops_per_sample
                                            / dt / 1e12)
                     self.state["log_history"].append(entry)
+                if self._preempted:
+                    # log the marker BEFORE serializing so the emergency
+                    # checkpoint's trainer_state.json records the preemption
+                    self.state["log_history"].append(
+                        {"step": gs,
+                         "preempted_checkpoint": self._ckpt_dir()})
+                    self.save_checkpoint()
+                    return True
                 if args.save_steps and gs % args.save_steps == 0:
                     self.save_checkpoint()
                 if args.eval_steps and self.eval_dataset is not None \
@@ -213,10 +233,34 @@ class Trainer:
                     self.evaluate()
                     self.model.train()
                 if gs >= target:
-                    done = True
-                    break
-        self.save_checkpoint()
-        return self.state
+                    return True
+        return done
+
+    @contextlib.contextmanager
+    def _sigterm_guard(self):
+        """Install a SIGTERM→flag handler for the duration of the loop
+        (SURVEY §5.3/5.4: preemption → emergency checkpoint). Exception-
+        safe restore; distinguishes install-failed from prior-handler-None
+        (C-installed handlers report None on success)."""
+        import signal as _signal
+        self._preempted = False
+        installed = False
+        prev = None
+
+        def _on_sigterm(signum, frame):
+            self._preempted = True
+        try:
+            prev = _signal.signal(_signal.SIGTERM, _on_sigterm)
+            installed = True
+        except ValueError:
+            pass  # not in the main thread: run without a handler
+        try:
+            yield
+        finally:
+            if installed:
+                _signal.signal(
+                    _signal.SIGTERM,
+                    prev if prev is not None else _signal.SIG_DFL)
 
     # -- eval ----------------------------------------------------------------
     def evaluate(self, eval_dataset=None) -> Dict[str, float]:

@@ -100,9 +100,7 @@ def test_fault_injection_sigkill_worker_recovers(tmp_path):
             os.kill(os.getpid(), signal.SIGKILL)  # simulated host failure
         if rank == "0" and not os.path.exists(s):
             time.sleep(30)  # would hang forever if the pod were not torn down
-        open(os.path.join({str(done)!r}, rank + "." +
-                          os.environ.get("PADDLE_RESTART_COUNT", "0")),
-             "w").write("ok")
+        open(os.path.join({str(done)!r}, rank), "w").write("ok")
         print("rank", rank, "finished")
     """)
     import time
@@ -114,3 +112,5 @@ def test_fault_injection_sigkill_worker_recovers(tmp_path):
     assert time.time() - t0 < 25
     assert "rank 0 finished" in (tmp_path / "log" / "workerlog.0").read_text()
     assert "rank 1 finished" in (tmp_path / "log" / "workerlog.1").read_text()
+    # both ranks completed the retry attempt
+    assert (done / "0").exists() and (done / "1").exists()

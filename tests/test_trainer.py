@@ -134,3 +134,36 @@ def test_bf16_autocast_path(tmp_path):
                 train_dataset=ToyDataset())
     state = t.train()
     assert state["global_step"] == 4
+
+
+def test_preemption_sigterm_saves_emergency_checkpoint(tmp_path):
+    """SIGTERM mid-training saves a consistent checkpoint at the next step
+    boundary and exits the loop (SURVEY §5.3 preemption story)."""
+    import os
+    import signal
+
+    class PreemptingNet(Net):
+        def forward(self, x, y=None):
+            # deliver SIGTERM during step 3's forward
+            if getattr(self, "_steps", 0) == 3 and not getattr(
+                    self, "_sent", False):
+                self._sent = True
+                os.kill(os.getpid(), signal.SIGTERM)
+            self._steps = getattr(self, "_steps", 0) + 1
+            return super().forward(x, y)
+
+    t = Trainer(model=PreemptingNet(),
+                args=_args(tmp_path, max_steps=50, logging_steps=0),
+                train_dataset=ToyDataset())
+    state = t.train()
+    assert state["global_step"] < 50  # stopped early
+    pre = [e for e in state["log_history"] if "preempted_checkpoint" in e]
+    assert len(pre) == 1
+    ckpt = pre[0]["preempted_checkpoint"]
+    assert os.path.exists(os.path.join(ckpt, "model_state.pdparams"))
+    # and the checkpoint resumes
+    t2 = Trainer(model=Net(), args=_args(tmp_path, max_steps=state[
+        "global_step"] + 2, logging_steps=0), train_dataset=ToyDataset())
+    t2.create_optimizer_and_scheduler(50)
+    t2.train(resume_from_checkpoint=ckpt)
+    assert t2.state["global_step"] == state["global_step"] + 2
