@@ -7,6 +7,7 @@ wrappers go through core.dispatch so autograd/jit see them as single ops.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...core.dispatch import apply
@@ -101,3 +102,183 @@ def fused_linear(x, weight, bias=None, transpose_weight=False):
         return out
     ins = [x, weight] + ([bias] if bias is not None else [])
     return apply("fused_linear", impl, ins)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """(x + bias) -> dropout -> + residual -> layer_norm, one fused region
+    (ref: FusedBiasDropoutResidualLnKernel,
+    paddle/phi/kernels/fusion/gpu/fused_bias_dropout_residual_layer_norm*;
+    on TPU the chain is a single XLA fusion). Normalization always runs;
+    ln_scale/ln_bias are the optional affine params."""
+    from ...nn import functional as F
+
+    h = x if bias is None else x + (bias if isinstance(bias, Tensor)
+                                    else Tensor(jnp.asarray(bias)))
+    if dropout_rate:
+        # F.dropout owns the mode semantics incl. downscale_in_infer's
+        # eval-time (1-p) scaling — never bypass it on training=False
+        h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = h + residual
+    return F.layer_norm(h, h.shape[-1:], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    """Transformer FFN block as one fused region (ref: FusedFeedForward
+    kernel, paddle/phi/kernels/fusion/gpu/fused_feedforward_kernel.cu):
+    residual + (pre/post) layer_norm + linear-act-dropout-linear-dropout.
+    The layer norm at the active position always runs (affine optional)."""
+    from ...nn import functional as F
+
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = F.linear(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate:
+        h = F.dropout(h, p=dropout1_rate, training=training)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate:
+        h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale,
+                           bias=ln2_bias, epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, num_heads=None, name=None):
+    """Full MHA block as one fused region (ref: FusedAttentionKernel,
+    paddle/phi/kernels/fusion/gpu/fused_attention_kernel.cu):
+    [pre-LN] -> packed qkv proj -> SDPA (flash-routable) -> out proj ->
+    dropout -> +residual -> [post-LN].
+
+    qkv_weight: paddle layout [3, num_heads, head_dim, embed_dim].
+    With cache_kv ([2, B, H, cache_len, D]) the new keys/values are
+    appended and (out, new_cache_kv) is returned (decode semantics).
+    """
+    from ...nn import functional as F
+
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1:], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+
+    three, H, D, E = (qkv_weight._data if isinstance(qkv_weight, Tensor)
+                      else jnp.asarray(qkv_weight)).shape
+    B, S, _ = h.shape
+    mask_arr = _arr(attn_mask) if attn_mask is not None else None
+    cache_arr = _arr(cache_kv) if cache_kv is not None else None
+
+    def impl(hh, wq, *rest):
+        w = wq.reshape(3 * H * D, E).T  # [E, 3*H*D]
+        qkv = hh @ w
+        if qkv_bias is not None:
+            qkv = qkv + rest[0].reshape(-1)
+        qkv = qkv.reshape(B, S, 3, H, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        new_cache = None
+        if cache_arr is not None:
+            # append along the cache sequence dim: [2,B,H,L,D] -> L+S
+            kc = jnp.concatenate([cache_arr[0],
+                                  jnp.swapaxes(k, 1, 2)], axis=2)
+            vc = jnp.concatenate([cache_arr[1],
+                                  jnp.swapaxes(v, 1, 2)], axis=2)
+            new_cache = jnp.stack([kc, vc])
+            k = jnp.swapaxes(kc, 1, 2)
+            v = jnp.swapaxes(vc, 1, 2)
+        from ...ops.flash_attention import sdpa
+        o = sdpa(q, k, v, mask=mask_arr,
+                 dropout_p=attn_dropout_rate if training else 0.0)
+        o = o.reshape(B, S, H * D)
+        return o if new_cache is None else (o, new_cache)
+    ins = [h, qkv_weight] + ([qkv_bias] if qkv_bias is not None else [])
+    res = apply("fused_multi_head_attention", impl, ins)
+    if cache_arr is not None:
+        o, new_cache = res
+    else:
+        o, new_cache = res, None
+    out = F.linear(o, linear_weight, linear_bias)
+    if dropout_rate:
+        out = F.dropout(out, p=dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale,
+                           bias=ln_bias, epsilon=ln_epsilon)
+    return out if new_cache is None else (out, new_cache)
+
+
+def masked_multihead_attention(x, cache_kv, src_mask=None, bias=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               name=None):
+    """Single-token decode attention over an in-place KV cache (ref:
+    MaskedMultiheadAttentionKernel, paddle/phi/kernels/fusion/gpu/
+    masked_multihead_attention_kernel.cu — the generation-loop kernel).
+
+    x: [B, 3*H*D] packed qkv for the CURRENT step (bias added if given).
+    cache_kv: [2, B, H, max_seq, D]; returns (out, new_cache_kv) with the
+    step written at `sequence_lengths` (or seq_len-1). src_mask
+    ([B, 1, 1, max_seq] additive) masks cached positions. Rotary/beam
+    features are not implemented — passing them raises rather than
+    silently computing unrotated attention."""
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: rotary embedding inside the kernel "
+            "is not implemented — apply rope to q/k before packing x")
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam_cache_offset not implemented")
+
+    ck = _arr(cache_kv)
+    _, B, H, MS, D = ck.shape
+    mask_arr = _arr(src_mask) if src_mask is not None else None
+    bias_arr = _arr(bias) if bias is not None else None
+
+    def impl(xx, cache):
+        if bias_arr is not None:
+            xx = xx + bias_arr.reshape(-1)
+        qkv = xx.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B,H,D]
+        if sequence_lengths is not None:
+            pos = _arr(sequence_lengths).reshape(B)
+        else:
+            pos = jnp.full((B,), seq_len - 1, jnp.int32)
+        bidx = jnp.arange(B)
+        cache = cache.at[0, bidx, :, pos].set(k)
+        cache = cache.at[1, bidx, :, pos].set(v)
+        keys, vals = cache[0], cache[1]          # [B,H,MS,D]
+        logits = jnp.einsum("bhd,bhsd->bhs", q, keys) * (D ** -0.5)
+        valid = jnp.arange(MS)[None, None, :] <= pos[:, None, None]
+        logits = jnp.where(valid, logits, -1e30)
+        if mask_arr is not None:
+            logits = logits + mask_arr.reshape(B, 1, MS)
+        p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        o = jnp.einsum("bhs,bhsd->bhd", p, vals)
+        return o.reshape(B, H * D), cache
+    out, new_cache = apply("masked_multihead_attention", impl,
+                           [x, cache_kv])
+    return out, new_cache
+
+
+__all__ += ["fused_bias_dropout_residual_layer_norm", "fused_feedforward",
+            "fused_multi_head_attention", "masked_multihead_attention"]

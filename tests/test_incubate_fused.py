@@ -1,0 +1,132 @@
+"""incubate fused-op block parity (SURVEY §2.1 fused kernels row:
+fused attention / FFN / bias+dropout+residual+LN / masked MHA decode)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as F
+
+R = np.random.RandomState(4)
+
+
+def _t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+def test_fused_bias_dropout_residual_ln():
+    x = R.randn(2, 5, 8).astype(np.float32)
+    res = R.randn(2, 5, 8).astype(np.float32)
+    b = R.randn(8).astype(np.float32)
+    w = np.ones(8, np.float32)
+    bias = np.zeros(8, np.float32)
+    out = F.fused_bias_dropout_residual_layer_norm(
+        _t(x), _t(res), bias=_t(b), ln_scale=_t(w), ln_bias=_t(bias),
+        dropout_rate=0.0)
+    h = x + b + res
+    ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+        h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_feedforward_matches_composite():
+    x = R.randn(2, 4, 8).astype(np.float32)
+    w1 = R.randn(8, 16).astype(np.float32)
+    w2 = R.randn(16, 8).astype(np.float32)
+    ln_w = np.ones(8, np.float32)
+    ln_b = np.zeros(8, np.float32)
+    out = F.fused_feedforward(_t(x), _t(w1), _t(w2),
+                              ln2_scale=_t(ln_w), ln2_bias=_t(ln_b),
+                              dropout1_rate=0.0, dropout2_rate=0.0,
+                              activation="relu")
+    h = x + np.maximum(x @ w1, 0) @ w2
+    ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+        h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_multi_head_attention_matches_sdpa():
+    B, S, H, D = 2, 6, 2, 4
+    E = H * D
+    x = R.randn(B, S, E).astype(np.float32)
+    qkv_w = R.randn(3, H, D, E).astype(np.float32)
+    lin_w = R.randn(E, E).astype(np.float32)
+    out = F.fused_multi_head_attention(
+        _t(x), _t(qkv_w), _t(lin_w), dropout_rate=0.0,
+        attn_dropout_rate=0.0)
+    # composite reference
+    qkv = x @ qkv_w.reshape(3 * H * D, E).T  # [B,S,3HD]
+    qkv = qkv.reshape(B, S, 3, H, D)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, S, E)
+    h = x + o @ lin_w
+    # paddle's fused kernel ALWAYS applies the post layer norm (affine
+    # params optional)
+    ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+        h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-3)
+
+
+def test_masked_multihead_attention_decode_steps():
+    """Two decode steps must equal full attention over the written cache."""
+    B, H, D, MS = 1, 2, 4, 8
+    cache = paddle.zeros([2, B, H, MS, D])
+    xs = [R.randn(B, 3 * H * D).astype(np.float32) for _ in range(2)]
+    outs = []
+    for step, xv in enumerate(xs):
+        seq = paddle.to_tensor(np.full((B,), step, np.int32))
+        out, cache = F.masked_multihead_attention(
+            _t(xv), cache, sequence_lengths=seq)
+        outs.append(out.numpy())
+    # reference: keys/values accumulated over both steps
+    ks, vs = [], []
+    for xv in xs:
+        qkv = xv.reshape(B, 3, H, D)
+        ks.append(qkv[:, 1]); vs.append(qkv[:, 2])
+    q2 = xs[1].reshape(B, 3, H, D)[:, 0]
+    K = np.stack(ks, 2)  # [B,H,2,D]
+    V = np.stack(vs, 2)
+    logits = np.einsum("bhd,bhsd->bhs", q2, K) / np.sqrt(D)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhs,bhsd->bhd", p, V).reshape(B, H * D)
+    np.testing.assert_allclose(outs[1], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_mha_qkv_weight_gets_grad():
+    B, S, H, D = 1, 4, 2, 4
+    E = H * D
+    x = _t(R.randn(B, S, E))
+    qkv_w = paddle.to_tensor(R.randn(3, H, D, E).astype(np.float32),
+                             stop_gradient=False)
+    lin_w = paddle.to_tensor(R.randn(E, E).astype(np.float32),
+                             stop_gradient=False)
+    out = F.fused_multi_head_attention(x, qkv_w, lin_w, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+    out.sum().backward()
+    assert qkv_w.grad is not None and float(
+        paddle.abs(qkv_w.grad).sum()) > 0
+    assert lin_w.grad is not None
+
+
+def test_fused_mha_cache_append():
+    B, S, H, D = 1, 2, 2, 4
+    E = H * D
+    x = _t(R.randn(B, S, E))
+    qkv_w = _t(R.randn(3, H, D, E))
+    lin_w = _t(R.randn(E, E))
+    cache = paddle.zeros([2, B, H, 0, D])
+    out, new_cache = F.fused_multi_head_attention(
+        x, qkv_w, lin_w, cache_kv=cache, dropout_rate=0.0,
+        attn_dropout_rate=0.0)
+    assert new_cache.shape == [2, B, H, S, D]
+
+
+def test_masked_mha_rejects_unimplemented_args():
+    cache = paddle.zeros([2, 1, 2, 4, 4])
+    x = _t(R.randn(1, 3 * 2 * 4))
+    with pytest.raises(NotImplementedError):
+        F.masked_multihead_attention(x, cache, rotary_emb_dims=1)
