@@ -431,3 +431,271 @@ def _kl_bernoulli(p, q):
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
     return jnp.log((q.high - q.low) / (p.high - p.low))
+
+# ---------------------------------------------------------------------------
+# long-tail distributions (ref: python/paddle/distribution/ — ~25 classes;
+# SURVEY §2.2 misc numerics row)
+# ---------------------------------------------------------------------------
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return self.loc + self.scale * jax.random.gumbel(next_key(), shp)
+
+    def _log_prob(self, v):
+        z = (v - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1.0 + 0.5772156649,
+                                self.batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc + self.scale * 0.5772156649,
+                                self.batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to((math.pi ** 2 / 6) * self.scale ** 2,
+                                self.batch_shape)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return self.loc + self.scale * jax.random.cauchy(next_key(), shp)
+
+    def _log_prob(self, v):
+        return jax.scipy.stats.cauchy.logpdf(v, self.loc, self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                self.batch_shape)
+
+    def _mean(self):
+        return jnp.full(self.batch_shape, jnp.nan)
+
+    def _variance(self):
+        return jnp.full(self.batch_shape, jnp.nan)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        return self.loc + self.scale * jax.random.t(next_key(), self.df, shp)
+
+    def _log_prob(self, v):
+        return jax.scipy.stats.t.logpdf(v, self.df, self.loc, self.scale)
+
+    def _mean(self):
+        return jnp.where(self.df > 1,
+                         jnp.broadcast_to(self.loc, self.batch_shape),
+                         jnp.nan)
+
+    def _variance(self):
+        var = self.scale ** 2 * self.df / (self.df - 2)
+        return jnp.where(self.df > 2,
+                         jnp.broadcast_to(var, self.batch_shape), jnp.nan)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        self.df = _arr(df)
+        super().__init__(self.df / 2.0, 0.5)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        n = jnp.broadcast_to(self.total_count, shp).astype(jnp.int32)
+        return jax.random.binomial(next_key(), n,
+                                   jnp.broadcast_to(self.probs, shp))
+
+    def _log_prob(self, v):
+        n = self.total_count
+        # clip like Bernoulli above: v*log(0) at degenerate p would give
+        # 0*(-inf) = NaN even at in-support values
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1)
+                + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.total_count * self.probs,
+                                self.batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to(
+            self.total_count * self.probs * (1 - self.probs),
+            self.batch_shape)
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_norm_const(self):
+        p = self.probs
+        near_half = jnp.logical_and(p > self._lims[0], p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        c = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+                    / jnp.abs(1.0 - 2.0 * safe))
+        return jnp.where(near_half, jnp.log(2.0), c)
+
+    def _log_prob(self, v):
+        p = self.probs
+        return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                + self._log_norm_const())
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape
+        u = jax.random.uniform(next_key(), shp)
+        p = jnp.broadcast_to(self.probs, shp)
+        near_half = jnp.abs(p - 0.5) < 1e-3
+        safe = jnp.where(near_half, 0.25, p)
+        x = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return jnp.where(near_half, u, x)
+
+    def _mean(self):
+        p = self.probs
+        near_half = jnp.abs(p - 0.5) < 1e-3
+        safe = jnp.where(near_half, 0.25, p)
+        m = safe / (2.0 * safe - 1.0) + 1.0 / (
+            2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        return jnp.broadcast_to(jnp.where(near_half, 0.5, m),
+                                self.batch_shape)
+
+    def _variance(self):
+        # closed form (paddle/torch): p(p-1)/(1-2p)^2 + 1/(log1p(-p)-log p)^2
+        # with the same near-half guard as _mean (limit at p=1/2 is 1/12)
+        p = self.probs
+        near_half = jnp.abs(p - 0.5) < 1e-3
+        safe = jnp.where(near_half, 0.25, p)
+        var = (safe * (safe - 1.0) / (1.0 - 2.0 * safe) ** 2
+               + 1.0 / (jnp.log1p(-safe) - jnp.log(safe)) ** 2)
+        return jnp.broadcast_to(jnp.where(near_half, 1.0 / 12.0, var),
+                                self.batch_shape)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _arr(loc)
+        if scale_tril is not None:
+            self.scale_tril = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self.scale_tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        else:
+            raise ValueError("need covariance_matrix or scale_tril")
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self.scale_tril.shape[:-2])
+        super().__init__(batch, self.loc.shape[-1:])
+
+    def _sample(self, shape):
+        shp = shape + self.batch_shape + self.event_shape
+        z = jax.random.normal(next_key(), shp)
+        return self.loc + jnp.einsum("...ij,...j->...i", self.scale_tril, z)
+
+    def _log_prob(self, v):
+        d = self.event_shape[0]
+        diff = v - self.loc
+        # broadcast the Cholesky factor over the value's batch dims (jax
+        # solve_triangular requires equal batch ranks)
+        L = jnp.broadcast_to(self.scale_tril,
+                             diff.shape[:-1] + self.scale_tril.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(L, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, -1)
+        logdet = 2 * jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1))), -1)
+        return -0.5 * (d * math.log(2 * math.pi) + logdet + maha)
+
+    def _entropy(self):
+        d = self.event_shape[0]
+        logdet = 2 * jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1))), -1)
+        return 0.5 * d * (1 + math.log(2 * math.pi)) + 0.5 * logdet
+
+    def _mean(self):
+        return self.loc
+
+    def _variance(self):
+        return jnp.sum(self.scale_tril ** 2, -1)
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims as event dims (sum of log_probs)."""
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        if not 0 <= self.rank <= len(bs):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} out of range for "
+                f"base batch_shape {bs}")
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    def _sample(self, shape):
+        return self.base._sample(shape)
+
+    def _log_prob(self, v):
+        lp = self.base._log_prob(v)
+        for _ in range(self.rank):
+            lp = jnp.sum(lp, -1)
+        return lp
+
+    def _entropy(self):
+        e = self.base._entropy()
+        for _ in range(self.rank):
+            e = jnp.sum(e, -1)
+        return e
+
+    def _mean(self):
+        return self.base._mean()
+
+    def _variance(self):
+        return self.base._variance()
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    # KL(Gumbel(m1,b1) || Gumbel(m2,b2)) = log(b2/b1) + γ(b1/b2 - 1)
+    #   + (m1-m2)/b2 + exp((m2-m1)/b2 + lgamma(1 + b1/b2)) - 1
+    euler = 0.5772156649
+    t = p.scale / q.scale
+    return Tensor(jnp.log(q.scale / p.scale) + euler * (t - 1.0)
+                  + (p.loc - q.loc) / q.scale
+                  + jnp.exp((q.loc - p.loc) / q.scale
+                            + jax.scipy.special.gammaln(1.0 + t)) - 1.0)
+
+
+__all__ += ["Gumbel", "Cauchy", "StudentT", "Chi2", "Binomial",
+            "ContinuousBernoulli", "MultivariateNormal", "Independent"]

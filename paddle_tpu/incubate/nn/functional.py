@@ -186,22 +186,24 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
                       else jnp.asarray(qkv_weight)).shape
     B, S, _ = h.shape
     mask_arr = _arr(attn_mask) if attn_mask is not None else None
-    cache_arr = _arr(cache_kv) if cache_kv is not None else None
+    has_bias = qkv_bias is not None
+    has_cache = cache_kv is not None
 
     def impl(hh, wq, *rest):
+        rest = list(rest)
         w = wq.reshape(3 * H * D, E).T  # [E, 3*H*D]
         qkv = hh @ w
-        if qkv_bias is not None:
-            qkv = qkv + rest[0].reshape(-1)
+        if has_bias:
+            qkv = qkv + rest.pop(0).reshape(-1)
         qkv = qkv.reshape(B, S, 3, H, D)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         new_cache = None
-        if cache_arr is not None:
-            # append along the cache sequence dim: [2,B,H,L,D] -> L+S
-            kc = jnp.concatenate([cache_arr[0],
-                                  jnp.swapaxes(k, 1, 2)], axis=2)
-            vc = jnp.concatenate([cache_arr[1],
-                                  jnp.swapaxes(v, 1, 2)], axis=2)
+        if has_cache:
+            # append along the cache sequence dim: [2,B,H,L,D] -> L+S;
+            # the cache enters through apply() so grads flow into it
+            cache = rest.pop(0)
+            kc = jnp.concatenate([cache[0], jnp.swapaxes(k, 1, 2)], axis=2)
+            vc = jnp.concatenate([cache[1], jnp.swapaxes(v, 1, 2)], axis=2)
             new_cache = jnp.stack([kc, vc])
             k = jnp.swapaxes(kc, 1, 2)
             v = jnp.swapaxes(vc, 1, 2)
@@ -210,9 +212,10 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
                  dropout_p=attn_dropout_rate if training else 0.0)
         o = o.reshape(B, S, H * D)
         return o if new_cache is None else (o, new_cache)
-    ins = [h, qkv_weight] + ([qkv_bias] if qkv_bias is not None else [])
+    ins = [h, qkv_weight] + ([qkv_bias] if has_bias else []) \
+        + ([cache_kv] if has_cache else [])
     res = apply("fused_multi_head_attention", impl, ins)
-    if cache_arr is not None:
+    if has_cache:
         o, new_cache = res
     else:
         o, new_cache = res, None

@@ -130,3 +130,19 @@ def test_masked_mha_rejects_unimplemented_args():
     x = _t(R.randn(1, 3 * 2 * 4))
     with pytest.raises(NotImplementedError):
         F.masked_multihead_attention(x, cache, rotary_emb_dims=1)
+
+
+def test_fused_mha_cache_receives_grad():
+    B, S, H, D = 1, 2, 2, 4
+    E = H * D
+    x = _t(R.randn(B, S, E))
+    qkv_w = _t(R.randn(3, H, D, E))
+    lin_w = _t(R.randn(E, E))
+    cache = paddle.to_tensor(R.randn(2, B, H, 3, D).astype(np.float32),
+                             stop_gradient=False)
+    out, _ = F.fused_multi_head_attention(
+        x, qkv_w, lin_w, cache_kv=cache, dropout_rate=0.0,
+        attn_dropout_rate=0.0)
+    out.sum().backward()
+    assert cache.grad is not None and float(
+        paddle.abs(cache.grad).sum()) > 0

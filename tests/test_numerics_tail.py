@@ -200,3 +200,83 @@ class TestProfiler:
             states.append(p._recording)
         p.stop()
         assert True in states and False in states
+
+
+def test_longtail_distributions():
+    """Gumbel/Cauchy/StudentT/Chi2/Binomial/MVN/Independent — log_prob vs
+    scipy, sample moments sanity."""
+    from scipy import stats as ss
+    import paddle_tpu.distribution as D
+
+    x = np.linspace(-2, 2, 7).astype(np.float32)
+    np.testing.assert_allclose(
+        D.Gumbel(0.5, 1.5).log_prob(paddle.to_tensor(x)).numpy(),
+        ss.gumbel_r.logpdf(x, 0.5, 1.5), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        D.Cauchy(0.0, 2.0).log_prob(paddle.to_tensor(x)).numpy(),
+        ss.cauchy.logpdf(x, 0, 2), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        D.StudentT(4.0).log_prob(paddle.to_tensor(x)).numpy(),
+        ss.t.logpdf(x, 4), rtol=1e-5, atol=1e-5)
+    xc = np.array([0.5, 1.5, 3.0], np.float32)
+    np.testing.assert_allclose(
+        D.Chi2(3.0).log_prob(paddle.to_tensor(xc)).numpy(),
+        ss.chi2.logpdf(xc, 3), rtol=1e-4, atol=1e-5)
+    k = np.array([0., 2., 5.], np.float32)
+    np.testing.assert_allclose(
+        D.Binomial(10.0, 0.3).log_prob(paddle.to_tensor(k)).numpy(),
+        ss.binom.logpmf(k, 10, 0.3), rtol=1e-4, atol=1e-5)
+
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                               covariance_matrix=cov)
+    v = np.array([0.3, -0.7], np.float32)
+    np.testing.assert_allclose(
+        mvn.log_prob(paddle.to_tensor(v)).numpy(),
+        ss.multivariate_normal.logpdf(v, np.zeros(2), cov), rtol=1e-4)
+    assert mvn.sample([5]).shape == [5, 2]
+
+    ind = D.Independent(D.Normal(np.zeros(3, np.float32),
+                                 np.ones(3, np.float32)), 1)
+    lp = ind.log_prob(paddle.to_tensor(np.zeros(3, np.float32)))
+    np.testing.assert_allclose(float(lp.numpy()),
+                               3 * ss.norm.logpdf(0.0), rtol=1e-5)
+
+    # Gumbel KL: zero for identical, positive otherwise
+    kl0 = D.kl_divergence(D.Gumbel(0.0, 1.0), D.Gumbel(0.0, 1.0))
+    assert abs(float(kl0.numpy())) < 1e-5
+    kl1 = D.kl_divergence(D.Gumbel(0.0, 1.0), D.Gumbel(1.0, 2.0))
+    assert float(kl1.numpy()) > 0
+
+
+def test_distribution_review_regressions():
+    import paddle_tpu.distribution as D
+    from scipy import stats as ss
+    # batched log_prob against a single MVN
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                               covariance_matrix=cov)
+    vals = np.random.RandomState(0).randn(4, 2).astype(np.float32)
+    np.testing.assert_allclose(
+        mvn.log_prob(paddle.to_tensor(vals)).numpy(),
+        ss.multivariate_normal.logpdf(vals, np.zeros(2), cov), rtol=1e-4)
+    # batched covariance
+    covs = np.stack([np.eye(2), 2 * np.eye(2), 3 * np.eye(2)]).astype(
+        np.float32)
+    mvb = D.MultivariateNormal(np.zeros(2, np.float32),
+                               covariance_matrix=covs)
+    assert mvb.batch_shape == (3,)
+    assert mvb.sample([5]).shape == [5, 3, 2]
+    # degenerate binomial params stay finite
+    assert np.isfinite(float(
+        D.Binomial(10.0, 1.0).log_prob(paddle.to_tensor(10.0)).numpy()))
+    assert np.isfinite(float(
+        D.Binomial(10.0, 0.0).log_prob(paddle.to_tensor(0.0)).numpy()))
+    # continuous bernoulli closed-form variance
+    v = float(D.ContinuousBernoulli(np.float32(0.3)).variance.numpy())
+    assert abs(v - 0.0804) < 5e-3, v
+    # Independent rank validation
+    import pytest
+    with pytest.raises(ValueError):
+        D.Independent(D.Normal(np.zeros(3, np.float32),
+                               np.ones(3, np.float32)), 2)
