@@ -562,7 +562,7 @@ class ContinuousBernoulli(Distribution):
 
     def _log_norm_const(self):
         p = self.probs
-        near_half = jnp.logical_and(p > self._lims[0], p < self._lims[1])
+        near_half = self._near_half(p)
         safe = jnp.where(near_half, 0.25, p)
         c = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))
                     / jnp.abs(1.0 - 2.0 * safe))
@@ -573,11 +573,14 @@ class ContinuousBernoulli(Distribution):
         return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
                 + self._log_norm_const())
 
+    def _near_half(self, p):
+        return jnp.logical_and(p > self._lims[0], p < self._lims[1])
+
     def _sample(self, shape):
         shp = shape + self.batch_shape
         u = jax.random.uniform(next_key(), shp)
         p = jnp.broadcast_to(self.probs, shp)
-        near_half = jnp.abs(p - 0.5) < 1e-3
+        near_half = self._near_half(p)
         safe = jnp.where(near_half, 0.25, p)
         x = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
              / (jnp.log(safe) - jnp.log1p(-safe)))
@@ -585,7 +588,7 @@ class ContinuousBernoulli(Distribution):
 
     def _mean(self):
         p = self.probs
-        near_half = jnp.abs(p - 0.5) < 1e-3
+        near_half = self._near_half(p)
         safe = jnp.where(near_half, 0.25, p)
         m = safe / (2.0 * safe - 1.0) + 1.0 / (
             2.0 * jnp.arctanh(1.0 - 2.0 * safe))
@@ -596,7 +599,7 @@ class ContinuousBernoulli(Distribution):
         # closed form (paddle/torch): p(p-1)/(1-2p)^2 + 1/(log1p(-p)-log p)^2
         # with the same near-half guard as _mean (limit at p=1/2 is 1/12)
         p = self.probs
-        near_half = jnp.abs(p - 0.5) < 1e-3
+        near_half = self._near_half(p)
         safe = jnp.where(near_half, 0.25, p)
         var = (safe * (safe - 1.0) / (1.0 - 2.0 * safe) ** 2
                + 1.0 / (jnp.log1p(-safe) - jnp.log(safe)) ** 2)
@@ -644,10 +647,12 @@ class MultivariateNormal(Distribution):
         return 0.5 * d * (1 + math.log(2 * math.pi)) + 0.5 * logdet
 
     def _mean(self):
-        return self.loc
+        return jnp.broadcast_to(self.loc,
+                                self.batch_shape + self.event_shape)
 
     def _variance(self):
-        return jnp.sum(self.scale_tril ** 2, -1)
+        return jnp.broadcast_to(jnp.sum(self.scale_tril ** 2, -1),
+                                self.batch_shape + self.event_shape)
 
 
 class Independent(Distribution):

@@ -8,4 +8,8 @@ python/paddle/incubate/distributed/models/moe/ — SURVEY §2.2 incubate row,
 from . import moe  # noqa: F401
 from . import nn  # noqa: F401
 
-__all__ = ["moe", "nn"]
+from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead  # noqa: F401
+
+__all__ = ["moe", "nn", "asp", "optimizer", "LookAhead"]

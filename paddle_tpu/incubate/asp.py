@@ -1,0 +1,73 @@
+"""ASP — automatic semi-structured (2:4) sparsity (ref:
+python/paddle/incubate/asp/ — SURVEY §2.2 incubate row: 'ASP 2:4
+sparsity'). TPU note: the capability is mask computation + mask
+maintenance through training; the 2x sparse-tensor-core speedup is
+NVIDIA hardware, so on TPU the masks are a compression/regularization
+feature (documented in docs/UNSUPPORTED.md spirit: honest mechanism
+substitution)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["calculate_density", "create_mask", "prune_model", "decorate"]
+
+# masks live ON the parameter object (attribute) — a module-global dict
+# keyed by id() would leak for the process lifetime and could mis-apply a
+# stale mask if CPython recycles an id
+_MASK_ATTR = "_asp_mask"
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def create_mask(weight, n: int = 2, m: int = 4):
+    """n:m mask along the last dim: keep the n largest-|w| of every m."""
+    arr = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    if arr.shape[-1] % m != 0:
+        raise ValueError(f"last dim {arr.shape[-1]} not divisible by {m}")
+    groups = arr.reshape(arr.shape[:-1] + (arr.shape[-1] // m, m))
+    # rank within each group; keep top-n by |value|
+    order = jnp.argsort(jnp.abs(groups), axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = (ranks >= m - n).astype(arr.dtype)
+    return mask.reshape(arr.shape)
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo="mask_1d") -> dict:
+    """Apply n:m masks to every prunable 2-D weight of the model and
+    remember them (on the parameter) so `decorate`d optimizers re-apply
+    after each step."""
+    if mask_algo != "mask_1d":
+        raise NotImplementedError(
+            f"mask_algo {mask_algo!r} not implemented (only mask_1d)")
+    applied = {}
+    for name, p in model.named_parameters():
+        if p.ndim != 2 or p.shape[-1] % m != 0:
+            continue
+        mask = create_mask(p, n, m)
+        p._data = p._data * mask
+        setattr(p, _MASK_ATTR, mask)
+        applied[name] = mask
+    return applied
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step so masks survive updates (ref: asp.decorate)."""
+    inner_step = optimizer.step
+
+    def masked_step():
+        inner_step()
+        for p in optimizer._param_groups:
+            mask = getattr(p, _MASK_ATTR, None)
+            if mask is not None:
+                p._data = p._data * mask
+    optimizer.step = masked_step
+    return optimizer

@@ -1,0 +1,70 @@
+"""paddle.incubate.optimizer parity: LookAhead (ref:
+python/paddle/incubate/optimizer/lookahead.py — SURVEY §2.2 incubate row).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["LookAhead"]
+
+
+class LookAhead:
+    """Wraps an inner optimizer: every k fast steps, the slow weights move
+    alpha of the way toward the fast weights and the fast weights reset to
+    the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = {id(p): p._data
+                      for p in inner_optimizer._param_groups}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self.inner_optimizer._param_groups:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    @property
+    def _param_groups(self):
+        # delegate so wrappers over the Optimizer protocol (grad clip,
+        # asp.decorate) see the parameters
+        return self.inner_optimizer._param_groups
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        # slow weights round-trip too (ref: the paddle implementation keeps
+        # them as optimizer accumulators) — without them a restored run
+        # would re-anchor the slow copy at the current fast weights
+        slow = [self._slow[id(p)]
+                for p in self.inner_optimizer._param_groups]
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step_count": self._step_count,
+                "slow": [jnp.asarray(s) for s in slow]}
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state["inner"])
+        self._step_count = state.get("step_count", 0)
+        if "slow" in state:
+            for p, s in zip(self.inner_optimizer._param_groups,
+                            state["slow"]):
+                self._slow[id(p)] = jnp.asarray(
+                    s._data if isinstance(s, Tensor) else s)

@@ -703,15 +703,25 @@ def _adaptive_pool(x, output_size, nd, mode):
         out = a
         for d, (insz, outsz) in enumerate(zip(in_sizes, out_sizes)):
             axis = a.ndim - nd + d
-            if insz % outsz != 0:
-                raise NotImplementedError(
-                    "adaptive pool requires divisible sizes on TPU "
-                    f"(in={insz}, out={outsz})")
-            k = insz // outsz
-            shape = out.shape[:axis] + (outsz, k) + out.shape[axis + 1:]
-            out = out.reshape(shape)
-            out = jnp.mean(out, axis=axis + 1) if mode == "avg" \
-                else jnp.max(out, axis=axis + 1)
+            if insz % outsz == 0:
+                # fast path: equal windows → reshape + reduce
+                k = insz // outsz
+                shape = out.shape[:axis] + (outsz, k) + out.shape[axis + 1:]
+                out = out.reshape(shape)
+                out = jnp.mean(out, axis=axis + 1) if mode == "avg" \
+                    else jnp.max(out, axis=axis + 1)
+                continue
+            # general paddle/torch windows: [floor(i*in/out), ceil((i+1)*in/out))
+            slices = []
+            for i in range(outsz):
+                lo = (i * insz) // outsz
+                hi = -(-((i + 1) * insz) // outsz)  # ceil
+                win = jax.lax.slice_in_dim(out, lo, hi, axis=axis)
+                red = jnp.mean(win, axis=axis, keepdims=True) \
+                    if mode == "avg" else jnp.max(win, axis=axis,
+                                                  keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=axis)
         return out
     return apply("adaptive_pool", impl, [x])
 

@@ -255,3 +255,76 @@ class MobileNetV3Small(nn.Layer):
 
 def mobilenet_v3_small(**kw) -> MobileNetV3Small:
     return MobileNetV3Small(**kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], **kw)
+
+
+def resnet152(**kw) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], **kw)
+
+
+class VGG(nn.Layer):
+    """ref: python/paddle/vision/models/vgg.py (features-classifier CNN)."""
+
+    def __init__(self, cfg: List, num_classes: int = 1000,
+                 batch_norm: bool = False, in_channels: int = 3):
+        super().__init__()
+        layers = []
+        c_in = in_channels
+        for v in cfg:
+            if v == "M":
+                layers.append(nn.MaxPool2D(2, stride=2))
+            else:
+                layers.append(nn.Conv2D(c_in, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(nn.BatchNorm2D(v))
+                layers.append(nn.ReLU())
+                c_in = v
+        self.features = nn.Sequential(*layers)
+        self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.classifier(x)
+        return x
+
+
+_VGG_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+         "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def vgg11(batch_norm=False, **kw) -> VGG:
+    return VGG(_VGG_CFGS[11], batch_norm=batch_norm, **kw)
+
+
+def vgg13(batch_norm=False, **kw) -> VGG:
+    return VGG(_VGG_CFGS[13], batch_norm=batch_norm, **kw)
+
+
+def vgg16(batch_norm=False, **kw) -> VGG:
+    return VGG(_VGG_CFGS[16], batch_norm=batch_norm, **kw)
+
+
+def vgg19(batch_norm=False, **kw) -> VGG:
+    return VGG(_VGG_CFGS[19], batch_norm=batch_norm, **kw)
+
+
+__all__ += ["resnet101", "resnet152", "VGG", "vgg11", "vgg13", "vgg16",
+            "vgg19"]

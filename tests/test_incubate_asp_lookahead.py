@@ -1,0 +1,86 @@
+"""incubate: LookAhead optimizer and ASP 2:4 sparsity (SURVEY §2.2
+incubate row)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+from paddle_tpu.incubate.optimizer import LookAhead
+
+
+def test_asp_mask_2_4():
+    w = paddle.to_tensor(np.array([[1., -5., 2., 0.5],
+                                   [3., 3., -4., 1.]], np.float32))
+    m = asp.create_mask(w)
+    mn = m.numpy() if hasattr(m, "numpy") else np.asarray(m)
+    assert mn.sum() == 4  # 2 of every 4 kept
+    np.testing.assert_allclose(mn[0], [0, 1, 1, 0])
+    # row 1: |-4| is always kept, plus exactly one of the tied |3|s
+    assert mn[1][2] == 1 and mn[1].sum() == 2
+
+
+def test_prune_and_decorate_keep_sparsity():
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    applied = asp.prune_model(net)
+    assert "weight" in list(applied)[0] or applied
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    asp.decorate(opt)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    for _ in range(3):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity pattern survives training steps
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+
+def test_lookahead_slow_weights():
+    paddle.seed(1)
+    net = nn.Linear(4, 4)
+    w0 = net.weight.numpy().copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                 parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    fast_after_1 = None
+    for i in range(2):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if i == 0:
+            fast_after_1 = net.weight.numpy().copy()
+    # after k=2 steps the weights are pulled back toward slow (w0)
+    w2 = net.weight.numpy()
+    # slow update: w0 + 0.5*(fast2 - w0); must differ from pure-fast path
+    assert not np.allclose(w2, fast_after_1)
+    assert np.isfinite(w2).all()
+
+
+def test_lookahead_state_dict_roundtrips_slow_weights():
+    paddle.seed(2)
+    net = nn.Linear(4, 4)
+    inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                 parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=5)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    for _ in range(3):
+        (net(x) ** 2).mean().backward()
+        opt.step(); opt.clear_grad()
+    sd = opt.state_dict()
+    assert "slow" in sd and len(sd["slow"]) == len(list(net.parameters()))
+    # restore into a fresh wrapper: slow anchors must come from the ckpt,
+    # not from the (moved) fast weights
+    inner2 = paddle.optimizer.SGD(learning_rate=0.5,
+                                  parameters=net.parameters())
+    opt2 = LookAhead(inner2, alpha=0.5, k=5)
+    opt2.set_state_dict(sd)
+    sid = id(inner2._param_groups[0])
+    np.testing.assert_allclose(np.asarray(opt2._slow[sid]),
+                               np.asarray(sd["slow"][0]))
