@@ -19,6 +19,8 @@ from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,  # noqa: 
                                LogSoftmax, Mish, PReLU, ReLU, ReLU6, Sigmoid,
                                SiLU, Softmax, Softplus, Softshrink, Softsign,
                                Swish, Tanh, Tanhshrink, ThresholdedReLU)
+from .layer.rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN,  # noqa: F401
+                        SimpleRNN, SimpleRNNCell)
 from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa: F401
                                 TransformerDecoder, TransformerDecoderLayer,
                                 TransformerEncoder, TransformerEncoderLayer)

@@ -1,0 +1,303 @@
+"""Recurrent layers — paddle.nn.{SimpleRNN,LSTM,GRU} + cells (ref:
+python/paddle/nn/layer/rnn.py over the cuDNN RNN kernels,
+paddle/phi/kernels/gpu/rnn_kernel.cu).
+
+TPU-native mechanism: the time loop is a `lax.scan` over the sequence —
+XLA compiles it into an on-device loop (no cuDNN descriptor machinery).
+Gate equations follow the cuDNN formulation (identical in paddle and
+torch), so weights transplant 1:1. Layout: batch-first [B, T, C] by
+default (`time_major=False`), multi-layer, optional bidirection.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from .layers import Layer
+from .. import initializer as I
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "SimpleRNN", "LSTM",
+           "GRU", "RNN"]
+
+
+def _uniform_init(fan, shape):
+    k = 1.0 / math.sqrt(fan)
+    return I.Uniform(-k, k)(list(shape), "float32")
+
+
+class _CellBase(Layer):
+    def __init__(self, input_size: int, hidden_size: int, gates: int):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        g = gates * hidden_size
+        self.weight_ih = self.create_parameter([g, input_size])
+        self.weight_hh = self.create_parameter([g, hidden_size])
+        self.bias_ih = self.create_parameter([g], is_bias=True)
+        self.bias_hh = self.create_parameter([g], is_bias=True)
+        for p, fan in ((self.weight_ih, hidden_size),
+                       (self.weight_hh, hidden_size),
+                       (self.bias_ih, hidden_size),
+                       (self.bias_hh, hidden_size)):
+            p._data = _uniform_init(fan, p.shape)
+
+    def _gates(self, x, h):
+        return (x @ self.weight_ih._data.T + self.bias_ih._data
+                + h @ self.weight_hh._data.T + self.bias_hh._data)
+
+    def _params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+
+
+def _norm_state(states, n):
+    """Accept Tensor, tuple of Tensors, or None-like; return raw tuple."""
+    if states is None:
+        return None
+    if isinstance(states, Tensor):
+        st = (states,)
+    else:
+        st = tuple(states)
+    if len(st) != n:
+        raise ValueError(f"expected {n} state tensor(s), got {len(st)}")
+    return tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                 for x in st)
+
+
+def _cell_forward(cell, op_name, inputs, states, n_states):
+    B = inputs.shape[0]
+    H = cell.hidden_size
+    init = _norm_state(states, n_states) or tuple(
+        jnp.zeros((B, H)) for _ in range(n_states))
+
+    def impl(x, *params):
+        out, ncarry = cell._pure_step(params, x, init)
+        return (out,) + tuple(ncarry)
+    res = apply(op_name, impl, [inputs, *cell._params()])
+    return res[0], tuple(res[1:])
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 name=None):
+        super().__init__(input_size, hidden_size, 1)
+        self.activation = activation
+
+    def _step(self, x, state):
+        return self._pure_step(
+            tuple(p._data for p in self._params()), x, state)
+
+    def _pure_step(self, params, x, state):
+        w_ih, w_hh, b_ih, b_hh = params
+        h = state[0] if isinstance(state, tuple) else state
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        nh = act(x @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+        return nh, (nh,)
+
+    def forward(self, inputs, states=None):
+        out, carry = _cell_forward(self, "simple_rnn_cell", inputs, states, 1)
+        return out, carry
+
+
+class LSTMCell(_CellBase):
+    """cuDNN gate order [i, f, g, o]."""
+
+    def __init__(self, input_size, hidden_size, name=None):
+        super().__init__(input_size, hidden_size, 4)
+
+    def _step(self, x, state):
+        return self._pure_step(
+            tuple(p._data for p in self._params()), x, state)
+
+    def _pure_step(self, params, x, state):
+        w_ih, w_hh, b_ih, b_hh = params
+        h, c = state
+        z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        H = self.hidden_size
+        i = jax.nn.sigmoid(z[..., :H])
+        f = jax.nn.sigmoid(z[..., H:2 * H])
+        g = jnp.tanh(z[..., 2 * H:3 * H])
+        o = jax.nn.sigmoid(z[..., 3 * H:])
+        nc = f * c + i * g
+        nh = o * jnp.tanh(nc)
+        return nh, (nh, nc)
+
+    def forward(self, inputs, states=None):
+        out, carry = _cell_forward(self, "lstm_cell", inputs, states, 2)
+        return out, carry
+
+
+class GRUCell(_CellBase):
+    """cuDNN gate order [r, z, n]; h' = (1-z)*n + z*h."""
+
+    def __init__(self, input_size, hidden_size, name=None):
+        super().__init__(input_size, hidden_size, 3)
+
+    def _step(self, x, state):
+        return self._pure_step(
+            tuple(p._data for p in self._params()), x, state)
+
+    def _pure_step(self, params, x, state):
+        w_ih, w_hh, b_ih, b_hh = params
+        h = state[0] if isinstance(state, tuple) else state
+        H = self.hidden_size
+        gi = x @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        r = jax.nn.sigmoid(gi[..., :H] + gh[..., :H])
+        z = jax.nn.sigmoid(gi[..., H:2 * H] + gh[..., H:2 * H])
+        n = jnp.tanh(gi[..., 2 * H:] + r * gh[..., 2 * H:])
+        nh = (1.0 - z) * n + z * h
+        return nh, (nh,)
+
+    def forward(self, inputs, states=None):
+        out, carry = _cell_forward(self, "gru_cell", inputs, states, 1)
+        return out, carry
+
+
+class RNN(Layer):
+    """Run a cell over time (ref: paddle.nn.RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False, name=None):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        if not isinstance(inputs, Tensor):
+            inputs = Tensor(jnp.asarray(inputs))
+        B = inputs.shape[0] if not self.time_major else inputs.shape[1]
+        H = self.cell.hidden_size
+        n_states = 2 if isinstance(self.cell, LSTMCell) else 1
+        init = _norm_state(initial_states, n_states) or tuple(
+            jnp.zeros((B, H)) for _ in range(n_states))
+
+        cell = self.cell
+        time_major, is_reverse = self.time_major, self.is_reverse
+
+        def impl(xx, *params):
+            # params enter through dispatch so autograd reaches the weights
+            if not time_major:
+                xx = jnp.swapaxes(xx, 0, 1)  # [T, B, C]
+            if is_reverse:
+                xx = jnp.flip(xx, 0)
+
+            def step(carry, xt):
+                out, ncarry = cell._pure_step(params, xt, carry)
+                return ncarry, out
+            carry, ys = jax.lax.scan(step, init, xx)
+            if is_reverse:
+                ys = jnp.flip(ys, 0)
+            if not time_major:
+                ys = jnp.swapaxes(ys, 0, 1)
+            return (ys,) + tuple(carry)
+        res = apply("rnn_scan", impl, [inputs, *cell._params()])
+        y, carry = res[0], tuple(res[1:])
+        return y, (carry if len(carry) > 1 else carry[0])
+
+
+class _MultiLayerRNN(Layer):
+    CELL = None
+    N_STATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"direction must be forward|bidirect, got "
+                             f"{direction!r}")
+        self.bidirect = direction != "forward"
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+        ndir = 2 if self.bidirect else 1
+        from .layers import LayerList
+        cells_fw, cells_bw, rnns_fw, rnns_bw = [], [], [], []
+        for l in range(num_layers):
+            in_sz = input_size if l == 0 else hidden_size * ndir
+            cfw = self._make_cell(in_sz, hidden_size, activation)
+            cells_fw.append(cfw)
+            rnns_fw.append(RNN(cfw, time_major=time_major))
+            if self.bidirect:
+                cbw = self._make_cell(in_sz, hidden_size, activation)
+                cells_bw.append(cbw)
+                rnns_bw.append(RNN(cbw, is_reverse=True,
+                                   time_major=time_major))
+        self.cells_fw = LayerList(cells_fw)
+        self.cells_bw = LayerList(cells_bw) if self.bidirect else None
+        # wrappers share the cells' parameters; built once, reused per call
+        self._rnns_fw = rnns_fw
+        self._rnns_bw = rnns_bw
+
+    def _make_cell(self, in_sz, hidden, activation):
+        if self.CELL is SimpleRNNCell:
+            return SimpleRNNCell(in_sz, hidden, activation)
+        return self.CELL(in_sz, hidden)
+
+    def _layer_states(self, initial_states, l, d, ndir):
+        """Slice [num_layers*ndir, B, H] stacked states for (layer, dir)."""
+        if initial_states is None:
+            return None
+        st = initial_states if isinstance(initial_states, (tuple, list)) \
+            else (initial_states,)
+        idx = l * ndir + d
+        return tuple(x[idx] for x in st)
+
+    def forward(self, inputs, initial_states=None):
+        from ..functional import dropout as F_dropout
+        from ...tensor.manipulation import concat, stack
+        x = inputs
+        ndir = 2 if self.bidirect else 1
+        finals = []
+        for l in range(self.num_layers):
+            y_fw, st_fw = self._rnns_fw[l](
+                x, self._layer_states(initial_states, l, 0, ndir))
+            if self.bidirect:
+                y_bw, st_bw = self._rnns_bw[l](
+                    x, self._layer_states(initial_states, l, 1, ndir))
+                y = concat([y_fw, y_bw], axis=-1)
+                finals.append((st_fw, st_bw))
+            else:
+                y = y_fw
+                finals.append((st_fw,))
+            if self.dropout and l < self.num_layers - 1 and self.training:
+                y = F_dropout(y, p=self.dropout, training=True)
+            x = y
+
+        # stack final states to [num_layers*ndir, B, H] (paddle layout)
+        def stk(idx):
+            parts = []
+            for per_layer in finals:
+                for st in per_layer:
+                    v = st if not isinstance(st, tuple) else st[idx]
+                    parts.append(Tensor(v) if not isinstance(v, Tensor)
+                                 else v)
+            return stack(parts, axis=0)
+        if self.N_STATES == 2:
+            out_states = (stk(0), stk(1))
+        else:
+            out_states = stk(0)
+        return x, out_states
+
+
+class SimpleRNN(_MultiLayerRNN):
+    CELL = SimpleRNNCell
+    N_STATES = 1
+
+
+class LSTM(_MultiLayerRNN):
+    CELL = LSTMCell
+    N_STATES = 2
+
+
+class GRU(_MultiLayerRNN):
+    CELL = GRUCell
+    N_STATES = 1

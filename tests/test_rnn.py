@@ -1,0 +1,115 @@
+"""Recurrent layers vs torch oracles (cuDNN gate equations — the paddle
+reference RNNs use the same formulation, so weights transplant 1:1)."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+R = np.random.RandomState(6)
+B, T, C, H = 2, 5, 3, 4
+
+
+def _transplant(cell, t_rnn, l=0, suffix=""):
+    with torch.no_grad():
+        getattr(t_rnn, f"weight_ih_l{l}{suffix}").copy_(
+            torch.tensor(cell.weight_ih.numpy()))
+        getattr(t_rnn, f"weight_hh_l{l}{suffix}").copy_(
+            torch.tensor(cell.weight_hh.numpy()))
+        getattr(t_rnn, f"bias_ih_l{l}{suffix}").copy_(
+            torch.tensor(cell.bias_ih.numpy()))
+        getattr(t_rnn, f"bias_hh_l{l}{suffix}").copy_(
+            torch.tensor(cell.bias_hh.numpy()))
+
+
+def test_lstm_matches_torch_multilayer():
+    x = R.randn(B, T, C).astype(np.float32)
+    lstm = nn.LSTM(C, H, num_layers=2)
+    tl = torch.nn.LSTM(C, H, num_layers=2, batch_first=True)
+    _transplant(lstm.cells_fw[0], tl, 0)
+    _transplant(lstm.cells_fw[1], tl, 1)
+    y, (h, c) = lstm(paddle.to_tensor(x))
+    ty, (th, tc) = tl(torch.tensor(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_bidirectional_matches_torch():
+    x = R.randn(B, T, C).astype(np.float32)
+    gru = nn.GRU(C, H, direction="bidirect")
+    tg = torch.nn.GRU(C, H, batch_first=True, bidirectional=True)
+    _transplant(gru.cells_fw[0], tg, 0)
+    _transplant(gru.cells_bw[0], tg, 0, "_reverse")
+    y, h = gru(paddle.to_tensor(x))
+    ty, th = tg(torch.tensor(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_simple_rnn_matches_torch():
+    x = R.randn(B, T, C).astype(np.float32)
+    rnn = nn.SimpleRNN(C, H)
+    tr = torch.nn.RNN(C, H, batch_first=True)
+    _transplant(rnn.cells_fw[0], tr, 0)
+    y, h = rnn(paddle.to_tensor(x))
+    ty, th = tr(torch.tensor(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_rnn_trains():
+    lstm = nn.LSTM(C, H)
+    x = paddle.to_tensor(R.randn(B, T, C).astype(np.float32))
+    y, _ = lstm(x)
+    (y ** 2).mean().backward()
+    g = lstm.cells_fw[0].weight_ih.grad
+    assert g is not None and float(paddle.abs(g).sum()) > 0
+
+
+def test_cells_single_step():
+    cell = nn.LSTMCell(C, H)
+    x = paddle.to_tensor(R.randn(B, C).astype(np.float32))
+    out, (h, c) = cell(x)
+    assert out.shape == [B, H] and c.shape == [B, H]
+    cell2 = nn.GRUCell(C, H)
+    out2, (h2,) = cell2(x)
+    assert out2.shape == [B, H]
+
+
+def test_initial_states_honored_and_torch_parity():
+    x = R.randn(B, T, C).astype(np.float32)
+    h0 = R.randn(1, B, H).astype(np.float32)
+    c0 = R.randn(1, B, H).astype(np.float32)
+    lstm = nn.LSTM(C, H)
+    tl = torch.nn.LSTM(C, H, batch_first=True)
+    _transplant(lstm.cells_fw[0], tl, 0)
+    y, _ = lstm(paddle.to_tensor(x), (paddle.to_tensor(h0),
+                                      paddle.to_tensor(c0)))
+    y0, _ = lstm(paddle.to_tensor(x))
+    assert not np.allclose(y.numpy(), y0.numpy())  # states not ignored
+    ty, _ = tl(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cell_grads_and_tensor_state():
+    cell = nn.LSTMCell(C, H)
+    x = paddle.to_tensor(R.randn(B, C).astype(np.float32))
+    out, _ = cell(x)
+    (out ** 2).mean().backward()
+    assert cell.weight_ih.grad is not None and float(
+        paddle.abs(cell.weight_ih.grad).sum()) > 0
+    # GRUCell with a bare Tensor state must equal the tuple form
+    g = nn.GRUCell(C, H)
+    h = paddle.to_tensor(R.randn(B, H).astype(np.float32))
+    o1, _ = g(x, h)
+    o2, _ = g(x, (h,))
+    np.testing.assert_allclose(o1.numpy(), o2.numpy())
