@@ -57,7 +57,7 @@ class _CellBase(Layer):
 
 
 def _norm_state(states, n):
-    """Accept Tensor, tuple of Tensors, or None-like; return raw tuple."""
+    """Accept Tensor, tuple of Tensors, or None; return tuple of Tensors."""
     if states is None:
         return None
     if isinstance(states, Tensor):
@@ -66,27 +66,37 @@ def _norm_state(states, n):
         st = tuple(states)
     if len(st) != n:
         raise ValueError(f"expected {n} state tensor(s), got {len(st)}")
-    return tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return tuple(x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
                  for x in st)
 
 
 def _cell_forward(cell, op_name, inputs, states, n_states):
     B = inputs.shape[0]
     H = cell.hidden_size
-    init = _norm_state(states, n_states) or tuple(
-        jnp.zeros((B, H)) for _ in range(n_states))
+    init = _norm_state(states, n_states)
+    if init is None:
+        init = tuple(Tensor(jnp.zeros((B, H))) for _ in range(n_states))
+    n_p = 4
 
-    def impl(x, *params):
-        out, ncarry = cell._pure_step(params, x, init)
+    def impl(x, *rest):
+        params, st = rest[:n_p], rest[n_p:]
+        out, ncarry = cell._pure_step(params, x, tuple(st))
         return (out,) + tuple(ncarry)
-    res = apply(op_name, impl, [inputs, *cell._params()])
-    return res[0], tuple(res[1:])
+    # states go through dispatch too: BPTT through chained cells and
+    # grads into user-provided initial states both need the link
+    res = apply(op_name, impl, [inputs, *cell._params(), *init])
+    carry = tuple(res[1:])
+    # paddle convention: 1-state cells return the bare tensor
+    return res[0], (carry if n_states > 1 else carry[0])
 
 
 class SimpleRNNCell(_CellBase):
     def __init__(self, input_size, hidden_size, activation="tanh",
                  name=None):
         super().__init__(input_size, hidden_size, 1)
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"activation must be tanh|relu, got "
+                             f"{activation!r}")
         self.activation = activation
 
     def _step(self, x, state):
@@ -101,8 +111,7 @@ class SimpleRNNCell(_CellBase):
         return nh, (nh,)
 
     def forward(self, inputs, states=None):
-        out, carry = _cell_forward(self, "simple_rnn_cell", inputs, states, 1)
-        return out, carry
+        return _cell_forward(self, "simple_rnn_cell", inputs, states, 1)
 
 
 class LSTMCell(_CellBase):
@@ -129,8 +138,7 @@ class LSTMCell(_CellBase):
         return nh, (nh, nc)
 
     def forward(self, inputs, states=None):
-        out, carry = _cell_forward(self, "lstm_cell", inputs, states, 2)
-        return out, carry
+        return _cell_forward(self, "lstm_cell", inputs, states, 2)
 
 
 class GRUCell(_CellBase):
@@ -156,8 +164,7 @@ class GRUCell(_CellBase):
         return nh, (nh,)
 
     def forward(self, inputs, states=None):
-        out, carry = _cell_forward(self, "gru_cell", inputs, states, 1)
-        return out, carry
+        return _cell_forward(self, "gru_cell", inputs, states, 1)
 
 
 class RNN(Layer):
@@ -175,14 +182,18 @@ class RNN(Layer):
         B = inputs.shape[0] if not self.time_major else inputs.shape[1]
         H = self.cell.hidden_size
         n_states = 2 if isinstance(self.cell, LSTMCell) else 1
-        init = _norm_state(initial_states, n_states) or tuple(
-            jnp.zeros((B, H)) for _ in range(n_states))
+        init = _norm_state(initial_states, n_states)
+        if init is None:
+            init = tuple(Tensor(jnp.zeros((B, H))) for _ in range(n_states))
 
         cell = self.cell
         time_major, is_reverse = self.time_major, self.is_reverse
+        n_p = 4
 
-        def impl(xx, *params):
-            # params enter through dispatch so autograd reaches the weights
+        def impl(xx, *rest):
+            # params AND initial states enter through dispatch so autograd
+            # reaches the weights and any state provider (e.g. an encoder)
+            params, st = rest[:n_p], tuple(rest[n_p:])
             if not time_major:
                 xx = jnp.swapaxes(xx, 0, 1)  # [T, B, C]
             if is_reverse:
@@ -191,13 +202,13 @@ class RNN(Layer):
             def step(carry, xt):
                 out, ncarry = cell._pure_step(params, xt, carry)
                 return ncarry, out
-            carry, ys = jax.lax.scan(step, init, xx)
+            carry, ys = jax.lax.scan(step, st, xx)
             if is_reverse:
                 ys = jnp.flip(ys, 0)
             if not time_major:
                 ys = jnp.swapaxes(ys, 0, 1)
             return (ys,) + tuple(carry)
-        res = apply("rnn_scan", impl, [inputs, *cell._params()])
+        res = apply("rnn_scan", impl, [inputs, *cell._params(), *init])
         y, carry = res[0], tuple(res[1:])
         return y, (carry if len(carry) > 1 else carry[0])
 
@@ -289,8 +300,17 @@ class _MultiLayerRNN(Layer):
 
 
 class SimpleRNN(_MultiLayerRNN):
+    """paddle positional order: (input_size, hidden_size, num_layers,
+    activation, direction, ...)."""
     CELL = SimpleRNNCell
     N_STATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 activation="tanh", direction="forward", time_major=False,
+                 dropout=0.0, name=None):
+        super().__init__(input_size, hidden_size, num_layers=num_layers,
+                         direction=direction, time_major=time_major,
+                         dropout=dropout, activation=activation)
 
 
 class LSTM(_MultiLayerRNN):

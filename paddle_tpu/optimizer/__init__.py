@@ -11,6 +11,7 @@ of the reference's fused/multi-tensor optimizer kernels
 
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
@@ -407,3 +408,129 @@ class Lamb(Optimizer):
         trust = jnp.where(jnp.logical_and(w_norm > 0, u_norm > 0),
                           w_norm / u_norm, 1.0)
         self._write_param(p, w - lr_v * trust * upd)
+
+
+class NAdam(Adam):
+    """Nesterov-momentum Adam (ref: python/paddle/optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, False, multi_precision)
+        self._psi = momentum_decay
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        t = self._step_count
+        # momentum schedule mu_t (torch/paddle nadam)
+        mu_t = self._b1 * (1.0 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = self._acc("mu_prod", p,
+                            init=jnp.ones((), w.dtype)) * mu_t
+        self._set_acc("mu_prod", p, mu_prod)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._b1 * m + (1 - self._b1) * g
+        v = self._b2 * v + (1 - self._b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                + (1 - mu_t) * g / (1 - mu_prod))
+        vhat = v / (1 - self._b2 ** t)
+        self._write_param(p, w - lr_v * mhat / (jnp.sqrt(vhat) + self._eps))
+
+
+class RAdam(Adam):
+    """Rectified Adam (ref: python/paddle/optimizer/radam.py)."""
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        t = self._step_count
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._b1 * m + (1 - self._b1) * g
+        v = self._b2 * v + (1 - self._b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._b1 ** t)
+        rho_inf = 2.0 / (1.0 - self._b2) - 1.0
+        b2t = self._b2 ** t
+        rho_t = rho_inf - 2.0 * t * b2t / (1.0 - b2t)
+        if rho_t > 5.0:
+            vhat = jnp.sqrt(v / (1 - b2t))
+            r = math.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                          / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            self._write_param(p, w - lr_v * r * mhat / (vhat + self._eps))
+        else:
+            self._write_param(p, w - lr_v * mhat)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (ref: python/paddle/optimizer/rprop.py) —
+    full-batch sign-based step-size adaptation."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._eta_minus, self._eta_plus = etas
+        self._lr_min, self._lr_max = learning_rate_range
+        self._init_lr = learning_rate if isinstance(learning_rate, float) \
+            else 1e-3
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = grad.astype(w.dtype)
+        prev = self._acc("prev_grad", p)
+        step = self._acc("step_size", p,
+                         init=jnp.full(w.shape, self._init_lr, w.dtype))
+        sign = jnp.sign(g * prev)
+        factor = jnp.where(sign > 0, self._eta_plus,
+                           jnp.where(sign < 0, self._eta_minus, 1.0))
+        step = jnp.clip(step * factor, self._lr_min, self._lr_max)
+        # on sign change, zero the gradient (classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        self._set_acc("prev_grad", p, g_eff)
+        self._set_acc("step_size", p, step)
+        self._write_param(p, w - jnp.sign(g_eff) * step)
+
+
+class ASGD(Optimizer):
+    """Averaged SGD: plain SGD fast weights plus a running average of the
+    iterates (Polyak averaging), exposed via averaged_parameters(). NOTE:
+    the paddle reference (python/paddle/optimizer/asgd.py) averages the
+    last batch_num GRADIENTS instead; that windowed-gradient mode is not
+    implemented, so batch_num > 1 raises rather than silently diverging."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if batch_num != 1:
+            raise NotImplementedError(
+                "ASGD batch_num > 1 (gradient-window averaging) is not "
+                "implemented; only iterate averaging (batch_num=1)")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+
+    def _update_param(self, p, grad, lr_v):
+        w = self._master_weight(p)
+        g = self._coupled_wd_grad(w, grad.astype(w.dtype))
+        new_w = w - lr_v * g
+        avg = self._acc("averaged_param", p, init=new_w)
+        t = self._step_count
+        avg = avg + (new_w - avg) / t
+        self._set_acc("averaged_param", p, avg)
+        self._write_param(p, new_w)
+
+    def averaged_parameters(self):
+        store = self._accumulators.get("averaged_param", {})
+        return {id(p): store[id(p)] for p in self._param_groups
+                if id(p) in store}
+
+
+__all__ += ["NAdam", "RAdam", "Rprop", "ASGD"]

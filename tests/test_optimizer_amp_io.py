@@ -206,3 +206,40 @@ def test_amp_backward_through_cast_boundary():
     assert str(net.weight.grad.dtype) == "bfloat16"
     o.step()
     o.clear_grad()
+
+
+def test_optimizer_tail_matches_torch():
+    """NAdam/RAdam/Rprop step-for-step vs torch (same update equations;
+    RAdam run long enough to cross the rho_t>5 rectification threshold)."""
+    import torch
+    import jax.numpy as jnp
+    import paddle_tpu.optimizer as O
+
+    w0 = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    x = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+
+    def run(make_p, make_t, steps):
+        lin = nn.Linear(4, 3, bias_attr=False)
+        lin.weight._data = jnp.asarray(w0)
+        po = make_p(lin)
+        tw = torch.nn.Parameter(torch.tensor(w0))
+        to = make_t([tw])
+        for _ in range(steps):
+            loss = (lin(paddle.to_tensor(x)) ** 2).mean()
+            loss.backward(); po.step(); po.clear_grad()
+            tl = ((torch.tensor(x) @ tw) ** 2).mean()
+            tl.backward(); to.step(); to.zero_grad()
+        return np.abs(lin.weight.numpy() - tw.detach().numpy()).max()
+
+    assert run(lambda l: O.NAdam(learning_rate=0.01,
+                                 parameters=l.parameters()),
+               lambda ps: torch.optim.NAdam(ps, lr=0.01), 5) < 1e-4
+    # beta2=0.9 makes rho_inf=19 and rho_t cross 5 within a few steps,
+    # covering the rectified branch
+    assert run(lambda l: O.RAdam(learning_rate=0.01, beta2=0.9,
+                                 parameters=l.parameters()),
+               lambda ps: torch.optim.RAdam(ps, lr=0.01,
+                                            betas=(0.9, 0.9)), 8) < 1e-4
+    assert run(lambda l: O.Rprop(learning_rate=0.01,
+                                 parameters=l.parameters()),
+               lambda ps: torch.optim.Rprop(ps, lr=0.01), 5) < 1e-5

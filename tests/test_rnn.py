@@ -79,9 +79,53 @@ def test_cells_single_step():
     x = paddle.to_tensor(R.randn(B, C).astype(np.float32))
     out, (h, c) = cell(x)
     assert out.shape == [B, H] and c.shape == [B, H]
+    # paddle convention: 1-state cells return the bare state tensor
     cell2 = nn.GRUCell(C, H)
-    out2, (h2,) = cell2(x)
-    assert out2.shape == [B, H]
+    out2, h2 = cell2(x)
+    assert out2.shape == [B, H] and h2.shape == [B, H]
+
+
+def test_bptt_through_chained_cells_matches_torch():
+    """Gradients must flow through the state chain (BPTT), incl. into a
+    state-producing module."""
+    cell = nn.LSTMCell(C, H)
+    tc = torch.nn.LSTMCell(C, H)
+    with torch.no_grad():
+        tc.weight_ih.copy_(torch.tensor(cell.weight_ih.numpy()))
+        tc.weight_hh.copy_(torch.tensor(cell.weight_hh.numpy()))
+        tc.bias_ih.copy_(torch.tensor(cell.bias_ih.numpy()))
+        tc.bias_hh.copy_(torch.tensor(cell.bias_hh.numpy()))
+    xs = [R.randn(B, C).astype(np.float32) for _ in range(4)]
+    st = None
+    for xv in xs:
+        out, st = cell(paddle.to_tensor(xv), st)
+    (out ** 2).mean().backward()
+    tst = None
+    for xv in xs:
+        th, tcc = tc(torch.tensor(xv), tst)
+        tst = (th, tcc)
+    (th ** 2).mean().backward()
+    np.testing.assert_allclose(cell.weight_hh.grad.numpy(),
+                               tc.weight_hh.grad.numpy(), rtol=1e-3,
+                               atol=1e-5)
+
+    # encoder providing the initial state must receive gradients
+    enc = nn.Linear(C, H)
+    x0 = paddle.to_tensor(R.randn(B, C).astype(np.float32))
+    h0 = enc(x0)
+    g = nn.GRUCell(C, H)
+    out, _ = g(paddle.to_tensor(xs[0]), h0)
+    (out ** 2).mean().backward()
+    assert enc.weight.grad is not None and float(
+        paddle.abs(enc.weight.grad).sum()) > 0
+
+
+def test_simple_rnn_positional_activation():
+    import pytest
+    rnn = nn.SimpleRNN(C, H, 1, "relu")  # paddle positional order
+    assert rnn.cells_fw[0].activation == "relu"
+    with pytest.raises(ValueError):
+        nn.SimpleRNNCell(C, H, activation="sigmoid")
 
 
 def test_initial_states_honored_and_torch_parity():
