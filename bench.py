@@ -103,4 +103,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # the tunneled device occasionally drops a request mid-run
+    # ("read body: response body closed", backend INTERNAL); one retry
+    # separates a transient transport hiccup from a real failure
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        print(f"bench attempt 1 failed ({type(e).__name__}: {e}); "
+              f"retrying once", file=sys.stderr)
+        main()
