@@ -20,6 +20,7 @@ from .collective import (ReduceOp, all_gather, all_reduce, alltoall,  # noqa: F4
 from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
                               RowParallelLinear, VocabParallelEmbedding,
                               annotate_sequence_parallel)
+from .pp_schedule import generate_schedule  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .ring_attention import (RingFlashAttention, ring_attention,  # noqa: F401
                              ulysses_attention)

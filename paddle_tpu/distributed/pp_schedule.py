@@ -1,0 +1,271 @@
+"""Pipeline-schedule generator: FThenB / 1F1B / interleaved VPP / ZBH1.
+
+Reference parity: python/paddle/distributed/passes/pipeline_scheduler_pass.py
+(schedules FThenB, 1F1B, Eager1F1B, VPP, ZBH1 — SURVEY §2.3 P6) and
+fleet/meta_parallel/pipeline_parallel.py's runtime orderings.
+
+TPU-native role: the compiled SPMD pipeline (`distributed/pipeline.py`)
+expresses the schedule as a scan over ticks, and XLA's latency-hiding
+scheduler owns actual compute/comm overlap. This module is the *explicit*
+schedule layer the reference exposes: it generates per-stage timetables
+(which op — forward F, backward-dgrad B, backward-wgrad W — of which
+microbatch/chunk runs at which tick), validates dependencies, and accounts
+bubbles and peak in-flight activations. Uses: host-driven interleaved
+execution across DCN slices, schedule visualization/debugging, and the
+auto-tuner's analytic cost model (bubble ratio per candidate pp degree).
+
+Model: every op costs one tick; stage-to-stage transfer is free (latency is
+folded into the dependency "completes before consumer's tick"). ZBH1 splits
+the backward into B (activation/dgrad, unlocks the upstream stage) and W
+(weight grad, pure filler work) — scheduling W into warm-up/drain holes is
+exactly the zero-bubble-H1 trick.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "Op", "Schedule", "generate_schedule", "SCHEDULERS",
+    "fthenb_schedule", "one_f_one_b_schedule", "interleaved_1f1b_schedule",
+    "zbh1_schedule",
+]
+
+
+@dataclass(frozen=True)
+class Op:
+    """One unit of pipeline work.
+
+    phase: 'F' forward, 'B' backward-dgrad, 'W' backward-wgrad.
+    chunk: virtual-stage index (0 unless VPP); the model chunk this op runs
+    on. Global layer block = chunk * n_stages + stage (Megatron ordering).
+    """
+    stage: int
+    mb: int
+    phase: str
+    chunk: int = 0
+
+
+class Schedule:
+    """Per-stage timetables: timeline[s][t] is an Op or None (bubble)."""
+
+    def __init__(self, n_stages: int, n_microbatches: int, n_chunks: int,
+                 timeline: List[List[Optional[Op]]], split_w: bool):
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.n_chunks = n_chunks
+        self.timeline = timeline
+        self.split_w = split_w
+
+    @property
+    def n_ticks(self) -> int:
+        return max(len(row) for row in self.timeline)
+
+    def bubble_ratio(self) -> float:
+        """Idle fraction of the stage×tick grid (the pipeline bubble)."""
+        total = self.n_stages * self.n_ticks
+        busy = sum(1 for row in self.timeline for op in row if op is not None)
+        return 1.0 - busy / total
+
+    def peak_inflight(self, stage: int) -> int:
+        """Max microbatch-activations held at `stage` (F done, B not yet) —
+        the memory figure 1F1B bounds at ~n_stages vs GPipe's M."""
+        live = 0
+        peak = 0
+        for op in self.timeline[stage]:
+            if op is None:
+                continue
+            if op.phase == "F":
+                live += 1
+                peak = max(peak, live)
+            elif op.phase == "B":
+                live -= 1
+        return peak
+
+    def validate(self) -> None:
+        """Assert completeness + dependency order (F chain down the virtual
+        stages, B chain back up, W after its B, one op per stage-tick)."""
+        S, M, C = self.n_stages, self.n_microbatches, self.n_chunks
+        done: Dict[Tuple, int] = {}  # (phase, vstage, mb) -> finish tick
+        for s, row in enumerate(self.timeline):
+            for t, op in enumerate(row):
+                if op is None:
+                    continue
+                if op.stage != s:
+                    raise AssertionError(f"op {op} on wrong row {s}")
+                key = (op.phase, op.chunk * S + s, op.mb)
+                if key in done:
+                    raise AssertionError(f"duplicate {key}")
+                done[key] = t + 1
+        phases = ["F", "B", "W"] if self.split_w else ["F", "B"]
+        V = S * C
+        for mb in range(M):
+            for v in range(V):
+                for ph in phases:
+                    if (ph, v, mb) not in done:
+                        raise AssertionError(f"missing {(ph, v, mb)}")
+        for (ph, v, mb), t_end in done.items():
+            t_start = t_end - 1
+            if ph == "F" and v > 0:
+                if done[("F", v - 1, mb)] > t_start:
+                    raise AssertionError(f"F dep violated at v={v} mb={mb}")
+            if ph == "B":
+                prev = done[("B", v + 1, mb)] if v < V - 1 \
+                    else done[("F", V - 1, mb)]
+                if prev > t_start:
+                    raise AssertionError(f"B dep violated at v={v} mb={mb}")
+            if ph == "W" and done[("B", v, mb)] > t_start:
+                raise AssertionError(f"W dep violated at v={v} mb={mb}")
+
+
+def _simulate(n_stages: int, n_microbatches: int, n_chunks: int,
+              policy, split_w: bool) -> Schedule:
+    """Greedy tick simulator. Each tick, every stage runs the ready op its
+    `policy(stage, ready_ops, issued_counts)` picks (or bubbles).
+
+    Readiness is evaluated against ops finished on PREVIOUS ticks, so a
+    consumer never runs in the same tick its producer finishes — the 1-tick
+    p2p latency of the reference's send/recv handshake.
+    """
+    S, M, C = n_stages, n_microbatches, n_chunks
+    V = S * C
+    done: Dict[Tuple, int] = {}
+    todo = {("F", c * S + s, m) for s in range(S) for c in range(C)
+            for m in range(M)}
+    todo |= {("B", c * S + s, m) for s in range(S) for c in range(C)
+             for m in range(M)}
+    if split_w:
+        todo |= {("W", c * S + s, m) for s in range(S) for c in range(C)
+                 for m in range(M)}
+    timeline: List[List[Optional[Op]]] = [[] for _ in range(S)]
+    issued = [dict(F=0, B=0, W=0) for _ in range(S)]
+    t = 0
+    limit = 16 * (len(todo) + S)  # safety net; real schedules end well under
+    while todo and t < limit:
+        picks = []
+        for s in range(S):
+            ready = []
+            for (ph, v, m) in todo:
+                if v % S != s:
+                    continue
+                if ph == "F":
+                    ok = v == 0 or done.get(("F", v - 1, m), 10**9) <= t
+                elif ph == "B":
+                    prev = ("B", v + 1, m) if v < V - 1 else ("F", V - 1, m)
+                    ok = done.get(prev, 10**9) <= t
+                else:
+                    ok = done.get(("B", v, m), 10**9) <= t
+                if ok:
+                    ready.append(Op(s, m, ph, v // S))
+            picks.append(policy(s, ready, issued[s]))
+        for s, op in enumerate(picks):
+            timeline[s].append(op)
+            if op is not None:
+                todo.discard((op.phase, op.chunk * S + s, op.mb))
+                done[(op.phase, op.chunk * S + s, op.mb)] = t + 1
+                issued[s][op.phase] += 1
+        t += 1
+    if todo:
+        raise RuntimeError(f"schedule did not converge: {len(todo)} ops left")
+    while any(timeline[s] and timeline[s][-1] is None for s in range(S)):
+        if all(not timeline[s] or timeline[s][-1] is None for s in range(S)):
+            for s in range(S):
+                if timeline[s]:
+                    timeline[s].pop()
+        else:
+            break
+    n = max(len(row) for row in timeline)
+    for row in timeline:
+        row.extend([None] * (n - len(row)))
+    return Schedule(S, M, C, timeline, split_w)
+
+
+def _pick(ready: List[Op], phase: str, chunk_order=None) -> Optional[Op]:
+    cand = [op for op in ready if op.phase == phase]
+    if not cand:
+        return None
+    if chunk_order == "reversed":
+        return min(cand, key=lambda o: (-o.chunk, o.mb))
+    return min(cand, key=lambda o: (o.chunk, o.mb))
+
+
+def fthenb_schedule(n_stages: int, n_microbatches: int) -> Schedule:
+    """GPipe order: all forwards, then all backwards. Peak in-flight = M."""
+    def policy(s, ready, issued):
+        return _pick(ready, "F") or _pick(ready, "B")
+    return _simulate(n_stages, n_microbatches, 1, policy, split_w=False)
+
+
+def one_f_one_b_schedule(n_stages: int, n_microbatches: int) -> Schedule:
+    """1F1B: warm up S-s forwards, then alternate; peak in-flight ≤ S-s.
+
+    Same bubble as FThenB (2(S-1) tick overhead) but activation memory is
+    bounded by the stage depth instead of the microbatch count — the reason
+    the reference defaults to it for pretrain.
+    """
+    S = n_stages
+
+    def policy(s, ready, issued):
+        in_flight = issued["F"] - issued["B"]
+        if in_flight >= S - s:  # steady state: drain one before next F
+            return _pick(ready, "B")  # cap in-flight: bubble rather than F
+        return _pick(ready, "F") or _pick(ready, "B")
+    return _simulate(S, n_microbatches, 1, policy, split_w=False)
+
+
+def interleaved_1f1b_schedule(n_stages: int, n_microbatches: int,
+                              n_chunks: int) -> Schedule:
+    """VPP: each stage owns `n_chunks` virtual stages (chunk c, stage s →
+    virtual stage c·S+s). Chunk-cyclic forwards shrink the warm-up bubble by
+    ~1/n_chunks at the cost of more in-flight microbatches."""
+    S = n_stages
+
+    def policy(s, ready, issued):
+        in_flight = issued["F"] - issued["B"]
+        if in_flight >= max(1, (S - s) + (n_chunks - 1) * S // 2):
+            op = _pick(ready, "B", chunk_order="reversed")
+            if op is not None:
+                return op
+        return _pick(ready, "F") or _pick(ready, "B",
+                                          chunk_order="reversed")
+    return _simulate(S, n_microbatches, n_chunks, policy, split_w=False)
+
+
+def zbh1_schedule(n_stages: int, n_microbatches: int) -> Schedule:
+    """ZBH1 (zero-bubble, memory class H1): backward split into dgrad B
+    (critical path) and wgrad W (filler). B/F follow 1F1B; W fills what
+    would otherwise be drain bubbles, so idle time drops below 1F1B while
+    peak activation memory stays at the 1F1B bound."""
+    S = n_stages
+
+    def policy(s, ready, issued):
+        in_flight = issued["F"] - issued["B"]
+        if in_flight >= S - s:
+            # at the 1F1B memory cap: drain a dgrad, else fill the would-be
+            # bubble with a deferred weight-grad (the ZB trick) — never F
+            return _pick(ready, "B") or _pick(ready, "W")
+        return _pick(ready, "F") or _pick(ready, "B") or _pick(ready, "W")
+    return _simulate(S, n_microbatches, 1, policy, split_w=True)
+
+
+SCHEDULERS = {
+    "FThenB": fthenb_schedule,
+    "1F1B": one_f_one_b_schedule,
+    "VPP": interleaved_1f1b_schedule,
+    "ZBH1": zbh1_schedule,
+}
+
+
+def generate_schedule(mode: str, n_stages: int, n_microbatches: int,
+                      n_chunks: int = 1) -> Schedule:
+    """`pipeline_scheduler_pass`-parity entry: mode ∈ SCHEDULERS."""
+    if mode not in SCHEDULERS:
+        raise ValueError(f"unknown schedule {mode!r}; "
+                         f"options: {sorted(SCHEDULERS)}")
+    if mode == "VPP":
+        return interleaved_1f1b_schedule(n_stages, n_microbatches, n_chunks)
+    if n_chunks != 1:
+        raise ValueError(f"n_chunks={n_chunks} requires mode='VPP'; "
+                         f"{mode} schedules a single chunk per stage")
+    return SCHEDULERS[mode](n_stages, n_microbatches)

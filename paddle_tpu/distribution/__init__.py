@@ -704,3 +704,120 @@ def _kl_gumbel(p, q):
 
 __all__ += ["Gumbel", "Cauchy", "StudentT", "Chi2", "Binomial",
             "ContinuousBernoulli", "MultivariateNormal", "Independent"]
+
+
+class ExponentialFamily(Distribution):
+    """ref: paddle.distribution.ExponentialFamily (python/paddle/
+    distribution/exponential_family.py). p(x) = h(x)·exp(θ·T(x) − A(θ)).
+
+    Subclasses provide `_natural_parameters` (tuple of arrays θ),
+    `_log_normalizer(*θ)` (A), and `_mean_carrier_measure` (E[log h]).
+    `entropy` uses the Bregman identity H = A(θ) − Σ θ_i·∂A/∂θ_i −
+    E[log h(x)]; the reference differentiates A with autograd — here it is
+    one `jax.grad` over the natural-parameter tuple.
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def _entropy(self):
+        nparams = tuple(jnp.asarray(p, jnp.float32)
+                        for p in self._natural_parameters)
+        lgn = self._log_normalizer(*nparams)
+        grads = jax.grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(nparams)
+        result = -self._mean_carrier_measure + lgn
+        nb = len(self.batch_shape)
+        for p, g in zip(nparams, grads):
+            term = p * g
+            # vector natural parameters: Σ θ_i·∂A/∂θ_i reduces the event
+            # dims (the reference flattens to batch + (-1,) and sums)
+            while term.ndim > nb:
+                term = jnp.sum(term, -1)
+            result = result - term
+        return result
+
+
+class LKJCholesky(Distribution):
+    """ref: paddle.distribution.LKJCholesky (python/paddle/distribution/
+    lkj_cholesky.py): LKJ prior over Cholesky factors of d×d correlation
+    matrices, density ∝ |det L|^(2(η−1))·Π L_ii^(d−i−1)-style diagonal
+    weighting (LKJ 2009). Sampling uses the onion construction: per-row
+    Beta squared-radii + uniform hypersphere directions.
+    """
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        if sample_method not in ("onion",):
+            raise NotImplementedError(
+                f"sample_method {sample_method!r}: only 'onion' is "
+                "implemented (cvine gives the same distribution)")
+        self.dim = int(dim)
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def _sample(self, shape):
+        d = self.dim
+        shp = shape + self.batch_shape
+        conc = jnp.broadcast_to(self.concentration, shp)
+        # per-row Beta(α_i, β_i): row 0 is a placeholder (no off-diagonal)
+        marginal = conc[..., None] + 0.5 * (d - 2)
+        offset = jnp.concatenate(
+            [jnp.zeros((1,), jnp.float32),
+             jnp.arange(d - 1, dtype=jnp.float32)])
+        conc1 = offset + 0.5
+        conc0 = marginal - 0.5 * offset
+        y = jax.random.beta(next_key(), jnp.broadcast_to(conc1, shp + (d,)),
+                            jnp.broadcast_to(conc0, shp + (d,)))[..., None]
+        u = jnp.tril(jax.random.normal(next_key(), shp + (d, d)), -1)
+        norm = jnp.linalg.norm(u, axis=-1, keepdims=True)
+        u_sphere = u / jnp.where(norm == 0, 1.0, norm)
+        u_sphere = u_sphere.at[..., 0, :].set(0.0)
+        w = jnp.sqrt(y) * u_sphere
+        diag = jnp.sqrt(jnp.clip(1.0 - jnp.sum(w ** 2, -1), 1e-38, None))
+        return w + diag[..., :, None] * jnp.eye(d)
+
+    def _log_prob(self, value):
+        d = self.dim
+        diag = jnp.diagonal(value, axis1=-2, axis2=-1)[..., 1:]
+        order = jnp.arange(2, d + 1, dtype=jnp.float32)
+        order = 2.0 * (self.concentration[..., None] - 1.0) + d - order
+        unnorm = jnp.sum(order * jnp.log(diag), -1)
+        dm1 = d - 1
+        alpha = self.concentration + 0.5 * dm1
+        denom = jax.scipy.special.gammaln(alpha) * dm1
+        numer = jax.scipy.special.multigammaln(alpha - 0.5, dm1)
+        pi_const = 0.5 * dm1 * math.log(math.pi)
+        return unnorm - (pi_const + numer - denom)
+
+    def _mean(self):
+        raise NotImplementedError("LKJCholesky mean is not defined")
+
+    def _variance(self):
+        raise NotImplementedError("LKJCholesky variance is not defined")
+
+
+from . import transform  # noqa: E402,F401
+from .transform import (AbsTransform, AffineTransform,  # noqa: E402,F401
+                        ChainTransform, ExpTransform, IndependentTransform,
+                        PowerTransform, ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform,
+                        TransformedDistribution)
+
+__all__ += ["ExponentialFamily", "LKJCholesky", "Transform", "AbsTransform",
+            "AffineTransform", "ChainTransform", "ExpTransform",
+            "IndependentTransform", "PowerTransform", "ReshapeTransform",
+            "SigmoidTransform", "SoftmaxTransform", "StackTransform",
+            "StickBreakingTransform", "TanhTransform",
+            "TransformedDistribution"]

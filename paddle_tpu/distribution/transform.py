@@ -1,0 +1,396 @@
+"""Transform family + TransformedDistribution (ref: python/paddle/
+distribution/transform.py, transformed_distribution.py — SURVEY §2.2 misc
+numerics: "~25 distributions + transforms + KL").
+
+Each Transform is a (mostly) bijective map with log-det-Jacobian tracking:
+forward(x), inverse(y), forward_log_det_jacobian(x). `event_rank_in/out`
+record how many trailing dims a single application consumes/produces so
+TransformedDistribution can sum base log-probs and Jacobian terms over the
+right dims. All math is jnp — traceable under jit, grads via JAX autodiff.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import Distribution, _arr
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution",
+]
+
+
+def _sum_rightmost(x, n):
+    for _ in range(n):
+        x = jnp.sum(x, axis=-1)
+    return x
+
+
+class Transform:
+    """Base transform. Subclasses implement _forward/_inverse/
+    _forward_log_det_jacobian on raw jnp arrays."""
+
+    _is_injective = True
+    event_rank_in = 0   # trailing dims one application consumes
+    event_rank_out = 0  # trailing dims it produces
+
+    # -- public API (Tensor in/out, paddle parity) --
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _arr(y)
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # -- subclass hooks --
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x|. Non-injective; inverse returns the positive branch (the
+    convention the reference documents)."""
+    _is_injective = False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("AbsTransform is not injective")
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0)."""
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return 1.0 / (1.0 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log σ'(x) = -softplus(-x) - softplus(x)
+        sp = lambda t: jnp.logaddexp(t, 0.0)
+        return -sp(-x) - sp(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh²x) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jnp.logaddexp(-2.0 * x, 0.0))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax-normalized exp; inverse = log then center. Not a
+    bijection on the full space (paddle parity: log-det unsupported)."""
+    event_rank_in = 1
+    event_rank_out = 1
+
+    def _forward(self, x):
+        z = jnp.exp(x - jnp.max(x, -1, keepdims=True))
+        return z / jnp.sum(z, -1, keepdims=True)
+
+    def _inverse(self, y):
+        lp = jnp.log(y)
+        return lp - jnp.mean(lp, -1, keepdims=True)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform has no log-det (not "
+                                  "injective on R^n)")
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} → open simplex Δ^{n} (n+1 coords summing to 1) via the
+    stick-breaking construction."""
+    event_rank_in = 1
+    event_rank_out = 1
+
+    def _forward(self, x):
+        n = x.shape[-1]
+        offset = jnp.arange(n, 0, -1, dtype=x.dtype)
+        z = 1.0 / (1.0 + jnp.exp(-(x - jnp.log(offset))))
+        zcp = jnp.cumprod(1.0 - z, -1)
+        lead = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, lead], -1) * \
+            jnp.concatenate([lead, zcp], -1)
+
+    def _inverse(self, y):
+        n = y.shape[-1] - 1
+        offset = jnp.arange(n, 0, -1, dtype=y.dtype)
+        remainder = 1.0 - jnp.cumsum(y[..., :-1], -1)
+        remainder = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), remainder], -1)[..., :-1]
+        z = y[..., :-1] / remainder
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        # ldj = Σ_i [ -t_i + log σ(t_i) + log y_i ]  with t = x - log(offset)
+        # and y_i = σ(t_i)·Π_{j<i}(1-σ(t_j)) the stick lengths
+        n = x.shape[-1]
+        offset = jnp.arange(n, 0, -1, dtype=x.dtype)
+        t = x - jnp.log(offset)
+        sp = lambda v: jnp.logaddexp(v, 0.0)   # softplus
+        log_sig = -sp(-t)
+        lead = jnp.zeros(x.shape[:-1] + (1,), x.dtype)
+        log_rem = jnp.concatenate(
+            [lead, jnp.cumsum(-sp(t), -1)[..., :-1]], -1)
+        log_y = log_sig + log_rem
+        return jnp.sum(-t + log_sig + log_y, -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape: Sequence[int],
+                 out_event_shape: Sequence[int]):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if math.prod(self.in_event_shape) != math.prod(self.out_event_shape):
+            raise ValueError("element counts differ")
+        self.event_rank_in = len(self.in_event_shape)
+        self.event_rank_out = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        if tuple(shape[len(shape) - n:]) != self.in_event_shape:
+            raise ValueError("shape mismatch")
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        if tuple(shape[len(shape) - n:]) != self.out_event_shape:
+            raise ValueError("shape mismatch")
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    """Promote `reinterpreted_batch_rank` trailing dims to event dims: the
+    log-det sums over them."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self.event_rank_in = base.event_rank_in + self.rank
+        self.event_rank_out = base.event_rank_out + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _sum_rightmost(self.base._forward_log_det_jacobian(x),
+                              self.rank)
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis`."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, x, method):
+        parts = jnp.split(x, x.shape[self.axis], self.axis)
+        if len(parts) != len(self.transforms):
+            raise ValueError("stack size != number of transforms")
+        outs = [getattr(t, method)(jnp.squeeze(p, self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map(x, "_forward_log_det_jacobian")
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+        # chain event ranks account for rank changes along the chain (the
+        # reference/compose semantics): walk from each end, carrying the
+        # rank delta and taking the max with each part's own requirement
+        ev = self.transforms[-1].event_rank_out if self.transforms else 0
+        for t in reversed(self.transforms):
+            ev += t.event_rank_in - t.event_rank_out
+            ev = max(ev, t.event_rank_in)
+        self.event_rank_in = ev
+        ev = self.transforms[0].event_rank_in if self.transforms else 0
+        for t in self.transforms:
+            ev += t.event_rank_out - t.event_rank_in
+            ev = max(ev, t.event_rank_out)
+        self.event_rank_out = ev
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        rank = self.event_rank_in
+        for t in self.transforms:
+            ldj = t._forward_log_det_jacobian(x)
+            total = total + _sum_rightmost(ldj, rank - t.event_rank_in)
+            rank += t.event_rank_out - t.event_rank_in
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class TransformedDistribution(Distribution):
+    """ref: paddle.distribution.TransformedDistribution(base, transforms).
+
+    sample = chain(base.sample); log_prob(y) folds the inverse log-det chain
+    into the base log-prob, summing over dims promoted to event dims.
+    """
+
+    def __init__(self, base: Distribution, transforms: Sequence[Transform]):
+        self.base = base
+        chain = ChainTransform(list(transforms))
+        self.transforms = chain.transforms
+        self._chain = chain
+        base_event = base.event_shape
+        shape = base.batch_shape + base_event
+        out_shape = chain.forward_shape(shape)
+        event_rank = chain.event_rank_out + max(
+            len(base_event) - chain.event_rank_in, 0)
+        super().__init__(out_shape[:len(out_shape) - event_rank],
+                         out_shape[len(out_shape) - event_rank:])
+
+    def _sample(self, shape):
+        x = self.base._sample(shape)
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _log_prob(self, y):
+        # walk the chain backwards accumulating inverse log-dets, tracking
+        # the event rank of the value at each altitude
+        x = y
+        lp = 0.0
+        event_rank = len(self.event_shape)
+        for t in reversed(self.transforms):
+            x_prev = t._inverse(x)
+            event_rank += t.event_rank_in - t.event_rank_out
+            lp = lp - _sum_rightmost(t._forward_log_det_jacobian(x_prev),
+                                     event_rank - t.event_rank_in)
+            x = x_prev
+        lp = lp + _sum_rightmost(self.base._log_prob(x),
+                                 event_rank - len(self.base.event_shape))
+        return lp

@@ -1,0 +1,221 @@
+"""DeepSeek-V2 family: MLA (multi-head latent attention) + MoE FFN.
+
+Reference capability: PaddleNLP paddlenlp/transformers/deepseek_v2/
+modeling.py (SURVEY §2.4 — DeepSeekMoE baseline row). The defining feature
+over the Qwen2-MoE pattern (models/moe_llm.py) is MLA: queries and KV are
+low-rank compressed (q_lora_rank / kv_lora_rank) and position information
+travels in a small decoupled rope sub-head — a single shared k_pe head
+(MQA-style) plus per-head q_pe — so the KV cache is the compressed latent
+instead of full K/V.
+
+TPU-first notes: the compressions are small dense matmuls (MXU-friendly);
+the decoupled-rope concat keeps the big nope dims rope-free so XLA fuses
+the kv_b expansion into the attention einsum; attention math is einsum-based
+because q/k head dim (nope+rope) differs from the v head dim — the flash
+kernel path applies when they match.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed.parallel_layers import MP_AXIS, ParallelCrossEntropy
+from .llama import LlamaMLP, apply_rope, precompute_rope
+from .moe_llm import MoEConfig
+from ..incubate.moe import MoELayer
+
+__all__ = ["DeepSeekV2Config", "MLAttention", "DeepSeekV2DecoderLayer",
+           "DeepSeekV2Model", "DeepSeekV2ForCausalLM",
+           "deepseek_v2_tiny_config"]
+
+
+class DeepSeekV2Config(MoEConfig):
+    def __init__(self, q_lora_rank=None, kv_lora_rank=512,
+                 qk_nope_head_dim=128, qk_rope_head_dim=64,
+                 v_head_dim=128, **kw):
+        super().__init__(**kw)
+        self.q_lora_rank = q_lora_rank
+        self.kv_lora_rank = kv_lora_rank
+        self.qk_nope_head_dim = qk_nope_head_dim
+        self.qk_rope_head_dim = qk_rope_head_dim
+        self.v_head_dim = v_head_dim
+        self.qk_head_dim = qk_nope_head_dim + qk_rope_head_dim
+
+
+def deepseek_v2_tiny_config(**kw) -> DeepSeekV2Config:
+    base = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=4,
+                intermediate_size=128, max_position_embeddings=64,
+                q_lora_rank=32, kv_lora_rank=16, qk_nope_head_dim=16,
+                qk_rope_head_dim=8, v_head_dim=16,
+                num_experts=4, top_k=2, moe_intermediate_size=32,
+                shared_expert_intermediate_size=32,
+                first_k_dense_replace=1)
+    base.update(kw)
+    return DeepSeekV2Config(**base)
+
+
+def _linear(in_f, out_f, spec=None):
+    l = nn.Linear(in_f, out_f, bias_attr=False)
+    if spec is not None:
+        l.weight._sharding_spec = spec
+    return l
+
+
+class MLAttention(nn.Layer):
+    """Multi-head latent attention (DeepSeek-V2).
+
+    x → [q_a → RMSNorm → q_b]              per-head (nope ‖ rope) queries
+    x → kv_a → (c_kv ‖ k_pe)               latent + shared rope key head
+        c_kv → RMSNorm → kv_b              per-head (k_nope ‖ v)
+    attn over (nope ‖ rope) q·k, value dim v_head_dim, then o_proj.
+    """
+
+    def __init__(self, c: DeepSeekV2Config):
+        super().__init__()
+        self.c = c
+        nh = c.num_attention_heads
+        dn, dr, dv = c.qk_nope_head_dim, c.qk_rope_head_dim, c.v_head_dim
+        if c.q_lora_rank:
+            self.q_a_proj = _linear(c.hidden_size, c.q_lora_rank)
+            self.q_a_layernorm = nn.RMSNorm(c.q_lora_rank, c.rms_norm_eps)
+            self.q_b_proj = _linear(c.q_lora_rank, nh * (dn + dr),
+                                    P(None, MP_AXIS))
+        else:
+            self.q_proj = _linear(c.hidden_size, nh * (dn + dr),
+                                  P(None, MP_AXIS))
+        self.kv_a_proj_with_mqa = _linear(c.hidden_size,
+                                          c.kv_lora_rank + dr)
+        self.kv_a_layernorm = nn.RMSNorm(c.kv_lora_rank, c.rms_norm_eps)
+        self.kv_b_proj = _linear(c.kv_lora_rank, nh * (dn + dv),
+                                 P(None, MP_AXIS))
+        self.o_proj = _linear(nh * dv, c.hidden_size, P(MP_AXIS, None))
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        c = self.c
+        B, S, _ = x.shape
+        nh = c.num_attention_heads
+        dn, dr, dv = c.qk_nope_head_dim, c.qk_rope_head_dim, c.v_head_dim
+
+        if c.q_lora_rank:
+            q = self.q_b_proj(self.q_a_layernorm(self.q_a_proj(x)))
+        else:
+            q = self.q_proj(x)
+        q = q.reshape([B, S, nh, dn + dr])._data
+        q_nope, q_pe = q[..., :dn], q[..., dn:]
+
+        kv_a = self.kv_a_proj_with_mqa(x)._data
+        c_kv, k_pe = kv_a[..., :c.kv_lora_rank], kv_a[..., c.kv_lora_rank:]
+        kv = self.kv_b_proj(self.kv_a_layernorm(Tensor(c_kv)))
+        kv = kv.reshape([B, S, nh, dn + dv])._data
+        k_nope, v = kv[..., :dn], kv[..., dn:]
+
+        q_pe = apply_rope(q_pe, cos, sin)
+        k_pe = apply_rope(k_pe[:, :, None, :], cos, sin)
+        k_pe = jnp.broadcast_to(k_pe, (B, S, nh, dr))
+
+        qh = jnp.concatenate([q_nope, q_pe], -1)
+        kh = jnp.concatenate([k_nope, k_pe], -1)
+
+        if dv == dn + dr and c.use_flash_attention:
+            from ..ops.flash_attention import sdpa
+            o = sdpa(qh, kh, v, causal=True)
+        else:
+            scale = 1.0 / float(jnp.sqrt(jnp.float32(dn + dr)))
+            scores = jnp.einsum("bsnd,btnd->bnst", qh, kh) * scale
+            causal = jnp.tril(jnp.ones((S, S), bool))
+            scores = jnp.where(causal[None, None], scores, -jnp.inf)
+            w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+            o = jnp.einsum("bnst,btnv->bsnv", w, v)
+        return self.o_proj(Tensor(o.reshape(B, S, nh * dv)))
+
+
+class DeepSeekV2DecoderLayer(nn.Layer):
+    def __init__(self, c: DeepSeekV2Config, layer_idx: int = 0):
+        super().__init__()
+        self.c = c
+        self.input_layernorm = nn.RMSNorm(c.hidden_size, c.rms_norm_eps)
+        self.self_attn = MLAttention(c)
+        self.post_attention_layernorm = nn.RMSNorm(c.hidden_size,
+                                                   c.rms_norm_eps)
+        if layer_idx < c.first_k_dense_replace:
+            self.mlp = LlamaMLP(c)
+        else:
+            self.mlp = MoELayer(
+                c.hidden_size, c.moe_intermediate_size, c.num_experts,
+                top_k=c.top_k, capacity_factor=c.capacity_factor,
+                activation="swiglu", dropless=c.moe_dropless,
+                shared_expert_hidden=c.shared_expert_intermediate_size,
+                z_loss_weight=c.router_z_loss_weight)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class DeepSeekV2Model(nn.Layer):
+    def __init__(self, config: DeepSeekV2Config):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.embed_tokens.weight._data = init(
+            [config.vocab_size, config.hidden_size], "float32")
+        self.embed_tokens.weight._sharding_spec = P(MP_AXIS, None)
+        self.layers = nn.LayerList(
+            [DeepSeekV2DecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = precompute_rope(config.qk_rope_head_dim,
+                                   config.max_position_embeddings,
+                                   config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def aux_loss(self):
+        total = None
+        for layer in self.layers:
+            la = getattr(layer.mlp, "l_aux", None)
+            if la is not None:
+                total = la if total is None else total + la
+        return total
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        cos, sin = self.rope_cos._data, self.rope_sin._data
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                from ..distributed.recompute import recompute
+                x = recompute(layer, x, cos, sin, attn_mask)
+            else:
+                x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class DeepSeekV2ForCausalLM(nn.Layer):
+    def __init__(self, config: DeepSeekV2Config):
+        super().__init__()
+        self.config = config
+        self.model = DeepSeekV2Model(config)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                 bias_attr=False)
+        self.lm_head.weight._sharding_spec = P(None, MP_AXIS)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.model(input_ids, attn_mask)
+        logits = self.lm_head(h)
+        if labels is not None:
+            tok_loss = ParallelCrossEntropy()(logits, labels)
+            loss = tok_loss.mean()
+            aux = self.model.aux_loss()
+            if aux is not None and self.training:
+                loss = loss + self.config.aux_loss_weight * aux
+            return loss, logits
+        return logits

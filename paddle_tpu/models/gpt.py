@@ -1,0 +1,184 @@
+"""GPT model family (ref capability: PaddleNLP
+paddlenlp/transformers/gpt/modeling.py — GPTModel / GPTForCausalLM, the
+GPT-3 pretrain recipe that predates the Llama baseline).
+
+Same TPU-first conventions as models/llama.py: weights carry Megatron
+sharding specs (qkv/fc-in: column on mp; proj/fc-out: row on mp; embeddings
+vocab-sharded), attention routes through scaled_dot_product_attention
+(flash-kernel routable), and the vocab-parallel CE loss comes from
+ParallelCrossEntropy. Architectural differences from Llama kept faithful to
+GPT-2/3: learned absolute position embeddings (no rope), pre-LN blocks with
+bias-ful linears, gelu 4x MLP, final LayerNorm, tied LM head by default.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed.parallel_layers import MP_AXIS
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt2_small_config",
+           "gpt3_6_7b_config", "gpt_tiny_config"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=None, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                 initializer_range=0.02, layer_norm_eps=1e-5,
+                 tie_word_embeddings=True, recompute=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.tie_word_embeddings = tie_word_embeddings
+        self.recompute = recompute
+        self.head_dim = hidden_size // num_attention_heads
+
+
+def gpt2_small_config(**kw) -> GPTConfig:
+    return GPTConfig(**kw)
+
+
+def gpt3_6_7b_config(**kw) -> GPTConfig:
+    base = dict(hidden_size=4096, num_hidden_layers=32,
+                num_attention_heads=32, max_position_embeddings=2048)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def gpt_tiny_config(**kw) -> GPTConfig:
+    base = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, max_position_embeddings=64)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _mp_linear(in_f, out_f, spec):
+    l = nn.Linear(in_f, out_f)
+    l.weight._sharding_spec = spec
+    if spec == P(None, MP_AXIS):          # column-parallel: bias sharded too
+        l.bias._sharding_spec = P(MP_AXIS)
+    return l
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__()
+        self.c = c
+        H = c.hidden_size
+        self.qkv = _mp_linear(H, 3 * H, P(None, MP_AXIS))
+        self.proj = _mp_linear(H, H, P(MP_AXIS, None))
+        self.dropout = nn.Dropout(c.attention_probs_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        B, S, H = x.shape
+        nh, hd = self.c.num_attention_heads, self.c.head_dim
+        qkv = self.qkv(x)
+        q, k, v = (t.reshape([B, S, nh, hd])
+                   for t in qkv.chunk(3, axis=-1))
+        # always causal; a user mask (e.g. padding) composes with it rather
+        # than replacing it (PaddleNLP builds the causal mask internally)
+        o = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=True,
+            dropout_p=self.c.attention_probs_dropout_prob
+            if self.training else 0.0)
+        return self.proj(o.reshape([B, S, H]))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__()
+        self.fc_in = _mp_linear(c.hidden_size, c.intermediate_size,
+                                P(None, MP_AXIS))
+        self.fc_out = _mp_linear(c.intermediate_size, c.hidden_size,
+                                 P(MP_AXIS, None))
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.attn = GPTAttention(c)
+        self.ln_2 = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.mlp = GPTMLP(c)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.dropout(self.attn(self.ln_1(x), attn_mask))
+        return x + self.dropout(self.mlp(self.ln_2(x)))
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.embed_tokens.weight._data = init(
+            [config.vocab_size, config.hidden_size], "float32")
+        self.embed_tokens.weight._sharding_spec = P(MP_AXIS, None)
+        self.embed_positions = nn.Embedding(config.max_position_embeddings,
+                                            config.hidden_size)
+        self.embed_positions.weight._data = init(
+            [config.max_position_embeddings, config.hidden_size], "float32")
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(S, dtype=jnp.int32)[None, :])
+        x = self.embed_tokens(input_ids) + self.embed_positions(position_ids)
+        x = self.dropout(x)
+        for block in self.h:
+            if self.config.recompute and self.training:
+                from ..distributed.recompute import recompute
+                x = recompute(block, x, attn_mask)
+            else:
+                x = block(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+            self.lm_head.weight._sharding_spec = P(None, MP_AXIS)
+
+    def forward(self, input_ids, labels=None, position_ids=None,
+                attn_mask=None):
+        h = self.gpt(input_ids, position_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = F.linear(h, self.gpt.embed_tokens.weight.T)
+        if labels is not None:
+            from ..distributed.parallel_layers import ParallelCrossEntropy
+            tok_loss = ParallelCrossEntropy()(logits, labels)
+            return tok_loss.mean(), logits
+        return logits

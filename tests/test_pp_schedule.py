@@ -1,0 +1,65 @@
+"""Pipeline schedule generator tests (ref: the schedule options of
+python/paddle/distributed/passes/pipeline_scheduler_pass.py — FThenB / 1F1B /
+VPP / ZBH1; SURVEY §2.3 P6).
+
+Pure-host checks: dependency-valid timetables, the 1F1B activation-memory
+bound, and the ZBH1 zero-bubble improvement.
+"""
+
+import pytest
+
+from paddle_tpu.distributed.pp_schedule import (
+    SCHEDULERS, fthenb_schedule, generate_schedule,
+    interleaved_1f1b_schedule, one_f_one_b_schedule, zbh1_schedule)
+
+CASES = [(2, 4), (4, 8), (4, 4), (8, 16), (3, 9)]
+
+
+@pytest.mark.parametrize("S,M", CASES)
+def test_all_schedules_complete_and_dependency_valid(S, M):
+    for mode in SCHEDULERS:
+        chunks = 2 if mode == "VPP" else 1
+        sched = generate_schedule(mode, S, M, n_chunks=chunks)
+        sched.validate()
+
+
+def test_non_vpp_rejects_chunks():
+    with pytest.raises(ValueError):
+        generate_schedule("1F1B", 4, 8, n_chunks=4)
+
+
+@pytest.mark.parametrize("S,M", CASES)
+def test_1f1b_bounds_activation_memory(S, M):
+    gpipe = fthenb_schedule(S, M)
+    ofob = one_f_one_b_schedule(S, M)
+    # GPipe holds every microbatch at stage 0; 1F1B holds at most the
+    # stage depth — and never more than GPipe
+    assert gpipe.peak_inflight(0) == M
+    assert ofob.peak_inflight(0) <= min(S, M)
+    for s in range(S):
+        assert ofob.peak_inflight(s) <= min(S - s, M)
+
+
+@pytest.mark.parametrize("S,M", [(2, 4), (4, 8), (8, 16)])
+def test_zbh1_zero_bubble_at_1f1b_memory(S, M):
+    ofob = one_f_one_b_schedule(S, M)
+    zb = zbh1_schedule(S, M)
+    zb.validate()
+    # strictly fewer bubbles...
+    assert zb.bubble_ratio() < ofob.bubble_ratio()
+    # ...at the same activation-memory class (H1)
+    for s in range(S):
+        assert zb.peak_inflight(s) <= ofob.peak_inflight(s)
+
+
+@pytest.mark.parametrize("S,M,C", [(2, 8, 2), (4, 8, 2), (4, 16, 4)])
+def test_vpp_shrinks_bubble(S, M, C):
+    ofob = one_f_one_b_schedule(S, M)
+    vpp = interleaved_1f1b_schedule(S, M, C)
+    vpp.validate()
+    assert vpp.bubble_ratio() < ofob.bubble_ratio()
+
+
+def test_generate_schedule_rejects_unknown():
+    with pytest.raises(ValueError):
+        generate_schedule("nope", 2, 4)
