@@ -102,38 +102,71 @@ class MLAttention(nn.Layer):
         B, S, _ = x.shape
         nh = c.num_attention_heads
         dn, dr, dv = c.qk_nope_head_dim, c.qk_rope_head_dim, c.v_head_dim
+        eps = c.rms_norm_eps
+        mask = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+        from ..core.dispatch import apply as _apply
 
+        def _rms(h, w):
+            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
+                           keepdims=True)
+            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * w
+
+        # the whole latent-attention computation runs inside ONE dispatch
+        # apply so the tape sees every projection weight (the llama.py
+        # convention — raw-array math outside apply would be invisible to
+        # autograd)
+        def impl(h, w_kv_a, g_kv, w_kv_b, w_o, *q_weights):
+            if c.q_lora_rank:
+                w_q_a, g_q, w_q_b = q_weights
+                q = _rms(h @ w_q_a, g_q) @ w_q_b
+            else:
+                (w_q,) = q_weights
+                q = h @ w_q
+            q = q.reshape(B, S, nh, dn + dr)
+            q_nope, q_pe = q[..., :dn], q[..., dn:]
+
+            kv_a = h @ w_kv_a
+            c_kv, k_pe = kv_a[..., :c.kv_lora_rank], \
+                kv_a[..., c.kv_lora_rank:]
+            kv = (_rms(c_kv, g_kv) @ w_kv_b).reshape(B, S, nh, dn + dv)
+            k_nope, v = kv[..., :dn], kv[..., dn:]
+
+            q_pe = apply_rope(q_pe, cos, sin)
+            k_pe = apply_rope(k_pe[:, :, None, :], cos, sin)
+            k_pe = jnp.broadcast_to(k_pe, (B, S, nh, dr))
+
+            qh = jnp.concatenate([q_nope, q_pe], -1)
+            kh = jnp.concatenate([k_nope, k_pe], -1)
+
+            if dv == dn + dr and c.use_flash_attention and mask is None:
+                from ..ops.flash_attention import sdpa
+                o = sdpa(qh, kh, v, causal=True)
+            else:
+                scale = 1.0 / float(jnp.sqrt(jnp.float32(dn + dr)))
+                scores = jnp.einsum("bsnd,btnd->bnst", qh, kh) * scale
+                scores = scores.astype(jnp.float32)
+                causal = jnp.tril(jnp.ones((S, S), bool))
+                neg = jnp.asarray(-1e30, scores.dtype)
+                scores = jnp.where(causal[None, None], scores, neg)
+                if mask is not None:  # compose, never replace (gpt.py conv.)
+                    m = jnp.asarray(mask)
+                    if m.dtype == jnp.bool_:
+                        scores = jnp.where(m, scores, neg)
+                    else:
+                        scores = scores + m.astype(scores.dtype)
+                w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+                o = jnp.einsum("bnst,btnv->bsnv", w, v)
+            return o.reshape(B, S, nh * dv) @ w_o
+
+        inputs = [x, self.kv_a_proj_with_mqa.weight,
+                  self.kv_a_layernorm.weight, self.kv_b_proj.weight,
+                  self.o_proj.weight]
         if c.q_lora_rank:
-            q = self.q_b_proj(self.q_a_layernorm(self.q_a_proj(x)))
+            inputs += [self.q_a_proj.weight, self.q_a_layernorm.weight,
+                       self.q_b_proj.weight]
         else:
-            q = self.q_proj(x)
-        q = q.reshape([B, S, nh, dn + dr])._data
-        q_nope, q_pe = q[..., :dn], q[..., dn:]
-
-        kv_a = self.kv_a_proj_with_mqa(x)._data
-        c_kv, k_pe = kv_a[..., :c.kv_lora_rank], kv_a[..., c.kv_lora_rank:]
-        kv = self.kv_b_proj(self.kv_a_layernorm(Tensor(c_kv)))
-        kv = kv.reshape([B, S, nh, dn + dv])._data
-        k_nope, v = kv[..., :dn], kv[..., dn:]
-
-        q_pe = apply_rope(q_pe, cos, sin)
-        k_pe = apply_rope(k_pe[:, :, None, :], cos, sin)
-        k_pe = jnp.broadcast_to(k_pe, (B, S, nh, dr))
-
-        qh = jnp.concatenate([q_nope, q_pe], -1)
-        kh = jnp.concatenate([k_nope, k_pe], -1)
-
-        if dv == dn + dr and c.use_flash_attention:
-            from ..ops.flash_attention import sdpa
-            o = sdpa(qh, kh, v, causal=True)
-        else:
-            scale = 1.0 / float(jnp.sqrt(jnp.float32(dn + dr)))
-            scores = jnp.einsum("bsnd,btnd->bnst", qh, kh) * scale
-            causal = jnp.tril(jnp.ones((S, S), bool))
-            scores = jnp.where(causal[None, None], scores, -jnp.inf)
-            w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-            o = jnp.einsum("bnst,btnv->bsnv", w, v)
-        return self.o_proj(Tensor(o.reshape(B, S, nh * dv)))
+            inputs += [self.q_proj.weight]
+        return _apply("mla_attention", impl, inputs)
 
 
 class DeepSeekV2DecoderLayer(nn.Layer):

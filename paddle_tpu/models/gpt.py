@@ -81,7 +81,6 @@ class GPTAttention(nn.Layer):
         H = c.hidden_size
         self.qkv = _mp_linear(H, 3 * H, P(None, MP_AXIS))
         self.proj = _mp_linear(H, H, P(MP_AXIS, None))
-        self.dropout = nn.Dropout(c.attention_probs_dropout_prob)
 
     def forward(self, x, attn_mask=None):
         B, S, H = x.shape

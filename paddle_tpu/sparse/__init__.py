@@ -41,7 +41,10 @@ class SparseCooTensor:
         return Tensor(self._bcoo.indices.T)  # paddle layout [ndim, nnz]
 
     def values(self) -> Tensor:
-        return Tensor(self._bcoo.data)
+        # ops that build the values differentiably (e.g. sparse conv) stash
+        # the tape-tracked Tensor here so grads flow through .values()
+        t = getattr(self, "_values_tensor", None)
+        return t if t is not None else Tensor(self._bcoo.data)
 
     def to_dense(self) -> Tensor:
         return Tensor(self._bcoo.todense())
@@ -295,9 +298,20 @@ class Softmax(_SparseLayerBase):
                                              b.indices), shape=b.shape))
 
 
+from .conv import Conv3D, SubmConv3D, conv3d, subm_conv3d  # noqa: E402
+
+
+class _functional:  # namespace shim: paddle.sparse.nn.functional.<fn>
+    conv3d = staticmethod(conv3d)
+    subm_conv3d = staticmethod(subm_conv3d)
+
+
 class nn:  # namespace shim: paddle.sparse.nn.<Layer>
     ReLU = ReLU
     Softmax = Softmax
+    Conv3D = Conv3D
+    SubmConv3D = SubmConv3D
+    functional = _functional
 
 
 __all__ += ["sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",

@@ -1,0 +1,230 @@
+"""Sparse 3-D convolutions for point clouds (ref: paddle.sparse.nn.Conv3D /
+SubmConv3D over paddle/phi/kernels/sparse/ gpu conv kernels — the
+SURVEY §2.1 sparse-kernel row's conv3d gap).
+
+TPU-native mechanism: no CUTLASS gather-scatter kernels. The rulebook is
+built with sorted-key lookups (linearized voxel coordinates +
+jnp.searchsorted, O(K·n·log n)) and each kernel offset becomes ONE dense
+[n, in_c] × [in_c, out_c] matmul on the MXU, masked-accumulated into the
+output features. Coordinates are data-dependent, so rulebook construction is
+eager-only (dynamic shapes); the feature math itself goes through the
+dispatch registry and is differentiable w.r.t. values/weight/bias.
+
+Input layout: SparseCooTensor of shape [N, D, H, W, C] with 4 sparse dims
+(batch + 3 spatial) and dense channels. Weight layout: [kd, kh, kw, in_c,
+out_c] (paddle parity).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["subm_conv3d", "conv3d", "SubmConv3D", "Conv3D"]
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        if len(v) != 3:
+            raise ValueError(f"expected 3 values, got {v}")
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _linearize(coords, spatial: Sequence[int]):
+    """[n, 4] (batch, d, h, w) int coords → unique sortable int64-ish key.
+    Out-of-bounds coordinates map to -1."""
+    D, H, W = spatial
+    b, d, h, w = (coords[:, i] for i in range(4))
+    valid = ((d >= 0) & (d < D) & (h >= 0) & (h < H)
+             & (w >= 0) & (w < W))
+    key = ((b * D + d) * H + h) * W + w
+    return jnp.where(valid, key, -1), valid
+
+
+def _offsets(kernel: Tuple[int, int, int]):
+    kd, kh, kw = kernel
+    out = []
+    for a in range(kd):
+        for b in range(kh):
+            for c in range(kw):
+                out.append((a, b, c))
+    return out
+
+
+def _gather_rulebook(in_coords, out_coords, spatial, kernel, stride, padding,
+                     subm: bool):
+    """For each kernel offset k: (gather_index_into_sorted_inputs, found).
+
+    out[c] = Σ_k W_k · in[c·stride − padding + off_k]   (cross-correlation)
+    For subm convs stride=1 and padding=(kernel−1)/2, so the neighbor of the
+    center offset is the site itself.
+    """
+    in_keys, _ = _linearize(in_coords, spatial)
+    order = jnp.argsort(in_keys)
+    sorted_keys = in_keys[order]
+    idxs, founds = [], []
+    st = jnp.asarray(stride, jnp.int32)
+    pad = jnp.asarray(padding, jnp.int32)
+    for off in _offsets(kernel):
+        nb = jnp.concatenate(
+            [out_coords[:, :1],
+             out_coords[:, 1:] * st[None, :] - pad[None, :]
+             + jnp.asarray(off, jnp.int32)[None, :]], axis=1)
+        nb_keys, valid = _linearize(nb, spatial)
+        pos = jnp.searchsorted(sorted_keys, nb_keys)
+        pos = jnp.clip(pos, 0, sorted_keys.shape[0] - 1)
+        found = (sorted_keys[pos] == nb_keys) & valid & (nb_keys >= 0)
+        idxs.append(order[pos])
+        founds.append(found)
+    return jnp.stack(idxs), jnp.stack(founds)
+
+
+def _sparse_conv(x, weight, bias, kernel, stride, padding, subm: bool,
+                 out_channels: int):
+    from . import SparseCooTensor, is_sparse
+    if not is_sparse(x):
+        raise TypeError("sparse conv expects a SparseCooTensor input")
+    b = x._bcoo
+    if b.n_sparse != 4 or b.data.ndim != 2:
+        raise ValueError("expected [N, D, H, W, C] layout: 4 sparse dims + "
+                         "dense channels")
+    N, D, H, W, C = b.shape
+    spatial = (D, H, W)
+    in_coords = b.indices.astype(jnp.int32)
+    kd, kh, kw = kernel
+
+    if subm:
+        out_coords = in_coords
+        out_spatial = spatial
+    else:
+        # output sites: every position some input voxel contributes to
+        # (data-dependent → eager-only), out = floor((c + pad − off)/stride)
+        st = jnp.asarray(stride, jnp.int32)
+        pad = jnp.asarray(padding, jnp.int32)
+        cands = []
+        for off in _offsets(kernel):
+            num = in_coords[:, 1:] + pad[None, :] \
+                - jnp.asarray(off, jnp.int32)[None, :]
+            ok = (num % st[None, :] == 0).all(axis=1)
+            oc = num // st[None, :]
+            cands.append((jnp.concatenate([in_coords[:, :1], oc], 1), ok))
+        out_spatial = tuple(
+            (s + 2 * p - k) // t + 1
+            for s, p, k, t in zip(spatial, padding, kernel, stride))
+        all_coords = jnp.concatenate([c for c, _ in cands], 0)
+        all_ok = jnp.concatenate([o for _, o in cands], 0)
+        keys, valid = _linearize(all_coords, out_spatial)
+        keys = jnp.where(all_ok & valid, keys, -1)
+        uniq = jnp.unique(keys)
+        uniq = uniq[uniq >= 0]
+        od, oh, ow = out_spatial
+        w_ = uniq % ow
+        h_ = (uniq // ow) % oh
+        d_ = (uniq // (ow * oh)) % od
+        b_ = uniq // (ow * oh * od)
+        out_coords = jnp.stack([b_, d_, h_, w_], 1).astype(jnp.int32)
+
+    gather_idx, found = _gather_rulebook(in_coords, out_coords, spatial,
+                                         kernel, stride, padding, subm)
+    K = kd * kh * kw
+
+    def impl(values, w, *maybe_bias):
+        wk = w.reshape(K, C, out_channels)
+        out = jnp.zeros((out_coords.shape[0], out_channels), values.dtype)
+        for k in range(K):
+            g = values[gather_idx[k]] * found[k][:, None].astype(values.dtype)
+            out = out + g @ wk[k]
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    # x.values() returns the tape-tracked Tensor when a previous sparse op
+    # produced it — required for gradients to flow through STACKED convs
+    inputs = [x.values(), weight]
+    if bias is not None:
+        inputs.append(bias)
+    out_vals = apply("subm_conv3d" if subm else "sparse_conv3d", impl, inputs)
+    out_shape = (N,) + out_spatial + (out_channels,)
+    result = SparseCooTensor(jsparse.BCOO((out_vals._data, out_coords),
+                                          shape=out_shape))
+    result._values_tensor = out_vals  # keep the autograd-tracked values
+    return result
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=None, name=None):
+    """Submanifold conv: output sites == input sites (ref:
+    paddle.sparse.nn.functional.subm_conv3d). stride must be 1."""
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    kd, kh, kw, ic, oc = w.shape
+    if _triple(stride) != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1")
+    pad = _triple(padding) if padding is not None else \
+        ((kd - 1) // 2, (kh - 1) // 2, (kw - 1) // 2)
+    return _sparse_conv(x, weight, bias, (kd, kh, kw), (1, 1, 1), pad,
+                        subm=True, out_channels=oc)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, name=None):
+    """Standard sparse conv: output sites densify per the kernel footprint
+    (ref: paddle.sparse.nn.functional.conv3d)."""
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    kd, kh, kw, ic, oc = w.shape
+    return _sparse_conv(x, weight, bias, (kd, kh, kw), _triple(stride),
+                        _triple(padding), subm=False, out_channels=oc)
+
+
+class _ConvBase:
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, bias_attr=None):
+        from ..nn import initializer as I
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _triple(kernel_size)
+        self.stride = _triple(stride)
+        self.padding = _triple(padding)
+        kd, kh, kw = self.kernel_size
+        fan_in = in_channels * kd * kh * kw
+        std = math.sqrt(2.0 / fan_in)
+        self.weight = Tensor(
+            I.Normal(0.0, std)([kd, kh, kw, in_channels, out_channels],
+                               "float32"))
+        self.weight.stop_gradient = False
+        if bias_attr is not False:
+            self.bias = Tensor(jnp.zeros((out_channels,), jnp.float32))
+            self.bias.stop_gradient = False
+        else:
+            self.bias = None
+
+    def parameters(self):
+        return [self.weight] + ([self.bias] if self.bias is not None else [])
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class SubmConv3D(_ConvBase):
+    """paddle.sparse.nn.SubmConv3D parity (point-cloud backbone block).
+
+    Submanifold semantics (spconv/paddle): the kernel is CENTERED on each
+    active site and output sites equal input sites; the `padding` argument
+    is accepted for signature parity but does not change the neighborhood.
+    """
+
+    def forward(self, x):
+        return subm_conv3d(x, self.weight, self.bias, stride=1,
+                           padding=None)
+
+
+class Conv3D(_ConvBase):
+    """paddle.sparse.nn.Conv3D parity."""
+
+    def forward(self, x):
+        return conv3d(x, self.weight, self.bias, stride=self.stride,
+                      padding=self.padding)

@@ -3,6 +3,7 @@ row): model zoo, transforms, datasets."""
 
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .datasets import Cifar10, FakeData, MNIST  # noqa: F401
 from .models import (LeNet, MobileNetV3Small, ResNet, resnet18,  # noqa: F401

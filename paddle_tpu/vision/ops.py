@@ -1,0 +1,322 @@
+"""paddle.vision.ops parity: detection operators (ref: python/paddle/
+vision/ops.py over CUDA kernels roi_align/nms/deform_conv — SURVEY §2.2
+vision row "GPU-accelerated ops").
+
+TPU-native mechanism notes:
+- roi_align / roi_pool: bilinear/max sampling expressed as dense gathers —
+  XLA lowers to vectorized dynamic-slices; no atomics needed (the CUDA
+  kernels' main complication).
+- nms: O(N²) IoU matrix + a greedy suppression sweep under lax.fori_loop —
+  compiler-friendly fixed-shape loop; the final index extraction is
+  data-dependent and therefore eager-only (like every NMS).
+- deform_conv2d: offset-shifted bilinear sampling (gather) followed by ONE
+  im2col-style matmul on the MXU — the idiomatic TPU shape for DCN.
+
+Layouts follow paddle: images NCHW, boxes [N, 4] as (x1, y1, x2, y2).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "deform_conv2d", "DeformConv2D"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# NMS
+# ---------------------------------------------------------------------------
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """ref: paddle.vision.ops.nms. Greedy suppression in score order;
+    category-aware when category_idxs is given (boxes of different
+    categories never suppress each other). Returns kept indices (Tensor,
+    int64-ordered by score) — data-dependent size, eager-only."""
+    b = _arr(boxes).astype(jnp.float32)
+    n = b.shape[0]
+    s = jnp.arange(n, 0, -1, jnp.float32) if scores is None \
+        else _arr(scores).astype(jnp.float32)
+    iou = _iou_matrix(b)
+    if category_idxs is not None:
+        cat = _arr(category_idxs)
+        same = cat[:, None] == cat[None, :]
+        iou = jnp.where(same, iou, 0.0)
+    order = jnp.argsort(-s)
+
+    def body(i, keep):
+        bi = order[i]
+        # suppressed iff a higher-scoring KEPT box overlaps > threshold
+        higher = jnp.arange(n) < i
+        sup = jnp.any(higher & keep[order] & (iou[bi, order] > iou_threshold))
+        return keep.at[bi].set(~sup)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    kept_sorted = order[keep[order]]  # score order, eager extraction
+    if top_k is not None:
+        kept_sorted = kept_sorted[:top_k]
+    return Tensor(kept_sorted.astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# RoI align / pool
+# ---------------------------------------------------------------------------
+def _bilinear(feat, y, x):
+    """feat [C, H, W]; y/x sample grids of any shape → [C, *grid]."""
+    H, W = feat.shape[-2:]
+    y = jnp.clip(y, 0.0, H - 1.0)
+    x = jnp.clip(x, 0.0, W - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = y - y0
+    wx = x - x0
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def _bilinear_zero(feat, y, x):
+    """Bilinear sampling with ZERO padding outside the image (the
+    deform-conv reference semantics; `_bilinear` edge-clamps instead,
+    which is what roi_align wants)."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    wy = y - y0
+    wx = x - x0
+    out = 0.0
+    for yc, ww_y in ((y0, 1 - wy), (y0 + 1, wy)):
+        for xc, ww_x in ((x0, 1 - wx), (x0 + 1, wx)):
+            valid = (yc >= 0) & (yc < H) & (xc >= 0) & (xc < W)
+            v = feat[:, jnp.clip(yc, 0, H - 1), jnp.clip(xc, 0, W - 1)]
+            out = out + v * (ww_y * ww_x * valid)
+    return out
+
+
+def _roi_grid(box, pooled: Tuple[int, int], spatial_scale, sr_h, sr_w,
+              aligned):
+    ph, pw = pooled
+    off = 0.5 if aligned else 0.0
+    x1 = box[0] * spatial_scale - off
+    y1 = box[1] * spatial_scale - off
+    x2 = box[2] * spatial_scale - off
+    y2 = box[3] * spatial_scale - off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    iy = (jnp.arange(sr_h) + 0.5) / sr_h
+    ix = (jnp.arange(sr_w) + 0.5) / sr_w
+    ys = y1 + (jnp.arange(ph)[:, None] + iy[None, :]) * bin_h  # [ph, sr_h]
+    xs = x1 + (jnp.arange(pw)[:, None] + ix[None, :]) * bin_w  # [pw, sr_w]
+    return ys, xs
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """ref: paddle.vision.ops.roi_align. boxes [R,4] concatenated over the
+    batch, boxes_num [N] giving the per-image count. sampling_ratio<=0
+    means reference-adaptive: ceil(roi_size/bin_count) samples per bin,
+    computed per ROI (host-side — boxes are data, so eager-only)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    import numpy as np
+    xb = _arr(x)
+    bx = _arr(boxes).astype(jnp.float32)
+    bn = [int(v) for v in jnp.asarray(_arr(boxes_num))]
+    img_idx = [i for i, c in enumerate(bn) for _ in range(c)]
+    ph, pw = output_size
+    bx_np = np.asarray(bx)
+    srs = []
+    for r in range(bx_np.shape[0]):
+        if sampling_ratio > 0:
+            srs.append((sampling_ratio, sampling_ratio))
+        else:
+            rh = (bx_np[r, 3] - bx_np[r, 1]) * spatial_scale
+            rw = (bx_np[r, 2] - bx_np[r, 0]) * spatial_scale
+            srs.append((max(int(math.ceil(rh / ph)), 1),
+                        max(int(math.ceil(rw / pw)), 1)))
+
+    def impl(feat_all):
+        outs = []
+        for r in range(bx_np.shape[0]):
+            feat = feat_all[img_idx[r]]
+            sr_h, sr_w = srs[r]
+            ys, xs = _roi_grid(bx[r], (ph, pw), spatial_scale, sr_h, sr_w,
+                               aligned)
+            Y, X = jnp.meshgrid(ys.reshape(-1), xs.reshape(-1),
+                                indexing="ij")
+            vals = _bilinear(feat, Y, X)
+            C = feat.shape[0]
+            vals = vals.reshape(C, ph, sr_h, pw, sr_w)
+            outs.append(vals.mean(axis=(2, 4)))
+        return jnp.stack(outs)
+
+    return apply("roi_align", impl, [x if isinstance(x, Tensor)
+                                     else Tensor(xb)])
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """ref: paddle.vision.ops.roi_pool (max pooling over quantized bins)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    xb = _arr(x)
+    bx = _arr(boxes).astype(jnp.float32)
+    bn = [int(v) for v in jnp.asarray(_arr(boxes_num))]
+    img_idx = jnp.asarray(
+        sum(([i] * c for i, c in enumerate(bn)), []), jnp.int32)
+    ph, pw = output_size
+    H, W = xb.shape[-2:]
+
+    def impl(feat_all):
+        def one(box, img):
+            feat = feat_all[img]
+            x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1)
+            rw = jnp.maximum(x2 - x1 + 1, 1)
+            # dense mask-max over the full feature map per bin (TPU-style:
+            # trade FLOPs for gather-free regular compute)
+            ys = jnp.arange(H)[None, :]
+            xs = jnp.arange(W)[None, :]
+            b_y0 = y1 + (jnp.arange(ph)[:, None] * rh) // ph
+            b_y1 = y1 + ((jnp.arange(ph)[:, None] + 1) * rh + ph - 1) // ph
+            b_x0 = x1 + (jnp.arange(pw)[:, None] * rw) // pw
+            b_x1 = x1 + ((jnp.arange(pw)[:, None] + 1) * rw + pw - 1) // pw
+            my = (ys >= b_y0) & (ys < jnp.maximum(b_y1, b_y0 + 1))  # [ph,H]
+            mx = (xs >= b_x0) & (xs < jnp.maximum(b_x1, b_x0 + 1))  # [pw,W]
+            m = my[:, None, :, None] & mx[None, :, None, :]  # [ph,pw,H,W]
+            neg = jnp.asarray(-3.4e38, feat.dtype)
+            v = jnp.where(m[None], feat[:, None, None, :, :], neg)
+            return v.max(axis=(-1, -2))
+        return jax.vmap(one)(bx, img_idx)
+
+    return apply("roi_pool", impl, [x if isinstance(x, Tensor)
+                                    else Tensor(xb)])
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution (DCNv1/v2)
+# ---------------------------------------------------------------------------
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """ref: paddle.vision.ops.deform_conv2d. x NCHW, offset
+    [N, 2·dg·kh·kw, Ho, Wo] ((dy, dx) interleaved per kernel point), mask
+    [N, dg·kh·kw, Ho, Wo] for DCNv2. groups/deformable_groups=1 supported.
+    """
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("groups/deformable_groups > 1")
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph_, pw_ = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    wshape = (_arr(weight)).shape
+    oc, ic, kh, kw = wshape
+    xb = _arr(x)
+    N, C, H, W = xb.shape
+    Ho = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+
+    base_y = jnp.arange(Ho) * sh - ph_
+    base_x = jnp.arange(Wo) * sw - pw_
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+
+    def impl(xa, off, w, *rest):
+        i = 0
+        m = None
+        if mask is not None:
+            m = rest[0].reshape(N, kh, kw, Ho, Wo)
+            i = 1
+        b = rest[i] if bias is not None else None
+        offr = off.reshape(N, kh, kw, 2, Ho, Wo)
+        dy = offr[:, :, :, 0]
+        dx = offr[:, :, :, 1]
+        # sample positions [N, kh, kw, Ho, Wo]
+        yy = (base_y[None, None, None, :, None]
+              + ky[None, :, None, None, None] + dy)
+        xx = (base_x[None, None, None, None, :]
+              + kx[None, None, :, None, None] + dx)
+        vals = jax.vmap(_bilinear_zero)(xa, yy, xx)  # [N,C,kh,kw,Ho,Wo]
+        if m is not None:
+            vals = vals * m[:, None]
+        # im2col contraction: one MXU einsum over (c, kh, kw)
+        out = jnp.einsum("ncijhw,ocij->nohw", vals, w)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    inputs = [x if isinstance(x, Tensor) else Tensor(xb),
+              offset if isinstance(offset, Tensor) else Tensor(_arr(offset)),
+              weight if isinstance(weight, Tensor) else Tensor(_arr(weight))]
+    if mask is not None:
+        inputs.append(mask if isinstance(mask, Tensor)
+                      else Tensor(_arr(mask)))
+    if bias is not None:
+        inputs.append(bias if isinstance(bias, Tensor)
+                      else Tensor(_arr(bias)))
+    return apply("deform_conv2d", impl, inputs)
+
+
+class DeformConv2D:
+    """ref: paddle.vision.ops.DeformConv2D layer wrapper."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 bias_attr=None):
+        from ..nn import initializer as I
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+        fan_in = in_channels * ks[0] * ks[1]
+        std = math.sqrt(2.0 / fan_in)
+        self.weight = Tensor(I.Normal(0.0, std)(
+            [out_channels, in_channels, ks[0], ks[1]], "float32"))
+        self.weight.stop_gradient = False
+        if bias_attr is not False:
+            self.bias = Tensor(jnp.zeros((out_channels,), jnp.float32))
+            self.bias.stop_gradient = False
+        else:
+            self.bias = None
+
+    def parameters(self):
+        return [self.weight] + ([self.bias] if self.bias is not None else [])
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self.stride, padding=self.padding,
+                             dilation=self.dilation,
+                             deformable_groups=self.deformable_groups,
+                             groups=self.groups, mask=mask)

@@ -1,0 +1,125 @@
+"""Sparse conv3d tests (ref: paddle.sparse.nn.Conv3D/SubmConv3D,
+paddle/phi/kernels/sparse/ conv kernels — SURVEY §2.1 sparse row).
+
+Oracle: torch.nn.functional.conv3d on the densified voxel grid.
+"""
+
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _random_cloud(N, D, H, W, C, nnz, seed=0):
+    rng = np.random.RandomState(seed)
+    # unique voxel sites
+    keys = rng.choice(N * D * H * W, size=nnz, replace=False)
+    b = keys // (D * H * W)
+    d = (keys // (H * W)) % D
+    h = (keys // W) % H
+    w = keys % W
+    idx = np.stack([b, d, h, w]).astype(np.int64)  # [4, nnz]
+    vals = rng.randn(nnz, C).astype(np.float32)
+    st = sparse.sparse_coo_tensor(idx, vals, shape=(N, D, H, W, C))
+    dense = np.zeros((N, D, H, W, C), np.float32)
+    dense[b, d, h, w] = vals
+    return st, dense
+
+
+def _torch_conv(dense_ndhwc, weight, stride, padding):
+    x = torch.tensor(dense_ndhwc).permute(0, 4, 1, 2, 3)  # NCDHW
+    w = torch.tensor(weight).permute(4, 3, 0, 1, 2)       # [oc,ic,kd,kh,kw]
+    y = torch.nn.functional.conv3d(x, w, stride=stride, padding=padding)
+    return y.permute(0, 2, 3, 4, 1).numpy()               # NDHWC
+
+
+def test_subm_conv3d_matches_dense_oracle_at_input_sites():
+    paddle.seed(0)
+    st, dense = _random_cloud(2, 6, 7, 5, 3, nnz=40)
+    rng = np.random.RandomState(1)
+    w = (rng.randn(3, 3, 3, 3, 4) * 0.2).astype(np.float32)
+    out = sparse.subm_conv3d(st, paddle.to_tensor(w))
+    ref = _torch_conv(dense, w, stride=1, padding=1)
+    oi = np.asarray(out._bcoo.indices)
+    ov = np.asarray(out._bcoo.data)
+    # same active sites as the input (submanifold property)
+    ii = np.asarray(st._bcoo.indices)
+    assert sorted(map(tuple, oi.tolist())) == sorted(map(tuple, ii.tolist()))
+    for (b, d, h, wd), v in zip(oi.tolist(), ov):
+        np.testing.assert_allclose(v, ref[b, d, h, wd], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_conv3d_matches_dense_oracle_everywhere():
+    paddle.seed(0)
+    st, dense = _random_cloud(1, 6, 6, 6, 2, nnz=20, seed=3)
+    rng = np.random.RandomState(2)
+    w = (rng.randn(3, 3, 3, 2, 5) * 0.3).astype(np.float32)
+    out = sparse.conv3d(st, paddle.to_tensor(w), stride=1, padding=1)
+    ref = _torch_conv(dense, w, stride=1, padding=1)
+    assert out.shape == (1, 6, 6, 6, 5)
+    oi = np.asarray(out._bcoo.indices)
+    ov = np.asarray(out._bcoo.data)
+    seen = np.zeros(ref.shape[:-1], bool)
+    for (b, d, h, wd), v in zip(oi.tolist(), ov):
+        np.testing.assert_allclose(v, ref[b, d, h, wd], rtol=1e-4,
+                                   atol=1e-5)
+        seen[b, d, h, wd] = True
+    # every site the dense conv leaves nonzero is covered by the sparse out
+    nonzero = np.abs(ref).max(-1) > 1e-6
+    assert not np.any(nonzero & ~seen)
+
+
+def test_conv3d_stride2():
+    paddle.seed(0)
+    st, dense = _random_cloud(1, 8, 8, 8, 2, nnz=30, seed=5)
+    rng = np.random.RandomState(4)
+    w = (rng.randn(3, 3, 3, 2, 3) * 0.3).astype(np.float32)
+    out = sparse.conv3d(st, paddle.to_tensor(w), stride=2, padding=1)
+    ref = _torch_conv(dense, w, stride=2, padding=1)
+    assert out.shape == (1, 4, 4, 4, 3)
+    oi = np.asarray(out._bcoo.indices)
+    ov = np.asarray(out._bcoo.data)
+    for (b, d, h, wd), v in zip(oi.tolist(), ov):
+        np.testing.assert_allclose(v, ref[b, d, h, wd], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_subm_layer_trains():
+    """Gradient flows to weight/bias through the gather-matmul rulebook."""
+    paddle.seed(0)
+    st, _ = _random_cloud(1, 5, 5, 5, 3, nnz=15, seed=7)
+    layer = sparse.nn.SubmConv3D(3, 4, kernel_size=3)
+    out = layer(st)
+    loss = out.values().pow(2).mean()
+    loss.backward()
+    g = layer.weight.grad
+    assert g is not None
+    assert float(np.abs(g.numpy()).max()) > 0
+    assert layer.bias.grad is not None
+
+
+def test_conv_layer_api():
+    paddle.seed(0)
+    st, _ = _random_cloud(1, 6, 6, 6, 2, nnz=12, seed=9)
+    layer = sparse.nn.Conv3D(2, 4, kernel_size=3, stride=2, padding=1,
+                             bias_attr=False)
+    out = layer(st)
+    assert out.shape == (1, 3, 3, 3, 4)
+    assert layer.bias is None
+
+
+def test_stacked_subm_convs_all_layers_train():
+    """Review regression: grads must flow through CHAINED sparse convs (the
+    values() tape-tensor path), not just the last layer."""
+    paddle.seed(0)
+    st, _ = _random_cloud(1, 5, 5, 5, 3, nnz=15, seed=11)
+    l1 = sparse.nn.SubmConv3D(3, 4, kernel_size=3)
+    l2 = sparse.nn.SubmConv3D(4, 2, kernel_size=3)
+    out = l2(l1(st))
+    loss = out.values().pow(2).mean()
+    loss.backward()
+    assert l1.weight.grad is not None
+    assert float(np.abs(l1.weight.grad.numpy()).max()) > 0
+    assert l2.weight.grad is not None

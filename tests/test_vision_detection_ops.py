@@ -1,0 +1,200 @@
+"""paddle.vision.ops detection operators (ref: python/paddle/vision/ops.py
+— roi_align/roi_pool/nms/deform_conv2d; SURVEY §2.2 vision row).
+
+Oracles: numpy hand-rolled NMS/roi_align; torch conv2d for the
+zero-offset deform_conv degenerate case (torchvision is not in the image).
+"""
+
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or sup[j]:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a_j = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            union = a_i + a_j - inter
+            if union > 0 and inter / union > thr and scores[j] <= scores[i]:
+                sup[j] = True
+    return keep
+
+
+class TestNMS:
+    def test_vs_numpy_reference(self):
+        rng = np.random.RandomState(0)
+        xy = rng.rand(40, 2) * 60
+        wh = rng.rand(40, 2) * 20 + 2
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        scores = rng.rand(40).astype(np.float32)
+        for thr in (0.2, 0.5, 0.8):
+            got = V.nms(paddle.to_tensor(boxes), thr,
+                        scores=paddle.to_tensor(scores)).numpy()
+            ref = _np_nms(boxes, scores, thr)
+            np.testing.assert_array_equal(got, ref)
+
+    def test_top_k_and_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [30, 30, 40, 40]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = V.nms(paddle.to_tensor(boxes), 0.3,
+                     scores=paddle.to_tensor(scores)).numpy()
+        np.testing.assert_array_equal(keep, [0, 2])
+        # different categories: overlapping boxes both survive
+        cats = np.array([0, 1, 0], np.int64)
+        keep2 = V.nms(paddle.to_tensor(boxes), 0.3,
+                      scores=paddle.to_tensor(scores),
+                      category_idxs=paddle.to_tensor(cats),
+                      categories=[0, 1]).numpy()
+        np.testing.assert_array_equal(keep2, [0, 1, 2])
+        keep3 = V.nms(paddle.to_tensor(boxes), 0.3,
+                      scores=paddle.to_tensor(scores), top_k=1).numpy()
+        np.testing.assert_array_equal(keep3, [0])
+
+
+class TestRoiAlign:
+    def test_whole_image_box_equals_interpolation(self):
+        """A box covering exactly the feature map, pooled to HxW with
+        sampling at pixel centers, reproduces the map itself."""
+        H = W = 6
+        feat = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+        boxes = np.array([[0.0, 0.0, W, H]], np.float32)
+        out = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1], np.int32)),
+                          output_size=(H, W), spatial_scale=1.0,
+                          sampling_ratio=1, aligned=False)
+        got = out.numpy()[0, 0]
+        # sampling_ratio=1: one center sample per bin → bilinear at centers
+        yy = np.arange(H) + 0.5
+        xx = np.arange(W) + 0.5
+        ref = np.empty((H, W), np.float32)
+        for i, y in enumerate(yy):
+            for j, x in enumerate(xx):
+                y0, x0 = int(min(np.floor(y), H - 1)), int(min(np.floor(x),
+                                                              W - 1))
+                y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+                wy, wx = y - y0, x - x0
+                f = feat[0, 0]
+                ref[i, j] = (f[y0, x0] * (1 - wy) * (1 - wx)
+                             + f[y0, x1] * (1 - wy) * wx
+                             + f[y1, x0] * wy * (1 - wx)
+                             + f[y1, x1] * wy * wx)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_shapes_and_batching(self):
+        rng = np.random.RandomState(1)
+        feat = rng.randn(2, 3, 16, 16).astype(np.float32)
+        boxes = np.array([[0, 0, 8, 8], [4, 4, 12, 12], [0, 0, 16, 16]],
+                         np.float32)
+        bn = np.array([2, 1], np.int32)
+        out = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                          paddle.to_tensor(bn), output_size=7,
+                          spatial_scale=1.0)
+        assert out.shape == [3, 3, 7, 7]
+        out2 = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                          paddle.to_tensor(bn), output_size=4)
+        assert out2.shape == [3, 3, 4, 4]
+
+    def test_roi_pool_max_semantics(self):
+        feat = np.zeros((1, 1, 8, 8), np.float32)
+        feat[0, 0, 2, 3] = 7.0
+        boxes = np.array([[0, 0, 7, 7]], np.float32)
+        out = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=2)
+        assert float(out.numpy().max()) == 7.0
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv2d(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 10, 10).astype(np.float32)
+        w = (rng.randn(5, 3, 3, 3) * 0.2).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w), paddle.to_tensor(b))
+        ref = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_offset_shifts_samples(self):
+        # 1x1 kernel, integer offset (dy=0, dx=1) == shift left by one
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        w = np.ones((1, 1, 1, 1), np.float32)
+        off = np.zeros((1, 2, 4, 4), np.float32)
+        off[0, 1] = 1.0  # dx
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w))
+        got = out.numpy()[0, 0]
+        ref = np.arange(16, dtype=np.float32).reshape(4, 4)
+        ref[:, :3] = ref[:, 1:]
+        ref[:, 3] = 0.0  # out-of-image sample is ZERO (reference padding)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_mask_and_layer_training(self):
+        paddle.seed(0)
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.randn(1, 3, 8, 8).astype(np.float32))
+        layer = V.DeformConv2D(3, 4, kernel_size=3, padding=1)
+        off = paddle.to_tensor(
+            (rng.randn(1, 18, 8, 8) * 0.1).astype(np.float32))
+        mask = paddle.to_tensor(
+            np.full((1, 9, 8, 8), 0.5, np.float32))
+        out = layer(x, off, mask=mask)
+        assert out.shape == [1, 4, 8, 8]
+        loss = out.pow(2).mean()
+        loss.backward()
+        assert layer.weight.grad is not None
+        assert float(np.abs(layer.weight.grad.numpy()).max()) > 0
+
+
+class TestReviewRegressions:
+    def test_deform_conv_padding_matches_torch(self):
+        """Zero-offset deform conv with padding>0 must zero-pad (not
+        edge-clamp) the border samples."""
+        rng = np.random.RandomState(5)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        w = (rng.randn(3, 2, 3, 3) * 0.3).astype(np.float32)
+        off = np.zeros((1, 18, 6, 6), np.float32)
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w), padding=1)
+        ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                         padding=1).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_roi_align_adaptive_sampling(self):
+        """sampling_ratio=-1 uses ceil(roi/bin) samples — more samples on a
+        large ROI than sr=1, matching the reference's adaptive rule."""
+        rng = np.random.RandomState(6)
+        feat = rng.randn(1, 1, 32, 32).astype(np.float32)
+        boxes = np.array([[0, 0, 32, 32]], np.float32)
+        bn = np.array([1], np.int32)
+        ad = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                         paddle.to_tensor(bn), output_size=4,
+                         sampling_ratio=-1, aligned=False).numpy()
+        # adaptive = ceil(32/4) = 8 samples/bin → equals explicit sr=8
+        sr8 = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                          paddle.to_tensor(bn), output_size=4,
+                          sampling_ratio=8, aligned=False).numpy()
+        np.testing.assert_allclose(ad, sr8, rtol=1e-6)
+        sr1 = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                          paddle.to_tensor(bn), output_size=4,
+                          sampling_ratio=1, aligned=False).numpy()
+        assert np.abs(ad - sr1).max() > 1e-6
