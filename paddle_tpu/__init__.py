@@ -41,7 +41,7 @@ import importlib as _importlib
 for _sub in ("nn", "optimizer", "amp", "io", "jit", "distribution",
              "sparse", "fft", "signal", "geometric", "audio",
              "quantization", "profiler", "vision", "hapi", "incubate",
-             "native"):
+             "native", "generation"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError:

@@ -1,0 +1,112 @@
+"""Text generation (ref capability: PaddleNLP GenerationMixin —
+model.generate with greedy_search / sampling decode strategies,
+paddlenlp/generation/utils.py).
+
+TPU-first mechanism: autoregressive decoding runs the model on a FIXED
+[B, prompt+max_new_tokens] buffer every step and reads the logits at the
+current position. Causal attention makes positions > t irrelevant to the
+step-t logits, so the pad tail is harmless — and the constant shape means
+ONE compiled executable serves every step (no per-length recompiles, the
+XLA analog of the reference's static decode graph). The serving-grade
+O(1)-per-step path is the paged/masked decode attention kernel set
+(ops/paged_attention.py, incubate.nn.functional.masked_multihead_attention)
+used by the inference Predictor; this module is the framework-level
+`generate()` every CausalLM model family shares.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .core import autograd as ag
+from .framework.random import next_key
+
+__all__ = ["generate"]
+
+
+def _logits_fn(model, ids_arr):
+    """One forward on the padded buffer → [B, S, V] raw logits array."""
+    out = model(Tensor(ids_arr))
+    if isinstance(out, tuple):
+        out = out[-1]
+    return out._data
+
+
+def _sample_token(logits, strategy, top_k, top_p, temperature):
+    """logits [B, V] → token ids [B]."""
+    if strategy == "greedy_search":
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    if temperature and temperature != 1.0:
+        logits = logits / temperature
+    if top_k:
+        kth = jnp.sort(logits, -1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, -1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, -1)
+        cum = jnp.cumsum(probs, -1)
+        # keep the smallest prefix with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, -1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], -1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(next_key(), logits, -1).astype(jnp.int32)
+
+
+def generate(model, input_ids, max_new_tokens: int = 20,
+             decode_strategy: str = "sampling", top_k: Optional[int] = None,
+             top_p: Optional[float] = None, temperature: float = 1.0,
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+    """ref: PaddleNLP model.generate(...). Returns (generated_ids, scores):
+    generated_ids [B, max_new_tokens] holds ONLY the new tokens (prompt
+    excluded, PaddleNLP convention), padded with pad_token_id after eos;
+    scores [B, max_new_tokens] are the chosen tokens' log-probs.
+    """
+    if decode_strategy not in ("greedy_search", "sampling"):
+        raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
+                         "'greedy_search' or 'sampling'")
+    ids = input_ids._data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    B, S0 = ids.shape
+    total = S0 + max_new_tokens
+    buf = jnp.concatenate(
+        [ids, jnp.full((B, max_new_tokens), pad_token_id, jnp.int32)], 1)
+    finished = jnp.zeros((B,), bool)
+    out_tokens = []
+    out_scores = []
+    was_training = getattr(model, "training", False)
+    if hasattr(model, "eval"):
+        model.eval()
+    try:
+        with ag.no_grad():
+            for t in range(S0 - 1, total - 1):
+                logits = _logits_fn(model, buf)[:, t]
+                tok = _sample_token(logits, decode_strategy, top_k, top_p,
+                                    temperature)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+                score = jnp.take_along_axis(logp, tok[:, None], -1)[:, 0]
+                if eos_token_id is not None:
+                    tok = jnp.where(finished, pad_token_id, tok)
+                    score = jnp.where(finished, 0.0, score)
+                    finished = finished | (tok == eos_token_id)
+                buf = buf.at[:, t + 1].set(tok)
+                out_tokens.append(tok)
+                out_scores.append(score)
+                if eos_token_id is not None and bool(jnp.all(finished)):
+                    break
+    finally:
+        if was_training and hasattr(model, "train"):
+            model.train()
+    gen = jnp.stack(out_tokens, 1)
+    sc = jnp.stack(out_scores, 1)
+    if gen.shape[1] < max_new_tokens:  # early eos: pad to the full width
+        padw = max_new_tokens - gen.shape[1]
+        gen = jnp.concatenate(
+            [gen, jnp.full((B, padw), pad_token_id, jnp.int32)], 1)
+        sc = jnp.concatenate([sc, jnp.zeros((B, padw), sc.dtype)], 1)
+    return Tensor(gen), Tensor(sc)

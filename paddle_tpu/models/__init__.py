@@ -7,3 +7,4 @@ from . import deepseek  # noqa: F401
 from . import gpt  # noqa: F401
 from . import llama  # noqa: F401
 from . import moe_llm  # noqa: F401
+from . import qwen2  # noqa: F401

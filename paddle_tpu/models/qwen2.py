@@ -1,0 +1,172 @@
+"""Qwen2 dense decoder family (ref capability: PaddleNLP
+paddlenlp/transformers/qwen2/modeling.py — the dense sibling of the
+Qwen2-MoE baseline row, SURVEY §2.4).
+
+Architecture = Llama GQA backbone with two Qwen2 signatures: attention
+q/k/v projections carry BIASES (o_proj does not), and small configs tie the
+LM head to the token embedding. Reuses the Llama rope/SDPA path; weights
+carry the same Megatron TP specs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed.parallel_layers import MP_AXIS, ParallelCrossEntropy
+from .llama import (LlamaConfig, LlamaMLP, apply_rope, precompute_rope)
+
+__all__ = ["Qwen2Config", "Qwen2Model", "Qwen2ForCausalLM",
+           "qwen2_tiny_config"]
+
+
+class Qwen2Config(LlamaConfig):
+    def __init__(self, qkv_bias=True, **kw):
+        kw.setdefault("rope_theta", 1000000.0)
+        super().__init__(**kw)
+        self.qkv_bias = qkv_bias
+
+
+def qwen2_tiny_config(**kw) -> Qwen2Config:
+    base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=256,
+                tie_word_embeddings=True)
+    base.update(kw)
+    return Qwen2Config(**base)
+
+
+class Qwen2Attention(nn.Layer):
+    """GQA with biased q/k/v projections (the Qwen2 signature)."""
+
+    def __init__(self, c: Qwen2Config):
+        super().__init__()
+        self.c = c
+        H, D, KV = c.num_attention_heads, c.head_dim, c.num_key_value_heads
+        bias = c.qkv_bias
+
+        def lin(out_f, col):
+            l = nn.Linear(c.hidden_size, out_f,
+                          bias_attr=None if (bias and col) else False)
+            l.weight._sharding_spec = P(None, MP_AXIS) if col \
+                else P(MP_AXIS, None)
+            if l.bias is not None:
+                l.bias._sharding_spec = P(MP_AXIS)
+            return l
+
+        self.q_proj = lin(H * D, True)
+        self.k_proj = lin(KV * D, True)
+        self.v_proj = lin(KV * D, True)
+        self.o_proj = lin(c.hidden_size, False)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        c = self.c
+        B, S, _ = x.shape
+        H, KV, D = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+        mask = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+        from ..core.dispatch import apply as _apply
+
+        def impl(h, wq, bq, wk, bk, wv, bv, wo):
+            q = (h @ wq + bq).reshape(B, S, H, D)
+            k = (h @ wk + bk).reshape(B, S, KV, D)
+            v = (h @ wv + bv).reshape(B, S, KV, D)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            rep = H // KV
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            from ..ops.flash_attention import sdpa, sdpa_reference
+            if c.use_flash_attention and mask is None:
+                o = sdpa(q, k, v, causal=True)
+            else:
+                o = sdpa_reference(q, k, v, mask=mask, causal=True)
+            return o.reshape(B, S, -1) @ wo
+
+        if c.qkv_bias:
+            inputs = [x, self.q_proj.weight, self.q_proj.bias,
+                      self.k_proj.weight, self.k_proj.bias,
+                      self.v_proj.weight, self.v_proj.bias,
+                      self.o_proj.weight]
+            return _apply("qwen2_attention", impl, inputs)
+
+        def impl_nobias(h, wq, wk, wv, wo):
+            z = jnp.zeros((1,), h.dtype)
+            return impl(h, wq, z, wk, z, wv, z, wo)
+        return _apply("qwen2_attention", impl_nobias,
+                      [x, self.q_proj.weight, self.k_proj.weight,
+                       self.v_proj.weight, self.o_proj.weight])
+
+
+class Qwen2DecoderLayer(nn.Layer):
+    def __init__(self, c: Qwen2Config):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(c.hidden_size, c.rms_norm_eps)
+        self.self_attn = Qwen2Attention(c)
+        self.post_attention_layernorm = nn.RMSNorm(c.hidden_size,
+                                                   c.rms_norm_eps)
+        self.mlp = LlamaMLP(c)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class Qwen2Model(nn.Layer):
+    def __init__(self, config: Qwen2Config):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.embed_tokens.weight._data = init(
+            [config.vocab_size, config.hidden_size], "float32")
+        self.embed_tokens.weight._sharding_spec = P(MP_AXIS, None)
+        self.layers = nn.LayerList(
+            [Qwen2DecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = precompute_rope(config.head_dim,
+                                   config.max_position_embeddings,
+                                   config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        cos, sin = self.rope_cos._data, self.rope_sin._data
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                from ..distributed.recompute import recompute
+                x = recompute(layer, x, cos, sin, attn_mask)
+            else:
+                x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class Qwen2ForCausalLM(nn.Layer):
+    def __init__(self, config: Qwen2Config):
+        super().__init__()
+        self.config = config
+        self.qwen2 = Qwen2Model(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+            self.lm_head.weight._sharding_spec = P(None, MP_AXIS)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.qwen2(input_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = F.linear(h, self.qwen2.embed_tokens.weight.T)
+        if labels is not None:
+            tok_loss = ParallelCrossEntropy()(logits, labels)
+            return tok_loss.mean(), logits
+        return logits

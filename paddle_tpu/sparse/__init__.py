@@ -47,7 +47,16 @@ class SparseCooTensor:
         return t if t is not None else Tensor(self._bcoo.data)
 
     def to_dense(self) -> Tensor:
-        return Tensor(self._bcoo.todense())
+        t = getattr(self, "_values_tensor", None)
+        if t is None:
+            return Tensor(self._bcoo.todense())
+        # densify through the dispatch so a dense head after sparse convs
+        # still backprops into the conv chain
+        from ..core.dispatch import apply as _apply
+        idx, shape = self._bcoo.indices, self.shape
+        return _apply("sparse_to_dense",
+                      lambda v: jsparse.BCOO((v, idx), shape=shape)
+                      .todense(), [t])
 
     def coalesce(self) -> "SparseCooTensor":
         return SparseCooTensor(self._bcoo.sum_duplicates())
@@ -83,7 +92,8 @@ class SparseCsrTensor:
         return int(self._values.shape[0])
 
     def values(self) -> Tensor:
-        return Tensor(self._values)
+        t = getattr(self, "_values_tensor", None)
+        return t if t is not None else Tensor(self._values)
 
     def to_coo(self) -> SparseCooTensor:
         counts = jnp.diff(self.crows)
@@ -145,11 +155,26 @@ def add(x, y):
     raise TypeError("both operands must be sparse")
 
 
+def _map_values(name, x, jfn, *args):
+    """Apply a zero-preserving value map, KEEPING the autograd tape: the
+    values go through core.dispatch.apply so a conv→relu→conv chain still
+    propagates gradients to the first conv (`_values_tensor` protocol)."""
+    from ..core.dispatch import apply as _apply
+    vals_t = x.values()
+    out_vals = _apply(f"sparse_{name}", lambda v: jfn(v, *args), [vals_t])
+    if isinstance(x, SparseCsrTensor):
+        out = SparseCsrTensor(x.crows, x.cols, out_vals._data, x.shape)
+    else:
+        b = x._bcoo
+        out = SparseCooTensor(jsparse.BCOO((out_vals._data, b.indices),
+                                           shape=b.shape))
+    out._values_tensor = out_vals
+    return out
+
+
 def relu(x):
     if is_sparse(x):
-        b = _as_bcoo(x)
-        return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
-                                            shape=b.shape))
+        return _map_values("relu", x, jax.nn.relu)
     raise TypeError("operand must be sparse")
 
 
@@ -161,13 +186,7 @@ def _unary(name, jfn):
     def op(x, *args):
         if not is_sparse(x):
             raise TypeError(f"sparse.{name} operand must be sparse")
-        if isinstance(x, SparseCsrTensor):
-            # structure unchanged: map the values in place, stay CSR
-            return SparseCsrTensor(x.crows, x.cols, jfn(x._values, *args),
-                                   x.shape)
-        b = x._bcoo
-        return SparseCooTensor(jsparse.BCOO((jfn(b.data, *args), b.indices),
-                                            shape=b.shape))
+        return _map_values(name, x, jfn, *args)
     op.__name__ = name
     return op
 

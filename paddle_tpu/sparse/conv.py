@@ -96,6 +96,11 @@ def _sparse_conv(x, weight, bias, kernel, stride, padding, subm: bool,
         raise ValueError("expected [N, D, H, W, C] layout: 4 sparse dims + "
                          "dense channels")
     N, D, H, W, C = b.shape
+    import jax as _jax
+    if N * D * H * W > 2**31 - 1 and not _jax.config.jax_enable_x64:
+        raise ValueError(
+            f"voxel key space N*D*H*W = {N * D * H * W} exceeds int32; "
+            "enable JAX x64 (JAX_ENABLE_X64=1) for grids this large")
     spatial = (D, H, W)
     in_coords = b.indices.astype(jnp.int32)
     kd, kh, kw = kernel
@@ -180,10 +185,17 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, name=None):
                         _triple(padding), subm=False, out_channels=oc)
 
 
-class _ConvBase:
+from ..nn import Layer as _Layer  # noqa: E402
+from ..nn import initializer as _I  # noqa: E402
+
+
+class _ConvBase(_Layer):
+    """Real nn.Layer so enclosing models see the weights in parameters()
+    and state_dict (paddle parity: sparse convs are Layers)."""
+
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, bias_attr=None):
-        from ..nn import initializer as I
+        super().__init__()
         self.in_channels = in_channels
         self.out_channels = out_channels
         self.kernel_size = _triple(kernel_size)
@@ -192,21 +204,14 @@ class _ConvBase:
         kd, kh, kw = self.kernel_size
         fan_in = in_channels * kd * kh * kw
         std = math.sqrt(2.0 / fan_in)
-        self.weight = Tensor(
-            I.Normal(0.0, std)([kd, kh, kw, in_channels, out_channels],
-                               "float32"))
-        self.weight.stop_gradient = False
+        self.weight = self.create_parameter(
+            [kd, kh, kw, in_channels, out_channels],
+            default_initializer=_I.Normal(0.0, std))
         if bias_attr is not False:
-            self.bias = Tensor(jnp.zeros((out_channels,), jnp.float32))
-            self.bias.stop_gradient = False
+            self.bias = self.create_parameter([out_channels], is_bias=True,
+                                              attr=bias_attr)
         else:
             self.bias = None
-
-    def parameters(self):
-        return [self.weight] + ([self.bias] if self.bias is not None else [])
-
-    def __call__(self, x):
-        return self.forward(x)
 
 
 class SubmConv3D(_ConvBase):

@@ -219,7 +219,10 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
             m = my[:, None, :, None] & mx[None, :, None, :]  # [ph,pw,H,W]
             neg = jnp.asarray(-3.4e38, feat.dtype)
             v = jnp.where(m[None], feat[:, None, None, :, :], neg)
-            return v.max(axis=(-1, -2))
+            mx = v.max(axis=(-1, -2))
+            # empty bin (box off the feature map / degenerate) → 0, the
+            # reference's convention — never the -3.4e38 sentinel
+            return jnp.where(m.any(axis=(-1, -2))[None], mx, 0.0)
         return jax.vmap(one)(bx, img_idx)
 
     return apply("roi_pool", impl, [x if isinstance(x, Tensor)
@@ -289,32 +292,34 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     return apply("deform_conv2d", impl, inputs)
 
 
-class DeformConv2D:
-    """ref: paddle.vision.ops.DeformConv2D layer wrapper."""
+from ..nn import Layer as _Layer  # noqa: E402
+from ..nn import initializer as _I  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """ref: paddle.vision.ops.DeformConv2D. A real nn.Layer so enclosing
+    models pick up weight/bias in parameters() and state_dict."""
 
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, deformable_groups=1, groups=1,
                  bias_attr=None):
-        from ..nn import initializer as I
+        super().__init__()
         ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
             else tuple(kernel_size)
         self.stride, self.padding, self.dilation = stride, padding, dilation
         self.deformable_groups, self.groups = deformable_groups, groups
         fan_in = in_channels * ks[0] * ks[1]
         std = math.sqrt(2.0 / fan_in)
-        self.weight = Tensor(I.Normal(0.0, std)(
-            [out_channels, in_channels, ks[0], ks[1]], "float32"))
-        self.weight.stop_gradient = False
+        self.weight = self.create_parameter(
+            [out_channels, in_channels, ks[0], ks[1]],
+            default_initializer=_I.Normal(0.0, std))
         if bias_attr is not False:
-            self.bias = Tensor(jnp.zeros((out_channels,), jnp.float32))
-            self.bias.stop_gradient = False
+            self.bias = self.create_parameter([out_channels], is_bias=True,
+                                              attr=bias_attr)
         else:
             self.bias = None
 
-    def parameters(self):
-        return [self.weight] + ([self.bias] if self.bias is not None else [])
-
-    def __call__(self, x, offset, mask=None):
+    def forward(self, x, offset, mask=None):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              stride=self.stride, padding=self.padding,
                              dilation=self.dilation,

@@ -1,0 +1,100 @@
+"""Generation tests (ref capability: PaddleNLP GenerationMixin /
+model.generate — paddlenlp/generation/utils.py)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.generation import generate
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+
+
+def _prompt(B, S, V, seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randint(0, V, (B, S)).astype(np.int32))
+
+
+def test_greedy_matches_manual_argmax_loop():
+    paddle.seed(0)
+    c = gpt_tiny_config(num_hidden_layers=1)
+    model = GPTForCausalLM(c)
+    model.eval()
+    ids = _prompt(2, 5, c.vocab_size)
+    gen, scores = generate(model, ids, max_new_tokens=4,
+                           decode_strategy="greedy_search")
+    assert gen.shape == [2, 4]
+    # manual loop: grow the sequence, argmax the last position each time
+    cur = ids.numpy()
+    for step in range(4):
+        logits = model(paddle.to_tensor(cur)).numpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        np.testing.assert_array_equal(gen.numpy()[:, step], nxt)
+        cur = np.concatenate([cur, nxt[:, None]], 1)
+    # scores are the chosen tokens' log-probs (finite, <= 0)
+    s = scores.numpy()
+    assert np.all(np.isfinite(s)) and np.all(s <= 1e-6)
+
+
+def test_sampling_reproducible_and_valid():
+    paddle.seed(0)
+    c = llama_tiny_config(num_hidden_layers=1)
+    model = LlamaForCausalLM(c)
+    model.eval()
+    ids = _prompt(2, 4, c.vocab_size, seed=1)
+    paddle.seed(123)
+    g1, _ = generate(model, ids, max_new_tokens=6, decode_strategy="sampling",
+                     top_k=8, temperature=0.9)
+    paddle.seed(123)
+    g2, _ = generate(model, ids, max_new_tokens=6, decode_strategy="sampling",
+                     top_k=8, temperature=0.9)
+    np.testing.assert_array_equal(g1.numpy(), g2.numpy())
+    assert g1.numpy().min() >= 0 and g1.numpy().max() < c.vocab_size
+
+
+def test_top_k_1_equals_greedy():
+    paddle.seed(0)
+    c = gpt_tiny_config(num_hidden_layers=1)
+    model = GPTForCausalLM(c)
+    model.eval()
+    ids = _prompt(1, 4, c.vocab_size, seed=2)
+    greedy, _ = generate(model, ids, max_new_tokens=5,
+                         decode_strategy="greedy_search")
+    paddle.seed(7)
+    topk1, _ = generate(model, ids, max_new_tokens=5,
+                        decode_strategy="sampling", top_k=1)
+    np.testing.assert_array_equal(greedy.numpy(), topk1.numpy())
+
+
+def test_top_p_filters_tail():
+    """top_p≈0 keeps only the argmax token → equals greedy."""
+    paddle.seed(0)
+    c = gpt_tiny_config(num_hidden_layers=1)
+    model = GPTForCausalLM(c)
+    model.eval()
+    ids = _prompt(1, 4, c.vocab_size, seed=3)
+    greedy, _ = generate(model, ids, max_new_tokens=4,
+                         decode_strategy="greedy_search")
+    paddle.seed(11)
+    nucleus, _ = generate(model, ids, max_new_tokens=4,
+                          decode_strategy="sampling", top_p=1e-6)
+    np.testing.assert_array_equal(greedy.numpy(), nucleus.numpy())
+
+
+def test_eos_stops_and_pads():
+    paddle.seed(0)
+    c = gpt_tiny_config(num_hidden_layers=1)
+    model = GPTForCausalLM(c)
+    model.eval()
+    ids = _prompt(1, 4, c.vocab_size, seed=4)
+    # force eos = the greedy first token → generation ends immediately
+    first, _ = generate(model, ids, max_new_tokens=1,
+                        decode_strategy="greedy_search")
+    eos = int(first.numpy()[0, 0])
+    gen, scores = generate(model, ids, max_new_tokens=5,
+                           decode_strategy="greedy_search", eos_token_id=eos,
+                           pad_token_id=0)
+    g = gen.numpy()[0]
+    assert g.shape == (5,)
+    assert g[0] == eos
+    np.testing.assert_array_equal(g[1:], 0)
+    np.testing.assert_array_equal(scores.numpy()[0, 1:], 0.0)

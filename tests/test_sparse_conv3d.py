@@ -123,3 +123,49 @@ def test_stacked_subm_convs_all_layers_train():
     assert l1.weight.grad is not None
     assert float(np.abs(l1.weight.grad.numpy()).max()) > 0
     assert l2.weight.grad is not None
+
+
+def test_conv_relu_conv_chain_trains():
+    """Review regression: value-map ops (relu) between convs must carry the
+    tape, not rebuild raw values."""
+    paddle.seed(0)
+    st, _ = _random_cloud(1, 5, 5, 5, 3, nnz=15, seed=13)
+    l1 = sparse.nn.SubmConv3D(3, 4, kernel_size=3)
+    l2 = sparse.nn.SubmConv3D(4, 2, kernel_size=3)
+    out = l2(sparse.relu(l1(st)))
+    loss = out.to_dense().pow(2).mean()  # dense head path also on the tape
+    loss.backward()
+    assert l1.weight.grad is not None
+    assert float(np.abs(l1.weight.grad.numpy()).max()) > 0
+
+
+def test_sparse_convs_are_layers():
+    """Review regression: enclosing nn.Layer models must see conv params."""
+    import paddle_tpu.nn as nn
+
+    class Backbone(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c1 = sparse.nn.SubmConv3D(3, 4, kernel_size=3)
+            self.c2 = sparse.nn.Conv3D(4, 2, kernel_size=3, stride=2,
+                                       padding=1)
+
+        def forward(self, x):
+            return self.c2(self.c1(x))
+
+    m = Backbone()
+    params = m.parameters()
+    assert len(params) == 4  # 2 weights + 2 biases
+    sd = m.state_dict()
+    assert any("c1" in k for k in sd)
+
+
+def test_huge_grid_key_overflow_raises():
+    idx = np.zeros((4, 2), np.int64)
+    idx[:, 1] = 1
+    st = sparse.sparse_coo_tensor(idx, np.ones((2, 1), np.float32),
+                                  shape=(2, 1300, 1300, 1300, 1))
+    w = np.zeros((3, 3, 3, 1, 1), np.float32)
+    import pytest
+    with pytest.raises(ValueError, match="int32"):
+        sparse.subm_conv3d(st, w)

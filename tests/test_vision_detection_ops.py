@@ -198,3 +198,26 @@ class TestReviewRegressions:
                           paddle.to_tensor(bn), output_size=4,
                           sampling_ratio=1, aligned=False).numpy()
         assert np.abs(ad - sr1).max() > 1e-6
+
+    def test_roi_pool_empty_bin_is_zero(self):
+        feat = np.ones((1, 2, 8, 8), np.float32)
+        boxes = np.array([[0, 130, 10, 140]], np.float32)  # off the map
+        out = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=2, spatial_scale=1.0 / 16)
+        np.testing.assert_array_equal(out.numpy(), 0.0)
+
+    def test_deform_layer_registers_params(self):
+        import paddle_tpu.nn as nn
+
+        class Det(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.dcn = V.DeformConv2D(3, 4, kernel_size=3, padding=1)
+
+            def forward(self, x, off):
+                return self.dcn(x, off)
+
+        m = Det()
+        assert len(m.parameters()) == 2
+        assert any("dcn" in k for k in m.state_dict())
