@@ -100,8 +100,14 @@ class SparseCsrTensor:
         rows = jnp.repeat(jnp.arange(len(counts)), counts,
                           total_repeat_length=self.nnz)
         idx = jnp.stack([rows, self.cols], axis=1)
-        return SparseCooTensor(jsparse.BCOO((self._values, idx),
-                                            shape=self._shape))
+        out = SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                           shape=self._shape))
+        # value order is preserved row-major, so the tracked values Tensor
+        # (autograd protocol) carries over unchanged
+        t = getattr(self, "_values_tensor", None)
+        if t is not None:
+            out._values_tensor = t
+        return out
 
     def to_dense(self) -> Tensor:
         return self.to_coo().to_dense()

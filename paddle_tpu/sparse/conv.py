@@ -97,12 +97,15 @@ def _sparse_conv(x, weight, bias, kernel, stride, padding, subm: bool,
                          "dense channels")
     N, D, H, W, C = b.shape
     import jax as _jax
-    if N * D * H * W > 2**31 - 1 and not _jax.config.jax_enable_x64:
+    x64 = bool(_jax.config.jax_enable_x64)
+    if N * D * H * W > 2**31 - 1 and not x64:
         raise ValueError(
             f"voxel key space N*D*H*W = {N * D * H * W} exceeds int32; "
             "enable JAX x64 (JAX_ENABLE_X64=1) for grids this large")
+    # keys must be computed in a dtype that actually holds N*D*H*W
+    key_dtype = jnp.int64 if x64 else jnp.int32
     spatial = (D, H, W)
-    in_coords = b.indices.astype(jnp.int32)
+    in_coords = b.indices.astype(key_dtype)
     kd, kh, kw = kernel
 
     if subm:
@@ -134,7 +137,7 @@ def _sparse_conv(x, weight, bias, kernel, stride, padding, subm: bool,
         h_ = (uniq // ow) % oh
         d_ = (uniq // (ow * oh)) % od
         b_ = uniq // (ow * oh * od)
-        out_coords = jnp.stack([b_, d_, h_, w_], 1).astype(jnp.int32)
+        out_coords = jnp.stack([b_, d_, h_, w_], 1).astype(key_dtype)
 
     gather_idx, found = _gather_rulebook(in_coords, out_coords, spatial,
                                          kernel, stride, padding, subm)

@@ -205,3 +205,185 @@ def pad_sequences(seqs: Sequence[List[int]], length: int,
     for i, s in enumerate(seqs):
         out[i, :min(len(s), length)] = s[:length]
     return out
+
+
+# ---------------------------------------------------------------------------
+# Byte-level BPE (GPT-2/Llama-family tokenizer; ref: PaddleNLP
+# paddlenlp/transformers/gpt/tokenizer.py — GPTTokenizer's byte-level BPE)
+# ---------------------------------------------------------------------------
+def _bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte→printable-char table (avoids raw control
+    chars in the vocab)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+def _gpt2_pretokenize_pattern():
+    """GPT-2's pre-tokenizer: contractions, space-prefixed word/number
+    runs, symbol runs, whitespace. The space ATTACHES to the following
+    word (" world" is one piece) — required for pretrained vocab/merges
+    compatibility. Uses the `regex` module's \\p classes when available
+    (the reference pattern), else an ASCII-equivalent re fallback."""
+    try:
+        import regex as _rx
+        return _rx.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+            r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+    except ImportError:
+        return re.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?[0-9]+"
+            r"| ?[^\sA-Za-z0-9]+|\s+(?!\S)|\s+")
+
+
+def train_bpe(corpus: Sequence[str], vocab_size: int,
+              special_tokens: Sequence[str] = ("<|endoftext|>",)):
+    """Learn byte-level BPE merges from a corpus (offline-trainable stand-in
+    for loading pretrained merges.txt). Returns (vocab: Dict[str, int],
+    merges: List[Tuple[str, str]])."""
+    byte_enc = _bytes_to_unicode()
+    words: Dict[tuple, int] = {}
+    pat = _gpt2_pretokenize_pattern()
+    for text in corpus:
+        for piece in pat.findall(text):
+            sym = tuple(byte_enc[b] for b in piece.encode("utf-8"))
+            if sym:
+                words[sym] = words.get(sym, 0) + 1
+    vocab = {tok: i for i, tok in enumerate(special_tokens)}
+    for ch in sorted(set(byte_enc.values())):
+        vocab.setdefault(ch, len(vocab))
+    merges: List[tuple] = []
+    while len(vocab) < vocab_size:
+        pairs: Dict[tuple, int] = {}
+        for sym, cnt in words.items():
+            for a, b in zip(sym, sym[1:]):
+                pairs[(a, b)] = pairs.get((a, b), 0) + cnt
+        if not pairs:
+            break
+        best = max(pairs, key=lambda p: (pairs[p], p))
+        merged = best[0] + best[1]
+        # a collision with an existing entry (two merge paths to the same
+        # string) still records the merge RULE; only vocab growth is skipped
+        if merged not in vocab:
+            vocab[merged] = len(vocab)
+        merges.append(best)
+        new_words = {}
+        for sym, cnt in words.items():
+            out, i = [], 0
+            while i < len(sym):
+                if i + 1 < len(sym) and (sym[i], sym[i + 1]) == best:
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(sym[i])
+                    i += 1
+            new_words[tuple(out)] = new_words.get(tuple(out), 0) + cnt
+        words = new_words
+    return vocab, merges
+
+
+class BPETokenizer:
+    """Byte-level BPE encode/decode (GPT/Llama tokenizer mechanism).
+
+    Construct from (vocab, merges) — learned via train_bpe or loaded from
+    pretrained vocab.json/merges.txt files via from_pretrained.
+    """
+
+    def __init__(self, vocab: Dict[str, int], merges,
+                 unk_token: str = "<|endoftext|>",
+                 eos_token: str = "<|endoftext|>", pad_token=None):
+        self.vocab = dict(vocab)
+        self.id_to_token = {i: t for t, i in self.vocab.items()}
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.byte_enc = _bytes_to_unicode()
+        self.byte_dec = {v: k for k, v in self.byte_enc.items()}
+        self.unk_token = unk_token
+        self.eos_token = eos_token
+        self.pad_token = pad_token if pad_token is not None else eos_token
+        self._cache: Dict[str, List[str]] = {}
+        self._pat = _gpt2_pretokenize_pattern()
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kw) -> "BPETokenizer":
+        vf = os.path.join(path, "vocab.json")
+        mf = os.path.join(path, "merges.txt")
+        with open(vf, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges = []
+        with open(mf, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                merges.append(tuple(line.split()))
+        return cls(vocab, merges, **kw)
+
+    def _bpe(self, token: str) -> List[str]:
+        if token in self._cache:
+            return self._cache[token]
+        sym = list(token)
+        while len(sym) > 1:
+            best, rank = None, None
+            for pair in zip(sym, sym[1:]):
+                r = self.ranks.get(pair)
+                if r is not None and (rank is None or r < rank):
+                    best, rank = pair, r
+            if best is None:
+                break
+            out, i = [], 0
+            while i < len(sym):
+                if i + 1 < len(sym) and (sym[i], sym[i + 1]) == best:
+                    out.append(sym[i] + sym[i + 1])
+                    i += 2
+                else:
+                    out.append(sym[i])
+                    i += 1
+            sym = out
+        self._cache[token] = sym
+        return sym
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for piece in self._pat.findall(text):
+            mapped = "".join(self.byte_enc[b] for b in piece.encode("utf-8"))
+            out.extend(self._bpe(mapped))
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        unk = self.vocab.get(self.unk_token, 0)
+        return [self.vocab.get(t, unk) for t in self.tokenize(text)]
+
+    def decode(self, ids) -> str:
+        toks = [self.id_to_token.get(int(i), "") for i in
+                np.asarray(ids).tolist()]
+        chars = "".join(t for t in toks
+                        if t not in (self.eos_token, self.pad_token))
+        raw = bytes(self.byte_dec[c] for c in chars if c in self.byte_dec)
+        return raw.decode("utf-8", errors="replace")
+
+    def __call__(self, texts, max_length: int = 128, padding: bool = True,
+                 truncation: bool = True):
+        if isinstance(texts, str):
+            texts = [texts]
+        encs = [self.encode(t) for t in texts]
+        if truncation:
+            encs = [e[:max_length] for e in encs]
+        pad_id = self.vocab.get(self.pad_token, 0)
+        # truncation off: L grows to the longest sequence (never chop)
+        L = max(len(e) for e in encs)
+        if padding and truncation:
+            L = max_length
+        return {"input_ids": pad_sequences(encs, L, pad_id),
+                "attention_mask": pad_sequences(
+                    [[1] * len(e) for e in encs], L, 0)}
+
+
+__all__ += ["BPETokenizer", "train_bpe"]

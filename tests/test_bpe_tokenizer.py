@@ -1,0 +1,105 @@
+"""Byte-level BPE tokenizer tests (ref capability: PaddleNLP GPT/Llama
+tokenizers — paddlenlp/transformers/gpt/tokenizer.py)."""
+
+import numpy as np
+
+from paddle_tpu.text import BPETokenizer, train_bpe
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick brown fox is quick and brown",
+    "lazy dogs sleep all day the lazy way",
+    "pack my box with five dozen liquor jugs",
+] * 4
+
+
+def _tok(vocab_size=400):
+    vocab, merges = train_bpe(CORPUS, vocab_size)
+    return BPETokenizer(vocab, merges)
+
+
+def test_roundtrip_exact():
+    tok = _tok()
+    for text in ["the quick brown fox", "lazy dog day",
+                 "unseen wordforms too", "punctuation, and; symbols!"]:
+        ids = tok.encode(text)
+        assert all(isinstance(i, int) for i in ids)
+        assert tok.decode(ids) == text
+
+
+def test_merges_compress_frequent_words():
+    tok = _tok()
+    # 'the' is the most frequent word: after training it should be few
+    # tokens, while a random unseen string stays byte-level
+    assert len(tok.encode("the")) <= 2
+    assert len(tok.encode("zxqj")) >= 3
+
+
+def test_unicode_bytes_roundtrip():
+    tok = _tok()
+    text = "héllo wörld — ¥1000"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_batched_call_padding():
+    tok = _tok()
+    out = tok(["the quick fox", "dog"], max_length=16)
+    assert out["input_ids"].shape == (2, 16)
+    assert out["attention_mask"].shape == (2, 16)
+    n1 = int(out["attention_mask"][1].sum())
+    assert n1 < 16  # short text padded
+    np.testing.assert_array_equal(out["input_ids"][1, n1:],
+                                  tok.vocab[tok.pad_token])
+
+
+def test_train_respects_vocab_size_and_specials():
+    vocab, merges = train_bpe(CORPUS, 300, special_tokens=("<eos>", "<pad>"))
+    assert vocab["<eos>"] == 0 and vocab["<pad>"] == 1
+    assert len(vocab) <= 300
+    assert len(merges) > 0
+
+
+class TestReviewRegressions:
+    def test_space_attaches_to_following_word(self):
+        """GPT-2 pre-tokenizer parity: ' world' is ONE piece, so merges can
+        produce the space-prefixed word tokens pretrained vocabs contain."""
+        tok = _tok()
+        pieces = tok._pat.findall("hello world")
+        assert pieces == ["hello", " world"]
+        # and the trained tokenizer merges ' the' into few tokens
+        assert len(tok.encode(" the")) <= 2
+
+    def test_no_truncation_keeps_full_length(self):
+        tok = _tok()
+        long = " ".join(["unseenworder"] * 40)
+        n = len(tok.encode(long))
+        assert n > 16
+        out = tok([long, "dog"], max_length=16, padding=True,
+                  truncation=False)
+        assert out["input_ids"].shape[1] == n  # nothing chopped
+        assert int(out["attention_mask"][0].sum()) == n
+
+    def test_train_bpe_survives_merge_collisions(self):
+        # tiny corpus engineered so multiple merge paths reach the same
+        # string; training must keep going instead of stopping early
+        corpus = ["aaab aab ab aaab aab ab abc bc"] * 8
+        vocab, merges = train_bpe(corpus, 290)
+        assert len(merges) >= 3
+
+
+def test_temperature_zero_is_greedy():
+    import paddle_tpu as paddle
+    from paddle_tpu.generation import generate
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+    paddle.seed(0)
+    c = gpt_tiny_config(num_hidden_layers=1)
+    model = GPTForCausalLM(c)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, c.vocab_size, (1, 4)).astype(np.int32))
+    greedy, _ = generate(model, ids, max_new_tokens=3,
+                         decode_strategy="greedy_search")
+    paddle.seed(99)
+    t0, _ = generate(model, ids, max_new_tokens=3,
+                     decode_strategy="sampling", temperature=0.0)
+    np.testing.assert_array_equal(greedy.numpy(), t0.numpy())

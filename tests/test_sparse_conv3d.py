@@ -169,3 +169,24 @@ def test_huge_grid_key_overflow_raises():
     import pytest
     with pytest.raises(ValueError, match="int32"):
         sparse.subm_conv3d(st, w)
+
+
+def test_csr_value_map_to_dense_keeps_tape():
+    """Review regression: CSR relu -> to_dense must keep the autograd tape
+    (the COO fix's CSR sibling)."""
+    import paddle_tpu.nn  # noqa: F401
+    dense_w = paddle.to_tensor(np.ones((2, 2), np.float32))
+    dense_w.stop_gradient = False
+    csr = sparse.sparse_csr_tensor([0, 1, 2], [0, 1],
+                                   np.array([2.0, -3.0], np.float32), (2, 2))
+    # build values that depend on a differentiable tensor
+    from paddle_tpu.core.dispatch import apply as _apply
+    vals = _apply("mk_vals", lambda w: w.reshape(-1)[:2], [dense_w])
+    csr._values_tensor = vals
+    csr._values = vals._data
+    out = sparse.relu(csr)
+    dense = out.to_dense()
+    loss = dense.pow(2).mean()
+    loss.backward()
+    assert dense_w.grad is not None
+    assert float(np.abs(dense_w.grad.numpy()).max()) > 0
