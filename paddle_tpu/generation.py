@@ -44,7 +44,7 @@ def _sample_token(logits, strategy, top_k, top_p, temperature):
         # temperature 0 degenerates to greedy (the usual convention),
         # never a silent fall-through to temperature-1 sampling
         return jnp.argmax(logits, -1).astype(jnp.int32)
-    if temperature != 1.0:
+    if temperature is not None and temperature != 1.0:
         logits = logits / temperature
     if top_k:
         kth = jnp.sort(logits, -1)[:, -top_k][:, None]

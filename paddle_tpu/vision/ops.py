@@ -325,3 +325,387 @@ class DeformConv2D(_Layer):
                              dilation=self.dilation,
                              deformable_groups=self.deformable_groups,
                              groups=self.groups, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# SSD / YOLO box utilities (ref: python/paddle/vision/ops.py prior_box,
+# box_coder, yolo_box, matrix_nms)
+# ---------------------------------------------------------------------------
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes for one feature map. input NCHW feature,
+    image NCHW original image. Returns (boxes [H, W, A, 4] in normalized
+    (x1, y1, x2, y2), variances broadcast to the same shape)."""
+    fh, fw = _arr(input).shape[-2:]
+    ih, iw = _arr(image).shape[-2:]
+    ars = []
+    for ar in aspect_ratios:
+        ars.append(float(ar))
+        if flip and abs(ar - 1.0) > 1e-6:
+            ars.append(1.0 / float(ar))
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    widths, heights = [], []
+    for mi, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            widths.append(ms); heights.append(ms)
+            if max_sizes:
+                s = math.sqrt(ms * max_sizes[mi])
+                widths.append(s); heights.append(s)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                widths.append(ms * math.sqrt(ar))
+                heights.append(ms / math.sqrt(ar))
+        else:
+            for ar in ars:
+                widths.append(ms * math.sqrt(ar))
+                heights.append(ms / math.sqrt(ar))
+            if max_sizes:
+                s = math.sqrt(ms * max_sizes[mi])
+                widths.append(s); heights.append(s)
+    A = len(widths)
+    w = jnp.asarray(widths, jnp.float32) / iw
+    h = jnp.asarray(heights, jnp.float32) / ih
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w / iw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h / ih
+    CX = cx[None, :, None]
+    CY = cy[:, None, None]
+    boxes = jnp.stack([
+        jnp.broadcast_to(CX - w / 2, (fh, fw, A)),
+        jnp.broadcast_to(CY - h / 2, (fh, fw, A)),
+        jnp.broadcast_to(CX + w / 2, (fh, fw, A)),
+        jnp.broadcast_to(CY + h / 2, (fh, fw, A))], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    return Tensor(boxes), Tensor(var)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """SSD box encode/decode (ref: paddle.vision.ops.box_coder).
+    encode: target corner boxes [N,4] vs priors [M,4] → offsets [N,M,4].
+    decode: offsets [N,M,4]-compatible vs priors → corner boxes."""
+    pb = _arr(prior_box).astype(jnp.float32)
+    tb = _arr(target_box).astype(jnp.float32)
+    pbv = None if prior_box_var is None else \
+        _arr(prior_box_var).astype(jnp.float32)
+    if pbv is not None and pbv.ndim == 1:  # 4-float list form (API parity)
+        pbv = jnp.broadcast_to(pbv, pb.shape)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], -1)
+        if pbv is not None:
+            out = out / pbv[None, :, :]
+        return Tensor(out)
+    if code_type == "decode_center_size":
+        # tb: [N, M, 4] offsets (or broadcastable); priors along `axis`
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, ph, pcx, pcy))
+            pbv_ = None if pbv is None else pbv[None, :, :]
+        else:
+            pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, ph, pcx, pcy))
+            pbv_ = None if pbv is None else pbv[:, None, :]
+        off = tb * pbv_ if pbv_ is not None else tb
+        cx = off[..., 0] * pw_ + pcx_
+        cy = off[..., 1] * ph_ + pcy_
+        w = jnp.exp(off[..., 2]) * pw_
+        h = jnp.exp(off[..., 3]) * ph_
+        return Tensor(jnp.stack([cx - w / 2, cy - h / 2,
+                                 cx + w / 2 - norm, cy + h / 2 - norm], -1))
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0, name=None):
+    """Decode a YOLOv3 head (ref: paddle.vision.ops.yolo_box). x is
+    [N, A*(5+C), H, W]; returns (boxes [N, H*W*A, 4] xyxy in image pixels,
+    scores [N, H*W*A, C]); low-confidence boxes are zeroed."""
+    xb = _arr(x).astype(jnp.float32)
+    N, _, H, W = xb.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    xb = xb.reshape(N, A, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    sig = lambda v: 1.0 / (1.0 + jnp.exp(-v))
+    bx = (sig(xb[:, :, 0]) * alpha + beta + gx) / W
+    by = (sig(xb[:, :, 1]) * alpha + beta + gy) / H
+    in_w = downsample_ratio * W
+    in_h = downsample_ratio * H
+    bw = jnp.exp(xb[:, :, 2]) * an[None, :, 0, None, None] / in_w
+    bh = jnp.exp(xb[:, :, 3]) * an[None, :, 1, None, None] / in_h
+    conf = sig(xb[:, :, 4])
+    probs = sig(xb[:, :, 5:]) * conf[:, :, None]
+    img = jnp.asarray(_arr(img_size), jnp.float32).reshape(N, 2)
+    ih = img[:, 0][:, None, None, None]
+    iw = img[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+    keep = (conf > conf_thresh).astype(jnp.float32)
+    boxes = jnp.stack([x1, y1, x2, y2], -1) * keep[..., None]
+    scores = probs * keep[:, :, None]
+    # row r of both outputs is the same (h, w, a) site
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, H * W * A, 4)
+    scores = scores.transpose(0, 3, 4, 1, 2).reshape(N, H * W * A,
+                                                     class_num)
+    return Tensor(boxes), Tensor(scores)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): fully-vectorized soft suppression — no
+    sequential loop, a natural TPU fit (ref: paddle.vision.ops.matrix_nms).
+    bboxes [N, M, 4], scores [N, C, M]. Returns [R, 6] rows of
+    (class, decayed_score, x1, y1, x2, y2) per image, concatenated."""
+    import numpy as _np
+    bb = _np.asarray(_arr(bboxes), _np.float32)  # one transfer, then host
+    sc = _np.asarray(_arr(scores), _np.float32)
+    N, C, M = sc.shape
+    outs, idxs, nums = [], [], []
+    for n in range(N):
+        cls_all, box_all = _np.nonzero(sc[n] > score_threshold)
+        if background_label >= 0:
+            keep_c = cls_all != background_label
+            cls_all, box_all = cls_all[keep_c], box_all[keep_c]
+        s_all = sc[n, cls_all, box_all]
+        order0 = _np.argsort(-s_all)[:nms_top_k]
+        flat = [(float(s_all[i]), int(cls_all[i]), int(box_all[i]))
+                for i in order0]
+        if not flat:
+            outs.append(_np.zeros((0, 6), _np.float32))
+            idxs.append(_np.zeros((0,), _np.int64))
+            nums.append(0)
+            continue
+        ss = jnp.asarray([f[0] for f in flat], jnp.float32)
+        cs = _np.asarray([f[1] for f in flat])
+        bs = jnp.asarray(bb[n, [f[2] for f in flat]])
+        k = len(flat)
+        iou = _iou_matrix(bs)
+        same_cls = jnp.asarray(cs[:, None] == cs[None, :])
+        # rows sorted by score desc: pair (i, j) active iff j outranks i
+        higher = jnp.arange(k)[None, :] < jnp.arange(k)[:, None]
+        iou_h = jnp.where(higher & same_cls, iou, 0.0)
+        # compensation: each suppressor j's own max overlap with ITS
+        # higher-ranked peers (the SOLOv2 matrix-NMS formula)
+        comp = jnp.max(iou_h, axis=1)
+        if use_gaussian:
+            # reference formula: exp(-σ·(iou² − comp²)) — σ MULTIPLIES
+            decay_mat = jnp.exp(-gaussian_sigma
+                                * (iou_h ** 2 - comp[None, :] ** 2))
+        else:
+            decay_mat = (1.0 - iou_h) / (1.0 - comp[None, :])
+        decay_mat = jnp.where(higher & same_cls, decay_mat, 1.0)
+        decay = jnp.min(decay_mat, axis=1)
+        dec = ss * decay
+        keep = dec >= post_threshold if post_threshold > 0 else \
+            jnp.ones_like(dec, bool)
+        dec_np = _np.asarray(dec)          # one device→host transfer
+        keep_np = _np.asarray(keep)
+        bs_np = _np.asarray(bs)
+        order = _np.argsort(-dec_np)
+        order = order[keep_np[order]][:keep_top_k]
+        rows = _np.concatenate(
+            [cs[order, None].astype(_np.float32),
+             dec_np[order, None], bs_np[order]], 1) if len(order) else \
+            _np.zeros((0, 6), _np.float32)
+        outs.append(rows)
+        idxs.append(_np.asarray([flat[i][2] for i in order], _np.int64))
+        nums.append(len(order))
+    out = Tensor(jnp.asarray(_np.concatenate(outs, 0)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(_np.concatenate(idxs, 0))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(_np.asarray(nums, _np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+class RoIAlign:
+    """ref: paddle.vision.ops.RoIAlign layer wrapper."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    """ref: paddle.vision.ops.RoIPool layer wrapper."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+__all__ += ["prior_box", "box_coder", "yolo_box", "matrix_nms",
+            "RoIAlign", "RoIPool"]
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (R-FCN; ref:
+    paddle.vision.ops.psroi_pool). Input channels must be
+    C_out * ph * pw; bin (i, j) of an ROI average-pools channel group
+    (i*pw + j) over that bin's spatial extent."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xb = _arr(x)
+    Cin, H, W = xb.shape[-3:]
+    if Cin % (ph * pw) != 0:
+        raise ValueError(f"input channels {Cin} not divisible by "
+                         f"{ph}*{pw} bins")
+    Cout = Cin // (ph * pw)
+    bx = _arr(boxes).astype(jnp.float32)
+    bn = [int(v) for v in jnp.asarray(_arr(boxes_num))]
+    img_idx = [i for i, c in enumerate(bn) for _ in range(c)]
+
+    def impl(feat_all):
+        outs = []
+        for r in range(bx.shape[0]):
+            # R-FCN layout: channel (k, i, j) = k·ph·pw + i·pw + j
+            feat = feat_all[img_idx[r]].reshape(Cout, ph, pw, H, W)
+            x1 = bx[r, 0] * spatial_scale
+            y1 = bx[r, 1] * spatial_scale
+            x2 = bx[r, 2] * spatial_scale
+            y2 = bx[r, 3] * spatial_scale
+            bh = jnp.maximum(y2 - y1, 0.1) / ph
+            bw = jnp.maximum(x2 - x1, 0.1) / pw
+            ys = jnp.arange(H, dtype=jnp.float32)[None, :]
+            xs = jnp.arange(W, dtype=jnp.float32)[None, :]
+            y0 = y1 + jnp.arange(ph, dtype=jnp.float32)[:, None] * bh
+            x0 = x1 + jnp.arange(pw, dtype=jnp.float32)[:, None] * bw
+            my = (ys >= jnp.floor(y0)) & (ys < jnp.ceil(y0 + bh))  # [ph,H]
+            mx = (xs >= jnp.floor(x0)) & (xs < jnp.ceil(x0 + bw))  # [pw,W]
+            m = (my[:, None, :, None] & mx[None, :, None, :])  # [ph,pw,H,W]
+            cnt = jnp.maximum(m.sum(axis=(-1, -2)), 1)         # [ph,pw]
+            v = jnp.where(m[None], feat, 0.0)
+            pooled = v.sum(axis=(-1, -2)) / cnt[None]          # [Cout,ph,pw]
+            outs.append(pooled)
+        return jnp.stack(outs)
+
+    return apply("psroi_pool", impl, [x if isinstance(x, Tensor)
+                                      else Tensor(xb)])
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (ref:
+    paddle.vision.ops.distribute_fpn_proposals):
+    level = floor(refer_level + log2(sqrt(area)/refer_scale)), clipped.
+    Returns (rois-per-level list, restore_index, rois_num-per-level)."""
+    import numpy as np
+    rois = np.asarray(_arr(fpn_rois), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, rois_nums = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        ids = np.nonzero(lvl == L)[0]
+        order.extend(ids.tolist())
+        multi_rois.append(Tensor(jnp.asarray(rois[ids])))
+        rois_nums.append(len(ids))
+    restore = np.empty(len(rois), np.int64)
+    restore[np.asarray(order, np.int64)] = np.arange(len(rois))
+    return (multi_rois, Tensor(jnp.asarray(restore)),
+            Tensor(jnp.asarray(np.asarray(rois_nums, np.int32))))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (ref: paddle.vision.ops.generate_proposals):
+    decode anchor deltas → clip to image → filter small → top-k → NMS.
+    scores [N, A, H, W], bbox_deltas [N, 4A, H, W], anchors/variances
+    [H, W, A, 4] (prior_box layout)."""
+    import numpy as np
+    sc = np.asarray(_arr(scores), np.float32)
+    bd = np.asarray(_arr(bbox_deltas), np.float32)
+    an = np.asarray(_arr(anchors), np.float32).reshape(-1, 4)
+    va = np.asarray(_arr(variances), np.float32).reshape(-1, 4)
+    imgs = np.asarray(_arr(img_size), np.float32)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_nums = [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # HWA order
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw * 0.5
+        acy = an[:, 1] + ah * 0.5
+        cx = va[:, 0] * d[:, 0] * aw + acx
+        cy = va[:, 1] * d[:, 1] * ah + acy
+        wd = np.exp(np.minimum(va[:, 2] * d[:, 2], 10.0)) * aw
+        hg = np.exp(np.minimum(va[:, 3] * d[:, 3], 10.0)) * ah
+        props = np.stack([cx - wd / 2, cy - hg / 2,
+                          cx + wd / 2 - off, cy + hg / 2 - off], 1)
+        ih, iw = imgs[n, 0], imgs[n, 1]
+        props[:, 0] = np.clip(props[:, 0], 0, iw - off)
+        props[:, 1] = np.clip(props[:, 1], 0, ih - off)
+        props[:, 2] = np.clip(props[:, 2], 0, iw - off)
+        props[:, 3] = np.clip(props[:, 3], 0, ih - off)
+        keep = ((props[:, 2] - props[:, 0] + off >= min_size)
+                & (props[:, 3] - props[:, 1] + off >= min_size))
+        props, s = props[keep], s[keep]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        props, s = props[order], s[order]
+        if len(props):
+            kept = np.asarray(nms(jnp.asarray(props), nms_thresh,
+                                  scores=jnp.asarray(s)).numpy())
+            kept = kept[:post_nms_top_n]
+            props, s = props[kept], s[kept]
+        all_rois.append(np.concatenate([props, s[:, None]], 1))
+        all_nums.append(len(props))
+    rois = np.concatenate(all_rois, 0) if all_rois else \
+        np.zeros((0, 5), np.float32)
+    out = (Tensor(jnp.asarray(rois[:, :4])), Tensor(jnp.asarray(rois[:, 4])))
+    if return_rois_num:
+        return out + (Tensor(jnp.asarray(np.asarray(all_nums, np.int32))),)
+    return out
+
+
+__all__ += ["psroi_pool", "distribute_fpn_proposals", "generate_proposals"]

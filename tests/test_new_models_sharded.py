@@ -1,0 +1,77 @@
+"""New model families under the hybrid mesh (SURVEY §4.2 build lesson:
+N-way-sharded step == single-device step on the simulated 8-device CPU
+mesh). Covers GPT / Qwen2 / DeepSeek-V2-MLA — the TP specs these models
+attach must actually materialize and train under fleet.distributed_model."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+
+
+def _strategy(dp=4, mp=2):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": 1,
+                        "sharding_degree": 1, "sep_degree": 1}
+    return s
+
+
+def _train_two_steps(model_fn, ids_np):
+    from paddle_tpu.optimizer import AdamW
+    model = model_fn()
+    model.train()
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    losses = []
+    for _ in range(2):
+        loss, _ = model(paddle.to_tensor(ids_np), labels=paddle.to_tensor(ids_np))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+@pytest.mark.parametrize("family", ["gpt", "qwen2", "deepseek"])
+def test_tp_sharded_step_matches_single_device(family):
+    if family == "gpt":
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+        cfg = gpt_tiny_config(num_hidden_layers=1)
+        model_fn = lambda: GPTForCausalLM(cfg)  # noqa: E731
+        V = cfg.vocab_size
+    elif family == "qwen2":
+        from paddle_tpu.models.qwen2 import (Qwen2ForCausalLM,
+                                             qwen2_tiny_config)
+        cfg = qwen2_tiny_config(num_hidden_layers=1)
+        model_fn = lambda: Qwen2ForCausalLM(cfg)  # noqa: E731
+        V = cfg.vocab_size
+    else:
+        from paddle_tpu.models.deepseek import (DeepSeekV2ForCausalLM,
+                                                deepseek_v2_tiny_config)
+        cfg = deepseek_v2_tiny_config(num_hidden_layers=1,
+                                      first_k_dense_replace=1)
+        model_fn = lambda: DeepSeekV2ForCausalLM(cfg)  # noqa: E731
+        V = cfg.vocab_size
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, V, (4, 16)).astype(np.int32)
+
+    paddle.seed(0)
+    ref = _train_two_steps(model_fn, ids)
+
+    paddle.seed(0)
+    fleet.init(is_collective=True, strategy=_strategy())
+    from paddle_tpu.optimizer import AdamW
+    model = model_fn()
+    model = fleet.distributed_model(model)
+    model.train()
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    got = []
+    for _ in range(2):
+        loss, _ = model(paddle.to_tensor(ids), labels=paddle.to_tensor(ids))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        got.append(float(loss.numpy()))
+
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)

@@ -1,0 +1,287 @@
+"""prior_box / box_coder / yolo_box / matrix_nms (ref:
+python/paddle/vision/ops.py — SSD/YOLO detection utilities)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+class TestPriorBox:
+    def test_grid_and_geometry(self):
+        feat = np.zeros((1, 8, 4, 4), np.float32)
+        img = np.zeros((1, 3, 32, 32), np.float32)
+        boxes, var = V.prior_box(paddle.to_tensor(feat),
+                                 paddle.to_tensor(img),
+                                 min_sizes=[8.0], aspect_ratios=[1.0],
+                                 clip=True)
+        b = boxes.numpy()
+        assert b.shape == (4, 4, 1, 4)
+        # center of cell (0,0) = offset*step/img = 0.5*8/32
+        cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+        np.testing.assert_allclose(cx, 0.125, rtol=1e-5)
+        # width = min_size / img_w
+        np.testing.assert_allclose(b[0, 0, 0, 2] - b[0, 0, 0, 0],
+                                   8.0 / 32, rtol=1e-5)
+        assert var.numpy().shape == b.shape
+        np.testing.assert_allclose(var.numpy()[..., 2], 0.2, rtol=1e-6)
+
+    def test_aspect_ratios_and_max_size(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        img = np.zeros((1, 3, 16, 16), np.float32)
+        boxes, _ = V.prior_box(paddle.to_tensor(feat), paddle.to_tensor(img),
+                               min_sizes=[4.0], max_sizes=[8.0],
+                               aspect_ratios=[1.0, 2.0], flip=True)
+        # A = ar-boxes (1, 2, 1/2) + sqrt(min*max) box = 4
+        assert boxes.numpy().shape == (2, 2, 4, 4)
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        priors = np.array([[10, 10, 30, 30], [5, 20, 25, 50]], np.float32)
+        pvar = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, np.float32)
+        targets = np.array([[12, 8, 33, 35]], np.float32)
+        enc = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(pvar),
+                          paddle.to_tensor(targets),
+                          code_type="encode_center_size")
+        assert enc.shape == [1, 2, 4]
+        dec = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(pvar),
+                          enc, code_type="decode_center_size")
+        # decoding the encoding recovers the target against every prior
+        np.testing.assert_allclose(dec.numpy()[0, 0], targets[0],
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(dec.numpy()[0, 1], targets[0],
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_unnormalized_boxes(self):
+        priors = np.array([[0, 0, 9, 9]], np.float32)
+        targets = np.array([[0, 0, 9, 9]], np.float32)
+        enc = V.box_coder(paddle.to_tensor(priors), None,
+                          paddle.to_tensor(targets),
+                          code_type="encode_center_size",
+                          box_normalized=False)
+        np.testing.assert_allclose(enc.numpy(), 0.0, atol=1e-6)
+
+
+class TestYoloBox:
+    def test_shapes_and_confidence_gate(self):
+        rng = np.random.RandomState(0)
+        C, A, H, W = 3, 2, 4, 4
+        x = rng.randn(1, A * (5 + C), H, W).astype(np.float32)
+        img_size = np.array([[64, 64]], np.int32)
+        boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                                   paddle.to_tensor(img_size),
+                                   anchors=[10, 13, 16, 30], class_num=C,
+                                   conf_thresh=0.5, downsample_ratio=16)
+        assert boxes.shape == [1, H * W * A, 4]
+        assert scores.shape == [1, H * W * A, C]
+        b = boxes.numpy()
+        assert np.all(b[..., 0] >= 0) and np.all(b[..., 2] <= 63)
+        # gated boxes are zeroed together with their scores
+        zero_rows = np.all(b == 0, -1)
+        s = scores.numpy()
+        assert np.all(s[zero_rows] == 0)
+
+    def test_known_decode(self):
+        # logits 0 → sigmoid 0.5 center offset, exp(0)=1 anchor size
+        C, H, W = 1, 1, 1
+        x = np.zeros((1, 5 + C, H, W), np.float32)
+        x[0, 4] = 10.0  # conf ≈ 1
+        x[0, 5] = 10.0
+        img_size = np.array([[32, 32]], np.int32)
+        boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                                   paddle.to_tensor(img_size),
+                                   anchors=[16, 16], class_num=C,
+                                   conf_thresh=0.01, downsample_ratio=32,
+                                   clip_bbox=False)
+        b = boxes.numpy()[0, 0]
+        # center (0.5, 0.5) of the 1x1 grid, box 16/32 of the image
+        np.testing.assert_allclose(b, [8.0, 8.0, 24.0, 24.0], atol=1e-3)
+        assert scores.numpy()[0, 0, 0] > 0.99
+
+
+class TestMatrixNMS:
+    def test_suppresses_overlaps_softly(self):
+        bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                            [30, 30, 40, 40]]], np.float32)
+        scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # [N=1, C=1, M=3]
+        out, nums = V.matrix_nms(paddle.to_tensor(bboxes),
+                                 paddle.to_tensor(scores),
+                                 score_threshold=0.1, background_label=-1)
+        o = out.numpy()
+        assert int(nums.numpy()[0]) == 3
+        top = o[o[:, 1].argmax()]
+        np.testing.assert_allclose(top[1], 0.9, rtol=1e-5)  # top undecayed
+        # overlapping second box decays below its raw score; far box doesn't
+        row_overlap = o[np.isclose(o[:, 2], 1.0)]
+        assert row_overlap[0, 1] < 0.8 - 0.1
+        row_far = o[np.isclose(o[:, 2], 30.0)]
+        np.testing.assert_allclose(row_far[0, 1], 0.7, rtol=1e-5)
+
+    def test_post_threshold_and_gaussian(self):
+        bboxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10]]], np.float32)
+        scores = np.array([[[0.9, 0.85]]], np.float32)
+        out, nums = V.matrix_nms(paddle.to_tensor(bboxes),
+                                 paddle.to_tensor(scores),
+                                 score_threshold=0.1, post_threshold=0.5,
+                                 background_label=-1)
+        assert int(nums.numpy()[0]) == 1  # identical box fully decayed
+        out2, nums2 = V.matrix_nms(paddle.to_tensor(bboxes),
+                                   paddle.to_tensor(scores),
+                                   score_threshold=0.1, use_gaussian=True,
+                                   gaussian_sigma=2.0, background_label=-1)
+        assert int(nums2.numpy()[0]) == 2  # gaussian decay keeps it, lower
+        o2 = out2.numpy()
+        # exp(-1/σ)·0.85 ≈ 0.516: decayed well below the raw 0.85
+        assert o2[:, 1].min() < 0.85 - 0.2
+
+
+def test_roi_wrappers():
+    rng = np.random.RandomState(1)
+    feat = rng.randn(1, 2, 8, 8).astype(np.float32)
+    boxes = np.array([[0, 0, 8, 8]], np.float32)
+    bn = np.array([1], np.int32)
+    ra = V.RoIAlign(output_size=4)
+    rp = V.RoIPool(output_size=4)
+    assert ra(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+              paddle.to_tensor(bn)).shape == [1, 2, 4, 4]
+    assert rp(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+              paddle.to_tensor(bn)).shape == [1, 2, 4, 4]
+
+
+class TestPSRoiPool:
+    def test_position_sensitive_selection(self):
+        # 2x2 bins, 1 out channel: channel (i*2+j) holds constant (i*2+j+1)
+        ph = pw = 2
+        feat = np.zeros((1, 4, 8, 8), np.float32)
+        for c in range(4):
+            feat[0, c] = c + 1
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        out = V.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                           paddle.to_tensor(np.array([1], np.int32)),
+                           output_size=2)
+        o = out.numpy()
+        assert o.shape == (1, 1, 2, 2)
+        # bin (i, j) pools its own channel i*pw+j → value i*pw+j+1
+        np.testing.assert_allclose(o[0, 0], [[1, 2], [3, 4]], rtol=1e-5)
+
+    def test_channel_check(self):
+        import pytest
+        feat = np.zeros((1, 5, 8, 8), np.float32)
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        with pytest.raises(ValueError):
+            V.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)), 2)
+
+
+class TestFPNDistribute:
+    def test_levels_and_restore(self):
+        rois = np.array([[0, 0, 16, 16],      # small -> low level
+                         [0, 0, 224, 224],    # refer scale -> refer level
+                         [0, 0, 500, 500]],   # large -> high level
+                        np.float32)
+        multi, restore, nums = V.distribute_fpn_proposals(
+            paddle.to_tensor(rois), min_level=2, max_level=5,
+            refer_level=4, refer_scale=224)
+        assert len(multi) == 4
+        counts = [int(v) for v in nums.numpy()]
+        assert sum(counts) == 3
+        assert counts[0] == 1          # level 2 gets the small roi
+        assert counts[2] == 1          # level 4 the refer-scale roi
+        # restore index maps concatenated-level order back to input order
+        conc = np.concatenate([m.numpy() for m in multi if m.numpy().size],
+                              0)
+        np.testing.assert_allclose(conc[restore.numpy()], rois)
+
+
+class TestGenerateProposals:
+    def test_end_to_end_rpn(self):
+        rng = np.random.RandomState(0)
+        H = W = 4
+        A = 2
+        scores = rng.rand(1, A, H, W).astype(np.float32)
+        deltas = (rng.randn(1, 4 * A, H, W) * 0.1).astype(np.float32)
+        feat = np.zeros((1, 8, H, W), np.float32)
+        img = np.zeros((1, 3, 64, 64), np.float32)
+        anchors, var = V.prior_box(paddle.to_tensor(feat),
+                                   paddle.to_tensor(img),
+                                   min_sizes=[16.0],
+                                   aspect_ratios=[1.0, 2.0])
+        # prior_box outputs are normalized; scale to pixels for RPN
+        an = anchors.numpy() * 64
+        va = np.broadcast_to(np.array([1.0, 1.0, 1.0, 1.0], np.float32),
+                             an.shape)
+        rois, rscores, nums = V.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[64, 64]], np.float32)),
+            paddle.to_tensor(an), paddle.to_tensor(va.copy()),
+            pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.6)
+        r = rois.numpy()
+        assert r.shape[0] == int(nums.numpy()[0]) <= 5
+        assert rscores.numpy().shape[0] == r.shape[0]
+        # clipped to the image
+        assert r.min() >= 0 and r.max() <= 64
+        # scores sorted descending (NMS keeps score order)
+        s = rscores.numpy()
+        assert np.all(np.diff(s) <= 1e-6)
+
+
+class TestReviewRegressions:
+    def test_box_coder_list_variance_and_axis1(self):
+        priors = np.array([[10, 10, 30, 30], [5, 20, 25, 50]], np.float32)
+        targets = np.array([[12, 8, 33, 35]], np.float32)
+        # list-form variance (paddle API accepts 4 floats)
+        enc = V.box_coder(paddle.to_tensor(priors), [0.1, 0.1, 0.2, 0.2],
+                          paddle.to_tensor(targets),
+                          code_type="encode_center_size")
+        dec = V.box_coder(paddle.to_tensor(priors), [0.1, 0.1, 0.2, 0.2],
+                          enc, code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy()[0, 0], targets[0],
+                                   rtol=1e-4, atol=1e-3)
+        # axis=1: priors along dim 0 of the offsets
+        off = np.transpose(enc.numpy(), (1, 0, 2))  # [M, N, 4]
+        dec1 = V.box_coder(paddle.to_tensor(priors), [0.1, 0.1, 0.2, 0.2],
+                           paddle.to_tensor(off),
+                           code_type="decode_center_size", axis=1)
+        np.testing.assert_allclose(dec1.numpy()[0, 0], targets[0],
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(dec1.numpy()[1, 0], targets[0],
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_prior_box_duplicate_min_sizes(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        img = np.zeros((1, 3, 16, 16), np.float32)
+        boxes, _ = V.prior_box(paddle.to_tensor(feat), paddle.to_tensor(img),
+                               min_sizes=[4.0, 4.0], max_sizes=[8.0, 12.0],
+                               aspect_ratios=[1.0])
+        b = boxes.numpy()
+        assert b.shape == (2, 2, 4, 4)
+        widths = b[0, 0, :, 2] - b[0, 0, :, 0]
+        # second min_size's max anchor uses max_sizes[1]=12: sqrt(4*12)/16
+        assert np.any(np.isclose(widths, np.sqrt(48.0) / 16, rtol=1e-4))
+
+    def test_matrix_nms_gaussian_sigma_multiplies(self):
+        bboxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10]]], np.float32)
+        scores = np.array([[[0.9, 0.85]]], np.float32)
+        out, _ = V.matrix_nms(paddle.to_tensor(bboxes),
+                              paddle.to_tensor(scores), score_threshold=0.1,
+                              use_gaussian=True, gaussian_sigma=2.0,
+                              background_label=-1)
+        o = out.numpy()
+        # iou=1, comp=0 → decay = exp(-2): 0.85*exp(-2) ≈ 0.115
+        np.testing.assert_allclose(sorted(o[:, 1]),
+                                   [0.85 * np.exp(-2.0), 0.9], rtol=1e-4)
+
+    def test_generation_temperature_none(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.generation import generate
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+        paddle.seed(0)
+        c = gpt_tiny_config(num_hidden_layers=1)
+        model = GPTForCausalLM(c)
+        model.eval()
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        gen, _ = generate(model, ids, max_new_tokens=2,
+                          decode_strategy="sampling", temperature=None,
+                          top_k=4)
+        assert gen.shape == [1, 2]
