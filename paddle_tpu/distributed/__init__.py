@@ -21,6 +21,7 @@ from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noq
                               RowParallelLinear, VocabParallelEmbedding,
                               annotate_sequence_parallel)
 from .pp_schedule import generate_schedule  # noqa: F401
+from .spawn import spawn  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .ring_attention import (RingFlashAttention, ring_attention,  # noqa: F401
                              ulysses_attention)

@@ -1,0 +1,76 @@
+"""paddle.distributed.spawn parity (ref: python/paddle/distributed/spawn.py
+— the test-suite workhorse that forks nprocs local ranks running a python
+callable; SURVEY §4.2 mechanism 1).
+
+TPU note: a single host owns its chip(s) through one process, so spawn's
+role here is what the reference uses it for in CI — exercising rank/env
+plumbing and CPU-backend collectives in subprocesses — not carving up
+device ownership. Each child gets the PADDLE_TRAINER_* env the launcher
+would set and runs `func(*args)` after an optional per-rank setup.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Sequence
+
+__all__ = ["spawn"]
+
+
+def _worker(func, args, rank, nprocs, env, err_q):
+    try:
+        os.environ.update(env)
+        os.environ["PADDLE_TRAINER_ID"] = str(rank)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+        os.environ["PADDLE_RANK_IN_NODE"] = str(rank)
+        func(*args)
+    except Exception:  # noqa: BLE001 — reraised in the parent
+        err_q.put((rank, traceback.format_exc()))
+        raise
+
+
+class SpawnContext:
+    def __init__(self, procs, err_q):
+        self.processes = procs
+        self._err_q = err_q
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        failures = []
+        while not self._err_q.empty():
+            failures.append(self._err_q.get())
+        for p in self.processes:
+            if p.exitcode not in (0, None) and not failures:
+                failures.append((p.name, f"exit code {p.exitcode}"))
+        if failures:
+            rank, tb = failures[0]
+            raise RuntimeError(
+                f"spawned rank {rank} failed:\n{tb}")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options):
+    """Launch ``func(*args)`` on ``nprocs`` local worker processes with
+    launcher-compatible rank env. Returns a SpawnContext (join()able) when
+    join=False; otherwise joins and raises the first child failure."""
+    if nprocs < 1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) or 1
+    ctx = mp.get_context("spawn")
+    err_q = ctx.Queue()
+    base_env = {k: v for k, v in options.get("env", {}).items()}
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, tuple(args), rank, nprocs, base_env,
+                              err_q),
+                        daemon=daemon, name=f"rank{rank}")
+        p.start()
+        procs.append(p)
+    sc = SpawnContext(procs, err_q)
+    if join:
+        sc.join()
+    return sc

@@ -10,6 +10,7 @@ from . import nn  # noqa: F401
 
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
-from .optimizer import LookAhead  # noqa: F401
+from .optimizer import DistributedFusedLamb, LookAhead  # noqa: F401
 
-__all__ = ["moe", "nn", "asp", "optimizer", "LookAhead"]
+__all__ = ["moe", "nn", "asp", "optimizer", "LookAhead",
+           "DistributedFusedLamb"]

@@ -68,3 +68,36 @@ class LookAhead:
                             state["slow"]):
                 self._slow[id(p)] = jnp.asarray(
                     s._data if isinstance(s, Tensor) else s)
+
+
+class DistributedFusedLamb:
+    """ref: paddle.incubate.DistributedFusedLamb — the reference fuses LAMB
+    math into flat buffers and shards moments across the data-parallel
+    group with custom CUDA kernels. TPU-native substitution: `optimizer.Lamb`
+    already runs fused under jit (XLA fuses the update chain), and sharding
+    the moments is a sharding-spec choice (distributed/sharding.py
+    DygraphShardingOptimizer wrapping Lamb). This class composes the two so
+    the reference's import path keeps working.
+    """
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 sharding_axis=None, **kw):
+        from ..optimizer import Lamb
+        self._inner = Lamb(learning_rate=learning_rate,
+                           lamb_weight_decay=lamb_weight_decay,
+                           beta1=beta1, beta2=beta2, epsilon=epsilon,
+                           parameters=parameters, grad_clip=grad_clip,
+                           exclude_from_weight_decay_fn=
+                           exclude_from_weight_decay_fn, **kw)
+        if sharding_axis:
+            from ..distributed.sharding import DygraphShardingOptimizer
+            self._inner = DygraphShardingOptimizer(self._inner,
+                                                   axis=sharding_axis)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+__all__ += ["DistributedFusedLamb"]

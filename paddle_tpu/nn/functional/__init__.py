@@ -46,7 +46,7 @@ __all__ = [
     "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "smooth_l1_loss",
     "nll_loss", "kl_div", "margin_ranking_loss", "cosine_similarity",
-    "cosine_embedding_loss", "ctc_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "ctc_loss", "rnnt_loss", "hinge_embedding_loss",
     "label_smooth", "square_error_cost", "sequence_mask", "temporal_shift",
 ]
 
@@ -1194,3 +1194,77 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             return jnp.mean(nll / jnp.maximum(lab_len.astype(nll.dtype), 1.0))
         return _reduce_loss(nll, reduction)
     return apply("ctc_loss", impl, [log_probs])
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (ref: the warprnnt external in the reference
+    build — paddle.nn.functional.rnnt_loss; here a native XLA
+    forward-algorithm over the (T, U) alignment lattice).
+
+    logits: [B, T, U+1, V] joint-network outputs (T acoustic frames,
+    U max label length), labels: [B, U] padded, blank: blank id.
+    alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+                            alpha[t, u-1] + emit(t, u-1));
+    loss = -(alpha[T-1, U] + blank(T-1, U)), with variable lengths masked.
+    """
+    lab = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+    t_len = logit_lengths._data if isinstance(logit_lengths, Tensor) \
+        else jnp.asarray(logit_lengths)
+    u_len = label_lengths._data if isinstance(label_lengths, Tensor) \
+        else jnp.asarray(label_lengths)
+
+    def impl(lg):
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        lb = lp[..., blank]                                   # [B, T, U+1]
+        ext = lab.astype(jnp.int32)                           # [B, U]
+        emit = jnp.take_along_axis(
+            lp[:, :, :U, :], ext[:, None, :, None], axis=3)[..., 0]
+        if fastemit_lambda:
+            emit = emit + jnp.log1p(jnp.asarray(fastemit_lambda, lp.dtype))
+        u_idx = jnp.arange(U1)
+
+        def row(alpha_prev, t):
+            # horizontal (blank) arrival from the previous frame
+            from_blank = alpha_prev + lb[:, t - 1, :]
+            # then the in-row emit recurrence: a[u] = logaddexp(
+            #   from_blank[u], a[u-1] + emit[t, u-1])
+            def cell(carry, u):
+                fb = from_blank[:, u]
+                em = jnp.where(u > 0, emit[:, t, jnp.maximum(u - 1, 0)],
+                               neg_inf)
+                a = jnp.logaddexp(fb, carry + em)
+                return a, a
+            _, cols = jax.lax.scan(cell, jnp.full((B,), neg_inf), u_idx)
+            return jnp.transpose(cols), None
+
+        # t = 0 row: only emits along u
+        def cell0(carry, u):
+            em = jnp.where(u > 0, emit[:, 0, jnp.maximum(u - 1, 0)], neg_inf)
+            a = jnp.where(u == 0, jnp.zeros((B,), lp.dtype), carry + em)
+            return a, a
+        _, cols0 = jax.lax.scan(cell0, jnp.full((B,), neg_inf), u_idx)
+        alpha0 = jnp.transpose(cols0)                         # [B, U+1]
+
+        def step(alpha, t):
+            nxt, _ = row(alpha, t)
+            return nxt, nxt
+        _, rows = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        all_rows = jnp.concatenate([alpha0[None], rows], 0)   # [T, B, U+1]
+
+        # terminal: alpha[t_len-1, u_len] + blank(t_len-1, u_len)
+        tb = jnp.clip(t_len.astype(jnp.int32) - 1, 0, T - 1)
+        ub = jnp.clip(u_len.astype(jnp.int32), 0, U)
+        bidx = jnp.arange(B)
+        final = all_rows[tb, bidx, ub] + lb[bidx, tb, ub]
+        loss = -final
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("rnnt_loss", impl, [logits])

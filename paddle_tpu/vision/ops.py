@@ -340,11 +340,14 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
     (x1, y1, x2, y2), variances broadcast to the same shape)."""
     fh, fw = _arr(input).shape[-2:]
     ih, iw = _arr(image).shape[-2:]
-    ars = []
+    # reference ExpandAspectRatios: 1.0 is always implicitly first, then
+    # each new ratio (+ reciprocal when flip), deduplicated
+    ars = [1.0]
     for ar in aspect_ratios:
-        ars.append(float(ar))
-        if flip and abs(ar - 1.0) > 1e-6:
-            ars.append(1.0 / float(ar))
+        ar = float(ar)
+        for cand in ([ar, 1.0 / ar] if flip else [ar]):
+            if all(abs(cand - e) > 1e-6 for e in ars):
+                ars.append(cand)
     step_w = steps[0] or iw / fw
     step_h = steps[1] or ih / fh
     widths, heights = [], []
@@ -506,32 +509,30 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             idxs.append(_np.zeros((0,), _np.int64))
             nums.append(0)
             continue
-        ss = jnp.asarray([f[0] for f in flat], jnp.float32)
+        # whole decay computation on host: the candidate set is small
+        # (<= nms_top_k) and this op is eager-only — no device round-trips
+        ss = _np.asarray([f[0] for f in flat], _np.float32)
         cs = _np.asarray([f[1] for f in flat])
-        bs = jnp.asarray(bb[n, [f[2] for f in flat]])
+        bs_np = bb[n, [f[2] for f in flat]]
         k = len(flat)
-        iou = _iou_matrix(bs)
-        same_cls = jnp.asarray(cs[:, None] == cs[None, :])
+        iou = _np_iou_matrix(bs_np)
+        same_cls = cs[:, None] == cs[None, :]
         # rows sorted by score desc: pair (i, j) active iff j outranks i
-        higher = jnp.arange(k)[None, :] < jnp.arange(k)[:, None]
-        iou_h = jnp.where(higher & same_cls, iou, 0.0)
+        higher = _np.arange(k)[None, :] < _np.arange(k)[:, None]
+        iou_h = _np.where(higher & same_cls, iou, 0.0)
         # compensation: each suppressor j's own max overlap with ITS
         # higher-ranked peers (the SOLOv2 matrix-NMS formula)
-        comp = jnp.max(iou_h, axis=1)
+        comp = _np.max(iou_h, axis=1)
         if use_gaussian:
             # reference formula: exp(-σ·(iou² − comp²)) — σ MULTIPLIES
-            decay_mat = jnp.exp(-gaussian_sigma
+            decay_mat = _np.exp(-gaussian_sigma
                                 * (iou_h ** 2 - comp[None, :] ** 2))
         else:
             decay_mat = (1.0 - iou_h) / (1.0 - comp[None, :])
-        decay_mat = jnp.where(higher & same_cls, decay_mat, 1.0)
-        decay = jnp.min(decay_mat, axis=1)
-        dec = ss * decay
-        keep = dec >= post_threshold if post_threshold > 0 else \
-            jnp.ones_like(dec, bool)
-        dec_np = _np.asarray(dec)          # one device→host transfer
-        keep_np = _np.asarray(keep)
-        bs_np = _np.asarray(bs)
+        decay_mat = _np.where(higher & same_cls, decay_mat, 1.0)
+        dec_np = ss * _np.min(decay_mat, axis=1)
+        keep_np = dec_np >= post_threshold if post_threshold > 0 else \
+            _np.ones_like(dec_np, bool)
         order = _np.argsort(-dec_np)
         order = order[keep_np[order]][:keep_top_k]
         rows = _np.concatenate(
@@ -640,17 +641,61 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     scale = np.sqrt(np.maximum(w * h, 1e-12))
     lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
-    multi_rois, rois_nums = [], []
+    # roi → owning image (for per-image per-level counts)
+    if rois_num is not None:
+        rn = np.asarray(_arr(rois_num)).astype(np.int64)
+        img_of = np.repeat(np.arange(len(rn)), rn)
+    else:
+        rn = None
+        img_of = np.zeros(len(rois), np.int64)
+    multi_rois, per_level_nums = [], []
     order = []
     for L in range(min_level, max_level + 1):
         ids = np.nonzero(lvl == L)[0]
         order.extend(ids.tolist())
         multi_rois.append(Tensor(jnp.asarray(rois[ids])))
-        rois_nums.append(len(ids))
+        if rn is not None:
+            per_level_nums.append(Tensor(jnp.asarray(np.bincount(
+                img_of[ids], minlength=len(rn)).astype(np.int32))))
+        else:
+            per_level_nums.append(len(ids))
     restore = np.empty(len(rois), np.int64)
     restore[np.asarray(order, np.int64)] = np.arange(len(rois))
-    return (multi_rois, Tensor(jnp.asarray(restore)),
-            Tensor(jnp.asarray(np.asarray(rois_nums, np.int32))))
+    if rn is None:
+        per_level_nums = Tensor(jnp.asarray(
+            np.asarray(per_level_nums, np.int32)))
+    return multi_rois, Tensor(jnp.asarray(restore)), per_level_nums
+
+
+def _np_iou_matrix(boxes):
+    import numpy as np
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def _np_greedy_nms(props, thresh, eta=1.0):
+    """Greedy NMS on score-sorted boxes with Paddle's adaptive-threshold
+    option: after each kept box, thresh *= eta while thresh > 0.5."""
+    import numpy as np
+    iou = _np_iou_matrix(props)
+    kept = []
+    adaptive = float(thresh)
+    sup = np.zeros(len(props), bool)
+    for i in range(len(props)):
+        if sup[i]:
+            continue
+        kept.append(i)
+        sup |= iou[i] > adaptive
+        if eta < 1.0 and adaptive > 0.5:
+            adaptive *= eta
+    return np.asarray(kept, np.int64)
 
 
 def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
@@ -694,8 +739,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         order = np.argsort(-s)[:pre_nms_top_n]
         props, s = props[order], s[order]
         if len(props):
-            kept = np.asarray(nms(jnp.asarray(props), nms_thresh,
-                                  scores=jnp.asarray(s)).numpy())
+            kept = _np_greedy_nms(props, nms_thresh, eta)
             kept = kept[:post_nms_top_n]
             props, s = props[kept], s[kept]
         all_rois.append(np.concatenate([props, s[:, None]], 1))

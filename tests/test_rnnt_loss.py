@@ -1,0 +1,101 @@
+"""RNN-Transducer loss (ref: warprnnt external / paddle.nn.functional
+rnnt_loss). Oracle: hand-rolled numpy forward algorithm over the (T, U)
+lattice."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _np_rnnt(logits, labels, t_len, u_len, blank=0):
+    """Reference forward algorithm, one sequence at a time."""
+    B = logits.shape[0]
+    losses = []
+    for b in range(B):
+        T, U = int(t_len[b]), int(u_len[b])
+        lp = logits[b] - np.log(
+            np.exp(logits[b]).sum(-1, keepdims=True))  # log softmax
+        alpha = np.full((T, U + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for u in range(1, U + 1):
+            alpha[0, u] = alpha[0, u - 1] + lp[0, u - 1, labels[b, u - 1]]
+        for t in range(1, T):
+            alpha[t, 0] = alpha[t - 1, 0] + lp[t - 1, 0, blank]
+            for u in range(1, U + 1):
+                a = alpha[t - 1, u] + lp[t - 1, u, blank]
+                bterm = alpha[t, u - 1] + lp[t, u - 1, labels[b, u - 1]]
+                alpha[t, u] = np.logaddexp(a, bterm)
+        losses.append(-(alpha[T - 1, U] + lp[T - 1, U, blank]))
+    return np.asarray(losses, np.float32)
+
+
+def _case(B=2, T=5, U=3, V=6, seed=0):
+    rng = np.random.RandomState(seed)
+    logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+    labels = rng.randint(1, V, (B, U)).astype(np.int32)
+    t_len = np.array([T] * B, np.int32)
+    u_len = np.array([U] * B, np.int32)
+    return logits, labels, t_len, u_len
+
+
+def test_matches_numpy_forward():
+    logits, labels, t_len, u_len = _case()
+    ref = _np_rnnt(logits, labels, t_len, u_len)
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(t_len), paddle.to_tensor(u_len),
+                      reduction="none")
+    np.testing.assert_allclose(got.numpy(), ref, rtol=1e-4, atol=1e-4)
+    mean = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                       paddle.to_tensor(t_len), paddle.to_tensor(u_len))
+    np.testing.assert_allclose(float(mean.numpy()), ref.mean(), rtol=1e-4)
+
+
+def test_variable_lengths():
+    logits, labels, t_len, u_len = _case(B=3, T=6, U=4, seed=1)
+    t_len = np.array([6, 4, 5], np.int32)
+    u_len = np.array([4, 2, 3], np.int32)
+    ref = _np_rnnt(logits, labels, t_len, u_len)
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(t_len), paddle.to_tensor(u_len),
+                      reduction="none")
+    np.testing.assert_allclose(got.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_gradient_finite_difference():
+    logits, labels, t_len, u_len = _case(B=1, T=3, U=2, V=4, seed=2)
+    lt = paddle.to_tensor(logits)
+    lt.stop_gradient = False
+    loss = F.rnnt_loss(lt, paddle.to_tensor(labels),
+                       paddle.to_tensor(t_len), paddle.to_tensor(u_len),
+                       reduction="sum")
+    loss.backward()
+    g = lt.grad.numpy()
+    eps = 1e-3
+    rng = np.random.RandomState(3)
+    for _ in range(5):
+        i = tuple(rng.randint(0, s) for s in logits.shape)
+        lp = logits.copy(); lp[i] += eps
+        lm = logits.copy(); lm[i] -= eps
+        fd = (_np_rnnt(lp, labels, t_len, u_len).sum()
+              - _np_rnnt(lm, labels, t_len, u_len).sum()) / (2 * eps)
+        np.testing.assert_allclose(g[i], fd, rtol=2e-2, atol=2e-3)
+
+
+def test_perfect_alignment_low_loss():
+    """Logits hugely favoring the correct emit/blank path → loss ≈ 0."""
+    B, T, U, V = 1, 4, 2, 5
+    labels = np.array([[2, 3]], np.int32)
+    logits = np.zeros((B, T, U + 1, V), np.float32)
+    big = 20.0
+    # emit the two labels at t=0, then blanks to the end
+    logits[0, 0, 0, 2] = big
+    logits[0, 0, 1, 3] = big
+    for t in range(T):
+        logits[0, t, 2, 0] = big
+    logits[0, 1, 2, 0] = big
+    loss = F.rnnt_loss(paddle.to_tensor(logits),
+                       paddle.to_tensor(labels),
+                       paddle.to_tensor(np.array([T], np.int32)),
+                       paddle.to_tensor(np.array([U], np.int32)))
+    assert float(loss.numpy()) < 0.5

@@ -1,0 +1,43 @@
+"""paddle.distributed.spawn (ref: python/paddle/distributed/spawn.py —
+subprocess multi-rank, SURVEY §4.2 mechanism 1)."""
+
+import os
+import tempfile
+
+import pytest
+
+
+def _write_rank(out_dir):
+    import os
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    n = os.environ["PADDLE_TRAINERS_NUM"]
+    with open(os.path.join(out_dir, f"rank{rank}.txt"), "w") as f:
+        f.write(f"{rank}/{n}")
+
+
+def _fail_on_rank1():
+    import os
+    if os.environ["PADDLE_TRAINER_ID"] == "1":
+        raise ValueError("boom from rank 1")
+
+
+def test_spawn_runs_all_ranks(tmp_path):
+    from paddle_tpu.distributed import spawn
+    spawn(_write_rank, args=(str(tmp_path),), nprocs=3)
+    got = sorted(p.name for p in tmp_path.iterdir())
+    assert got == ["rank0.txt", "rank1.txt", "rank2.txt"]
+    assert (tmp_path / "rank2.txt").read_text() == "2/3"
+
+
+def test_spawn_propagates_child_failure():
+    from paddle_tpu.distributed import spawn
+    with pytest.raises(RuntimeError, match="rank 1"):
+        spawn(_fail_on_rank1, nprocs=2)
+
+
+def test_spawn_nonjoining_context(tmp_path):
+    from paddle_tpu.distributed import spawn
+    ctx = spawn(_write_rank, args=(str(tmp_path),), nprocs=2, join=False)
+    assert len(ctx.processes) == 2
+    ctx.join()
+    assert len(list(tmp_path.iterdir())) == 2

@@ -285,3 +285,60 @@ class TestReviewRegressions:
                           decode_strategy="sampling", temperature=None,
                           top_k=4)
         assert gen.shape == [1, 2]
+
+    def test_prior_box_implicit_unit_ratio(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        img = np.zeros((1, 3, 16, 16), np.float32)
+        boxes, _ = V.prior_box(paddle.to_tensor(feat), paddle.to_tensor(img),
+                               min_sizes=[4.0], aspect_ratios=[2.0],
+                               flip=True)
+        # expanded ratios: 1 (implicit), 2, 0.5 → A = 3
+        assert boxes.numpy().shape == (2, 2, 3, 4)
+
+    def test_fpn_per_image_counts(self):
+        rois = np.array([[0, 0, 16, 16], [0, 0, 500, 500],
+                         [0, 0, 16, 16]], np.float32)
+        rois_num = np.array([2, 1], np.int32)
+        multi, restore, per_level = V.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224,
+            rois_num=paddle.to_tensor(rois_num))
+        assert isinstance(per_level, list) and len(per_level) == 4
+        lvl2 = per_level[0].numpy()   # small rois land on min level
+        np.testing.assert_array_equal(lvl2, [1, 1])
+        lvl5 = per_level[-1].numpy()  # big roi from image 0
+        np.testing.assert_array_equal(lvl5, [1, 0])
+
+    def test_generate_proposals_eta_adaptive(self):
+        # identical high-overlap boxes: eta < 1 lowers the threshold after
+        # each keep, suppressing more than fixed-threshold NMS
+        rng = np.random.RandomState(1)
+        H = W = 2
+        A = 1
+        scores = rng.rand(1, A, H, W).astype(np.float32)
+        deltas = np.zeros((1, 4, H, W), np.float32)
+        an = np.broadcast_to(np.array([0, 0, 32, 32], np.float32),
+                             (H, W, A, 4))
+        va = np.ones((H, W, A, 4), np.float32)
+        _, _, n_fixed = V.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[64, 64]], np.float32)),
+            paddle.to_tensor(an.copy()), paddle.to_tensor(va),
+            nms_thresh=0.95, eta=1.0)
+        _, _, n_eta = V.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[64, 64]], np.float32)),
+            paddle.to_tensor(an.copy()), paddle.to_tensor(va),
+            nms_thresh=0.95, eta=0.5)
+        assert int(n_eta.numpy()[0]) <= int(n_fixed.numpy()[0])
+
+    def test_fused_lamb_forwards_grad_clip(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import DistributedFusedLamb
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        opt = DistributedFusedLamb(learning_rate=1e-2,
+                                   parameters=lin.parameters(),
+                                   grad_clip=ClipGradByGlobalNorm(1.0))
+        assert opt._inner._grad_clip is not None
