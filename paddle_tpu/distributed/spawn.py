@@ -37,19 +37,33 @@ class SpawnContext:
         self._err_q = err_q
 
     def join(self, timeout=None):
-        for p in self.processes:
-            p.join(timeout)
+        import time as _time
+        deadline = None if timeout is None else _time.time() + timeout
         failures = []
-        while not self._err_q.empty():
-            failures.append(self._err_q.get())
-        for p in self.processes:
-            if p.exitcode not in (0, None) and not failures:
-                failures.append((p.name, f"exit code {p.exitcode}"))
-        if failures:
-            rank, tb = failures[0]
-            raise RuntimeError(
-                f"spawned rank {rank} failed:\n{tb}")
-        return all(p.exitcode == 0 for p in self.processes)
+        while True:
+            while not self._err_q.empty():
+                failures.append(self._err_q.get())
+            dead_fail = [p for p in self.processes
+                         if p.exitcode not in (0, None)]
+            if failures or dead_fail:
+                # a rank failed: terminate survivors (they may be blocked
+                # on a barrier waiting for the dead peer — the reference
+                # spawn context tears the pod down rather than hanging)
+                for p in self.processes:
+                    if p.is_alive():
+                        p.terminate()
+                for p in self.processes:
+                    p.join(5.0)
+                if not failures:
+                    p0 = dead_fail[0]
+                    failures.append((p0.name, f"exit code {p0.exitcode}"))
+                rank, tb = failures[0]
+                raise RuntimeError(f"spawned rank {rank} failed:\n{tb}")
+            if all(p.exitcode == 0 for p in self.processes):
+                return True
+            if deadline is not None and _time.time() > deadline:
+                return False
+            _time.sleep(0.05)
 
 
 def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,

@@ -1224,7 +1224,11 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
         emit = jnp.take_along_axis(
             lp[:, :, :U, :], ext[:, None, :, None], axis=3)[..., 0]
         if fastemit_lambda:
-            emit = emit + jnp.log1p(jnp.asarray(fastemit_lambda, lp.dtype))
+            # FastEmit: scale the EMIT-branch gradient by (1+λ) while
+            # leaving the forward loss unchanged (the warprnnt/torchaudio
+            # kernel semantics) — value-preserving gradient reweighting
+            lam = jnp.asarray(fastemit_lambda, lp.dtype)
+            emit = (1.0 + lam) * emit - jax.lax.stop_gradient(lam * emit)
         u_idx = jnp.arange(U1)
 
         def row(alpha_prev, t):

@@ -528,7 +528,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             decay_mat = _np.exp(-gaussian_sigma
                                 * (iou_h ** 2 - comp[None, :] ** 2))
         else:
-            decay_mat = (1.0 - iou_h) / (1.0 - comp[None, :])
+            # comp→1 (duplicate suppressor) would be 0/0: guard the
+            # denominator so the duplicate decays to 0, not nan
+            decay_mat = (1.0 - iou_h) / _np.maximum(1.0 - comp[None, :],
+                                                    1e-10)
         decay_mat = _np.where(higher & same_cls, decay_mat, 1.0)
         dec_np = ss * _np.min(decay_mat, axis=1)
         keep_np = dec_np >= post_threshold if post_threshold > 0 else \
@@ -687,12 +690,12 @@ def _np_greedy_nms(props, thresh, eta=1.0):
     iou = _np_iou_matrix(props)
     kept = []
     adaptive = float(thresh)
-    sup = np.zeros(len(props), bool)
     for i in range(len(props)):
-        if sup[i]:
+        # each candidate tests against the CURRENT (decayed) threshold —
+        # the reference NMSFast order of operations
+        if kept and float(iou[i, kept].max()) > adaptive:
             continue
         kept.append(i)
-        sup |= iou[i] > adaptive
         if eta < 1.0 and adaptive > 0.5:
             adaptive *= eta
     return np.asarray(kept, np.int64)

@@ -98,3 +98,43 @@ def test_eos_stops_and_pads():
     assert g[0] == eos
     np.testing.assert_array_equal(g[1:], 0)
     np.testing.assert_array_equal(scores.numpy()[0, 1:], 0.0)
+
+
+def test_cached_generation_matches_padded_buffer():
+    """KV-cache decode (generate_cached) must produce exactly the greedy
+    tokens of the padded-buffer path."""
+    from paddle_tpu.generation import generate_cached
+    paddle.seed(0)
+    c = llama_tiny_config(num_hidden_layers=2)
+    model = LlamaForCausalLM(c)
+    model.eval()
+    ids = _prompt(2, 6, c.vocab_size, seed=7)
+    ref, ref_scores = generate(model, ids, max_new_tokens=6,
+                               decode_strategy="greedy_search")
+    got, got_scores = generate_cached(model, ids, max_new_tokens=6,
+                                      decode_strategy="greedy_search")
+    np.testing.assert_array_equal(ref.numpy(), got.numpy())
+    np.testing.assert_allclose(ref_scores.numpy(), got_scores.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_cached_generation_eos_and_limits():
+    from paddle_tpu.generation import generate_cached
+    paddle.seed(0)
+    c = llama_tiny_config(num_hidden_layers=1)
+    model = LlamaForCausalLM(c)
+    model.eval()
+    ids = _prompt(1, 4, c.vocab_size, seed=8)
+    first, _ = generate_cached(model, ids, max_new_tokens=1,
+                               decode_strategy="greedy_search")
+    eos = int(first.numpy()[0, 0])
+    gen, _ = generate_cached(model, ids, max_new_tokens=5,
+                             decode_strategy="greedy_search",
+                             eos_token_id=eos)
+    g = gen.numpy()[0]
+    assert g[0] == eos
+    np.testing.assert_array_equal(g[1:], 0)
+    import pytest
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        generate_cached(model, ids,
+                        max_new_tokens=c.max_position_embeddings)

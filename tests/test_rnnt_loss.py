@@ -99,3 +99,24 @@ def test_perfect_alignment_low_loss():
                        paddle.to_tensor(np.array([T], np.int32)),
                        paddle.to_tensor(np.array([U], np.int32)))
     assert float(loss.numpy()) < 0.5
+
+
+def test_fastemit_scales_gradients_not_loss():
+    """Review regression: fastemit_lambda reweights EMIT gradients by
+    (1+lambda) and leaves the forward loss unchanged."""
+    logits, labels, t_len, u_len = _case(B=1, T=4, U=2, V=5, seed=5)
+
+    def run(lam):
+        lt = paddle.to_tensor(logits)
+        lt.stop_gradient = False
+        loss = F.rnnt_loss(lt, paddle.to_tensor(labels),
+                           paddle.to_tensor(t_len),
+                           paddle.to_tensor(u_len),
+                           fastemit_lambda=lam, reduction="sum")
+        loss.backward()
+        return float(loss.numpy()), lt.grad.numpy()
+
+    l0, g0 = run(0.0)
+    l1, g1 = run(0.5)
+    np.testing.assert_allclose(l1, l0, rtol=1e-6)   # loss unchanged
+    assert np.abs(g1 - g0).max() > 1e-5             # gradients changed

@@ -342,3 +342,28 @@ class TestReviewRegressions:
                                    parameters=lin.parameters(),
                                    grad_clip=ClipGradByGlobalNorm(1.0))
         assert opt._inner._grad_clip is not None
+
+    def test_adaptive_nms_tests_current_threshold(self):
+        from paddle_tpu.vision.ops import _np_greedy_nms
+        # IoU(0,1)=0.538: thresh 0.9 keeps both at eta=1; with eta=0.5 the
+        # threshold decays to 0.45 BEFORE box 1 is tested -> suppressed
+        props = np.array([[0, 0, 10, 10], [0, 3, 10, 13]], np.float32)
+        keep_fixed = _np_greedy_nms(props, 0.9, eta=1.0)
+        keep_eta = _np_greedy_nms(props, 0.9, eta=0.5)
+        assert list(keep_fixed) == [0, 1]
+        assert list(keep_eta) == [0]
+
+    def test_matrix_nms_duplicate_no_nan(self):
+        import warnings
+        bboxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                            [0, 0, 10, 10]]], np.float32)
+        scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any RuntimeWarning fails
+            out, nums = V.matrix_nms(paddle.to_tensor(bboxes),
+                                     paddle.to_tensor(scores),
+                                     score_threshold=0.1,
+                                     background_label=-1)
+        o = out.numpy()
+        assert np.all(np.isfinite(o))
+        assert int(nums.numpy()[0]) == 3
