@@ -147,3 +147,52 @@ class KaimingUniform(Initializer):
         limit = self.gain * math.sqrt(3.0 / fan_in)
         return jax.random.uniform(next_key(), tuple(shape), dt,
                                   minval=-limit, maxval=limit)
+
+
+class Orthogonal(Initializer):
+    """ref: paddle.nn.initializer.Orthogonal — (semi-)orthogonal matrix via
+    QR of a gaussian; rows orthonormal when rows <= cols, else columns."""
+
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        if len(shape) < 2:
+            raise ValueError("Orthogonal requires at least 2 dimensions")
+        rows = int(shape[0])
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.normal(next_key(), (max(rows, cols),
+                                              min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        # sign correction makes the distribution uniform (Haar)
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dt)
+
+
+class Dirac(Initializer):
+    """ref: paddle.nn.initializer.Dirac — identity-preserving conv kernels:
+    out-channel i passes through in-channel i at the spatial center."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        if len(shape) < 3:
+            raise ValueError("Dirac requires a conv weight of rank >= 3")
+        out_c, in_c = int(shape[0]), int(shape[1])
+        if out_c % self.groups:
+            raise ValueError("out_channels must divide by groups")
+        w = np.zeros(shape, np.float32)
+        og = out_c // self.groups
+        center = tuple(int(s) // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(min(og, in_c)):
+                w[(g * og + i, i) + center] = 1.0
+        return jnp.asarray(w, dt)
+
+
+__all__ += ["Orthogonal", "Dirac"]

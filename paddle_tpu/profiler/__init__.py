@@ -18,6 +18,7 @@ from ..native import (RecordEvent, prof_clear, prof_enable,  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerTarget", "RecordEvent", "make_scheduler",
            "export_chrome_tracing", "SummaryView"]
+# load_profiler_result appended below (__all__ extended there)
 
 
 class ProfilerTarget(Enum):
@@ -171,3 +172,17 @@ class Profiler:
                   f"{total / max(calls, 1):<12.3f}")
         return {name: {"calls": c, "total_ms": t} for name, (c, t)
                 in rows}
+
+
+def load_profiler_result(filename: str):
+    """ref: paddle.profiler.load_profiler_result — read back an exported
+    chrome-trace JSON as a list of event dicts (name/ph/ts/dur/tid/pid)."""
+    import json as _json
+    with open(filename, encoding="utf-8") as f:
+        data = _json.load(f)
+    if isinstance(data, list):   # legacy bare-array chrome trace
+        return data
+    return data.get("traceEvents", [])
+
+
+__all__ += ["load_profiler_result"]

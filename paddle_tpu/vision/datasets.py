@@ -120,3 +120,126 @@ class Cifar10(Dataset):
 
     def __len__(self):
         return len(self.labels)
+
+
+def _default_image_loader(path):
+    """Load an image file to an HWC numpy array: .npy passthrough, PIL for
+    the standard formats when installed, and a native binary-PPM/PGM
+    fallback (8-bit and 16-bit, comment-tolerant) otherwise."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    if not path.endswith((".ppm", ".pgm")):   # PNM: exact native parse
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError:
+            raise RuntimeError(
+                f"no loader available for {path} (PIL not installed); "
+                "provide loader=")
+    if path.endswith((".ppm", ".pgm")):
+        with open(path, "rb") as f:
+            def token():
+                t = b""
+                while True:
+                    ch = f.read(1)
+                    if not ch:
+                        raise ValueError(f"truncated header in {path}")
+                    if ch == b"#":          # comment to end of line
+                        while f.read(1) not in (b"\n", b""):
+                            pass
+                        continue
+                    if ch.isspace():
+                        if t:
+                            return t
+                        continue
+                    t += ch
+            magic = token()
+            w, h = int(token()), int(token())
+            maxv = int(token())
+            dt = np.uint8 if maxv < 256 else np.dtype(">u2")
+            data = np.frombuffer(f.read(), dt)
+            if magic == b"P6":
+                return data.reshape(h, w, 3)
+            if magic == b"P5":
+                return data.reshape(h, w)
+            raise ValueError(f"unsupported PNM magic {magic!r} in {path}")
+    raise RuntimeError(f"no loader available for {path}; provide loader=")
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def _walk_files(root, extensions, is_valid_file):
+    """Recursive sorted file listing with the extension/predicate filter
+    shared by DatasetFolder and ImageFolder."""
+    exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            p = os.path.join(dirpath, fn)
+            ok = is_valid_file(p) if is_valid_file else \
+                fn.lower().endswith(exts)
+            if ok:
+                out.append(p)
+    return out
+
+
+class DatasetFolder(Dataset):
+    """ref: paddle.vision.datasets.DatasetFolder — samples arranged as
+    root/class_x/xxx.ext; classes sorted alphabetically to indices."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_image_loader
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for p in _walk_files(os.path.join(root, c), extensions,
+                                 is_valid_file):
+                self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """ref: paddle.vision.datasets.ImageFolder — flat/recursive listing of
+    images under root, NO labels (returns [img])."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_image_loader
+        self.transform = transform
+        self.samples = _walk_files(root, extensions, is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "IMG_EXTENSIONS"]

@@ -1,0 +1,120 @@
+"""DatasetFolder/ImageFolder + Orthogonal/Dirac initializers +
+profiler.load_profiler_result (long-tail parity rows)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _mk_tree(tmp_path, classes=("cat", "dog"), per_class=3):
+    for c in classes:
+        d = tmp_path / c
+        d.mkdir()
+        for i in range(per_class):
+            np.save(str(d / f"{i}.npy"),
+                    np.full((4, 4, 3), ord(c[0]) + i, np.uint8))
+    return str(tmp_path)
+
+
+class TestFolders:
+    def test_dataset_folder_classes_and_samples(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+        root = _mk_tree(tmp_path)
+        ds = DatasetFolder(root)
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        img, label = ds[0]
+        assert img.shape == (4, 4, 3)
+        assert label == 0
+        img5, label5 = ds[5]
+        assert label5 == 1
+
+    def test_image_folder_flat(self, tmp_path):
+        from paddle_tpu.vision.datasets import ImageFolder
+        root = _mk_tree(tmp_path, classes=("a",), per_class=4)
+        ds = ImageFolder(root)
+        assert len(ds) == 4
+        (img,) = ds[1]
+        assert img.shape == (4, 4, 3)
+
+    def test_transform_and_loader(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+        root = _mk_tree(tmp_path)
+        ds = DatasetFolder(root, transform=lambda x: x.astype(np.float32)
+                           / 255.0)
+        img, _ = ds[0]
+        assert img.dtype == np.float32 and img.max() <= 1.0
+
+    def test_empty_raises(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(RuntimeError):
+            DatasetFolder(str(tmp_path))
+
+
+class TestInitializers:
+    def test_orthogonal_rows_orthonormal(self):
+        from paddle_tpu.nn.initializer import Orthogonal
+        paddle.seed(0)
+        w = np.asarray(Orthogonal()( [4, 16], "float32"))
+        np.testing.assert_allclose(w @ w.T, np.eye(4), atol=1e-5)
+        # tall case: columns orthonormal
+        w2 = np.asarray(Orthogonal(gain=2.0)([16, 4], "float32"))
+        np.testing.assert_allclose(w2.T @ w2, 4.0 * np.eye(4), atol=1e-4)
+
+    def test_dirac_identity_conv(self):
+        import torch
+        from paddle_tpu.nn.initializer import Dirac
+        w = np.asarray(Dirac()([3, 3, 3, 3], "float32"))
+        x = np.random.RandomState(0).randn(1, 3, 8, 8).astype(np.float32)
+        y = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                       padding=1).numpy()
+        np.testing.assert_allclose(y, x, rtol=1e-5, atol=1e-6)
+
+
+def test_load_profiler_result(tmp_path):
+    import json
+    from paddle_tpu.profiler import load_profiler_result
+    f = tmp_path / "trace.json"
+    f.write_text(json.dumps({"traceEvents": [
+        {"name": "op1", "ph": "X", "ts": 0, "dur": 5}]}))
+    ev = load_profiler_result(str(f))
+    assert ev[0]["name"] == "op1"
+
+
+class TestReviewRegressions:
+    def test_legacy_array_trace(self, tmp_path):
+        import json
+        from paddle_tpu.profiler import load_profiler_result
+        f = tmp_path / "legacy.json"
+        f.write_text(json.dumps([{"name": "op2", "ph": "X"}]))
+        ev = load_profiler_result(str(f))
+        assert ev[0]["name"] == "op2"
+
+    def test_ppm_loader_comments_and_16bit(self, tmp_path):
+        from paddle_tpu.vision.datasets import _default_image_loader
+        p8 = tmp_path / "img.pgm"
+        payload = bytes(range(6))
+        p8.write_bytes(b"P5\n# a comment\n3 2\n255\n" + payload)
+        img = _default_image_loader(str(p8))
+        assert img.shape == (2, 3) and img[0, 1] == 1
+        p16 = tmp_path / "img16.pgm"
+        data16 = np.arange(6, dtype=">u2").tobytes()
+        p16.write_bytes(b"P5 3 2 65535\n" + data16)
+        img16 = _default_image_loader(str(p16))
+        assert img16.shape == (2, 3) and int(img16[1, 2]) == 5
+
+    def test_png_via_pil(self, tmp_path):
+        # PIL ships in this image: the standard-format path must work
+        from PIL import Image
+        from paddle_tpu.vision.datasets import DatasetFolder
+        d = tmp_path / "cls"
+        d.mkdir()
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
+            str(d / "a.png"))
+        ds = DatasetFolder(str(tmp_path))
+        img, label = ds[0]
+        assert img.shape == (4, 4, 3) and label == 0
