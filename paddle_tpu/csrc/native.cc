@@ -434,3 +434,131 @@ int pt_prof_export(const char* path, int pid) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// 4. Fast BPE encoder — ref: PaddleNLP's fast_tokenizer C++ library (the
+//    byte-level BPE merge loop, the tokenizer hot path). Pre-tokenization
+//    (regex) stays in Python; this owns the O(n·merges) symbol-merge loop
+//    with a per-piece cache.
+// ---------------------------------------------------------------------------
+#include <unordered_map>
+
+namespace {
+
+struct BpeModel {
+  std::unordered_map<std::string, int> vocab;
+  std::unordered_map<std::string, int> ranks;  // "left\x01right" -> rank
+  std::unordered_map<std::string, std::vector<int>> cache;
+  std::mutex mu;
+  int unk = 0;
+};
+
+std::mutex g_bpe_mu;
+std::map<long long, BpeModel*> g_bpe;
+long long g_bpe_next = 1;
+
+// split a UTF-8 string into codepoint-wise substrings
+std::vector<std::string> utf8_split(const std::string& s) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i < s.size()) {
+    unsigned char c = s[i];
+    size_t n = (c < 0x80) ? 1 : (c < 0xE0) ? 2 : (c < 0xF0) ? 3 : 4;
+    if (i + n > s.size()) n = 1;  // tolerate malformed tails
+    out.emplace_back(s.substr(i, n));
+    i += n;
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+long long pt_bpe_create() {
+  std::lock_guard<std::mutex> lk(g_bpe_mu);
+  long long h = g_bpe_next++;
+  g_bpe[h] = new BpeModel();
+  return h;
+}
+
+void pt_bpe_add_token(long long h, const char* tok, int id) {
+  std::lock_guard<std::mutex> lk(g_bpe_mu);
+  auto it = g_bpe.find(h);
+  if (it != g_bpe.end()) it->second->vocab[tok] = id;
+}
+
+void pt_bpe_add_merge(long long h, const char* l, const char* r, int rank) {
+  std::lock_guard<std::mutex> lk(g_bpe_mu);
+  auto it = g_bpe.find(h);
+  if (it != g_bpe.end())
+    it->second->ranks[std::string(l) + '\x01' + r] = rank;
+}
+
+void pt_bpe_set_unk(long long h, int unk) {
+  std::lock_guard<std::mutex> lk(g_bpe_mu);
+  auto it = g_bpe.find(h);
+  if (it != g_bpe.end()) it->second->unk = unk;
+}
+
+void pt_bpe_free(long long h) {
+  std::lock_guard<std::mutex> lk(g_bpe_mu);
+  auto it = g_bpe.find(h);
+  if (it != g_bpe.end()) {
+    delete it->second;
+    g_bpe.erase(it);
+  }
+}
+
+// encode one pre-tokenized piece. Returns the FULL token count (which may
+// exceed max_out — the caller re-calls with a bigger buffer); at most
+// max_out ids are written.
+int pt_bpe_encode_piece(long long h, const char* piece, int* out,
+                        int max_out) {
+  BpeModel* m;
+  {
+    std::lock_guard<std::mutex> lk(g_bpe_mu);
+    auto it = g_bpe.find(h);
+    if (it == g_bpe.end()) return -1;
+    m = it->second;
+  }
+  std::string key(piece);
+  {
+    std::lock_guard<std::mutex> lk(m->mu);
+    auto c = m->cache.find(key);
+    if (c != m->cache.end()) {
+      int n = std::min<int>(c->second.size(), max_out);
+      for (int i = 0; i < n; ++i) out[i] = c->second[i];
+      return static_cast<int>(c->second.size());
+    }
+  }
+  std::vector<std::string> sym = utf8_split(key);
+  while (sym.size() > 1) {
+    int best = -1, best_rank = INT32_MAX;
+    for (size_t i = 0; i + 1 < sym.size(); ++i) {
+      auto it = m->ranks.find(sym[i] + '\x01' + sym[i + 1]);
+      if (it != m->ranks.end() && it->second < best_rank) {
+        best_rank = it->second;
+        best = static_cast<int>(i);
+      }
+    }
+    if (best < 0) break;
+    sym[best] += sym[best + 1];
+    sym.erase(sym.begin() + best + 1);
+  }
+  std::vector<int> ids;
+  ids.reserve(sym.size());
+  for (const auto& s : sym) {
+    auto it = m->vocab.find(s);
+    ids.push_back(it == m->vocab.end() ? m->unk : it->second);
+  }
+  {
+    std::lock_guard<std::mutex> lk(m->mu);
+    m->cache[key] = ids;
+  }
+  int n = std::min<int>(ids.size(), max_out);
+  for (int i = 0; i < n; ++i) out[i] = ids[i];
+  return static_cast<int>(ids.size());
+}
+
+}  // extern "C"

@@ -82,6 +82,17 @@ def _load():
     L.pt_prof_export.argtypes = [ctypes.c_char_p, ctypes.c_int]
     L.pt_prof_export.restype = ctypes.c_int
     L.pt_prof_event_count.restype = ctypes.c_int
+    L.pt_bpe_create.restype = ctypes.c_longlong
+    L.pt_bpe_add_token.argtypes = [ctypes.c_longlong, ctypes.c_char_p,
+                                   ctypes.c_int]
+    L.pt_bpe_add_merge.argtypes = [ctypes.c_longlong, ctypes.c_char_p,
+                                   ctypes.c_char_p, ctypes.c_int]
+    L.pt_bpe_set_unk.argtypes = [ctypes.c_longlong, ctypes.c_int]
+    L.pt_bpe_free.argtypes = [ctypes.c_longlong]
+    L.pt_bpe_encode_piece.argtypes = [ctypes.c_longlong, ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_int),
+                                      ctypes.c_int]
+    L.pt_bpe_encode_piece.restype = ctypes.c_int
     lib = L
 
 
@@ -323,3 +334,49 @@ def prof_event_count() -> int:
         return int(lib.pt_prof_event_count())
     with _py_lock:
         return len(_py_events)
+
+
+# ---------------------------------------------------------------------------
+# Fast BPE (ref: PaddleNLP fast_tokenizer C++ — the merge-loop hot path)
+# ---------------------------------------------------------------------------
+class NativeBPE:
+    """C++ byte-pair merge loop with per-piece cache. Construct from the
+    same (vocab, merges) a text.BPETokenizer holds; encode_piece operates
+    on pre-tokenized, byte-alphabet-mapped pieces."""
+
+    def __init__(self, vocab, merges, unk_id: int = 0):
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = lib.pt_bpe_create()
+        for tok, i in vocab.items():
+            lib.pt_bpe_add_token(self._h, tok.encode("utf-8"), int(i))
+        for rank, (l, r) in enumerate(merges):
+            lib.pt_bpe_add_merge(self._h, l.encode("utf-8"),
+                                 r.encode("utf-8"), rank)
+        lib.pt_bpe_set_unk(self._h, int(unk_id))
+
+    def encode_piece(self, piece: str):
+        # per-call buffer: ctypes releases the GIL during the C call, so a
+        # shared buffer would race under threaded data loading. The C side
+        # returns the FULL count; retry with a bigger buffer if truncated.
+        cap = 4096
+        raw = piece.encode("utf-8")
+        while True:
+            buf = (ctypes.c_int * cap)()
+            n = lib.pt_bpe_encode_piece(self._h, raw, buf, cap)
+            if n < 0:
+                raise RuntimeError("invalid native BPE handle")
+            if n <= cap:
+                return list(buf[:n])
+            cap = n
+
+    def close(self):
+        if getattr(self, "_h", None) and lib is not None:
+            lib.pt_bpe_free(self._h)
+            self._h = 0
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -309,7 +309,19 @@ class BPETokenizer:
         self.eos_token = eos_token
         self.pad_token = pad_token if pad_token is not None else eos_token
         self._cache: Dict[str, List[str]] = {}
+        self._id_cache: Dict[str, List[int]] = {}
         self._pat = _gpt2_pretokenize_pattern()
+        # the merge loop runs in C++ when the native runtime is built
+        # (ref: PaddleNLP fast_tokenizer); falls back to the python loop
+        self._native = None
+        try:
+            from ..native import NativeBPE, available
+            if available():
+                self._native = NativeBPE(
+                    self.vocab, merges,
+                    unk_id=self.vocab.get(unk_token, 0))
+        except Exception:
+            self._native = None
 
     @classmethod
     def from_pretrained(cls, path: str, **kw) -> "BPETokenizer":
@@ -358,6 +370,20 @@ class BPETokenizer:
         return out
 
     def encode(self, text: str) -> List[int]:
+        if self._native is not None:
+            # python-side memo in front of the C call: repeated pieces
+            # skip the ctypes boundary entirely
+            memo = self._id_cache
+            out: List[int] = []
+            for piece in self._pat.findall(text):
+                ids = memo.get(piece)
+                if ids is None:
+                    mapped = "".join(self.byte_enc[b]
+                                     for b in piece.encode("utf-8"))
+                    ids = self._native.encode_piece(mapped)
+                    memo[piece] = ids
+                out.extend(ids)
+            return out
         unk = self.vocab.get(self.unk_token, 0)
         return [self.vocab.get(t, unk) for t in self.tokenize(text)]
 

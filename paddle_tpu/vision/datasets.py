@@ -128,7 +128,7 @@ def _default_image_loader(path):
     fallback (8-bit and 16-bit, comment-tolerant) otherwise."""
     if path.endswith(".npy"):
         return np.load(path)
-    if not path.endswith((".ppm", ".pgm")):   # PNM: exact native parse
+    if not path.endswith((".ppm", ".pgm")):
         try:
             from PIL import Image
             return np.asarray(Image.open(path).convert("RGB"))
@@ -136,7 +136,7 @@ def _default_image_loader(path):
             raise RuntimeError(
                 f"no loader available for {path} (PIL not installed); "
                 "provide loader=")
-    if path.endswith((".ppm", ".pgm")):
+    else:   # PNM: exact native parse (keeps grayscale un-RGB-converted)
         with open(path, "rb") as f:
             def token():
                 t = b""
@@ -163,7 +163,6 @@ def _default_image_loader(path):
             if magic == b"P5":
                 return data.reshape(h, w)
             raise ValueError(f"unsupported PNM magic {magic!r} in {path}")
-    raise RuntimeError(f"no loader available for {path}; provide loader=")
 
 
 IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",

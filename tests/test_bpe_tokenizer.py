@@ -103,3 +103,81 @@ def test_temperature_zero_is_greedy():
     t0, _ = generate(model, ids, max_new_tokens=3,
                      decode_strategy="sampling", temperature=0.0)
     np.testing.assert_array_equal(greedy.numpy(), t0.numpy())
+
+
+class TestNativeBPE:
+    def test_native_matches_python_loop(self):
+        from paddle_tpu.native import available
+        if not available():
+            import pytest
+            pytest.skip("native runtime unavailable")
+        tok = _tok()
+        assert tok._native is not None  # C++ path active
+        texts = ["the quick brown fox", " the lazy dog",
+                 "héllo wörld — ¥1000", "punctuation, and; symbols!"]
+        for t in texts:
+            native_ids = tok.encode(t)
+            # python reference loop
+            unk = tok.vocab.get(tok.unk_token, 0)
+            py_ids = [tok.vocab.get(s, unk) for s in tok.tokenize(t)]
+            assert native_ids == py_ids, t
+            assert tok.decode(native_ids) == t
+
+    def test_native_throughput_not_worse(self):
+        import time
+        from paddle_tpu.native import available
+        if not available():
+            import pytest
+            pytest.skip("native runtime unavailable")
+        tok = _tok()
+        text = " ".join(CORPUS) * 20
+        t0 = time.perf_counter()
+        n1 = len(tok.encode(text))
+        t_native = time.perf_counter() - t0
+        tok._native = None  # force the python loop (cold cache)
+        tok._cache.clear()
+        t0 = time.perf_counter()
+        n2 = len(tok.encode(text))
+        t_py = time.perf_counter() - t0
+        assert n1 == n2
+        # smoke bound only: native shouldn't be dramatically slower
+        assert t_native < t_py * 5 + 0.5, (t_native, t_py)
+
+    def test_native_long_piece_not_truncated(self):
+        from paddle_tpu.native import available
+        if not available():
+            import pytest
+            pytest.skip("native runtime unavailable")
+        tok = _tok()
+        long_run = "z" * 6000  # single pre-token piece > 4096 symbols
+        ids = tok.encode(long_run)
+        tok2 = _tok()
+        tok2._native = None
+        py_ids = tok2.encode(long_run)
+        assert ids == py_ids
+        assert tok.decode(ids) == long_run
+
+    def test_native_thread_safety(self):
+        import threading
+        from paddle_tpu.native import available
+        if not available():
+            import pytest
+            pytest.skip("native runtime unavailable")
+        tok = _tok()
+        texts = ["the quick brown fox", "lazy dogs sleep", "pack my box",
+                 "five dozen jugs"] * 8
+        expect = {t: tok.encode(t) for t in set(texts)}
+        errors = []
+
+        def worker(seq):
+            for t in seq:
+                if tok.encode(t) != expect[t]:
+                    errors.append(t)
+
+        threads = [threading.Thread(target=worker, args=(texts,))
+                   for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors
