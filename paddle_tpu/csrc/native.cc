@@ -441,6 +441,7 @@ int pt_prof_export(const char* path, int pid) {
 //    (regex) stays in Python; this owns the O(n·merges) symbol-merge loop
 //    with a per-piece cache.
 // ---------------------------------------------------------------------------
+#include <memory>
 #include <unordered_map>
 
 namespace {
@@ -448,13 +449,14 @@ namespace {
 struct BpeModel {
   std::unordered_map<std::string, int> vocab;
   std::unordered_map<std::string, int> ranks;  // "left\x01right" -> rank
-  std::unordered_map<std::string, std::vector<int>> cache;
-  std::mutex mu;
   int unk = 0;
 };
 
+// shared_ptr ownership: encode holds a reference, so a concurrent
+// pt_bpe_free cannot free the model mid-merge (no use-after-free).
+// No C++-side result cache: the python caller memoizes per piece.
 std::mutex g_bpe_mu;
-std::map<long long, BpeModel*> g_bpe;
+std::map<long long, std::shared_ptr<BpeModel>> g_bpe;
 long long g_bpe_next = 1;
 
 // split a UTF-8 string into codepoint-wise substrings
@@ -478,7 +480,7 @@ extern "C" {
 long long pt_bpe_create() {
   std::lock_guard<std::mutex> lk(g_bpe_mu);
   long long h = g_bpe_next++;
-  g_bpe[h] = new BpeModel();
+  g_bpe[h] = std::make_shared<BpeModel>();
   return h;
 }
 
@@ -503,11 +505,7 @@ void pt_bpe_set_unk(long long h, int unk) {
 
 void pt_bpe_free(long long h) {
   std::lock_guard<std::mutex> lk(g_bpe_mu);
-  auto it = g_bpe.find(h);
-  if (it != g_bpe.end()) {
-    delete it->second;
-    g_bpe.erase(it);
-  }
+  g_bpe.erase(h);  // in-flight encodes keep their shared_ptr alive
 }
 
 // encode one pre-tokenized piece. Returns the FULL token count (which may
@@ -515,24 +513,14 @@ void pt_bpe_free(long long h) {
 // max_out ids are written.
 int pt_bpe_encode_piece(long long h, const char* piece, int* out,
                         int max_out) {
-  BpeModel* m;
+  std::shared_ptr<BpeModel> m;
   {
     std::lock_guard<std::mutex> lk(g_bpe_mu);
     auto it = g_bpe.find(h);
     if (it == g_bpe.end()) return -1;
     m = it->second;
   }
-  std::string key(piece);
-  {
-    std::lock_guard<std::mutex> lk(m->mu);
-    auto c = m->cache.find(key);
-    if (c != m->cache.end()) {
-      int n = std::min<int>(c->second.size(), max_out);
-      for (int i = 0; i < n; ++i) out[i] = c->second[i];
-      return static_cast<int>(c->second.size());
-    }
-  }
-  std::vector<std::string> sym = utf8_split(key);
+  std::vector<std::string> sym = utf8_split(piece);
   while (sym.size() > 1) {
     int best = -1, best_rank = INT32_MAX;
     for (size_t i = 0; i + 1 < sym.size(); ++i) {
@@ -551,10 +539,6 @@ int pt_bpe_encode_piece(long long h, const char* piece, int* out,
   for (const auto& s : sym) {
     auto it = m->vocab.find(s);
     ids.push_back(it == m->vocab.end() ? m->unk : it->second);
-  }
-  {
-    std::lock_guard<std::mutex> lk(m->mu);
-    m->cache[key] = ids;
   }
   int n = std::min<int>(ids.size(), max_out);
   for (int i = 0; i < n; ++i) out[i] = ids[i];

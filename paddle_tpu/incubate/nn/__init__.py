@@ -1,3 +1,187 @@
 """paddle.incubate.nn parity (fused-op wrappers)."""
 
 from . import functional  # noqa: F401
+
+# Layer-class wrappers over the fused functional blocks (ref:
+# python/paddle/incubate/nn/layer/fused_transformer.py —
+# FusedMultiHeadAttention / FusedFeedForward / FusedTransformerEncoderLayer
+# / FusedLinear). Same single-fused-region semantics; parameters are real
+# nn.Layer parameters so state_dict/optimizers see them.
+
+import math as _math
+
+from ... import nn as _nn
+from ...nn import initializer as _I
+
+
+class FusedLinear(_nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else \
+            [in_features, out_features]
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=_I.XavierNormal())
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([out_features], is_bias=True,
+                                  attr=bias_attr)
+
+    def forward(self, x):
+        return functional.fused_linear(
+            x, self.weight, self.bias,
+            transpose_weight=self.transpose_weight)
+
+
+class FusedMultiHeadAttention(_nn.Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim "
+                f"({embed_dim})")
+        # reference contract: fused MHA is SELF-attention only
+        if kdim is not None and kdim != embed_dim:
+            raise ValueError("kdim must equal embed_dim (self-attention)")
+        if vdim is not None and vdim != embed_dim:
+            raise ValueError("vdim must equal embed_dim (self-attention)")
+        if need_weights:
+            raise ValueError("need_weights is not supported (ref parity)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        std = _math.sqrt(2.0 / (2 * embed_dim))
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim], attr=qkv_weight_attr,
+            default_initializer=_I.Normal(0.0, std))
+        self.qkv_bias = None if qkv_bias_attr is False else \
+            self.create_parameter([3, num_heads, self.head_dim],
+                                  is_bias=True, attr=qkv_bias_attr)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=_I.XavierNormal())
+        self.linear_bias = None if linear_bias_attr is False else \
+            self.create_parameter([embed_dim], is_bias=True,
+                                  attr=linear_bias_attr)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=_I.Constant(1.0))
+        self.pre_ln_bias = None if pre_ln_bias_attr is False else \
+            self.create_parameter([embed_dim], is_bias=True,
+                                  attr=pre_ln_bias_attr)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr,
+            default_initializer=_I.Constant(1.0))
+        self.ln_bias = None if ln_bias_attr is False else \
+            self.create_parameter([embed_dim], is_bias=True,
+                                  attr=ln_bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query):
+            raise ValueError("FusedMultiHeadAttention is self-attention "
+                             "only: key/value must be None or the query")
+        return functional.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training,
+            num_heads=self.num_heads)
+
+
+class FusedFeedForward(_nn.Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=_I.XavierNormal())
+        self.linear1_bias = None if linear1_bias_attr is False else \
+            self.create_parameter([dim_feedforward], is_bias=True,
+                                  attr=linear1_bias_attr)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=_I.XavierNormal())
+        self.linear2_bias = None if linear2_bias_attr is False else \
+            self.create_parameter([d_model], is_bias=True,
+                                  attr=linear2_bias_attr)
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr,
+            default_initializer=_I.Constant(1.0))
+        self.ln1_bias = None if ln1_bias_attr is False else \
+            self.create_parameter([d_model], is_bias=True,
+                                  attr=ln1_bias_attr)
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr,
+            default_initializer=_I.Constant(1.0))
+        self.ln2_bias = None if ln2_bias_attr is False else \
+            self.create_parameter([d_model], is_bias=True,
+                                  attr=ln2_bias_attr)
+
+    def forward(self, x):
+        return functional.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate, activation=self.activation,
+            ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(_nn.Layer):
+    """ref: paddle.incubate.nn.FusedTransformerEncoderLayer — fused MHA
+    block + fused FFN block."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        ad = dropout_rate if attn_dropout_rate is None else attn_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=ad, normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        if cache is not None:
+            out, new_cache = out
+            return self.ffn(out), new_cache
+        return self.ffn(out)
+
+
+__all__ = ["functional", "FusedLinear", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer"]

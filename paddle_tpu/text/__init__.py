@@ -413,3 +413,33 @@ class BPETokenizer:
 
 
 __all__ += ["BPETokenizer", "train_bpe"]
+
+
+def _bpe_getstate(self):
+    """Pickle/deepcopy support: the native handle and caches are process-
+    local and rebuilt lazily on restore."""
+    state = self.__dict__.copy()
+    state["_native"] = None
+    state["_pat"] = None
+    state["_cache"] = {}
+    state["_id_cache"] = {}
+    state["_merges_for_restore"] = \
+        [tuple(m) for m in sorted(self.ranks, key=self.ranks.get)]
+    return state
+
+
+def _bpe_setstate(self, state):
+    merges = state.pop("_merges_for_restore", [])
+    self.__dict__.update(state)
+    self._pat = _gpt2_pretokenize_pattern()
+    try:
+        from ..native import NativeBPE, available
+        if available():
+            self._native = NativeBPE(
+                self.vocab, merges, unk_id=self.vocab.get(self.unk_token, 0))
+    except Exception:
+        self._native = None
+
+
+BPETokenizer.__getstate__ = _bpe_getstate
+BPETokenizer.__setstate__ = _bpe_setstate

@@ -181,3 +181,18 @@ class TestNativeBPE:
         for th in threads:
             th.join()
         assert not errors
+
+    def test_pickle_and_deepcopy_rebuild_native(self):
+        import copy
+        import pickle
+        tok = _tok()
+        ref = tok.encode("the quick brown fox")
+        c = copy.deepcopy(tok)
+        assert c.encode("the quick brown fox") == ref
+        p = pickle.loads(pickle.dumps(tok))
+        assert p.encode("the quick brown fox") == ref
+        # and the ORIGINAL still works after the copies are dropped
+        del c, p
+        import gc
+        gc.collect()
+        assert tok.encode("the quick brown fox") == ref

@@ -1,0 +1,92 @@
+"""Fused Layer classes (ref: python/paddle/incubate/nn/layer/
+fused_transformer.py — FusedMultiHeadAttention/FusedFeedForward/
+FusedTransformerEncoderLayer/FusedLinear)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import (FusedFeedForward, FusedLinear,
+                                    FusedMultiHeadAttention,
+                                    FusedTransformerEncoderLayer)
+
+
+def _x(B=2, S=8, E=16, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(B, S, E).astype(np.float32))
+
+
+def test_fused_linear():
+    paddle.seed(0)
+    lin = FusedLinear(16, 8)
+    out = lin(_x())
+    assert out.shape == [2, 8, 8]
+    assert len(lin.parameters()) == 2
+
+
+def test_fused_mha_shapes_and_residual_ln():
+    paddle.seed(0)
+    attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0)
+    attn.eval()
+    x = _x()
+    out = attn(x)
+    assert out.shape == [2, 8, 16]
+    # post-LN output is normalized over the feature dim
+    o = out.numpy()
+    np.testing.assert_allclose(o.mean(-1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(o.std(-1), 1.0, atol=1e-2)
+
+
+def test_fused_ffn_pre_vs_post_norm():
+    paddle.seed(0)
+    ffn_post = FusedFeedForward(16, 32, dropout_rate=0.0)
+    ffn_post.eval()
+    out = ffn_post(_x())
+    np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-4)
+    ffn_pre = FusedFeedForward(16, 32, dropout_rate=0.0,
+                               normalize_before=True)
+    ffn_pre.eval()
+    out2 = ffn_pre(_x())
+    assert out2.shape == [2, 8, 16]
+
+
+def test_fused_encoder_layer_trains():
+    paddle.seed(0)
+    layer = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    layer.train()
+    from paddle_tpu.optimizer import AdamW
+    opt = AdamW(learning_rate=1e-2, parameters=layer.parameters())
+    x = _x(seed=1)
+    losses = []
+    for _ in range(4):
+        out = layer(x)
+        loss = (out - 0.1).pow(2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert len(layer.parameters()) >= 14  # all fused params registered
+
+
+class TestReviewRegressions:
+    def test_bias_attr_false_disables_biases(self):
+        ffn = FusedFeedForward(16, 32, linear1_bias_attr=False,
+                               linear2_bias_attr=False)
+        assert ffn.linear1_bias is None and ffn.linear2_bias is None
+        ffn.eval()
+        assert ffn(_x()).shape == [2, 8, 16]
+
+    def test_self_attention_contract(self):
+        import pytest
+        with pytest.raises(ValueError, match="kdim"):
+            FusedMultiHeadAttention(16, 4, kdim=8)
+        with pytest.raises(ValueError, match="need_weights"):
+            FusedMultiHeadAttention(16, 4, need_weights=True)
+        attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+        x, other = _x(), _x(seed=9)
+        with pytest.raises(ValueError, match="self-attention"):
+            attn(x, key=other)
+        with pytest.raises(ValueError, match="divide"):
+            FusedMultiHeadAttention(15, 4)
