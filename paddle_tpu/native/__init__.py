@@ -340,9 +340,10 @@ def prof_event_count() -> int:
 # Fast BPE (ref: PaddleNLP fast_tokenizer C++ — the merge-loop hot path)
 # ---------------------------------------------------------------------------
 class NativeBPE:
-    """C++ byte-pair merge loop with per-piece cache. Construct from the
-    same (vocab, merges) a text.BPETokenizer holds; encode_piece operates
-    on pre-tokenized, byte-alphabet-mapped pieces."""
+    """C++ byte-pair merge loop (no caching here — BPETokenizer.encode
+    memoizes per piece on the python side). Construct from the same
+    (vocab, merges) a text.BPETokenizer holds; encode_piece operates on
+    pre-tokenized, byte-alphabet-mapped pieces."""
 
     def __init__(self, vocab, merges, unk_id: int = 0):
         if lib is None:

@@ -423,14 +423,13 @@ def _bpe_getstate(self):
     state["_pat"] = None
     state["_cache"] = {}
     state["_id_cache"] = {}
-    state["_merges_for_restore"] = \
-        [tuple(m) for m in sorted(self.ranks, key=self.ranks.get)]
     return state
 
 
 def _bpe_setstate(self, state):
-    merges = state.pop("_merges_for_restore", [])
     self.__dict__.update(state)
+    # merges are derivable from the pickled ranks — no duplicate payload
+    merges = sorted(self.ranks, key=self.ranks.get)
     self._pat = _gpt2_pretokenize_pattern()
     try:
         from ..native import NativeBPE, available

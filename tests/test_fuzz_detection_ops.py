@@ -1,0 +1,85 @@
+"""Hypothesis fuzz for the detection ops (auto_scan parity, SURVEY §4.3):
+random boxes/shapes/attrs; properties checked against numpy references."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+settings.register_profile("ci-det", max_examples=20, deadline=None)
+settings.load_profile("ci-det")
+
+
+def _boxes(n, seed, size=50.0):
+    rng = np.random.RandomState(seed)
+    xy = rng.rand(n, 2) * size
+    wh = rng.rand(n, 2) * (size / 3) + 1.0
+    return np.concatenate([xy, xy + wh], 1).astype(np.float32)
+
+
+@given(n=st.integers(2, 24), seed=st.integers(0, 1000),
+       thr=st.floats(0.1, 0.9))
+def test_nms_properties(n, seed, thr):
+    boxes = _boxes(n, seed)
+    scores = np.random.RandomState(seed + 1).rand(n).astype(np.float32)
+    keep = V.nms(paddle.to_tensor(boxes), thr,
+                 scores=paddle.to_tensor(scores)).numpy()
+    # kept indices are unique, score-sorted, and mutually below-threshold
+    assert len(set(keep.tolist())) == len(keep)
+    ks = scores[keep]
+    assert np.all(np.diff(ks) <= 1e-6)
+    from paddle_tpu.vision.ops import _np_iou_matrix
+    iou = _np_iou_matrix(boxes[keep])
+    np.fill_diagonal(iou, 0.0)
+    assert np.all(iou <= thr + 1e-5)
+    # the top-scoring box always survives
+    assert int(np.argmax(scores)) in keep.tolist()
+
+
+@given(n=st.integers(1, 10), seed=st.integers(0, 1000),
+       out=st.integers(1, 6), sr=st.integers(-1, 3))
+def test_roi_align_bounds_property(n, seed, out, sr):
+    rng = np.random.RandomState(seed)
+    feat = rng.randn(1, 2, 12, 12).astype(np.float32)
+    boxes = _boxes(n, seed, size=11.0)
+    res = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                      paddle.to_tensor(np.array([n], np.int32)),
+                      output_size=out,
+                      sampling_ratio=sr if sr != 0 else 1).numpy()
+    assert res.shape == (n, 2, out, out)
+    # bilinear averages never exceed the input range
+    assert res.max() <= feat.max() + 1e-5
+    assert res.min() >= feat.min() - 1e-5
+
+
+@given(n=st.integers(1, 16), seed=st.integers(0, 1000))
+def test_box_coder_roundtrip_property(n, seed):
+    priors = _boxes(n, seed)
+    targets = _boxes(1, seed + 7)
+    var = np.full((n, 4), 0.2, np.float32)
+    enc = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      paddle.to_tensor(targets),
+                      code_type="encode_center_size")
+    dec = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      enc, code_type="decode_center_size").numpy()
+    for j in range(n):
+        np.testing.assert_allclose(dec[0, j], targets[0], rtol=1e-3,
+                                   atol=1e-2)
+
+
+@given(seed=st.integers(0, 1000), use_gaussian=st.booleans())
+def test_matrix_nms_monotone_property(seed, use_gaussian):
+    """Decayed scores never exceed raw scores; disjoint boxes undecayed."""
+    rng = np.random.RandomState(seed)
+    n = 8
+    boxes = _boxes(n, seed)[None]
+    scores = rng.rand(1, 2, n).astype(np.float32)
+    out, nums = V.matrix_nms(paddle.to_tensor(boxes),
+                             paddle.to_tensor(scores),
+                             score_threshold=0.05,
+                             use_gaussian=use_gaussian,
+                             background_label=-1)
+    o = out.numpy()
+    assert np.all(np.isfinite(o))
+    assert o[:, 1].max() <= scores.max() + 1e-6
