@@ -118,3 +118,6 @@ def distributed_optimizer(optimizer, strategy=None):
     through; optimizer state inherits each param's sharding lazily on first
     step (accumulators are created from the param's sharded buffer)."""
     return optimizer
+
+
+from . import utils  # noqa: F401,E402  (fleet.utils parity)

@@ -1,0 +1,4 @@
+"""fleet.utils parity (ref: python/paddle/distributed/fleet/utils/)."""
+
+from . import hybrid_parallel_util  # noqa: F401
+from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401

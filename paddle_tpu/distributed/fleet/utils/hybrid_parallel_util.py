@@ -1,0 +1,46 @@
+"""ref: python/paddle/distributed/fleet/utils/hybrid_parallel_util.py —
+the manual data-parallel gradient sync used when a model is NOT wrapped in
+DataParallel (SURVEY §2.3 P1: "manual alternative
+fused_allreduce_gradients").
+
+TPU-native mechanism: one flattened eager all_reduce (mean) over the dp
+axis of the hybrid mesh (GSPMD handles the in-graph case; this is the
+explicit eager path for hand-rolled training loops) — matching the
+reference's fused-buffer NCCL allreduce semantics. With no active mesh
+(single process) it is a no-op, like the reference on world_size 1.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Allreduce-mean every parameter's .grad across the data-parallel
+    group. Grads are fused into one flat buffer for a single collective
+    (tensor-fusion parity), then scattered back."""
+    from ...collective import Group, all_reduce, get_group
+    from ....core.tensor import Tensor
+
+    params = [p for p in parameter_list if getattr(p, "grad", None)
+              is not None]
+    if not params:
+        return
+    # hcg may be the HybridTopology (the reference call pattern) — the dp
+    # group is what gradient sync uses either way
+    group = hcg if isinstance(hcg, (Group, str)) else get_group("dp")
+    # fuse per dtype (reference buckets per dtype too): concatenating
+    # mixed bf16/f32 grads would silently promote and re-type them
+    by_dtype = {}
+    for p in params:
+        by_dtype.setdefault(jnp.dtype(p.grad._data.dtype), []).append(p)
+    for dt, group_params in by_dtype.items():
+        flat = jnp.concatenate([p.grad._data.reshape(-1)
+                                for p in group_params])
+        reduced = all_reduce(Tensor(flat), op="avg", group=group)._data
+        off = 0
+        for p in group_params:
+            n = int(jnp.size(p.grad._data))
+            p.grad._data = reduced[off:off + n].reshape(
+                p.grad._data.shape)
+            off += n

@@ -90,3 +90,78 @@ def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
         m = m + e if message_op == "add" else m * e
         return red(m, dst, n)
     return apply("send_ue_recv", impl, [x, y])
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """ref: paddle.geometric.sample_neighbors — uniform neighbor sampling
+    over a CSC graph (row: concatenated in-neighbors, colptr: [N+1] offsets).
+    Returns (out_neighbors, out_count[, out_eids]). Output sizes are
+    data-dependent → eager-only (same restriction as the reference's
+    dynamic-shape GPU kernel under CINN).
+    """
+    import numpy as np
+    from ..framework.random import next_key
+    import jax
+
+    row_np = np.asarray(_arr(row))
+    colptr_np = np.asarray(_arr(colptr))
+    nodes = np.asarray(_arr(input_nodes))
+    eids_np = None if eids is None else np.asarray(_arr(eids))
+    rng = None  # lazily seeded: full-neighborhood calls use no randomness
+    neigh, counts, out_eids = [], [], []
+    for n in nodes.reshape(-1):
+        s, e = int(colptr_np[n]), int(colptr_np[n + 1])
+        deg = e - s
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(s, e)
+        else:
+            if rng is None:
+                seed = int(jax.random.randint(next_key(), (), 0,
+                                              2**31 - 1))
+                rng = np.random.RandomState(seed)
+            idx = s + rng.choice(deg, size=sample_size, replace=False)
+        neigh.append(row_np[idx])
+        counts.append(len(idx))
+        if eids_np is not None:
+            out_eids.append(eids_np[idx])
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    out = (Tensor(jnp.asarray(np.concatenate(neigh)
+                              if neigh else np.zeros(0, row_np.dtype))),
+           Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids=True requires eids")
+        out += (Tensor(jnp.asarray(
+            np.concatenate(out_eids) if out_eids
+            else np.zeros(0, eids_np.dtype))),)
+    return out
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """ref: paddle.geometric.reindex_graph — compact (x ∪ neighbors) to
+    local ids; returns (reindexed_src, reindexed_dst, out_nodes)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    x_np = np.asarray(_arr(x)).reshape(-1)
+    nb = np.asarray(_arr(neighbors)).reshape(-1)
+    cnt = np.asarray(_arr(count)).reshape(-1)
+    mapping = {}
+    for v in x_np.tolist():
+        mapping.setdefault(int(v), len(mapping))
+    for v in nb.tolist():
+        mapping.setdefault(int(v), len(mapping))
+    src = np.asarray([mapping[int(v)] for v in nb], np.int64)
+    dst = np.repeat(np.arange(len(x_np)), cnt).astype(np.int64)
+    # insertion order == id order: no sort needed
+    out_nodes = np.fromiter(mapping, np.int64, len(mapping))
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+__all__ += ["sample_neighbors", "reindex_graph"]

@@ -427,6 +427,7 @@ def _bpe_getstate(self):
 
 
 def _bpe_setstate(self, state):
+    state.pop("_merges_for_restore", None)  # legacy pickles carried this
     self.__dict__.update(state)
     # merges are derivable from the pickled ranks — no duplicate payload
     merges = sorted(self.ranks, key=self.ranks.get)

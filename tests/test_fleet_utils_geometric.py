@@ -1,0 +1,138 @@
+"""fleet.utils.fused_allreduce_gradients (P1 manual path) +
+geometric.sample_neighbors/reindex_graph (SURVEY §2.2 geometric row)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_fused_allreduce_gradients_noop_single_process():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.utils import fused_allreduce_gradients
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    lin(x).pow(2).mean().backward()
+    before = lin.weight.grad.numpy().copy()
+    fused_allreduce_gradients(lin.parameters())
+    np.testing.assert_allclose(lin.weight.grad.numpy(), before, rtol=1e-6)
+
+
+def test_fused_allreduce_gradients_dp_mesh():
+    """Under a dp mesh the eager collective averages grads (they are
+    replica-identical here, so the mean is value-preserving)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import fused_allreduce_gradients
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                        "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    lin = fleet.distributed_model(lin)
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    lin(x).pow(2).mean().backward()
+    before = lin.weight.grad.numpy().copy()
+    fused_allreduce_gradients(lin.parameters())
+    np.testing.assert_allclose(lin.weight.grad.numpy(), before,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sample_neighbors_and_reindex():
+    from paddle_tpu import geometric as G
+    # CSC graph: node0 <- {1,2,3}, node1 <- {0}, node2 <- {}
+    row = np.array([1, 2, 3, 0], np.int64)
+    colptr = np.array([0, 3, 4, 4], np.int64)
+    paddle.seed(0)
+    nbr, cnt = G.sample_neighbors(paddle.to_tensor(row),
+                                  paddle.to_tensor(colptr),
+                                  paddle.to_tensor(
+                                      np.array([0, 1, 2], np.int64)),
+                                  sample_size=2)
+    c = cnt.numpy()
+    np.testing.assert_array_equal(c, [2, 1, 0])
+    n = nbr.numpy()
+    assert set(n[:2]).issubset({1, 2, 3})
+    assert n[2] == 0
+    # full sampling (-1) returns every neighbor
+    nbr2, cnt2 = G.sample_neighbors(paddle.to_tensor(row),
+                                    paddle.to_tensor(colptr),
+                                    paddle.to_tensor(
+                                        np.array([0], np.int64)))
+    np.testing.assert_array_equal(sorted(nbr2.numpy()), [1, 2, 3])
+    # eids thread through
+    eids = np.array([10, 11, 12, 13], np.int64)
+    _, _, oe = G.sample_neighbors(paddle.to_tensor(row),
+                                  paddle.to_tensor(colptr),
+                                  paddle.to_tensor(np.array([1], np.int64)),
+                                  eids=paddle.to_tensor(eids),
+                                  return_eids=True)
+    np.testing.assert_array_equal(oe.numpy(), [13])
+
+    src, dst, nodes = G.reindex_graph(
+        paddle.to_tensor(np.array([5, 9], np.int64)),
+        paddle.to_tensor(np.array([9, 7, 5], np.int64)),
+        paddle.to_tensor(np.array([2, 1], np.int64)))
+    np.testing.assert_array_equal(nodes.numpy(), [5, 9, 7])
+    np.testing.assert_array_equal(src.numpy(), [1, 2, 0])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1])
+
+
+class TestReviewRegressions:
+    def test_hcg_object_accepted(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.utils import (
+            fused_allreduce_gradients)
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                            "sharding_degree": 1, "sep_degree": 1}
+        fleet.init(is_collective=True, strategy=s)
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        lin = fleet.distributed_model(lin)
+        lin(paddle.to_tensor(np.ones((8, 4), np.float32))).mean().backward()
+        hcg = fleet.get_hybrid_communicate_group()
+        fused_allreduce_gradients(lin.parameters(), hcg)  # must not raise
+
+    def test_mixed_dtype_grads_keep_dtype(self):
+        import jax.numpy as jnp
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.utils import (
+            fused_allreduce_gradients)
+        paddle.seed(0)
+        l1, l2 = nn.Linear(4, 4), nn.Linear(4, 4)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        (l2(l1(x))).pow(2).mean().backward()
+        # force one grad to bf16 (as AMP would)
+        l1.weight.grad._data = l1.weight.grad._data.astype(jnp.bfloat16)
+        fused_allreduce_gradients([l1.weight, l2.weight])
+        assert l1.weight.grad._data.dtype == jnp.bfloat16
+        assert l2.weight.grad._data.dtype == jnp.float32
+
+    def test_sample_neighbors_empty_inputs_with_eids(self):
+        from paddle_tpu import geometric as G
+        row = np.array([1], np.int64)
+        colptr = np.array([0, 1], np.int64)
+        nbr, cnt, oe = G.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.zeros((0,), np.int64)),
+            eids=paddle.to_tensor(np.array([7], np.int64)),
+            return_eids=True)
+        assert nbr.numpy().shape == (0,)
+        assert oe.numpy().shape == (0,)
+
+    def test_full_sampling_does_not_consume_rng(self):
+        from paddle_tpu import geometric as G
+        row = np.array([1, 2], np.int64)
+        colptr = np.array([0, 2], np.int64)
+        paddle.seed(42)
+        G.sample_neighbors(paddle.to_tensor(row), paddle.to_tensor(colptr),
+                           paddle.to_tensor(np.array([0], np.int64)))
+        a = paddle.to_tensor(np.zeros(4, np.float32))
+        import paddle_tpu.nn.functional as F
+        r1 = F.dropout(a, p=0.5, training=True).numpy()
+        paddle.seed(42)
+        r2 = F.dropout(a, p=0.5, training=True).numpy()
+        np.testing.assert_array_equal(r1, r2)
