@@ -29,6 +29,13 @@ def fused_allreduce_gradients(parameter_list, hcg=None):
     # hcg may be the HybridTopology (the reference call pattern) — the dp
     # group is what gradient sync uses either way
     group = hcg if isinstance(hcg, (Group, str)) else get_group("dp")
+    # documented no-op on a 1-wide (or absent) dp axis: skip the
+    # flatten/scatter copies entirely
+    from ...mesh import get_mesh
+    mesh = get_mesh()
+    axis = group if isinstance(group, str) else getattr(group, "axis", "dp")
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return
     # fuse per dtype (reference buckets per dtype too): concatenating
     # mixed bf16/f32 grads would silently promote and re-type them
     by_dtype = {}

@@ -103,8 +103,9 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     """
     import numpy as np
     from ..framework.random import next_key
-    import jax
 
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
     row_np = np.asarray(_arr(row))
     colptr_np = np.asarray(_arr(colptr))
     nodes = np.asarray(_arr(input_nodes))
@@ -126,14 +127,10 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
         counts.append(len(idx))
         if eids_np is not None:
             out_eids.append(eids_np[idx])
-    from ..core.tensor import Tensor
-    import jax.numpy as jnp
     out = (Tensor(jnp.asarray(np.concatenate(neigh)
                               if neigh else np.zeros(0, row_np.dtype))),
            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
     if return_eids:
-        if eids_np is None:
-            raise ValueError("return_eids=True requires eids")
         out += (Tensor(jnp.asarray(
             np.concatenate(out_eids) if out_eids
             else np.zeros(0, eids_np.dtype))),)
@@ -145,8 +142,6 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     """ref: paddle.geometric.reindex_graph — compact (x ∪ neighbors) to
     local ids; returns (reindexed_src, reindexed_dst, out_nodes)."""
     import numpy as np
-    from ..core.tensor import Tensor
-    import jax.numpy as jnp
 
     x_np = np.asarray(_arr(x)).reshape(-1)
     nb = np.asarray(_arr(neighbors)).reshape(-1)
@@ -156,10 +151,11 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
         mapping.setdefault(int(v), len(mapping))
     for v in nb.tolist():
         mapping.setdefault(int(v), len(mapping))
-    src = np.asarray([mapping[int(v)] for v in nb], np.int64)
-    dst = np.repeat(np.arange(len(x_np)), cnt).astype(np.int64)
+    idt = x_np.dtype  # preserve the caller's node-id dtype (ref parity)
+    src = np.asarray([mapping[int(v)] for v in nb], idt)
+    dst = np.repeat(np.arange(len(x_np)), cnt).astype(idt)
     # insertion order == id order: no sort needed
-    out_nodes = np.fromiter(mapping, np.int64, len(mapping))
+    out_nodes = np.fromiter(mapping, idt, len(mapping))
     return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
             Tensor(jnp.asarray(out_nodes)))
 

@@ -33,3 +33,22 @@ jax.config.update("jax_default_matmul_precision", "highest")
 jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+# ---------------------------------------------------------------------------
+# global-state hygiene: tests that fleet.init() a hybrid mesh must not leak
+# it into later tests (the ambient mesh changes eager-collective routing)
+# ---------------------------------------------------------------------------
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_mesh():
+    from paddle_tpu.distributed.mesh import get_mesh, set_mesh
+    from paddle_tpu.distributed import fleet
+    prev = get_mesh()
+    prev_fleet = dict(fleet._fleet_state)
+    yield
+    set_mesh(prev)
+    fleet._fleet_state.clear()
+    fleet._fleet_state.update(prev_fleet)
