@@ -33,23 +33,47 @@ def segment_sum(data, segment_ids, name=None):
                     jax.ops.segment_sum(a, i, n), data, segment_ids)
 
 
+def _segment_count(a, ids, n):
+    return jax.ops.segment_sum(
+        jnp.ones((a.shape[0],) + (1,) * (a.ndim - 1), a.dtype), ids, n)
+
+
+def _segment_mean(a, i, n):
+    s = jax.ops.segment_sum(a, i, n)
+    return s / jnp.maximum(_segment_count(a, i, n), 1)
+
+
 def segment_mean(data, segment_ids, name=None):
+    return _segment("segment_mean", _segment_mean, data, segment_ids)
+
+
+def _masked_extremum(reducer):
+    """Reference fills EMPTY segments with 0, not the ±inf identity."""
     def red(a, i, n):
-        s = jax.ops.segment_sum(a, i, n)
-        c = jax.ops.segment_sum(jnp.ones((a.shape[0],) + (1,) * (a.ndim - 1),
-                                         a.dtype), i, n)
-        return s / jnp.maximum(c, 1)
-    return _segment("segment_mean", red, data, segment_ids)
+        out = reducer(a, i, n)
+        return jnp.where(_segment_count(a, i, n) > 0, out,
+                         jnp.zeros((), a.dtype))
+    return red
 
 
 def segment_max(data, segment_ids, name=None):
-    return _segment("segment_max", lambda a, i, n:
-                    jax.ops.segment_max(a, i, n), data, segment_ids)
+    return _segment("segment_max", _masked_extremum(jax.ops.segment_max),
+                    data, segment_ids)
 
 
 def segment_min(data, segment_ids, name=None):
-    return _segment("segment_min", lambda a, i, n:
-                    jax.ops.segment_min(a, i, n), data, segment_ids)
+    return _segment("segment_min", _masked_extremum(jax.ops.segment_min),
+                    data, segment_ids)
+
+
+def _reducer(reduce_op: str):
+    try:
+        return {"sum": jax.ops.segment_sum,
+                "mean": _segment_mean,
+                "max": _masked_extremum(jax.ops.segment_max),
+                "min": _masked_extremum(jax.ops.segment_min)}[reduce_op]
+    except KeyError:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}") from None
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
@@ -60,18 +84,10 @@ def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
     dst = _arr(dst_index).astype(jnp.int32)
     xa = _arr(x)
     n = int(out_size) if out_size is not None else xa.shape[0]
-    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
-           "min": jax.ops.segment_min}.get(reduce_op)
+    red = _reducer(reduce_op)
 
     def impl(a):
-        msgs = a[src]
-        if reduce_op == "mean":
-            s = jax.ops.segment_sum(msgs, dst, n)
-            c = jax.ops.segment_sum(
-                jnp.ones((msgs.shape[0],) + (1,) * (msgs.ndim - 1),
-                         msgs.dtype), dst, n)
-            return s / jnp.maximum(c, 1)
-        return red(msgs, dst, n)
+        return red(a[src], dst, n)
     return apply("send_u_recv", impl, [x])
 
 
@@ -82,8 +98,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
     dst = _arr(dst_index).astype(jnp.int32)
     xa = _arr(x)
     n = int(out_size) if out_size is not None else xa.shape[0]
-    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
-           "min": jax.ops.segment_min}[reduce_op]
+    red = _reducer(reduce_op)
 
     def impl(a, e):
         m = a[src]

@@ -44,8 +44,6 @@ class Vocab:
         for t in texts:
             for w in basic.tokenize(t):
                 counter[w] += 1
-                for i in range(1, len(w)):
-                    counter["##" + w[i:]] += 0  # ensure continuations exist
         tok2id = {s: i for i, s in enumerate(specials)}
         # whole words + char pieces
         chars = set()

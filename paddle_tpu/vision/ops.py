@@ -175,7 +175,12 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                                aligned)
             Y, X = jnp.meshgrid(ys.reshape(-1), xs.reshape(-1),
                                 indexing="ij")
-            vals = _bilinear(feat, Y, X)
+            # samples past the [-1, size] band contribute zero (the
+            # reference clamps only within that band; beyond it the
+            # sample is dropped, not edge-clamped)
+            H_, W_ = feat.shape[-2:]
+            valid = ((Y >= -1.0) & (Y <= H_) & (X >= -1.0) & (X <= W_))
+            vals = _bilinear(feat, Y, X) * valid.astype(feat.dtype)
             C = feat.shape[0]
             vals = vals.reshape(C, ph, sr_h, pw, sr_w)
             outs.append(vals.mean(axis=(2, 4)))
@@ -475,9 +480,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     keep = (conf > conf_thresh).astype(jnp.float32)
     boxes = jnp.stack([x1, y1, x2, y2], -1) * keep[..., None]
     scores = probs * keep[:, :, None]
-    # row r of both outputs is the same (h, w, a) site
-    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, H * W * A, 4)
-    scores = scores.transpose(0, 3, 4, 1, 2).reshape(N, H * W * A,
+    # reference kernel writes anchor-major rows: r = a*H*W + h*W + w
+    boxes = boxes.reshape(N, A * H * W, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W,
                                                      class_num)
     return Tensor(boxes), Tensor(scores)
 

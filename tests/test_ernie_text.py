@@ -101,6 +101,19 @@ class TestTokenizer:
         ids = tok.encode("the cat")["input_ids"]
         assert tok.decode(ids) == "the cat"
 
+    def test_vocab_build_tight_budget_keeps_char_pieces(self):
+        # zero-count '##'-continuation placeholders must not consume
+        # frequency slots ahead of the char pieces under a tight max_size
+        texts = ["alpha beta gamma delta"] * 3
+        v = Vocab.build(texts, max_size=30)
+        # every single char of every word must be reachable as a piece
+        for ch in set("alphabetagammadelta"):
+            assert ch in v.token_to_id, ch
+        # and no zero-count multi-char continuation stole a slot
+        junk = [t for t in v.token_to_id
+                if t.startswith("##") and len(t) > 3]
+        assert junk == [], junk
+
     def test_end_to_end_with_bert(self):
         from paddle_tpu.models.bert import BertForSequenceClassification, \
             bert_tiny_config

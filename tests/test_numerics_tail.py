@@ -120,13 +120,31 @@ class TestGeometric:
         np.testing.assert_allclose(
             np.asarray(segment_max(x, ids)._data), [[2.0], [4.0]])
 
+    def test_empty_segments_fill_zero(self):
+        # reference fills skipped segment ids with 0, not ±inf
+        from paddle_tpu.geometric import (segment_max, segment_min,
+                                          send_u_recv)
+        x = Tensor(jnp.asarray([[1.0], [-2.0], [3.0]]))
+        ids = jnp.asarray([0, 0, 2])  # segment 1 is empty
+        np.testing.assert_allclose(
+            np.asarray(segment_max(x, ids)._data), [[1.0], [0.0], [3.0]])
+        np.testing.assert_allclose(
+            np.asarray(segment_min(x, ids)._data), [[-2.0], [0.0], [3.0]])
+        out = send_u_recv(x, jnp.asarray([0, 1]), jnp.asarray([0, 0]),
+                          "max")
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   [[1.0], [0.0], [0.0]])
+
     def test_send_u_recv(self):
-        from paddle_tpu.geometric import send_u_recv
+        from paddle_tpu.geometric import send_u_recv, send_ue_recv
         x = Tensor(jnp.asarray([[1.0, 0.0], [0.0, 1.0], [2.0, 2.0]]))
         src = jnp.asarray([0, 1, 2])
         dst = jnp.asarray([1, 2, 1])
         out = np.asarray(send_u_recv(x, src, dst, "sum")._data)
         np.testing.assert_allclose(out, [[0, 0], [3, 2], [0, 1]])
+        e = Tensor(jnp.asarray([[1.0, 1.0], [1.0, 1.0], [1.0, 1.0]]))
+        m = np.asarray(send_ue_recv(x, e, src, dst, "add", "mean")._data)
+        np.testing.assert_allclose(m, [[0, 0], [2.5, 2], [1, 2]])
 
 
 class TestAudio:

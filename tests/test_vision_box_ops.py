@@ -100,6 +100,35 @@ class TestYoloBox:
         assert scores.numpy()[0, 0, 0] > 0.99
 
 
+    def test_anchor_major_row_order(self):
+        # reference kernel writes row r = a*H*W + h*W + w; make each site
+        # identifiable through its decoded center
+        C, A, H, W = 1, 2, 2, 3
+        x = np.zeros((1, A * (5 + C), H, W), np.float32)
+        x = x.reshape(1, A, 5 + C, H, W)
+        x[0, :, 4] = 10.0  # conf ≈ 1 everywhere
+        x = x.reshape(1, A * (5 + C), H, W)
+        img_size = np.array([[H * 8, W * 8]], np.int32)
+        boxes, _ = V.yolo_box(paddle.to_tensor(x),
+                              paddle.to_tensor(img_size),
+                              anchors=[4, 4, 8, 8], class_num=C,
+                              conf_thresh=0.01, downsample_ratio=8,
+                              clip_bbox=False)
+        b = boxes.numpy()[0]
+        for a in range(A):
+            for h in range(H):
+                for w in range(W):
+                    r = a * H * W + h * W + w
+                    cx = (b[r, 0] + b[r, 2]) / 2
+                    cy = (b[r, 1] + b[r, 3]) / 2
+                    np.testing.assert_allclose(cx, (w + 0.5) * 8, atol=1e-3)
+                    np.testing.assert_allclose(cy, (h + 0.5) * 8, atol=1e-3)
+                    # anchor size identifies a: anchor 0 is 4px, anchor 1 8px
+                    np.testing.assert_allclose(b[r, 2] - b[r, 0],
+                                               4.0 if a == 0 else 8.0,
+                                               atol=1e-3)
+
+
 class TestMatrixNMS:
     def test_suppresses_overlaps_softly(self):
         bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],

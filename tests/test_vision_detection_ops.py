@@ -97,6 +97,28 @@ class TestRoiAlign:
                              + f[y1, x1] * wy * wx)
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
 
+    def test_out_of_bounds_samples_are_zeroed(self):
+        """Samples beyond the [-1, size] band contribute 0, not the
+        edge-clamped value (reference border semantics)."""
+        H = W = 4
+        feat = np.full((1, 1, H, W), 5.0, np.float32)
+        # box far outside the map: every sample lands past W+? → all-zero
+        boxes = np.array([[20.0, 20.0, 30.0, 30.0]], np.float32)
+        out = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1], np.int32)),
+                          output_size=2, spatial_scale=1.0,
+                          sampling_ratio=1, aligned=False)
+        np.testing.assert_allclose(out.numpy(), 0.0, atol=1e-6)
+        # box hanging half off the right edge: the outside half pools 0,
+        # so means must be strictly below the constant value
+        boxes2 = np.array([[2.0, 0.0, 10.0, 4.0]], np.float32)
+        out2 = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes2),
+                           paddle.to_tensor(np.array([1], np.int32)),
+                           output_size=(1, 2), spatial_scale=1.0,
+                           sampling_ratio=2, aligned=False)
+        o = out2.numpy()[0, 0, 0]
+        assert o[0] > 0.0 and o[1] < 5.0
+
     def test_shapes_and_batching(self):
         rng = np.random.RandomState(1)
         feat = rng.randn(2, 3, 16, 16).astype(np.float32)
