@@ -20,7 +20,7 @@ from ..core import dispatch
 from ..core.dtypes import convert_dtype
 from ..core.tensor import Tensor
 
-__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate", "decorate_tree",
            "WHITE_LIST", "BLACK_LIST"]
 
 # ops that benefit from low precision (MXU ops)
@@ -128,6 +128,17 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
                 o._multi_precision = True
         return out_models, (opt_list[0] if opt_single else opt_list)
     return out_models
+
+
+def decorate_tree(tree, dtype="bfloat16"):
+    """Functional O2 decorate for jitted SPMD steps: cast every floating
+    leaf of a raw param pytree to the compute dtype, leaving integer leaves
+    (and the f32 master copy, kept by the optimizer) untouched. This is the
+    same O2 contract as `decorate` expressed as a pure tree transform."""
+    import jax
+    dt = convert_dtype(dtype) if isinstance(dtype, str) else dtype
+    return jax.tree.map(
+        lambda v: v.astype(dt) if _is_float(v) else v, tree)
 
 
 class GradScaler:

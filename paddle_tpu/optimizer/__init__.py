@@ -332,27 +332,24 @@ class AdamW(Adam):
         if self._apply_decay_fn is not None:
             do_decay = self._apply_decay_fn(p.name) if p.name else True
         w = self._master_weight(p)
-        if wd and do_decay:
-            w = w * (1.0 - lr_v * wd)
-            pid = id(p)
-            if pid in self._master:
-                self._master[pid] = w
-        g = grad.astype(w.dtype)
         m = self._acc("moment1", p)
         v = self._acc("moment2", p)
         t = self._step_count
-        m = self._b1 * m + (1 - self._b1) * g
-        v = self._b2 * v + (1 - self._b2) * jnp.square(g)
+        # the one shared AdamW kernel (also the jitted pretrain path)
+        from .functional import adamw_kernel
+        if self._amsgrad:
+            new_w, m, v, vmax = adamw_kernel(
+                w, grad, m, v, t, lr=lr_v, b1=self._b1, b2=self._b2,
+                eps=self._eps, weight_decay=wd, do_decay=do_decay,
+                vmax=self._acc("moment2_max", p))
+            self._set_acc("moment2_max", p, vmax)
+        else:
+            new_w, m, v = adamw_kernel(
+                w, grad, m, v, t, lr=lr_v, b1=self._b1, b2=self._b2,
+                eps=self._eps, weight_decay=wd, do_decay=do_decay)
         self._set_acc("moment1", p, m)
         self._set_acc("moment2", p, v)
-        mhat = m / (1 - self._b1 ** t)
-        vhat = v / (1 - self._b2 ** t)
-        if self._amsgrad:
-            vmax = self._acc("moment2_max", p)
-            vmax = jnp.maximum(vmax, vhat)
-            self._set_acc("moment2_max", p, vmax)
-            vhat = vmax
-        self._write_param(p, w - lr_v * mhat / (jnp.sqrt(vhat) + self._eps))
+        self._write_param(p, new_w)
 
 
 class Adamax(Optimizer):

@@ -1,0 +1,122 @@
+"""Functional optimizer core (ref: paddle/phi/kernels/gpu/adamw_kernel.cu —
+the fused AdamW update; python/paddle/optimizer/adamw.py for semantics).
+
+The per-tensor `adamw_kernel` is THE AdamW math for the whole framework:
+the eager `optimizer.AdamW.step()` path and the jitted SPMD pretrain step
+(trainer/pretrain.py) both call it, so the flagship benchmark exercises the
+product's optimizer rather than a bespoke re-implementation. Tree-level
+`FunctionalAdamW` packages it as a pure (grads, state, params) -> (params,
+state) transform whose state inherits the params' shardings — the TPU analog
+of the reference's multi-tensor fused optimizer sweep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["adamw_kernel", "global_norm", "clip_tree_by_global_norm",
+           "AdamWState", "FunctionalAdamW"]
+
+
+def adamw_kernel(w, g, m, v, t, *, lr, b1, b2, eps, weight_decay,
+                 do_decay=True, vmax=None):
+    """One decoupled-weight-decay Adam update in f32 master precision.
+
+    t is the 1-based step AFTER this update (bias correction uses it).
+    Returns (new_w, new_m, new_v), plus new_vmax when vmax is given
+    (amsgrad: the denominator uses the running max of vhat).
+    """
+    g = g.astype(w.dtype)
+    if do_decay:
+        w = w * (1.0 - lr * weight_decay)
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * jnp.square(g)
+    mhat = m / (1.0 - b1 ** t)
+    vhat = v / (1.0 - b2 ** t)
+    if vmax is not None:
+        vmax = jnp.maximum(vmax, vhat)
+        return w - lr * mhat / (jnp.sqrt(vmax) + eps), m, v, vmax
+    return w - lr * mhat / (jnp.sqrt(vhat) + eps), m, v
+
+
+def global_norm(grads: Any) -> jnp.ndarray:
+    """f32 global l2 norm over a pytree of gradients."""
+    leaves = [g for g in jax.tree.leaves(grads) if g is not None]
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
+
+
+def clip_tree_by_global_norm(grads: Any, clip_norm: float):
+    """ClipGradByGlobalNorm semantics (nn/clip.py): scale by
+    clip_norm / max(norm, clip_norm). Returns (clipped, norm)."""
+    norm = global_norm(grads)
+    scale = clip_norm / jnp.maximum(norm, clip_norm)
+    return jax.tree.map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads), norm
+
+
+class AdamWState(NamedTuple):
+    moment1: Any
+    moment2: Any
+    count: jnp.ndarray  # int32 scalar, number of updates applied
+
+
+class FunctionalAdamW:
+    """Pure-tree AdamW with master-precision state, global-norm clipping and
+    an optional jnp-traceable LR schedule (lr may be a float or a fn
+    step -> scalar, e.g. optimizer.lr schedules' traceable forms)."""
+
+    def __init__(self, learning_rate: Union[float, Callable] = 1e-3,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, weight_decay: float = 0.01,
+                 clip_norm: Optional[float] = None,
+                 decay_mask: Optional[Any] = None):
+        self.lr = learning_rate
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+        self.weight_decay = weight_decay
+        self.clip_norm = clip_norm
+        # decay_mask: optional pytree of bools (same structure as params);
+        # None = decay everything (paddle AdamW default)
+        self.decay_mask = decay_mask
+
+    def init(self, params: Any) -> AdamWState:
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return AdamWState(moment1=jax.tree.map(zeros, params),
+                          moment2=jax.tree.map(zeros, params),
+                          count=jnp.zeros((), jnp.int32))
+
+    def lr_at(self, count) -> jnp.ndarray:
+        return self.lr(count) if callable(self.lr) else jnp.asarray(
+            self.lr, jnp.float32)
+
+    def update(self, grads: Any, state: AdamWState, params: Any):
+        """-> (new_params, new_state, grad_norm). params are the f32 master
+        weights; the caller owns the bf16 compute-cast (amp O2)."""
+        if self.clip_norm is not None:
+            grads, norm = clip_tree_by_global_norm(grads, self.clip_norm)
+        else:
+            norm = global_norm(grads)
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        lr = self.lr_at(count)
+
+        if self.decay_mask is not None:
+            triples = jax.tree.map(
+                lambda w, g, m, v, dm: adamw_kernel(
+                    w, g, m, v, t, lr=lr, b1=self.b1, b2=self.b2,
+                    eps=self.eps, weight_decay=self.weight_decay,
+                    do_decay=dm),
+                params, grads, state.moment1, state.moment2, self.decay_mask)
+        else:
+            triples = jax.tree.map(
+                lambda w, g, m, v: adamw_kernel(
+                    w, g, m, v, t, lr=lr, b1=self.b1, b2=self.b2,
+                    eps=self.eps, weight_decay=self.weight_decay),
+                params, grads, state.moment1, state.moment2)
+        new_params, new_m, new_v = jax.tree.transpose(
+            jax.tree.structure(params), jax.tree.structure((0, 0, 0)),
+            triples)
+        return new_params, AdamWState(new_m, new_v, count), norm

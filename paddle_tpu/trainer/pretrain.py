@@ -8,8 +8,10 @@ One jitted SPMD program composes every axis:
   sep — sequence dim sharded (context parallelism via GSPMD resharding
         around attention; ring-attention kernel lands at L6)
   mp  — Megatron TP (weight specs) + vocab-parallel CE
-Optimizer is a functional AdamW (optax) whose state inherits param shardings;
-bf16 params with f32 master weights (multi_precision parity).
+Optimizer is the framework's own AdamW (optimizer.functional.FunctionalAdamW
+— the same adamw_kernel the eager optimizer.AdamW.step() runs) with
+ClipGradByGlobalNorm semantics; bf16 compute params via amp.decorate_tree
+(functional O2) over f32 master weights (multi_precision parity).
 """
 
 from __future__ import annotations
@@ -20,9 +22,9 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..amp import decorate_tree
 from ..core.tensor import Tensor
 from ..distributed.mesh import build_hybrid_mesh, mesh_context
 from ..distributed.pipeline import (PP_AXIS, spmd_pipeline,
@@ -31,6 +33,7 @@ from ..distributed.pipeline import (PP_AXIS, spmd_pipeline,
                                     stack_layer_params_interleaved)
 from ..models.llama import (LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM,
                             precompute_rope)
+from ..optimizer.functional import FunctionalAdamW
 from ..jit import _StateSwap, bind_state, extract_state
 
 __all__ = ["PretrainConfig", "build_llama_pretrain_step",
@@ -199,10 +202,9 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
     master = {g: place(params[g], specs[g]) for g in params}
     compute = {g: place(params[g], specs[g], param_dtype) for g in params}
 
-    tx = optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip),
-        optax.adamw(cfg.lr, b1=0.9, b2=0.95, eps=1e-8,
-                    weight_decay=cfg.weight_decay))
+    tx = FunctionalAdamW(cfg.lr, beta1=0.9, beta2=0.95, epsilon=1e-8,
+                         weight_decay=cfg.weight_decay,
+                         clip_norm=cfg.grad_clip)
     opt_state = tx.init(master)
 
     cos, sin = precompute_rope(mc.head_dim, cfg.seq_len, mc.rope_theta)
@@ -299,18 +301,15 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
 
     def train_step(state: TrainState, ids, labels):
         def cast_loss(master_params):
-            comp = jax.tree.map(
-                lambda v: v.astype(param_dtype)
-                if jnp.issubdtype(v.dtype, jnp.floating) else v, master_params)
-            return loss_fn(comp, ids, labels)
+            return loss_fn(decorate_tree(master_params, param_dtype),
+                           ids, labels)
         loss, grads = jax.value_and_grad(cast_loss)(state.master)
-        updates, new_opt = tx.update(grads, state.opt_state, state.master)
-        new_master = optax.apply_updates(state.master, updates)
-        new_params = jax.tree.map(
-            lambda v: v.astype(param_dtype)
-            if jnp.issubdtype(v.dtype, jnp.floating) else v, new_master)
+        new_master, new_opt, gnorm = tx.update(grads, state.opt_state,
+                                               state.master)
+        new_params = decorate_tree(new_master, param_dtype)
         return TrainState(new_params, new_master, new_opt,
-                          state.step + 1), {"loss": loss}
+                          state.step + 1), {"loss": loss,
+                                            "grad_norm": gnorm}
 
     state = TrainState(compute, master, opt_state, jnp.zeros((), jnp.int32))
 

@@ -446,8 +446,9 @@ def box_coder(prior_box, prior_box_var, target_box,
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
              downsample_ratio, clip_bbox=True, scale_x_y=1.0, name=None):
     """Decode a YOLOv3 head (ref: paddle.vision.ops.yolo_box). x is
-    [N, A*(5+C), H, W]; returns (boxes [N, H*W*A, 4] xyxy in image pixels,
-    scores [N, H*W*A, C]); low-confidence boxes are zeroed."""
+    [N, A*(5+C), H, W]; returns (boxes [N, A*H*W, 4] xyxy in image pixels,
+    scores [N, A*H*W, C]) with anchor-major rows r = a*H*W + h*W + w;
+    low-confidence boxes are zeroed."""
     xb = _arr(x).astype(jnp.float32)
     N, _, H, W = xb.shape
     A = len(anchors) // 2

@@ -1,0 +1,105 @@
+"""FunctionalAdamW (the jitted pretrain optimizer) vs the eager
+optimizer.AdamW — both must run the SAME adamw_kernel (ref:
+python/paddle/optimizer/adamw.py + phi adamw_kernel.cu; VERDICT r1 item 4:
+the flagship hot path must exercise the product optimizer)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.optimizer.functional import (AdamWState, FunctionalAdamW,
+                                             adamw_kernel,
+                                             clip_tree_by_global_norm)
+
+
+def _mk_params(rng, shapes):
+    return {f"p{i}": jnp.asarray(rng.standard_normal(s), jnp.float32)
+            for i, s in enumerate(shapes)}
+
+
+class TestFunctionalAdamW:
+    def test_matches_eager_adamw(self):
+        # same adamw_kernel on both paths; only the lr scalar's precision
+        # differs (python double eagerly vs traced f32), so 1-ulp tolerance
+        rng = np.random.RandomState(0)
+        shapes = [(4, 3), (3,), (2, 2, 2)]
+        tree = _mk_params(rng, shapes)
+        grads = {k: jnp.asarray(rng.standard_normal(v.shape), jnp.float32)
+                 for k, v in tree.items()}
+
+        # eager: Tensor params through AdamW.step() with global-norm clip
+        params = [Tensor(v) for v in tree.values()]
+        for p, g in zip(params, grads.values()):
+            p.stop_gradient = False
+            p._grad = Tensor(g)
+        opt = AdamW(learning_rate=0.01, beta1=0.9, beta2=0.95,
+                    weight_decay=0.1, parameters=params,
+                    grad_clip=ClipGradByGlobalNorm(1.0))
+        fopt = FunctionalAdamW(0.01, beta1=0.9, beta2=0.95,
+                               weight_decay=0.1, clip_norm=1.0)
+        fstate = fopt.init(tree)
+        for _ in range(3):
+            opt.step()
+            tree, fstate, gnorm = fopt.update(grads, fstate, tree)
+        for p, (k, v) in zip(params, tree.items()):
+            np.testing.assert_allclose(np.asarray(p._data),
+                                       np.asarray(v), rtol=1e-6,
+                                       atol=1e-7, err_msg=k)
+        assert int(fstate.count) == 3
+        assert np.isfinite(float(gnorm))
+
+    def test_clip_semantics_match_nn_clip(self):
+        rng = np.random.RandomState(1)
+        grads = _mk_params(rng, [(8,), (5, 5)])
+        clipped, norm = clip_tree_by_global_norm(grads, 0.5)
+        ref_norm = np.sqrt(sum(float(jnp.sum(jnp.square(g)))
+                               for g in grads.values()))
+        np.testing.assert_allclose(float(norm), ref_norm, rtol=1e-6)
+        got = np.sqrt(sum(float(jnp.sum(jnp.square(g)))
+                          for g in clipped.values()))
+        np.testing.assert_allclose(got, 0.5, rtol=1e-5)
+        # below the threshold: untouched
+        small = jax.tree.map(lambda g: g * 1e-3, grads)
+        same, _ = clip_tree_by_global_norm(small, 0.5)
+        for k in small:
+            np.testing.assert_allclose(np.asarray(same[k]),
+                                       np.asarray(small[k]), rtol=1e-6)
+
+    def test_decay_mask_and_schedule(self):
+        tree = {"w": jnp.ones((3,)), "norm": jnp.ones((3,))}
+        grads = {"w": jnp.ones((3,)), "norm": jnp.ones((3,))}
+        lr_fn = lambda step: 0.1 / step.astype(jnp.float32)
+        fopt = FunctionalAdamW(lr_fn, weight_decay=0.5,
+                               decay_mask={"w": True, "norm": False})
+        st = fopt.init(tree)
+        new, st, _ = fopt.update(grads, st, tree)
+        # identical grads: the only difference between leaves is the decay
+        assert float(new["w"][0]) < float(new["norm"][0])
+        # schedule: second step must use lr/2
+        lr1 = float(fopt.lr_at(jnp.asarray(1)))
+        lr2 = float(fopt.lr_at(jnp.asarray(2)))
+        np.testing.assert_allclose(lr1, 2 * lr2)
+
+    def test_update_is_jittable_and_state_donatable(self):
+        tree = {"w": jnp.ones((4, 4))}
+        fopt = FunctionalAdamW(1e-2, clip_norm=1.0)
+        st = fopt.init(tree)
+        step = jax.jit(lambda g, s, p: fopt.update(g, s, p))
+        new, st2, _ = step({"w": jnp.ones((4, 4))}, st, tree)
+        assert isinstance(st2, AdamWState)
+        assert st2.moment1["w"].dtype == jnp.float32
+        assert not np.allclose(np.asarray(new["w"]), 1.0)
+
+    def test_kernel_bias_correction_first_step(self):
+        w = jnp.zeros((1,))
+        g = jnp.full((1,), 0.5)
+        m = jnp.zeros((1,))
+        v = jnp.zeros((1,))
+        new_w, m1, v1 = adamw_kernel(w, g, m, v, 1.0, lr=0.1, b1=0.9,
+                                     b2=0.999, eps=0.0, weight_decay=0.0)
+        # bias-corrected first step: mhat = g, vhat = g^2 → step = -lr*sign
+        np.testing.assert_allclose(np.asarray(new_w), [-0.1], atol=1e-6)
