@@ -1,0 +1,382 @@
+"""Timetable-driven pipeline EXECUTOR: runs pp_schedule.Schedule
+(FThenB / 1F1B / ZBH1) as one compiled SPMD program.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py (1F1B runtime) + distributed/passes/
+pipeline_scheduler_pass.py (ZBH1) — SURVEY §2.3 P6. The reference drives
+these orders with an actor runtime and NCCL p2p; here the SAME validated
+timetable (distributed/pp_schedule.py) is baked into a `lax.scan` over
+ticks inside a `shard_map` over the `pp` mesh axis:
+
+  - tick t, stage s executes exactly timeline[s][t]: F (forward one
+    microbatch), B (backward-dgrad; at the last stage this also runs the
+    loss head and seeds the cotangent), or W (deferred weight-grad — the
+    ZBH1 split).
+  - activations hop downstream and cotangents upstream via lax.ppermute,
+    one message per tick, matching the schedule's 1-tick p2p latency
+    model.
+  - each stage keeps stage-INPUTS only (remat: B/W recompute the stage
+    forward), in a ring buffer whose size is the schedule's peak-liveness
+    bound (~n_stages) — NOT the microbatch count. This is 1F1B's memory
+    point: GPipe's compiled autodiff stores M stage-inputs per stage, the
+    executor stores ≤ bound(s) ≤ S+1.
+
+Because forward and backward INTERLEAVE inside one program, outer
+autodiff cannot drive it; `scheduled_pipeline_loss` therefore computes
+all gradients in its (custom_vjp) forward pass and replays them, scaled,
+in the backward rule — embedding and anything upstream of the pipeline
+still differentiate normally through the returned d_microbatches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .pipeline import PP_AXIS, _cpu_f32_upcast, _pp_shard_map
+from .pp_schedule import Schedule
+
+__all__ = ["scheduled_pipeline_loss", "schedule_buffer_bounds"]
+
+_PHASES = {"F": 1, "B": 2, "W": 3}  # 0 = bubble
+
+
+def _tables(schedule: Schedule):
+    """timeline -> (phase[S,T], mb[S,T]) int32 numpy tables."""
+    S, T = schedule.n_stages, schedule.n_ticks
+    phase = np.zeros((S, T), np.int32)
+    mb = np.zeros((S, T), np.int32)
+    for s, row in enumerate(schedule.timeline):
+        for t, op in enumerate(row):
+            if op is not None:
+                phase[s, t] = _PHASES[op.phase]
+                mb[s, t] = op.mb
+    return phase, mb
+
+
+def schedule_buffer_bounds(schedule: Schedule) -> Dict[str, int]:
+    """Peak liveness the executor must buffer, derived from the timetable:
+
+    in_buf  — stage inputs: live from the producing stage's F (arrival)
+              until this stage's B consumes them;
+    cot_buf — cotangents: from downstream B until this stage's B;
+    w_buf   — (ZBH1) retained (input, cotangent) pairs from B until W.
+
+    For 1F1B these are O(n_stages); for FThenB in_buf is O(M) — the
+    executor allocates what the schedule needs, so the memory claim is
+    checkable per schedule.
+    """
+    S, M = schedule.n_stages, schedule.n_microbatches
+    fin: Dict[Tuple[str, int, int], int] = {}
+    start: Dict[Tuple[str, int, int], int] = {}
+    for s, row in enumerate(schedule.timeline):
+        for t, op in enumerate(row):
+            if op is not None:
+                fin[(op.phase, s, op.mb)] = t + 1
+                start[(op.phase, s, op.mb)] = t
+    def peak(intervals):
+        events = []
+        for a, b in intervals:
+            events.append((a, 1))
+            events.append((b, -1))
+        live = best = 0
+        for _, d in sorted(events, key=lambda e: (e[0], -e[1])):
+            live += d
+            best = max(best, live)
+        return best
+    in_pk = cot_pk = w_pk = 0
+    for s in range(S):  # buffers are PER DEVICE: max over stages
+        in_live, cot_live, w_live = [], [], []
+        for m in range(M):
+            arr = fin[("F", s - 1, m)] if s > 0 else start[("F", s, m)]
+            in_live.append((arr, fin[("B", s, m)]))
+            if s < S - 1:
+                cot_live.append((fin[("B", s + 1, m)], fin[("B", s, m)]))
+            if schedule.split_w:
+                w_live.append((fin[("B", s, m)], fin[("W", s, m)]))
+        in_pk = max(in_pk, peak(in_live))
+        cot_pk = max(cot_pk, peak(cot_live))
+        w_pk = max(w_pk, peak(w_live))
+    return {"in_buf": in_pk, "cot_buf": max(1, cot_pk),
+            "w_buf": max(1, w_pk) if schedule.split_w else 0}
+
+
+def _check_slots(schedule: Schedule, K: int, KC: int, KW: int) -> None:
+    """Simulate ring-buffer occupancy against the timetable: writing slot
+    m % K while a DIFFERENT live microbatch occupies it is a hard error
+    (would corrupt an activation). Guards the contiguous-window assumption
+    the modulo slotting relies on."""
+    S, M = schedule.n_stages, schedule.n_microbatches
+    fin: Dict[Tuple[str, int, int], int] = {}
+    start: Dict[Tuple[str, int, int], int] = {}
+    for s, row in enumerate(schedule.timeline):
+        for t, op in enumerate(row):
+            if op is not None:
+                fin[(op.phase, s, op.mb)] = t + 1
+                start[(op.phase, s, op.mb)] = t
+    def check(intervals, nslots, name, stage):
+        occupied: Dict[int, Tuple[int, int]] = {}
+        for m, a, b in sorted(intervals, key=lambda iv: iv[1]):
+            slot = m % nslots
+            if slot in occupied:
+                m0, b0 = occupied[slot]
+                if a < b0 and m0 != m:
+                    raise AssertionError(
+                        f"{name} slot collision at stage {stage}: mb {m} "
+                        f"overwrites live mb {m0} (slots={nslots})")
+            occupied[slot] = (m, b)
+    for s in range(S):
+        iv_in, iv_cot, iv_w = [], [], []
+        for m in range(M):
+            arr = fin[("F", s - 1, m)] if s > 0 else start[("F", s, m)]
+            iv_in.append((m, arr, fin[("B", s, m)]))
+            if s < S - 1:
+                iv_cot.append((m, fin[("B", s + 1, m)], fin[("B", s, m)]))
+            if schedule.split_w:
+                iv_w.append((m, fin[("B", s, m)], fin[("W", s, m)]))
+        check(iv_in, K, "in_buf", s)
+        check(iv_cot, KC, "cot_buf", s)
+        if schedule.split_w:
+            check(iv_w, KW, "w_buf", s)
+
+
+def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,
+                            head_fn: Callable, mesh: Mesh,
+                            stacked_params: Dict[str, Any], head_params,
+                            microbatches, labels, extra_args=()):
+    """Execute `schedule` over the pp axis of `mesh`; returns the SUMMED
+    loss (caller normalizes). Differentiable in (stacked_params,
+    head_params, microbatches).
+
+    stage_fn(local_params, x, *extra) -> y          (one stage's layers)
+    head_fn(head_params, y, labels_mb) -> scalar    (last-stage loss head,
+                                                     SUM over tokens)
+    stacked_params: {name: [S, L/S, ...]}, dim 0 on pp.
+    microbatches: [M, mb, ...] stage-0 inputs (already embedded).
+    labels: [M, mb, ...] int labels per microbatch.
+    """
+    S = mesh.shape[PP_AXIS]
+    M = schedule.n_microbatches
+    if schedule.n_stages != S:
+        raise ValueError(f"schedule has {schedule.n_stages} stages, "
+                         f"mesh pp={S}")
+    if schedule.n_chunks != 1:
+        raise ValueError("scheduled executor supports n_chunks=1; use "
+                         "spmd_pipeline_interleaved for VPP")
+    if S == 1:
+        raise ValueError("pp=1 needs no schedule; use spmd_pipeline")
+
+    upcast = _cpu_f32_upcast(stacked_params, microbatches, extra_args)
+    if upcast is not None:
+        stacked_params, microbatches, extra_args, _ = upcast
+        head_params = jax.tree.map(
+            lambda v: v.astype(jnp.float32)
+            if jnp.issubdtype(v.dtype, jnp.floating)
+            and jnp.dtype(v.dtype).itemsize < 4 else v, head_params)
+
+    phase_np, mb_np = _tables(schedule)
+    bounds = schedule_buffer_bounds(schedule)
+    K = bounds["in_buf"] + 1          # +1: write-before-read margin
+    KC = bounds["cot_buf"] + 1
+    KW = (bounds["w_buf"] + 1) if schedule.split_w else 1
+    _check_slots(schedule, K, KC, KW)
+    T = schedule.n_ticks
+    phase_tab = jnp.asarray(phase_np)
+    mb_tab = jnp.asarray(mb_np)
+    down = [(i, (i + 1) % S) for i in range(S)]
+    up = [((i + 1) % S, i) for i in range(S)]
+
+    cdt = microbatches.dtype
+    mb_shape = microbatches.shape[1:]
+
+    def _f32_psum(x):
+        return jax.lax.psum(x.astype(jnp.float32), PP_AXIS).astype(x.dtype)
+
+    def per_device(params, head_p, mbs, labels_, *extra):
+        local = {k: v[0] for k, v in params.items()}   # [L/S, ...]
+        stage = jax.lax.axis_index(PP_AXIS)
+        zero_mb = jnp.zeros(mb_shape, cdt)
+
+        def stage_f(p, x):
+            return stage_fn(p, x, *extra)
+
+        def pv(a):
+            """pvary, idempotent: no-op when already device-varying."""
+            vma = getattr(jax.typeof(a), "vma", frozenset())
+            return a if PP_AXIS in vma else jax.lax.pvary(a, PP_AXIS)
+        # CRITICAL: vjp w.r.t. a pp-INVARIANT value makes shard_map insert
+        # a psum_invariant collective to re-invariant the cotangent — and
+        # a collective inside one lax.switch branch deadlocks devices that
+        # took other branches. Mark the replicated head params varying
+        # BEFORE any vjp; grads are psum'd once at the end instead.
+        head_v = jax.tree.map(pv, head_p)
+        carry0 = dict(
+            in_buf=pv(jnp.zeros((K,) + mb_shape, cdt)),
+            cot_buf=pv(jnp.zeros((KC,) + mb_shape, cdt)),
+            wx_buf=pv(jnp.zeros((KW,) + mb_shape, cdt)),
+            wg_buf=pv(jnp.zeros((KW,) + mb_shape, cdt)),
+            dmbs=pv(jnp.zeros((M,) + mb_shape, cdt)),
+            accp=jax.tree.map(
+                lambda v: pv(jnp.zeros(v.shape, jnp.float32)), local),
+            acch=jax.tree.map(
+                lambda v: pv(jnp.zeros(v.shape, jnp.float32)), head_p),
+            loss=pv(jnp.zeros((), jnp.float32)),
+            fmsg=(pv(zero_mb), pv(jnp.zeros((), jnp.int32)),
+                  pv(jnp.zeros((), jnp.bool_))),
+            bmsg=(pv(zero_mb), pv(jnp.zeros((), jnp.int32)),
+                  pv(jnp.zeros((), jnp.bool_))),
+        )
+
+        def tick(carry, t):
+            c = dict(carry)
+            # 1) deliver last tick's messages (1-tick p2p latency)
+            fy, fm, fv = c["fmsg"]
+            recv_f = jnp.logical_and(fv, stage > 0)
+            c["in_buf"] = jax.lax.dynamic_update_index_in_dim(
+                c["in_buf"],
+                jnp.where(recv_f, fy, c["in_buf"][fm % K]), fm % K, 0)
+            by, bm, bv = c["bmsg"]
+            recv_b = jnp.logical_and(bv, stage < S - 1)
+            c["cot_buf"] = jax.lax.dynamic_update_index_in_dim(
+                c["cot_buf"],
+                jnp.where(recv_b, by, c["cot_buf"][bm % KC]), bm % KC, 0)
+
+            ph = phase_tab[stage, t]
+            m = mb_tab[stage, t]
+            no_f = (pv(zero_mb), pv(jnp.zeros((), jnp.int32)),
+                    pv(jnp.zeros((), jnp.bool_)))
+            no_b = (pv(zero_mb), pv(jnp.zeros((), jnp.int32)),
+                    pv(jnp.zeros((), jnp.bool_)))
+
+            def do_idle(c):
+                return c, no_f, no_b
+
+            def do_f(c):
+                x = jnp.where(stage == 0, mbs[m], c["in_buf"][m % K])
+                c = dict(c)
+                c["in_buf"] = jax.lax.dynamic_update_index_in_dim(
+                    c["in_buf"], x, m % K, 0)
+                y = stage_f(local, x)
+                fmsg = (y, m, stage < S - 1)
+                return c, fmsg, no_b
+
+            def do_b(c):
+                x = c["in_buf"][m % K]
+                last = stage == S - 1
+                # ONE stage forward, residuals shared with the backward
+                # (ZBH1 keeps the x-only vjp so W can be deferred)
+                if schedule.split_w:
+                    y, vjp_x = jax.vjp(lambda xx: stage_f(local, xx), x)
+                else:
+                    y, vjp_px = jax.vjp(stage_f, local, x)
+                # the loss head runs ONLY on the last stage (lax.cond is
+                # safe here: with head_v pre-pvary'd no branch contains a
+                # collective); elsewhere the cotangent arrived upstream
+
+                def head_branch():
+                    loss, vjp = jax.vjp(
+                        lambda hp_, y_: head_fn(hp_, y_, labels_[m]),
+                        head_v, y)
+                    dhp, dy_ = vjp(pv(jnp.ones((), loss.dtype)))
+                    return loss.astype(jnp.float32), dy_, dhp
+
+                def skip_branch():
+                    return (pv(jnp.zeros((), jnp.float32)),
+                            pv(jnp.zeros_like(y)),
+                            jax.tree.map(lambda h: pv(jnp.zeros_like(h)),
+                                         head_v))
+                loss_l, dy_l, dhp_l = jax.lax.cond(last, head_branch,
+                                                   skip_branch)
+                dy = jnp.where(last, dy_l, c["cot_buf"][m % KC])
+                c = dict(c)
+                c["loss"] = c["loss"] + loss_l
+                if schedule.split_w:
+                    # ZBH1: dgrad now (critical path), wgrad deferred
+                    (dx,) = vjp_x(dy)
+                    c["wx_buf"] = jax.lax.dynamic_update_index_in_dim(
+                        c["wx_buf"], x, m % KW, 0)
+                    c["wg_buf"] = jax.lax.dynamic_update_index_in_dim(
+                        c["wg_buf"], dy, m % KW, 0)
+                else:
+                    dp, dx = vjp_px(dy)
+                    c["accp"] = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32),
+                        c["accp"], dp)
+                c["acch"] = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32),
+                    c["acch"], dhp_l)
+                c["dmbs"] = jax.lax.dynamic_update_index_in_dim(
+                    c["dmbs"],
+                    jnp.where(stage == 0, dx, c["dmbs"][m]), m, 0)
+                bmsg = (dx, m, stage > 0)
+                return c, no_f, bmsg
+
+            def do_w(c):
+                x = c["wx_buf"][m % KW]
+                dy = c["wg_buf"][m % KW]
+                _, vjp_p = jax.vjp(lambda p: stage_f(p, x), local)
+                (dp,) = vjp_p(dy)
+                c = dict(c)
+                c["accp"] = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), c["accp"], dp)
+                return c, no_f, no_b
+
+            c, fmsg, bmsg = jax.lax.switch(
+                ph, [do_idle, do_f, do_b, do_w], c)
+            # 3) rotate messages
+            c["fmsg"] = (jax.lax.ppermute(fmsg[0], PP_AXIS, down),
+                         jax.lax.ppermute(fmsg[1], PP_AXIS, down),
+                         jax.lax.ppermute(fmsg[2], PP_AXIS, down))
+            c["bmsg"] = (jax.lax.ppermute(bmsg[0], PP_AXIS, up),
+                         jax.lax.ppermute(bmsg[1], PP_AXIS, up),
+                         jax.lax.ppermute(bmsg[2], PP_AXIS, up))
+            return c, None
+
+        c, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        loss = jax.lax.psum(c["loss"], PP_AXIS)
+        dmbs = _f32_psum(c["dmbs"])
+        acch = jax.tree.map(lambda a: jax.lax.psum(a, PP_AXIS), c["acch"])
+        accp = jax.tree.map(lambda a: a[None], c["accp"])  # [1, L/S, ...]
+        return loss, accp, acch, dmbs
+
+    param_specs = {k: P(PP_AXIS, *([None] * (v.ndim - 1)))
+                   for k, v in stacked_params.items()}
+    head_specs = jax.tree.map(lambda v: P(*([None] * jnp.ndim(v))),
+                              head_params)
+    mb_spec = P(*([None] * microbatches.ndim))
+    lab_spec = P(*([None] * labels.ndim))
+    extra_specs = tuple(P(*([None] * jnp.ndim(e))) for e in extra_args)
+
+    fn = _pp_shard_map(
+        per_device, mesh,
+        in_specs=(param_specs, head_specs, mb_spec, lab_spec)
+        + extra_specs,
+        out_specs=(P(), param_specs, head_specs, mb_spec))
+
+    pdt = {k: v.dtype for k, v in stacked_params.items()}
+    hdt = jax.tree.map(lambda v: v.dtype, head_params)
+
+    @jax.custom_vjp
+    def run(sp, hp, mbs):
+        loss, _, _, _ = jax.jit(fn)(sp, hp, mbs, labels, *extra_args)
+        return loss
+
+    def run_fwd(sp, hp, mbs):
+        loss, accp, acch, dmbs = jax.jit(fn)(sp, hp, mbs, labels,
+                                             *extra_args)
+        accp = {k: v.astype(pdt[k]) for k, v in accp.items()}
+        acch = jax.tree.map(lambda v, d: v.astype(d), acch, hdt)
+        return loss, (accp, acch, dmbs)
+
+    def run_bwd(res, g):
+        accp, acch, dmbs = res
+        scale = lambda v: (g * v.astype(jnp.float32)).astype(v.dtype)
+        return (jax.tree.map(scale, accp), jax.tree.map(scale, acch),
+                scale(dmbs))
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked_params, head_params, microbatches)

@@ -241,6 +241,14 @@ def zbh1_schedule(n_stages: int, n_microbatches: int) -> Schedule:
 
     def policy(s, ready, issued):
         in_flight = issued["F"] - issued["B"]
+        w_backlog = issued["B"] - issued["W"]
+        # H1 memory contract: the deferred-W window (retained input +
+        # cotangent pairs) stays O(S); once it fills, drain a W before
+        # admitting new forward work
+        if w_backlog >= S:
+            op = _pick(ready, "W")
+            if op is not None:
+                return op
         if in_flight >= S - s:
             # at the 1F1B memory cap: drain a dgrad, else fill the would-be
             # bubble with a deferred weight-grad (the ZB trick) — never F

@@ -42,7 +42,8 @@ class LlamaConfig:
                  tie_word_embeddings=False, use_flash_attention=True,
                  sequence_parallel=True, recompute=False,
                  context_parallel=False, fuse_attention_qkv=False,
-                 fuse_attention_ffn=False, fuse_pack_groups=1):
+                 fuse_attention_ffn=False, fuse_pack_groups=1,
+                 head_dim=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -69,11 +70,39 @@ class LlamaConfig:
         # same config always reproduces the same weight layout
         # (checkpoints are layout-compatible iff fuse_pack_groups matches).
         self.fuse_pack_groups = fuse_pack_groups
-        self.head_dim = hidden_size // num_attention_heads
+        # explicit head_dim decouples attention width from hidden_size —
+        # needed to model a TP shard (heads/mp heads of the ORIGINAL
+        # head_dim over the full hidden residual stream)
+        self.head_dim = head_dim if head_dim is not None \
+            else hidden_size // num_attention_heads
 
 
 def llama3_8b_config(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
+
+
+def llama3_8b_shard_config(mp: int = 8, pp: int = 4, **kw) -> LlamaConfig:
+    """The per-chip model an mp×pp-partitioned Llama-3-8B places on ONE
+    chip (ref: PaddleNLP llm/run_pretrain.py hybrid configs): layers/pp
+    decoder layers whose attention holds heads/mp query heads (kv heads
+    likewise, min 1) of the true head_dim 128, FFN width 14336/mp, and a
+    vocab-parallel slice 128256/mp of the embedding/CE. Benchmarking this
+    config single-chip measures the MXU efficiency of the flagship's
+    per-chip computation (collectives excluded — accounted separately in
+    docs/FLAGSHIP.md)."""
+    full = llama3_8b_config()
+    base = dict(
+        vocab_size=full.vocab_size // mp,
+        hidden_size=full.hidden_size,
+        intermediate_size=full.intermediate_size // mp,
+        num_hidden_layers=full.num_hidden_layers // pp,
+        num_attention_heads=max(full.num_attention_heads // mp, 1),
+        num_key_value_heads=max(full.num_key_value_heads // mp, 1),
+        head_dim=full.head_dim,
+        max_position_embeddings=full.max_position_embeddings,
+        rope_theta=full.rope_theta)
+    base.update(kw)
+    return LlamaConfig(**base)
 
 
 def llama_tiny_config(**kw) -> LlamaConfig:

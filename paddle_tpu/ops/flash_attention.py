@@ -18,7 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sdpa_reference", "flash_attention"]
+__all__ = ["sdpa_reference", "flash_attention", "sdpa_path"]
 
 
 def sdpa_reference(q, k, v, mask=None, causal: bool = False,
@@ -76,13 +76,49 @@ def _flash_block_sizes(Sq: int, Sk: int):
         block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
 
 
-def _flash_eligible(q, k) -> bool:
+def _flash_eligible(q, k, causal: bool = False) -> bool:
     """Shared Pallas-kernel eligibility gate: TPU backend, block-divisible
-    equal seq lengths, MXU-friendly head dim."""
+    seq lengths (equal when causal — the kernel's causal offset assumes
+    aligned diagonals), MXU-friendly head dim."""
     D = q.shape[-1]
-    return (_tpu_flash_available() and q.shape[1] == k.shape[1]
+    if causal and q.shape[1] != k.shape[1]:
+        return False
+    return (_tpu_flash_available()
             and _largest_dividing_block(q.shape[1]) > 0
+            and _largest_dividing_block(k.shape[1]) > 0
             and ((D <= 128 and D % 64 == 0) or D % 128 == 0))
+
+
+def _as_key_padding(mask, B, Sq, Sk):
+    """If `mask` is a boolean KEY mask ([B,Sk], [B,1,Sk] or [B,1,1,Sk]),
+    return it as [B,Sk] bool; else None. This is the shape every padded
+    fine-tune batch produces — routable to the fused segment-id kernel
+    instead of the O(S^2) composite. ([B,1,Sq,Sk] masks are not
+    detected: whether their rows are identical is runtime data.)"""
+    m = jnp.asarray(mask)
+    if m.dtype != jnp.bool_:
+        return None
+    if m.shape == (B, Sk):
+        return m
+    if m.shape in ((B, 1, Sk), (B, 1, 1, Sk)):
+        return m.reshape(B, Sk)
+    return None  # [B,1,Sq,Sk] forms can't be shape-checked as padding
+
+
+def sdpa_path(q, k, mask=None, causal: bool = False,
+              dropout_p: float = 0.0) -> str:
+    """Which implementation `sdpa` will take for this config — so tests
+    and users can ASSERT the fused kernel is actually hit ("flash",
+    "flash_segmented", or "composite"). Mirrors sdpa's routing exactly."""
+    B, Sq = q.shape[0], q.shape[1]
+    Sk = k.shape[1]
+    if dropout_p != 0.0 or not _flash_eligible(q, k, causal):
+        return "composite"
+    if mask is None:
+        return "flash"
+    if _as_key_padding(mask, B, Sq, Sk) is not None:
+        return "flash_segmented"
+    return "composite"
 
 
 def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
@@ -91,21 +127,39 @@ def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
     (ref parity: FlashAttnKernel, paddle/phi/kernels/gpu/flash_attn_kernel.cu
     — here the fused device kernel is the in-tree Pallas TPU flash attention
     rather than a .cu file), XLA composite elsewhere. The XLA composite
-    (`sdpa_reference`) is the correctness oracle per SURVEY §4.1."""
-    D = q.shape[-1]
+    (`sdpa_reference`) is the correctness oracle per SURVEY §4.1.
+
+    Boolean key-padding masks route through the fused segment-id kernel
+    (masked keys get segment 0, every query row segment 1) — NOT the
+    composite; all query rows match the composite's semantics (masked
+    keys are excluded for everyone)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    use_flash = mask is None and dropout_p == 0.0 and _flash_eligible(q, k)
-    if use_flash:
+    path = sdpa_path(q, k, mask=mask, causal=causal, dropout_p=dropout_p)
+    if path == "flash":
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _pallas_flash)
         qh = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
         kh = jnp.swapaxes(k, 1, 2)
         vh = jnp.swapaxes(v, 1, 2)
         out = _pallas_flash(qh, kh, vh, causal=causal, sm_scale=scale,
-                            block_sizes=_flash_block_sizes(q.shape[1],
-                                                           k.shape[1]))
+                            block_sizes=_flash_block_sizes(Sq, Sk))
         return jnp.swapaxes(out, 1, 2)
+    if path == "flash_segmented":
+        pad = _as_key_padding(mask, B, Sq, Sk)
+        seg_kv = pad.astype(jnp.int32)
+        # every QUERY row keeps segment 1: a key mask excludes keys for
+        # ALL queries (composite semantics) — tying seg_q to the mask
+        # would make masked-position queries attend ONLY excluded keys
+        seg_q = jnp.ones((B, Sq), jnp.int32)
+        return sdpa_segmented(q, k, v, seg_q, kv_segment_ids=seg_kv,
+                              causal=causal, scale=scale)
+    if mask is not None:
+        pad = _as_key_padding(mask, B, Sq, Sk)
+        if pad is not None:  # normalize [B,Sk] forms for broadcasting
+            mask = pad[:, None, None, :]
     return sdpa_reference(q, k, v, mask=mask, causal=causal,
                           dropout_p=dropout_p, scale=scale)
 
@@ -139,7 +193,7 @@ def sdpa_segmented(q, k, v, segment_ids, kv_segment_ids=None, causal=True,
     seg_q = segment_ids.astype(jnp.int32)
     seg_kv = (seg_q if kv_segment_ids is None
               else kv_segment_ids.astype(jnp.int32))
-    if dropout_p == 0.0 and _flash_eligible(q, k):
+    if dropout_p == 0.0 and _flash_eligible(q, k, causal):
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _pallas_flash, SegmentIds)
         out = _pallas_flash(
@@ -195,15 +249,20 @@ def flashmask_attention(query, key, value, startend_row_indices,
         (lower triangle) OR i < ut_end[j] (upper triangle).
       non-causal, C=4: [LTStart, LTEnd, UTStart, UTEnd] — masked inside
         either band.
-    Built as a row-index comparison mask into the f32-softmax composite
-    (the O(S) index encoding is preserved; the dense mask exists only as
-    an XLA fusion intermediate, never in HBM as a user tensor).
+    Block-divisible shapes (and dropout=0) run the in-tree Pallas
+    block-skipping kernel (ops/pallas_flashmask.py): O(S) mask memory
+    end-to-end, fully-masked key blocks skipped on the MXU, flash-style
+    backward. Other shapes fall back to a row-index comparison mask into
+    the f32-softmax composite.
     """
     from ..core.dispatch import apply as _apply
+    from .pallas_flashmask import flashmask_kernel_eligible, flashmask_sdpa
 
     def impl(q, k, v, se):
         B, Sq, H, D = q.shape
         Sk = k.shape[1]
+        if dropout == 0.0 and flashmask_kernel_eligible(Sq, Sk, D):
+            return flashmask_sdpa(q, k, v, se, causal=causal)
         rows = jnp.arange(Sq, dtype=jnp.int32)[:, None]      # [Sq,1]
         C = se.shape[-1]
         se_b = se  # [B,Hm,Sk,C]

@@ -83,9 +83,25 @@ class FunctionalAdamW:
         self.decay_mask = decay_mask
 
     def init(self, params: Any) -> AdamWState:
-        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
-        return AdamWState(moment1=jax.tree.map(zeros, params),
-                          moment2=jax.tree.map(zeros, params),
+        leaves, treedef = jax.tree.flatten(params)
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            m = [jnp.zeros_like(l, dtype=jnp.float32) for l in leaves]
+            v = [jnp.zeros_like(l, dtype=jnp.float32) for l in leaves]
+        else:
+            # allocate both moment trees ON DEVICE in one compiled
+            # program: no host->device transfer of gigabytes of zeros
+            # and no per-(shape,sharding) compile — for billion-param
+            # trees this is minutes faster than device_put of np zeros
+            shapes = [l.shape for l in leaves]
+            shardings = [getattr(l, "sharding", None) for l in leaves]
+            mk = jax.jit(
+                lambda: tuple([jnp.zeros(s, jnp.float32) for s in shapes]
+                              for _ in range(2)),
+                out_shardings=(shardings, shardings)
+                if all(s is not None for s in shardings) else None)
+            m, v = mk()
+        return AdamWState(moment1=jax.tree.unflatten(treedef, m),
+                          moment2=jax.tree.unflatten(treedef, v),
                           count=jnp.zeros((), jnp.int32))
 
     def lr_at(self, count) -> jnp.ndarray:

@@ -46,7 +46,7 @@ class PretrainConfig:
                  param_dtype="bfloat16", grad_clip=1.0,
                  dp=1, mp=1, pp=1, sharding=1, sep=1, vpp=1,
                  scan_layers: bool = True, remat: str = "full",
-                 ce_chunks: int = 4):
+                 ce_chunks: int = 4, pp_schedule: str = "compiled"):
         self.model = model
         self.global_batch = global_batch
         self.seq_len = seq_len
@@ -76,6 +76,24 @@ class PretrainConfig:
         if ce_chunks < 1:
             raise ValueError(f"ce_chunks must be >= 1, got {ce_chunks}")
         self.ce_chunks = ce_chunks
+        # pipeline execution strategy (ref: fleet pipeline_scheduler_pass):
+        #   "compiled" — scan+ppermute program, autodiff'd (GPipe-class
+        #                memory; + interleaved when vpp>1);
+        #   "1F1B" / "ZBH1" / "FThenB" — the pp_schedule timetable run by
+        #                the distributed.pp_exec executor (1F1B bounds
+        #                live activations by stage depth, ZBH1 also fills
+        #                bubbles with deferred weight-grads). Timetable
+        #                modes imply stage-level remat and require vpp=1.
+        if pp_schedule not in ("compiled", "1F1B", "ZBH1", "FThenB"):
+            raise ValueError(f"unknown pp_schedule {pp_schedule!r}")
+        if pp_schedule != "compiled" and vpp > 1:
+            raise ValueError("timetable pp_schedule requires vpp=1 "
+                             "(interleaving is the compiled path's job)")
+        if pp_schedule != "compiled" and pp <= 1:
+            raise ValueError(f"pp_schedule={pp_schedule!r} requires "
+                             f"pp>1 (got pp={pp}); a single stage has "
+                             f"no pipeline to schedule")
+        self.pp_schedule = pp_schedule
 
 
 def make_hybrid_mesh_for(cfg: PretrainConfig, devices=None) -> Mesh:
@@ -241,12 +259,67 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
     B, S = cfg.global_batch, cfg.seq_len
     assert B % M == 0
 
+    use_timetable = cfg.pp_schedule != "compiled" and n_stages > 1
+    if use_timetable:
+        from ..distributed.pp_exec import scheduled_pipeline_loss
+        from ..distributed.pp_schedule import generate_schedule
+        pp_timetable = generate_schedule(cfg.pp_schedule, n_stages, M)
+        pp_timetable.validate()
+
+    def _rms_head_loss(norm_w, w_head, h, labels_h, constrain=False):
+        """final RMSNorm + chunked-CE SUM over h [.., S, H]. constrain
+        adds the logits sharding hint (outer-graph path only — inside the
+        timetable executor's shard_map the pp axis is manual)."""
+        h32 = h.astype(jnp.float32)
+        hn = (h32 * jax.lax.rsqrt(
+            jnp.mean(jnp.square(h32), -1, keepdims=True) + mc.rms_norm_eps)
+        ).astype(h.dtype) * norm_w
+
+        @jax.checkpoint
+        def chunk_loss(h_c, labels_c):
+            logits = h_c @ w_head
+            if constrain:
+                logits = jax.lax.with_sharding_constraint(
+                    logits,
+                    NamedSharding(mesh, P(("dp", "sharding"), None, "mp")))
+            logits32 = logits.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits32, axis=-1)
+            picked = jnp.take_along_axis(
+                logits32, labels_c[..., None], axis=-1)[..., 0]
+            return (lse - picked).sum()
+
+        n_chunks = min(cfg.ce_chunks, S)
+        bounds = [i * S // n_chunks for i in range(n_chunks)] + [S]
+        total = jnp.zeros((), jnp.float32)
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            total = total + chunk_loss(hn[..., lo:hi, :],
+                                       labels_h[..., lo:hi])
+        return total
+
     def loss_fn(compute_params, ids, labels):
         emb = compute_params["outer"][embed_key]
         x = jnp.take(emb, ids, axis=0)  # [B,S,H]
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(("dp", "sharding"), "sep", None)))
         mbs = x.reshape((M, B // M) + x.shape[1:])
+        if use_timetable:
+            # 1F1B/ZBH1/FThenB: the loss head runs ON the last stage
+            # inside the executor (the cotangent seeds the interleaved
+            # backward); embedding still differentiates through d_mbs
+            if head_key in compute_params["outer"]:
+                w_head = compute_params["outer"][head_key]
+            else:
+                w_head = emb.T
+            hp = {"norm": compute_params["outer"][norm_key],
+                  "head": w_head}
+            labels_mb = labels.reshape((M, B // M, S))
+            total = scheduled_pipeline_loss(
+                pp_timetable, stage_fn,
+                lambda hp_, y, lab: _rms_head_loss(hp_["norm"],
+                                                   hp_["head"], y, lab),
+                mesh, compute_params["stacked"], hp, mbs, labels_mb,
+                extra_args=(cos.astype(x.dtype), sin.astype(x.dtype)))
+            return total / (B * S)
         # remat="full" keeps the stage-level checkpoint (per-tick
         # residual = stage input only, GPipe footprint); for "dots"/"none"
         # the stage body owns the policy — an outer checkpoint would
@@ -263,39 +336,19 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
                                              sin.astype(x.dtype)),
                                  remat=(cfg.remat == "full"))
         h = outs.reshape((B, S, -1))
-        # final norm
-        h32 = h.astype(jnp.float32)
-        h = (h32 * jax.lax.rsqrt(
-            jnp.mean(jnp.square(h32), -1, keepdims=True) + mc.rms_norm_eps)
-        ).astype(h.dtype) * compute_params["outer"][norm_key]
         if head_key in compute_params["outer"]:
             w_head = compute_params["outer"][head_key]
         else:
             w_head = emb.T
-
-        # Chunked softmax cross-entropy: never materializes the full
-        # [B, S, vocab] f32 logits (the reference's c_softmax_with_
-        # cross_entropy solves the same memory blow-up for TP; here the
-        # lever is chunking + per-chunk remat — bwd recomputes each
-        # chunk's logits instead of keeping 4·B·S·V bytes live).
-        @jax.checkpoint
-        def chunk_loss(h_c, labels_c):
-            logits = h_c @ w_head
-            logits = jax.lax.with_sharding_constraint(
-                logits, NamedSharding(mesh, P(("dp", "sharding"), None, "mp")))
-            logits32 = logits.astype(jnp.float32)
-            lse = jax.scipy.special.logsumexp(logits32, axis=-1)
-            picked = jnp.take_along_axis(
-                logits32, labels_c[..., None], axis=-1)[..., 0]
-            return (lse - picked).sum()
-
-        # uneven chunking keeps the memory bound for every S (ceil-division
-        # boundaries; each chunk shape is static so XLA compiles ≤2 variants)
-        n_chunks = min(cfg.ce_chunks, S)
-        bounds = [i * S // n_chunks for i in range(n_chunks)] + [S]
-        total = jnp.zeros((), jnp.float32)
-        for lo, hi in zip(bounds[:-1], bounds[1:]):
-            total = total + chunk_loss(h[:, lo:hi], labels[:, lo:hi])
+        # Chunked softmax cross-entropy (in _rms_head_loss): never
+        # materializes the full [B, S, vocab] f32 logits (the reference's
+        # c_softmax_with_cross_entropy solves the same memory blow-up for
+        # TP; here the lever is chunking + per-chunk remat — bwd
+        # recomputes each chunk's logits instead of keeping 4·B·S·V
+        # bytes live). Uneven ceil-division chunk boundaries keep the
+        # bound for every S with ≤2 compiled chunk variants.
+        total = _rms_head_loss(compute_params["outer"][norm_key], w_head,
+                               h, labels, constrain=True)
         loss = total / (B * S)
         return loss
 

@@ -1,0 +1,221 @@
+"""In-tree FlashMask block-skipping kernel (ops/pallas_flashmask.py) —
+parity vs the dense-mask composite oracle for every paddle startend
+encoding, gradient parity, O(S) memory assertion, skip-map soundness,
+and the sdpa routing report (VERDICT r1 item 3; ref: FlashMask variant
+of paddle/phi/kernels/gpu/flash_attn_kernel.cu, SURVEY §5.7.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.flash_attention import (flashmask_attention,
+                                            sdpa_path, sdpa_reference)
+from paddle_tpu.ops.pallas_flashmask import (bands_from_startend,
+                                             flashmask_block_kinds,
+                                             flashmask_sdpa)
+
+B, S, H, D = 2, 256, 2, 64
+
+
+def _qkv(seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, S, H, D)),
+                             jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _dense_allow(se_np, causal):
+    """numpy oracle: dense [B,Hm,S,S] allow mask from the encoding."""
+    Bm, Hm, Sk, C = se_np.shape
+    rows = np.arange(S)[:, None]
+    allow = np.ones((Bm, Hm, S, Sk), bool)
+    for b in range(Bm):
+        for h in range(Hm):
+            if C == 1:
+                m = rows >= se_np[b, h, :, 0][None, :]
+            elif C == 2 and causal:
+                m = ((rows >= se_np[b, h, :, 0][None, :])
+                     & (rows < se_np[b, h, :, 1][None, :]))
+            elif C == 2:
+                m = ((rows >= se_np[b, h, :, 0][None, :])
+                     | (rows < se_np[b, h, :, 1][None, :]))
+            else:
+                m = (((rows >= se_np[b, h, :, 0][None, :])
+                      & (rows < se_np[b, h, :, 1][None, :]))
+                     | ((rows >= se_np[b, h, :, 2][None, :])
+                        & (rows < se_np[b, h, :, 3][None, :])))
+            allow[b, h] = ~m
+    if causal:
+        allow &= (np.arange(S)[None, :] <= rows)
+    return allow
+
+
+def _packed_doc_se():
+    """causal C=1 (LTS): three packed documents per batch row."""
+    ends = np.zeros((B, 1, S, 1), np.int32)
+    for b in range(B):
+        cuts = [96, 160, S] if b == 0 else [128, 224, S]
+        lo = 0
+        for c in cuts:
+            ends[b, 0, lo:c, 0] = c
+            lo = c
+    return ends
+
+
+CASES = {
+    "causal_C1_packed_docs": (_packed_doc_se, True),
+    "causal_C2_band": (
+        lambda: np.stack([
+            np.full((B, 1, S), 80, np.int32),
+            np.full((B, 1, S), 200, np.int32)], -1), True),
+    "noncausal_C2": (
+        lambda: np.stack([
+            np.full((B, 1, S), 192, np.int32),
+            np.full((B, 1, S), 64, np.int32)], -1), False),
+    "noncausal_C4": (
+        lambda: np.stack([
+            np.full((B, 1, S), 160, np.int32),
+            np.full((B, 1, S), 224, np.int32),
+            np.full((B, 1, S), 32, np.int32),
+            np.full((B, 1, S), 96, np.int32)], -1), False),
+}
+
+
+@pytest.mark.parametrize("name", list(CASES))
+def test_kernel_matches_dense_oracle(name):
+    mk_se, causal = CASES[name]
+    se_np = np.asarray(mk_se())
+    q, k, v = _qkv()
+    out = flashmask_sdpa(q, k, v, jnp.asarray(se_np), causal=causal)
+    allow = _dense_allow(se_np, causal)
+    ref = sdpa_reference(q, k, v, mask=jnp.asarray(allow), causal=False)
+    valid = allow.any(axis=-1)  # [B,Hm,S] rows with >=1 visible key
+    got, refn = np.asarray(out), np.asarray(ref)
+    for b in range(B):
+        vmask = valid[b, 0]
+        np.testing.assert_allclose(got[b][vmask], refn[b][vmask],
+                                   rtol=3e-5, atol=3e-5, err_msg=name)
+    # fully-masked rows are exactly zero from the kernel (documented)
+    if not valid.all():
+        empty = ~valid[0, 0]
+        np.testing.assert_allclose(got[0][empty], 0.0, atol=1e-6)
+
+
+def test_kernel_gradients_match_oracle():
+    se_np = np.asarray(_packed_doc_se())
+    q, k, v = _qkv(3)
+    allow = _dense_allow(se_np, True)
+    valid = jnp.asarray(allow.any(axis=-1)[:, 0], jnp.float32)
+
+    def loss_kernel(q_, k_, v_):
+        o = flashmask_sdpa(q_, k_, v_, jnp.asarray(se_np), causal=True)
+        return (o * valid[:, :, None, None]).sum()
+
+    def loss_ref(q_, k_, v_):
+        o = sdpa_reference(q_, k_, v_, mask=jnp.asarray(allow),
+                           causal=False)
+        return (o * valid[:, :, None, None]).sum()
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, nm in zip(gk, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-4, err_msg=nm)
+
+
+def test_block_kinds_sound_and_skipping():
+    """kind==0 blocks must be fully masked in the dense oracle
+    (soundness), and a packed-doc mask must actually skip a meaningful
+    fraction beyond the causal triangle (the FlashMask point)."""
+    se_np = np.asarray(_packed_doc_se())
+    bands = bands_from_startend(jnp.asarray(se_np), S, S, True)
+    kinds = np.asarray(flashmask_block_kinds(bands, S, S, 128, 128, True))
+    allow = _dense_allow(se_np, True)
+    nq = nk = S // 128
+    for b in range(B):
+        for qi in range(nq):
+            for kj in range(nk):
+                blk = allow[b, 0, qi * 128:(qi + 1) * 128,
+                            kj * 128:(kj + 1) * 128]
+                if kinds[b, 0, qi, kj] == 0:
+                    assert not blk.any(), (b, qi, kj)
+    # causal triangle alone keeps nq*(nq+1)/2 blocks; packed docs must
+    # skip at least one more (the cross-document block)
+    kept = kinds[:, 0].sum(axis=(1, 2))
+    assert (kept < nq * (nq + 1) // 2).any(), kinds
+
+
+def test_no_dense_mask_materialized():
+    """THE FlashMask memory contract: no [.., Sq, Sk] buffer anywhere in
+    the kernel-path jaxpr (the dense mask exists only as [bq, bk] tiles
+    inside the pallas kernel)."""
+    se = jnp.asarray(_packed_doc_se())
+    q, k, v = _qkv()
+
+    def run(q_, k_, v_):
+        return flashmask_sdpa(q_, k_, v_, se, causal=True)
+
+    jaxpr = jax.make_jaxpr(run)(q, k, v)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for av in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(av, "aval", None)
+                if aval is not None and len(aval.shape) >= 2:
+                    assert not (aval.shape[-2:] == (S, S)), \
+                        f"dense [.., {S}, {S}] buffer: {eqn.primitive}"
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+    walk(jaxpr.jaxpr)
+
+
+def test_flashmask_attention_routes_to_kernel():
+    """The public API must hit the kernel for block-divisible shapes and
+    the composite otherwise (shape 100 is not 128-divisible)."""
+    se = jnp.asarray(_packed_doc_se())
+    q, k, v = _qkv()
+    out, _ = flashmask_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v),
+                                 paddle.to_tensor(se), causal=True)
+    assert tuple(out.shape) == (B, S, H, D)
+    q2 = paddle.to_tensor(np.asarray(q)[:, :100])
+    k2 = paddle.to_tensor(np.asarray(k)[:, :100])
+    v2 = paddle.to_tensor(np.asarray(v)[:, :100])
+    se2 = paddle.to_tensor(np.asarray(se)[:, :, :100])
+    out2, _ = flashmask_attention(q2, k2, v2, se2, causal=True)
+    assert tuple(out2.shape) == (B, 100, H, D)
+
+
+class TestSdpaRouting:
+    def test_padding_mask_routes_to_segmented(self):
+        q, k, v = _qkv()
+        pad = np.ones((B, S), bool)
+        pad[:, 200:] = False
+        # off-TPU the gate reports composite; the ROUTING decision is
+        # what we assert, so emulate eligibility via the path fn inputs
+        path = sdpa_path(q, k, mask=jnp.asarray(pad), causal=True)
+        if jax.default_backend() == "tpu":
+            assert path == "flash_segmented"
+        else:
+            assert path == "composite"
+
+    def test_dense_mask_and_dropout_stay_composite(self):
+        q, k, v = _qkv()
+        m = jnp.ones((B, 1, S, S), bool)
+        assert sdpa_path(q, k, mask=m, causal=True) == "composite"
+        assert sdpa_path(q, k, dropout_p=0.1) == "composite"
+
+    def test_padding_mask_values_match_composite_on_valid_rows(self):
+        from paddle_tpu.ops.flash_attention import sdpa
+        q, k, v = _qkv(5)
+        pad_np = np.ones((B, S), bool)
+        pad_np[:, 192:] = False
+        pad = jnp.asarray(pad_np)
+        got = np.asarray(sdpa(q, k, v, mask=pad, causal=True))
+        ref = np.asarray(sdpa_reference(
+            q, k, v, mask=pad[:, None, None, :], causal=True))
+        np.testing.assert_allclose(got[:, :192], ref[:, :192],
+                                   rtol=3e-5, atol=3e-5)

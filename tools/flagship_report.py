@@ -1,0 +1,100 @@
+"""Generate the measured data behind docs/FLAGSHIP.md.
+
+AOT-compiles the flagship per-chip shard train step (the bench.py config)
+on the local TPU and extracts XLA's memory_analysis() and cost_analysis()
+— the HLO-derived HBM footprint and FLOP count that anchor the v5p-64
+MFU projection. Writes docs/FLAGSHIP_data.json.
+
+Usage: python tools/flagship_report.py [--batch 3] [--remat none]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=3)
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--remat", default="none")
+    ap.add_argument("--mp", type=int, default=8)
+    ap.add_argument("--pp", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models.llama import (llama3_8b_config,
+                                         llama3_8b_shard_config)
+    from paddle_tpu.trainer.pretrain import (PretrainConfig,
+                                             build_llama_pretrain_step,
+                                             make_hybrid_mesh_for,
+                                             flops_per_token)
+
+    mc = llama3_8b_shard_config(mp=args.mp, pp=args.pp,
+                                max_position_embeddings=args.seq,
+                                sequence_parallel=False)
+    cfg = PretrainConfig(mc, global_batch=args.batch, seq_len=args.seq,
+                         n_microbatches=1, param_dtype="bfloat16",
+                         scan_layers=False, remat=args.remat)
+    mesh = make_hybrid_mesh_for(cfg, devices=jax.devices()[:1])
+    state, train_step, meta = build_llama_pretrain_step(cfg, mesh)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, mc.vocab_size,
+                                  (args.batch, args.seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, mc.vocab_size,
+                                     (args.batch, args.seq)), jnp.int32)
+    lowered = train_step.lower(state, ids, labels)
+    compiled = lowered.compile()
+    mem = compiled.memory_analysis()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+
+    n_shard_params = sum(
+        int(np.prod(v.shape)) for grp in state.params.values()
+        for v in grp.values())
+    full = llama3_8b_config()
+    full_fpt = flops_per_token(full)
+    shard_fpt = flops_per_token(mc)
+    gib = 1024 ** 3
+    out = {
+        "shard": {"mp": args.mp, "pp": args.pp, "batch": args.batch,
+                  "seq": args.seq, "remat": args.remat,
+                  "params": n_shard_params,
+                  "flops_per_token_6N": shard_fpt},
+        "full_8b": {"flops_per_token_6N": full_fpt},
+        "memory_analysis_bytes": {
+            "argument_size": getattr(mem, "argument_size_in_bytes", None),
+            "output_size": getattr(mem, "output_size_in_bytes", None),
+            "temp_size": getattr(mem, "temp_size_in_bytes", None),
+            "generated_code_size": getattr(
+                mem, "generated_code_size_in_bytes", None),
+            "peak_estimate": (getattr(mem, "argument_size_in_bytes", 0)
+                              + getattr(mem, "temp_size_in_bytes", 0)),
+        },
+        "cost_analysis": {
+            "flops_per_step": cost.get("flops") if cost else None,
+            "bytes_accessed": cost.get("bytes accessed") if cost else None,
+        },
+        "device": str(jax.devices()[0].device_kind),
+    }
+    out["memory_analysis_gib"] = {
+        k: (round(v / gib, 3) if isinstance(v, (int, float)) else v)
+        for k, v in out["memory_analysis_bytes"].items()}
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "FLAGSHIP_data.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
