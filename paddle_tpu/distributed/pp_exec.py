@@ -58,6 +58,33 @@ def _tables(schedule: Schedule):
     return phase, mb
 
 
+def _stage_intervals(schedule: Schedule):
+    """Per-stage liveness intervals derived from the timetable — the ONE
+    source both the buffer sizing and the slot-collision guard use.
+    Yields (stage, {"in_buf": [(mb, start, end)], "cot_buf": ...,
+    "w_buf": ...})."""
+    S, M = schedule.n_stages, schedule.n_microbatches
+    fin: Dict[Tuple[str, int, int], int] = {}
+    start: Dict[Tuple[str, int, int], int] = {}
+    for s, row in enumerate(schedule.timeline):
+        for t, op in enumerate(row):
+            if op is not None:
+                fin[(op.phase, s, op.mb)] = t + 1
+                start[(op.phase, s, op.mb)] = t
+    for s in range(S):
+        iv = {"in_buf": [], "cot_buf": [], "w_buf": []}
+        for m in range(M):
+            arr = fin[("F", s - 1, m)] if s > 0 else start[("F", s, m)]
+            iv["in_buf"].append((m, arr, fin[("B", s, m)]))
+            if s < S - 1:
+                iv["cot_buf"].append((m, fin[("B", s + 1, m)],
+                                      fin[("B", s, m)]))
+            if schedule.split_w:
+                iv["w_buf"].append((m, fin[("B", s, m)],
+                                    fin[("W", s, m)]))
+        yield s, iv
+
+
 def schedule_buffer_bounds(schedule: Schedule) -> Dict[str, int]:
     """Peak liveness the executor must buffer, derived from the timetable:
 
@@ -68,19 +95,11 @@ def schedule_buffer_bounds(schedule: Schedule) -> Dict[str, int]:
 
     For 1F1B these are O(n_stages); for FThenB in_buf is O(M) — the
     executor allocates what the schedule needs, so the memory claim is
-    checkable per schedule.
+    checkable per schedule. Buffers are PER DEVICE: max over stages.
     """
-    S, M = schedule.n_stages, schedule.n_microbatches
-    fin: Dict[Tuple[str, int, int], int] = {}
-    start: Dict[Tuple[str, int, int], int] = {}
-    for s, row in enumerate(schedule.timeline):
-        for t, op in enumerate(row):
-            if op is not None:
-                fin[(op.phase, s, op.mb)] = t + 1
-                start[(op.phase, s, op.mb)] = t
     def peak(intervals):
         events = []
-        for a, b in intervals:
+        for _, a, b in intervals:
             events.append((a, 1))
             events.append((b, -1))
         live = best = 0
@@ -88,21 +107,13 @@ def schedule_buffer_bounds(schedule: Schedule) -> Dict[str, int]:
             live += d
             best = max(best, live)
         return best
-    in_pk = cot_pk = w_pk = 0
-    for s in range(S):  # buffers are PER DEVICE: max over stages
-        in_live, cot_live, w_live = [], [], []
-        for m in range(M):
-            arr = fin[("F", s - 1, m)] if s > 0 else start[("F", s, m)]
-            in_live.append((arr, fin[("B", s, m)]))
-            if s < S - 1:
-                cot_live.append((fin[("B", s + 1, m)], fin[("B", s, m)]))
-            if schedule.split_w:
-                w_live.append((fin[("B", s, m)], fin[("W", s, m)]))
-        in_pk = max(in_pk, peak(in_live))
-        cot_pk = max(cot_pk, peak(cot_live))
-        w_pk = max(w_pk, peak(w_live))
-    return {"in_buf": in_pk, "cot_buf": max(1, cot_pk),
-            "w_buf": max(1, w_pk) if schedule.split_w else 0}
+    out = {"in_buf": 0, "cot_buf": 1, "w_buf": 0}
+    for _, iv in _stage_intervals(schedule):
+        for name in out:
+            out[name] = max(out[name], peak(iv[name]))
+    if not schedule.split_w:
+        out["w_buf"] = 0
+    return out
 
 
 def _check_slots(schedule: Schedule, K: int, KC: int, KW: int) -> None:
@@ -110,14 +121,6 @@ def _check_slots(schedule: Schedule, K: int, KC: int, KW: int) -> None:
     m % K while a DIFFERENT live microbatch occupies it is a hard error
     (would corrupt an activation). Guards the contiguous-window assumption
     the modulo slotting relies on."""
-    S, M = schedule.n_stages, schedule.n_microbatches
-    fin: Dict[Tuple[str, int, int], int] = {}
-    start: Dict[Tuple[str, int, int], int] = {}
-    for s, row in enumerate(schedule.timeline):
-        for t, op in enumerate(row):
-            if op is not None:
-                fin[(op.phase, s, op.mb)] = t + 1
-                start[(op.phase, s, op.mb)] = t
     def check(intervals, nslots, name, stage):
         occupied: Dict[int, Tuple[int, int]] = {}
         for m, a, b in sorted(intervals, key=lambda iv: iv[1]):
@@ -129,19 +132,12 @@ def _check_slots(schedule: Schedule, K: int, KC: int, KW: int) -> None:
                         f"{name} slot collision at stage {stage}: mb {m} "
                         f"overwrites live mb {m0} (slots={nslots})")
             occupied[slot] = (m, b)
-    for s in range(S):
-        iv_in, iv_cot, iv_w = [], [], []
-        for m in range(M):
-            arr = fin[("F", s - 1, m)] if s > 0 else start[("F", s, m)]
-            iv_in.append((m, arr, fin[("B", s, m)]))
-            if s < S - 1:
-                iv_cot.append((m, fin[("B", s + 1, m)], fin[("B", s, m)]))
-            if schedule.split_w:
-                iv_w.append((m, fin[("B", s, m)], fin[("W", s, m)]))
-        check(iv_in, K, "in_buf", s)
-        check(iv_cot, KC, "cot_buf", s)
-        if schedule.split_w:
-            check(iv_w, KW, "w_buf", s)
+    sizes = {"in_buf": K, "cot_buf": KC, "w_buf": KW}
+    for s, iv in _stage_intervals(schedule):
+        for name, nslots in sizes.items():
+            if name == "w_buf" and not schedule.split_w:
+                continue
+            check(iv[name], nslots, name, s)
 
 
 def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,

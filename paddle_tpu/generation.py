@@ -261,4 +261,255 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
     return Tensor(gen), Tensor(sc)
 
 
-__all__ += ["generate_cached"]
+# ---------------------------------------------------------------------------
+# Beam search (ref: PaddleNLP GenerationMixin beam_search / group_beam_search,
+# paddlenlp/generation/utils.py + BeamHypotheses in beam_utils) — with length
+# penalty (score / len**length_penalty), repetition penalty (CTRL-style
+# multiply/divide), and diverse groups (Hamming diversity: later groups pay
+# diversity_rate per token already chosen this step by earlier groups).
+# Fixed-shape: the model always sees [B*num_beams, S0+max_new_tokens].
+# ---------------------------------------------------------------------------
+def _repetition_penalize(logp, seen_tokens, penalty):
+    """logp [R, V]; seen_tokens [R, T] int; CTRL penalty on log-probs:
+    seen tokens' log-probs (always < 0) are multiplied by `penalty`
+    (ref: paddlenlp RepetitionPenaltyLogitsProcessor on logits; applied
+    to log-softmax values the multiply branch is the operative one)."""
+    if penalty == 1.0:
+        return logp
+    R, V = logp.shape
+    seen = jnp.zeros((R, V), bool).at[
+        jnp.arange(R)[:, None], seen_tokens].set(True)
+    return jnp.where(seen, logp * penalty, logp)
+
+
+def _beam_step(scores, finished, logp, num_beams, num_beam_groups,
+               diversity_rate, pad_token_id, eos_token_id):
+    """One beam-search selection. scores/finished [B, nb]; logp
+    [B*nb, V] log-softmaxed. Returns (scores, tok, src_beam) [B, nb]."""
+    B, nb = scores.shape
+    V = logp.shape[-1]
+    logp = logp.reshape(B, nb, V)
+    # finished beams emit pad with frozen score
+    frozen = jnp.full((V,), -jnp.inf).at[pad_token_id].set(0.0)
+    logp = jnp.where(finished[..., None], frozen[None, None], logp)
+    gs = nb // num_beam_groups
+    parts = []
+    chosen = jnp.zeros((B, V), jnp.float32)
+    for g in range(num_beam_groups):
+        lg = logp[:, g * gs:(g + 1) * gs]
+        cand = scores[:, g * gs:(g + 1) * gs, None] + lg
+        if g > 0 and diversity_rate:
+            cand = cand - diversity_rate * chosen[:, None, :]
+        top_s, top_i = jax.lax.top_k(cand.reshape(B, gs * V), gs)
+        src = top_i // V + g * gs
+        tok = (top_i % V).astype(jnp.int32)
+        if num_beam_groups > 1:
+            chosen = chosen.at[jnp.arange(B)[:, None], tok].add(1.0)
+        parts.append((top_s, tok, src))
+    new_scores = jnp.concatenate([p[0] for p in parts], 1)
+    new_tok = jnp.concatenate([p[1] for p in parts], 1)
+    new_src = jnp.concatenate([p[2] for p in parts], 1)
+    return new_scores, new_tok, new_src
+
+
+def _beam_engine(step_logits, reorder_state, ids, max_new_tokens,
+                 num_beams, num_beam_groups, diversity_rate,
+                 length_penalty, repetition_penalty, eos_token_id,
+                 pad_token_id, num_return_sequences):
+    """Shared beam loop. step_logits(t) -> [B*nb, V] logits at position
+    t given current buffers; reorder_state(src_beam [B, nb], tok [B,nb],
+    t) commits the beam permutation + chosen tokens."""
+    B, S0 = ids.shape
+    nb = num_beams
+    if nb % num_beam_groups:
+        raise ValueError(f"num_beams {nb} not divisible by "
+                         f"num_beam_groups {num_beam_groups}")
+    if num_return_sequences > nb:
+        raise ValueError("num_return_sequences > num_beams")
+    # beam 0 of each group starts live, the rest -inf (identical prompts
+    # would otherwise fill the beam with duplicates)
+    gs = nb // num_beam_groups
+    init = np.full((B, nb), -1e9, np.float32)
+    init[:, 0::gs] = 0.0
+    scores = jnp.asarray(init)
+    finished = jnp.zeros((B, nb), bool)
+    toks = []  # committed tokens per step, [B, nb] AFTER reordering
+    for t in range(S0 - 1, S0 + max_new_tokens - 1):
+        logits = step_logits(t)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        logp = _repetition_penalize(
+            logp, reorder_state.current_tokens(t), repetition_penalty)
+        scores, tok, src = _beam_step(scores, finished, logp, nb,
+                                      num_beam_groups, diversity_rate,
+                                      pad_token_id, eos_token_id)
+        finished = jnp.take_along_axis(finished, src, 1)
+        if eos_token_id is not None:
+            finished = finished | (tok == eos_token_id)
+        reorder_state.commit(src, tok, t)
+        toks = [jnp.take_along_axis(x, src, 1) for x in toks]
+        toks.append(tok)
+        if eos_token_id is not None and bool(jnp.all(finished)):
+            break
+    gen = jnp.stack(toks, -1)                      # [B, nb, L]
+    L = gen.shape[-1]
+    if eos_token_id is not None:
+        is_eos = gen == eos_token_id
+        has = is_eos.any(-1)
+        first = jnp.where(has, jnp.argmax(is_eos, -1) + 1, L)
+    else:
+        first = jnp.full(gen.shape[:2], L)
+    lengths = first.astype(jnp.float32)
+    final = scores / (lengths ** length_penalty) \
+        if length_penalty != 0.0 else scores
+    order = jnp.argsort(-final, axis=1)[:, :num_return_sequences]
+    gen = jnp.take_along_axis(gen, order[..., None], 1)  # [B, nrs, L]
+    best_sc = jnp.take_along_axis(final, order, 1)
+    # mask everything after (and incl.) nothing — pad after eos
+    pos = jnp.arange(L)[None, None, :]
+    keep = pos < jnp.take_along_axis(first, order, 1)[..., None]
+    gen = jnp.where(keep, gen, pad_token_id)
+    if L < max_new_tokens:
+        gen = jnp.concatenate(
+            [gen, jnp.full(gen.shape[:2] + (max_new_tokens - L,),
+                           pad_token_id, jnp.int32)], -1)
+    gen = gen.reshape(B * num_return_sequences, max_new_tokens)
+    return Tensor(gen), Tensor(best_sc.reshape(-1))
+
+
+class _BufferBeamState:
+    """Fixed-buffer model state for beam search: [B*nb, total] ids."""
+
+    def __init__(self, model, ids, nb, max_new_tokens, pad_token_id):
+        B, S0 = ids.shape
+        self.B, self.nb, self.S0 = B, nb, S0
+        total = S0 + max_new_tokens
+        buf = jnp.concatenate(
+            [ids, jnp.full((B, max_new_tokens), pad_token_id,
+                           jnp.int32)], 1)
+        self.buf = jnp.repeat(buf, nb, axis=0)     # [B*nb, total]
+        self.model = model
+
+    def logits_at(self, t):
+        return _logits_fn(self.model, self.buf)[:, t]
+
+    def current_tokens(self, t):
+        return self.buf[:, :t + 1]  # pad tail excluded from penalties
+
+    def commit(self, src, tok, t):
+        B, nb = self.B, self.nb
+        buf = self.buf.reshape(B, nb, -1)
+        buf = jnp.take_along_axis(buf, src[..., None], 1)
+        buf = buf.at[:, :, t + 1].set(tok)
+        self.buf = buf.reshape(B * nb, -1)
+
+
+def beam_search(model, input_ids, max_new_tokens: int = 20,
+                num_beams: int = 4, num_beam_groups: int = 1,
+                diversity_rate: float = 0.0, length_penalty: float = 0.0,
+                repetition_penalty: float = 1.0,
+                eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+                num_return_sequences: int = 1):
+    """ref: PaddleNLP GenerationMixin.beam_search / group_beam_search.
+    Returns (generated_ids [B*num_return_sequences, max_new_tokens],
+    scores [B*num_return_sequences]) — sequences ranked by
+    sum-logprob / len**length_penalty; tokens after eos are pad."""
+    ids = input_ids._data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    state = _BufferBeamState(model, ids, num_beams, max_new_tokens,
+                             pad_token_id)
+    was_training = getattr(model, "training", False)
+    if hasattr(model, "eval"):
+        model.eval()
+    try:
+        with ag.no_grad():
+            return _beam_engine(state.logits_at, state, ids,
+                                max_new_tokens, num_beams,
+                                num_beam_groups, diversity_rate,
+                                length_penalty, repetition_penalty,
+                                eos_token_id, pad_token_id,
+                                num_return_sequences)
+    finally:
+        if was_training and hasattr(model, "train"):
+            model.train()
+
+
+class _CachedBeamState:
+    """KV-cache model state for beam search: caches gathered by the beam
+    permutation every step (the reference's cache reorder on beam_idx)."""
+
+    def __init__(self, model, ids, nb, max_new_tokens):
+        p = _llama_decode_params(model)
+        self.p = p
+        cfg = p["cfg"]
+        B, S0 = ids.shape
+        self.B, self.nb, self.S0 = B, nb, S0
+        total = S0 + max_new_tokens
+        if total > cfg.max_position_embeddings:
+            raise ValueError(
+                f"{total} tokens exceed max_position_embeddings")
+        KV, D = cfg.num_key_value_heads, cfg.head_dim
+        dt = p["embed"].dtype
+        R = B * nb
+        self.caches = [(jnp.zeros((R, total, KV, D), dt),
+                        jnp.zeros((R, total, KV, D), dt))
+                       for _ in p["layers"]]
+        self.step = _make_llama_cached_step(p, total)
+        self.buf = jnp.repeat(
+            jnp.concatenate([ids, jnp.zeros((B, max_new_tokens),
+                                            jnp.int32)], 1), nb, 0)
+        self._logits = None
+        self._pending = None  # (tok, t) decode deferred until needed
+
+    def logits_at(self, t):
+        # lazy: the engine may break on all-finished right after a
+        # commit — deferring the decode forward here saves that call
+        if self._logits is None:
+            logits, self.caches = self.step(self.buf[:, :self.S0],
+                                            self.caches, 0)
+            self._logits = logits
+        elif self._pending is not None:
+            tok, tp = self._pending
+            self._pending = None
+            self._logits, self.caches = self.step(
+                tok.reshape(-1, 1), self.caches, tp + 1)
+        return self._logits
+
+    def current_tokens(self, t):
+        return self.buf[:, :t + 1]
+
+    def commit(self, src, tok, t):
+        B, nb = self.B, self.nb
+        flat_src = (src + jnp.arange(B)[:, None] * nb).reshape(-1)
+        self.caches = [(ck[flat_src], cv[flat_src])
+                       for ck, cv in self.caches]
+        buf = self.buf.reshape(B, nb, -1)
+        buf = jnp.take_along_axis(buf, src[..., None], 1)
+        buf = buf.at[:, :, t + 1].set(tok)
+        self.buf = buf.reshape(B * nb, -1)
+        self._pending = (tok, t)
+
+
+def beam_search_cached(model, input_ids, max_new_tokens: int = 20,
+                       num_beams: int = 4, num_beam_groups: int = 1,
+                       diversity_rate: float = 0.0,
+                       length_penalty: float = 0.0,
+                       repetition_penalty: float = 1.0,
+                       eos_token_id: Optional[int] = None,
+                       pad_token_id: int = 0,
+                       num_return_sequences: int = 1):
+    """KV-cache beam search for the Llama family (cache rows gathered by
+    the beam permutation each step); same contract as beam_search."""
+    ids = input_ids._data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    state = _CachedBeamState(model, ids, num_beams, max_new_tokens)
+    with ag.no_grad():
+        return _beam_engine(state.logits_at, state, ids, max_new_tokens,
+                            num_beams, num_beam_groups, diversity_rate,
+                            length_penalty, repetition_penalty,
+                            eos_token_id, pad_token_id,
+                            num_return_sequences)
+
+
+__all__ += ["generate_cached", "beam_search", "beam_search_cached"]

@@ -25,7 +25,7 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "BatchSampler",
            "DistributedBatchSampler", "WeightedRandomSampler", "DataLoader",
-           "default_collate_fn", "get_worker_info"]
+           "default_collate_fn", "numpy_collate_fn", "get_worker_info"]
 
 
 class Dataset:
@@ -263,45 +263,90 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
-def default_collate_fn(batch: List[Any]):
-    """Stack samples into device tensors (numpy-first, single h2d per field)."""
+def numpy_collate_fn(batch: List[Any]):
+    """Stack samples into HOST numpy arrays — the worker-process-safe
+    collate (no jax/device touch; workers must never initialize the TPU
+    client)."""
     first = batch[0]
     if isinstance(first, Tensor):
-        return Tensor(np.stack([np.asarray(b._data) for b in batch]))
+        return np.stack([np.asarray(b._data) for b in batch])
     if isinstance(first, np.ndarray):
-        return Tensor(np.stack(batch))
+        return np.stack(batch)
     if isinstance(first, (int, np.integer)):
-        return Tensor(np.asarray(batch, np.int64))
+        return np.asarray(batch, np.int64)
     if isinstance(first, (float, np.floating)):
-        return Tensor(np.asarray(batch, np.float32))
+        return np.asarray(batch, np.float32)
     if isinstance(first, (str, bytes)):
         return list(batch)
     if isinstance(first, dict):
-        return {k: default_collate_fn([b[k] for b in batch]) for k in first}
+        return {k: numpy_collate_fn([b[k] for b in batch]) for k in first}
     if isinstance(first, (tuple, list)):
         transposed = list(zip(*batch))
-        return type(first)(default_collate_fn(list(s)) for s in transposed)
+        return type(first)(numpy_collate_fn(list(s)) for s in transposed)
     raise TypeError(f"cannot collate type {type(first)}")
 
 
+def _tensorize_tree(x):
+    if isinstance(x, np.ndarray):
+        return Tensor(x)
+    if isinstance(x, dict):
+        return {k: _tensorize_tree(v) for k, v in x.items()}
+    if isinstance(x, (tuple, list)):
+        return type(x)(_tensorize_tree(v) for v in x)
+    return x
+
+
+def default_collate_fn(batch: List[Any]):
+    """Stack samples into device tensors (numpy-first, single h2d per field)."""
+    return _tensorize_tree(numpy_collate_fn(batch))
+
+
 class DataLoader:
-    """ref: paddle.io.DataLoader. Threaded prefetch replaces the reference's
-    multiprocess shared-memory workers (device feeding is the bottleneck on
-    TPU hosts, and numpy collation is GIL-friendly); num_workers>0 enables a
-    producer thread pool with a bounded prefetch queue."""
+    """ref: paddle.io.DataLoader. num_workers>0 prefetches batches off
+    the training thread. Two worker modes:
+
+      worker_mode="thread" (default fast path): one producer thread with
+        a bounded queue — numpy collation releases the GIL, and device
+        feeding is the usual bottleneck on TPU hosts;
+      worker_mode="process": the reference's multiprocess workers
+        (python/paddle/io/dataloader/worker.py) — forked worker
+        processes each own a round-robin share of the batches, collate
+        with the numpy-safe collate (never touching jax/the TPU client),
+        and ship pickled arrays back over an mp queue; the parent
+        restores batch order and converts to Tensors. Use it when
+        __getitem__ transforms are CPU-bound python (the OCR/vision
+        pipelines). Workers are seeded per-worker (base_seed + id) and
+        run worker_init_fn(worker_id).
+    """
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, worker_mode: str = "thread",
+                 mp_context: str = "fork"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode {worker_mode!r}: expected "
+                             "'thread' or 'process'")
+        self.worker_mode = worker_mode
+        # fork matches the reference's default and avoids pickling the
+        # dataset, but forking a jax-initialized parent is only safe
+        # because workers are forbidden to touch device state (enforced
+        # in _process_worker); pass "spawn" for full isolation (dataset,
+        # collate_fn and worker_init_fn must then be picklable)
+        self.mp_context = mp_context
         self.is_iterable = isinstance(dataset, IterableDataset)
+        if worker_mode == "process" and self.is_iterable:
+            raise NotImplementedError(
+                "process workers support map-style datasets; shard an "
+                "IterableDataset via get_worker_info with thread mode")
         if self.is_iterable:
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -339,6 +384,9 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
+        if self.worker_mode == "process":
+            yield from self._iter_processes()
+            return
         # threaded prefetch pipeline
         q: _queue.Queue = _queue.Queue(self.num_workers * self.prefetch_factor)
         sentinel = object()
@@ -365,3 +413,99 @@ class DataLoader:
             if isinstance(item, BaseException):
                 raise item
             yield item
+
+    def _iter_processes(self):
+        import multiprocessing as mp
+        ctx = mp.get_context(self.mp_context)
+        batches = list(self.batch_sampler)
+        if not batches:
+            return
+        W = min(self.num_workers, len(batches))
+        base_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        result_q = ctx.Queue(maxsize=W * self.prefetch_factor)
+        user_collate = None if self.collate_fn is default_collate_fn \
+            else self.collate_fn
+        procs = []
+        for w in range(W):
+            p = ctx.Process(
+                target=_process_worker,
+                args=(self.dataset, user_collate, batches[w::W],
+                      [i * W + w for i in range(len(batches[w::W]))],
+                      w, W, base_seed, self.worker_init_fn, result_q),
+                daemon=True)
+            p.start()
+            procs.append(p)
+        try:
+            pending: dict = {}
+            done_workers = 0
+            nxt = 0
+            total = len(batches)
+            while nxt < total:
+                if nxt in pending:
+                    item = pending.pop(nxt)
+                else:
+                    try:
+                        got = result_q.get(
+                            timeout=self.timeout if self.timeout
+                            else None)
+                    except _queue.Empty:
+                        raise RuntimeError(
+                            f"DataLoader worker timed out after "
+                            f"{self.timeout}s waiting for batch {nxt} "
+                            f"(num_workers={W}, worker_mode='process')"
+                        ) from None
+                    if got[0] is None:       # worker finished / failed
+                        done_workers += 1
+                        if got[1] is not None:
+                            raise got[1]
+                        if done_workers == W and nxt not in pending \
+                                and nxt < total:
+                            raise RuntimeError(
+                                "dataloader workers exited before "
+                                f"producing batch {nxt}")
+                        continue
+                    if got[0] != nxt:
+                        pending[got[0]] = got[1]
+                        continue
+                    item = got[1]
+                yield item if user_collate is not None \
+                    else _tensorize_tree(item)
+                nxt += 1
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
+
+def _process_worker(dataset, user_collate, index_batches, batch_ids,
+                    worker_id, num_workers, base_seed, init_fn, out_q):
+    """Worker-process body: seed, run init_fn, produce this worker's
+    round-robin share. Sends (global_batch_idx, collated_numpy) tuples,
+    then a (None, exception_or_None) sentinel."""
+    import random as _random
+    err = None
+    try:
+        np.random.seed((base_seed + worker_id) % (2 ** 32))
+        _random.seed(base_seed + worker_id)
+        _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)
+        if init_fn is not None:
+            init_fn(worker_id)
+        collate = user_collate if user_collate is not None \
+            else numpy_collate_fn
+        for bid, indices in zip(batch_ids, index_batches):
+            samples = [dataset[i] for i in indices]
+            for s in samples:
+                if isinstance(s, Tensor):
+                    # converting an inherited device array in a forked
+                    # child touches the (fork-unsafe) runtime — fail
+                    # loudly instead of deadlocking
+                    raise RuntimeError(
+                        "process workers require host (numpy/python) "
+                        "samples; this dataset returned a device "
+                        "Tensor — convert to numpy in __getitem__ or "
+                        "use worker_mode='thread'")
+            out_q.put((bid, collate(samples)))
+    except BaseException as e:  # noqa: BLE001 — shipped to the parent
+        err = e
+    out_q.put((None, err))

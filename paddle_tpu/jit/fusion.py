@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.extend import core as jcore
 
-__all__ = ["fuse", "match_sdpa_patterns"]
+__all__ = ["fuse", "match_sdpa_patterns", "match_rmsnorm_patterns",
+           "match_swiglu_patterns", "PATTERNS"]
 
 
 def _only_consumer(uses: Dict[Any, List[int]], var, eqn_idx: int) -> bool:
@@ -185,11 +186,192 @@ def match_sdpa_patterns(jaxpr) -> List[dict]:
             # the result (AD then uses the kernel's custom VJP).
             continue
         matches.append({
-            "final": i, "chain": chain - keep,
+            "pattern": "sdpa", "final": i, "chain": chain - keep,
             "q": q_var, "k": k_var, "v": v_var,
             "scale": scale if scale is not None else 1.0,
         })
     return matches
+
+
+def match_rmsnorm_patterns(jaxpr) -> List[dict]:
+    """RMSNorm chains as the models emit them:
+
+        x32 = convert(x); var = mean(square(x32), -1, keepdims=True)
+        y = (x32 * rsqrt(var + eps)).astype(x.dtype) * w
+
+    i.e. [convert] -> square -> reduce_sum -> broadcast -> div(n) ->
+    add(eps) -> rsqrt -> mul -> [convert] -> mul(broadcast(w)).
+    Rewritten to the in-tree Pallas fused_rms_norm kernel."""
+    eqns = jaxpr.eqns
+    producer: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+    uses = _build_use_map(jaxpr)
+
+    def prod(v):
+        return eqns[producer[v]] if v in producer else None
+
+    matches = []
+    for i, eqn in enumerate(eqns):
+        if eqn.primitive.name != "rsqrt":
+            continue
+        chain: Set[int] = {i}
+        e_add = prod(eqn.invars[0])
+        if e_add is None or e_add.primitive.name != "add":
+            continue
+        lit = [x for x in e_add.invars if isinstance(x, jcore.Literal)]
+        varin = [x for x in e_add.invars
+                 if not isinstance(x, jcore.Literal)]
+        if len(lit) != 1 or len(varin) != 1:
+            continue
+        eps = float(lit[0].val)
+        chain.add(producer[eqn.invars[0]])  # the add itself
+        chain.add(producer[varin[0]])
+        e_div = prod(varin[0])
+        if e_div is None or e_div.primitive.name != "div":
+            continue
+        if not isinstance(e_div.invars[1], jcore.Literal):
+            continue
+        chain.add(producer[e_div.invars[0]])
+        e_bc = prod(e_div.invars[0])
+        if e_bc is None or e_bc.primitive.name != "broadcast_in_dim":
+            continue
+        chain.add(producer[e_bc.invars[0]])
+        e_sum = prod(e_bc.invars[0])
+        if e_sum is None or e_sum.primitive.name != "reduce_sum":
+            continue
+        chain.add(producer[e_sum.invars[0]])
+        e_sq = prod(e_sum.invars[0])
+        if e_sq is None or e_sq.primitive.name != "square":
+            continue
+        x32_var = e_sq.invars[0]
+        e_conv = prod(x32_var)
+        if e_conv is not None and \
+                e_conv.primitive.name == "convert_element_type":
+            x_var = e_conv.invars[0]
+            chain.add(producer[x32_var])
+        else:
+            x_var = x32_var
+        if float(e_div.invars[1].val) != float(x_var.aval.shape[-1]):
+            continue  # the mean divisor must be the hidden dim
+        # forward: rsqrt -> mul(x32, .) -> [convert] -> mul(., bcast(w))
+        r_uses = uses.get(eqn.outvars[0], [])
+        if len(r_uses) != 1 or r_uses[0] == -1:
+            continue
+        e_mul = eqns[r_uses[0]]
+        if e_mul.primitive.name != "mul":
+            continue
+        other = [v for v in e_mul.invars if v is not eqn.outvars[0]]
+        if len(other) != 1 or _follow_converts_back(
+                eqns, producer, other[0], chain) is not \
+                _follow_converts_back(eqns, producer, x32_var, set()):
+            continue
+        chain.add(r_uses[0])
+        nv = e_mul.outvars[0]
+        u2 = uses.get(nv, [])
+        if len(u2) != 1 or u2[0] == -1:
+            continue
+        e_next = eqns[u2[0]]
+        if e_next.primitive.name == "convert_element_type":
+            chain.add(u2[0])
+            nv = e_next.outvars[0]
+            u2 = uses.get(nv, [])
+            if len(u2) != 1 or u2[0] == -1:
+                continue
+            e_next = eqns[u2[0]]
+        if e_next.primitive.name != "mul":
+            continue
+        w_side = [v for v in e_next.invars if v is not nv]
+        if len(w_side) != 1:
+            continue
+        wv = w_side[0]
+        e_wb = prod(wv)
+        if e_wb is not None and e_wb.primitive.name == "broadcast_in_dim":
+            chain.add(producer[wv])
+            wv = e_wb.invars[0]
+        if len(wv.aval.shape) != 1 or \
+                wv.aval.shape[0] != x_var.aval.shape[-1]:
+            continue
+        final = u2[0]
+        kept = _external_uses_keep(eqns, uses, producer, chain, final)
+        if kept is None:
+            continue
+        matches.append({"pattern": "rmsnorm", "final": final,
+                        "chain": kept, "x": x_var, "w": wv, "eps": eps})
+    return matches
+
+
+def match_swiglu_patterns(jaxpr) -> List[dict]:
+    """silu(gate) * up -> the in-tree Pallas swiglu kernel. jax.nn.silu
+    traces as a pjit[name=silu] call eqn, so the anchor is exact."""
+    eqns = jaxpr.eqns
+    producer: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+    uses = _build_use_map(jaxpr)
+    matches = []
+    for i, eqn in enumerate(eqns):
+        if eqn.primitive.name != "mul":
+            continue
+        for a, b in ((eqn.invars[0], eqn.invars[1]),
+                     (eqn.invars[1], eqn.invars[0])):
+            if isinstance(a, jcore.Literal) or a not in producer:
+                continue
+            e_silu = eqns[producer[a]]
+            if e_silu.primitive.name not in ("pjit", "jit", "closed_call") \
+                    or e_silu.params.get("name") != "silu":
+                continue
+            if isinstance(b, jcore.Literal) or \
+                    a.aval.shape != b.aval.shape:
+                continue
+            chain = {producer[a]}
+            kept = _external_uses_keep(eqns, uses, producer, chain, i)
+            if kept is None:
+                continue
+            matches.append({"pattern": "swiglu", "final": i,
+                            "chain": kept, "gate": e_silu.invars[0],
+                            "up": b})
+            break
+    return matches
+
+
+def _follow_converts_back(eqns, producer, var, chain: Set[int]):
+    """Resolve through convert_element_type producers, adding them to
+    chain; returns the root var."""
+    while var in producer and \
+            eqns[producer[var]].primitive.name == "convert_element_type":
+        chain.add(producer[var])
+        var = eqns[producer[var]].invars[0]
+    return var
+
+
+def _external_uses_keep(eqns, uses, producer, chain: Set[int],
+                        final: int) -> Optional[Set[int]]:
+    """Drop chain eqns whose outputs escape (they must stay materialized,
+    plus their upstream chain producers). None = nothing left to skip
+    (fusing would be a pessimization)."""
+    keep: Set[int] = set()
+    for idx in chain:
+        for ov in eqns[idx].outvars:
+            ext = [u for u in uses.get(ov, [])
+                   if u != final and u not in chain]
+            if ext:
+                keep.add(idx)
+    changed = True
+    while changed:
+        changed = False
+        for idx in list(keep):
+            for iv in eqns[idx].invars:
+                if isinstance(iv, jcore.Literal):
+                    continue
+                p = producer.get(iv)
+                if p is not None and p in chain and p not in keep:
+                    keep.add(p)
+                    changed = True
+    remaining = chain - keep
+    return remaining if remaining else None
 
 
 def _flash_eligible_shapes(q_aval, k_aval) -> bool:
@@ -208,9 +390,60 @@ def _flash_eligible_shapes(q_aval, k_aval) -> bool:
             and ((D <= 128 and D % 64 == 0) or D % 128 == 0))
 
 
+def _exec_sdpa(m, read):
+    q, k, v = read(m["q"]), read(m["k"]), read(m["v"])
+    from ..ops.flash_attention import (_flash_block_sizes,
+                                       _tpu_flash_available,
+                                       sdpa_reference)
+    if _tpu_flash_available():
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _pallas_flash)
+        return _pallas_flash(
+            q, k, v, causal=False, sm_scale=m["scale"],
+            block_sizes=_flash_block_sizes(q.shape[2], k.shape[2]))
+    return jnp.swapaxes(sdpa_reference(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+        jnp.swapaxes(v, 1, 2), scale=m["scale"]), 1, 2)
+
+
+def _exec_rmsnorm(m, read):
+    from ..ops.fused import fused_rms_norm
+    return fused_rms_norm(read(m["x"]), read(m["w"]), eps=m["eps"])
+
+
+def _exec_swiglu(m, read):
+    from ..ops.fused import swiglu as _swiglu
+    return _swiglu(read(m["gate"]), read(m["up"]))
+
+
+def _sdpa_shape_ok(m):
+    return _flash_eligible_shapes(m["q"].aval, m["k"].aval)
+
+
+def _lane_ok(m, key):
+    # the Pallas elementwise kernels want a 128-multiple (or tiny-test
+    # interpret) lane dim; off-TPU interpret mode takes anything
+    import jax as _jax
+    if _jax.default_backend() != "tpu":
+        return True
+    return m[key].aval.shape[-1] % 128 == 0
+
+
+# The CINN-parity pattern table (ref: paddle/cinn/operator_fusion/ —
+# pattern registry + replace-with-kernel): matcher, eligibility filter,
+# executor. Extending the pass = adding a row.
+PATTERNS = {
+    "sdpa": (match_sdpa_patterns, _sdpa_shape_ok, _exec_sdpa),
+    "rmsnorm": (match_rmsnorm_patterns,
+                lambda m: _lane_ok(m, "x"), _exec_rmsnorm),
+    "swiglu": (match_swiglu_patterns,
+               lambda m: _lane_ok(m, "gate"), _exec_swiglu),
+}
+
+
 def _run_fused(closed, matches, consts, *flat_args):
-    """Interpret the jaxpr, executing matched SDPA chains as flash calls
-    and skipping their interior equations."""
+    """Interpret the jaxpr, executing matched chains as fused-kernel
+    calls and skipping their interior equations."""
     jaxpr = closed.jaxpr
     env: Dict[Any, Any] = {}
 
@@ -235,20 +468,7 @@ def _run_fused(closed, matches, consts, *flat_args):
             continue
         if i in by_final:
             m = by_final[i]
-            q, k, v = read(m["q"]), read(m["k"]), read(m["v"])
-            from ..ops.flash_attention import (_flash_block_sizes,
-                                               _tpu_flash_available,
-                                               sdpa_reference)
-            if _tpu_flash_available():
-                from jax.experimental.pallas.ops.tpu.flash_attention import (
-                    flash_attention as _pallas_flash)
-                out = _pallas_flash(
-                    q, k, v, causal=False, sm_scale=m["scale"],
-                    block_sizes=_flash_block_sizes(q.shape[2], k.shape[2]))
-            else:
-                out = jnp.swapaxes(sdpa_reference(
-                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                    jnp.swapaxes(v, 1, 2), scale=m["scale"]), 1, 2)
+            out = PATTERNS[m["pattern"]][2](m, read)
             write(eqn.outvars[0], out.astype(eqn.outvars[0].aval.dtype))
             continue
         vals = [read(x) for x in eqn.invars]
@@ -275,8 +495,17 @@ def fuse(fn):
                 lambda *a: fn(*a, **kwargs), return_shape=True)(*args)
         except Exception:
             return fn(*args, **kwargs)
-        matches = [m for m in match_sdpa_patterns(closed.jaxpr)
-                   if _flash_eligible_shapes(m["q"].aval, m["k"].aval)]
+        matches = []
+        claimed: Set[int] = set()
+        for name, (matcher, eligible, _) in PATTERNS.items():
+            for m in matcher(closed.jaxpr):
+                if not eligible(m):
+                    continue
+                span = m["chain"] | {m["final"]}
+                if span & claimed:
+                    continue  # first pattern wins on overlapping regions
+                claimed |= span
+                matches.append(m)
         flat, _ = jax.tree_util.tree_flatten(args)
         # no-match: interpret the already-traced jaxpr rather than
         # re-tracing fn a second time

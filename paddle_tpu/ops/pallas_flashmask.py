@@ -313,16 +313,14 @@ def _flashmask_fwd_impl(q, k, v, s1, e1, s2, e2, scale, causal, bq, bk):
 def _flashmask_vjp_fwd(q, k, v, s1, e1, s2, e2, scale, causal, bq, bk):
     o, (lse, kinds) = _flashmask_fwd_impl(q, k, v, s1, e1, s2, e2, scale,
                                           causal, bq, bk)
-    return o, (q, k, v, s1, e1, s2, e2, o, lse)
+    return o, (q, k, v, s1, e1, s2, e2, o, lse, kinds)
 
 
 def _flashmask_vjp_bwd(scale, causal, bq, bk, res, do):
-    q, k, v, s1, e1, s2, e2, o, lse = res
+    q, k, v, s1, e1, s2, e2, o, lse, kinds = res
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     Hm = s1.shape[1]
-    kinds = flashmask_block_kinds((s1, e1, s2, e2), Sq, Sk, bq, bk,
-                                  causal)
     di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                  axis=-1, keepdims=True)                     # [B,H,Sq,1]
     nq, nk = Sq // bq, Sk // bk

@@ -1,0 +1,114 @@
+"""Multiprocess DataLoader workers (ref: python/paddle/io/dataloader/
+worker.py — VERDICT r1 item 9): order/content parity with the serial
+path, per-worker seeding + worker_init_fn, error propagation, and a
+parallelizable-transform speedup."""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset, get_worker_info
+
+
+class SquareDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i, i * i], np.int64)
+
+
+class SleepDataset(SquareDataset):
+    def __getitem__(self, i):
+        time.sleep(0.05)
+        return super().__getitem__(i)
+
+
+class FailingDataset(SquareDataset):
+    def __getitem__(self, i):
+        if i == 7:
+            raise RuntimeError("boom at 7")
+        return super().__getitem__(i)
+
+
+class WorkerInfoDataset(SquareDataset):
+    def __getitem__(self, i):
+        info = get_worker_info()
+        assert info is not None and info.num_workers == 2
+        return np.asarray([i, info.id], np.int64)
+
+
+def _collect(loader):
+    return [np.asarray(b._data) if hasattr(b, "_data") else np.asarray(b)
+            for b in loader]
+
+
+class TestProcessWorkers:
+    def test_matches_serial_order_and_content(self):
+        ds = SquareDataset(33)
+        serial = _collect(DataLoader(ds, batch_size=4, num_workers=0))
+        proc = _collect(DataLoader(ds, batch_size=4, num_workers=3,
+                                   worker_mode="process"))
+        assert len(serial) == len(proc)
+        for a, b in zip(serial, proc):
+            np.testing.assert_array_equal(a, b)
+
+    def test_worker_info_and_init_fn(self, tmp_path):
+        marker = tmp_path / "init"
+
+        def init(worker_id):
+            (marker.parent / f"init{worker_id}").write_text(str(worker_id))
+
+        out = _collect(DataLoader(WorkerInfoDataset(8), batch_size=2,
+                                  num_workers=2, worker_mode="process",
+                                  worker_init_fn=init))
+        ids = np.concatenate([o[:, 1] for o in out])
+        assert set(ids.tolist()) == {0, 1}
+        assert (tmp_path / "init0").exists()
+        assert (tmp_path / "init1").exists()
+
+    def test_error_propagates(self):
+        dl = DataLoader(FailingDataset(16), batch_size=4, num_workers=2,
+                        worker_mode="process")
+        with pytest.raises(RuntimeError, match="boom at 7"):
+            _collect(dl)
+
+    def test_parallel_transform_speedup(self):
+        # sleep-based transform: parallel across processes even on a
+        # single-core host (the CPU-bound-python case needs >1 core, but
+        # the mechanism under test — concurrent workers — is the same)
+        ds = SleepDataset(40)
+        t0 = time.perf_counter()
+        _collect(DataLoader(ds, batch_size=4, num_workers=0))
+        serial = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _collect(DataLoader(ds, batch_size=4, num_workers=4,
+                            worker_mode="process"))
+        par = time.perf_counter() - t0
+        # 4 workers on a 1.6s-of-sleep pipeline: well under serial even
+        # after ~0.3s of fork startup for a jax-heavy parent
+        assert par < serial * 0.62, (serial, par)
+
+    def test_iterable_rejected(self):
+        from paddle_tpu.io import IterableDataset
+
+        class It(IterableDataset):
+            def __iter__(self):
+                yield from range(4)
+        with pytest.raises(NotImplementedError):
+            DataLoader(It(), num_workers=2, worker_mode="process")
+
+    def test_custom_collate_runs_in_worker(self):
+        def collate(batch):
+            return np.stack(batch).sum(0)
+        out = list(DataLoader(SquareDataset(8), batch_size=4,
+                              num_workers=2, worker_mode="process",
+                              collate_fn=collate))
+        ref = list(DataLoader(SquareDataset(8), batch_size=4,
+                              num_workers=0, collate_fn=collate))
+        for a, b in zip(out, ref):
+            np.testing.assert_array_equal(a, b)
