@@ -171,9 +171,13 @@ class PPOCRRec(nn.Layer):
     def __init__(self, num_classes: int = 97, in_channels: int = 3,
                  scale: float = 0.5, hidden: int = 120):
         super().__init__()
+        # rec_mode: height-only downsampling in the blocks (PaddleOCR
+        # rec backbone) — the CTC time axis is W/2 columns; the old
+        # symmetric strides left W/32 steps, fewer than most labels
         self.backbone = MobileNetV3Small(
             num_classes=0, with_pool=False, in_channels=in_channels,
-            scale=scale, feature_only=True, out_indices=(10,))
+            scale=scale, feature_only=True, out_indices=(10,),
+            rec_mode=True)
         cback = _make_divisible(96 * scale)
         self.squeeze = nn.Conv2D(cback, hidden, 1, bias_attr=False)
         self.mix = nn.Sequential(nn.Linear(hidden, hidden), nn.GELU(),

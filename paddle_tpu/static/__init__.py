@@ -94,7 +94,9 @@ class Program:
         env: Dict[int, object] = {}
         for nm, ph in self.placeholders.items():
             if nm in feed:
-                env[id(ph)] = jnp.asarray(np.asarray(feed[nm]))
+                # jnp.asarray alone: feed values may be traced (the
+                # save_inference_model export traces through replay)
+                env[id(ph)] = jnp.asarray(feed[nm])
         for op in self.ops:
             ins = []
             for tid, ref, const in zip(op.in_ids, op.in_refs, op.in_consts):
@@ -223,17 +225,94 @@ class name_scope:
 
 def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
                          program=None):
-    """ref: paddle.static.save_inference_model — delegates to the traced
-    export (paddle_tpu.jit.save semantics: StableHLO program on disk)."""
-    raise NotImplementedError(
-        "static-graph export is unified with paddle_tpu.jit.save (the traced "
-        "StableHLO program is the deployment format; SURVEY §7.0 inference "
-        "row)")
+    """ref: paddle.static.save_inference_model (python/paddle/static/io.py).
+    Serializes the captured Program as a jax.export artifact (weights
+    baked in — the same .jaxexport servable jit.save produces) plus a
+    .meta.json with the feed names/specs, so ported reference deployment
+    code works unchanged:
+
+        save_inference_model(prefix, [x], [out], exe)
+        prog, feeds, fetches = load_inference_model(prefix, exe)
+        out, = exe.run(prog, feed={feeds[0]: arr}, fetch_list=fetches)
+    """
+    import json
+
+    program = program or default_main_program()
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    names = [v._feed_name if isinstance(v, _Placeholder) else str(v)
+             for v in feed_vars]
+
+    def infer(*arrays):
+        env = program.replay(dict(zip(names, arrays)))
+        outs = []
+        for f in fetch_vars:
+            outs.append(env.get(id(f), f._data if isinstance(f, Tensor)
+                                else jnp.asarray(f)))
+        return tuple(outs)
+
+    import jax as _jax
+    from jax import export as jexport
+    specs = []
+    for i, v in enumerate(feed_vars):
+        dims = [int(d) for d in getattr(v, "_declared_shape", v.shape)]
+        if any(d < 0 for d in dims):
+            # -1 dims (the reference's variable batch) become export
+            # symbolic dimensions
+            sym = jexport.symbolic_shape(", ".join(
+                f"d{i}_{j}" if d < 0 else str(d)
+                for j, d in enumerate(dims)))
+            specs.append(_jax.ShapeDtypeStruct(sym, v.dtype))
+        else:
+            specs.append(_jax.ShapeDtypeStruct(tuple(dims), v.dtype))
+    exported = jexport.export(_jax.jit(infer))(*specs)
+    with open(path_prefix + ".jaxexport", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".meta.json", "w") as f:
+        json.dump({"feed_names": names,
+                   "feed_shapes": [
+                       [int(d) for d in getattr(v, "_declared_shape",
+                                                v.shape)]
+                       for v in feed_vars],
+                   "feed_dtypes": [str(np.dtype(s.dtype)) for s in specs],
+                   "n_fetch": len(fetch_vars)}, f)
+
+
+class _LoadedProgram(Program):
+    """Program stand-in returned by load_inference_model: replay calls
+    the deserialized exported program instead of an op list."""
+
+    def __init__(self, exported, meta):
+        super().__init__()
+        self._exported = exported
+        # jit once here — a fresh wrapper per replay() would recompile
+        # the loaded program on every Executor.run
+        self._call = jax.jit(exported.call)
+        self._meta = meta
+        self.fetch_targets = [Tensor(jnp.zeros(()))
+                              for _ in range(meta["n_fetch"])]
+        for nm, shp, dt in zip(meta["feed_names"], meta["feed_shapes"],
+                               meta["feed_dtypes"]):
+            self.placeholders[nm] = _Placeholder(nm, shp, dt)
+
+    def replay(self, feed: Dict[str, object]):
+        args = [jnp.asarray(feed[nm]) for nm in self._meta["feed_names"]]
+        outs = self._call(*args)
+        return {id(t): o for t, o in zip(self.fetch_targets, outs)}
 
 
 def load_inference_model(path_prefix: str, executor):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.load (TranslatedLayer over the saved trace)")
+    """ref: paddle.static.load_inference_model — returns
+    [program, feed_target_names, fetch_targets] runnable via
+    Executor.run exactly like the reference."""
+    import json
+
+    from ..jit import _deserialize_exported
+    exported = _deserialize_exported(path_prefix + ".jaxexport")
+    with open(path_prefix + ".meta.json") as f:
+        meta = json.load(f)
+    prog = _LoadedProgram(exported, meta)
+    return [prog, list(meta["feed_names"]), list(prog.fetch_targets)]
 
 
 class _StaticNN:

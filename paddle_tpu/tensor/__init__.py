@@ -22,9 +22,10 @@ from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 from .attribute import *  # noqa: F401,F403
+from .tail import *  # noqa: F401,F403
 
 from . import (attribute, creation, einsum as _einsum_mod, linalg, logic,
-               manipulation, math, random, search, stat)
+               manipulation, math, random, search, stat, tail)
 
 
 # ---------------------------------------------------------------------------
@@ -204,6 +205,44 @@ _METHODS = dict(
     bucketize=search.bucketize,
     is_empty=attribute.is_empty,
     as_complex=attribute.as_complex, as_real=attribute.as_real,
+    # long tail batch 2
+    copysign=tail.copysign, gammaln=tail.gammaln, gammainc=tail.gammainc,
+    gammaincc=tail.gammaincc, multigammaln=tail.multigammaln,
+    i0e=tail.i0e, i1e=tail.i1e, frexp=tail.frexp, isin=tail.isin,
+    baddbmm=tail.baddbmm, bitwise_left_shift=tail.bitwise_left_shift,
+    bitwise_right_shift=tail.bitwise_right_shift,
+    bitwise_invert=tail.bitwise_invert, nanargmax=tail.nanargmax,
+    nanargmin=tail.nanargmin, positive=tail.positive,
+    take_along_dim=tail.take_along_dim,
+    diagonal_scatter=tail.diagonal_scatter, view_as=tail.view_as,
+    cauchy_=tail.cauchy_, geometric_=tail.geometric_,
+    ceil_=tail.ceil_, exp_=tail.exp_, fill_=tail.fill_,
+    floor_=tail.floor_, reciprocal_=tail.reciprocal_,
+    round_=tail.round_, rsqrt_=tail.rsqrt_, sqrt_=tail.sqrt_,
+    tanh_=tail.tanh_, zero_=tail.zero_, erfinv_=tail.erfinv_,
+    lerp_=tail.lerp_, remainder_=tail.remainder_, scatter_=tail.scatter_,
+    tril_=tail.tril_, triu_=tail.triu_, flatten_=tail.flatten_,
+    sigmoid_=tail.sigmoid_, index_fill_=tail.index_fill_,
+    masked_fill_=tail.masked_fill_, index_put_=tail.index_put_,
+    fill_diagonal_=tail.fill_diagonal_,
+    # in-place batch 2
+    abs_=tail.abs_, acos_=tail.acos_, asin_=tail.asin_,
+    atan_=tail.atan_, atanh_=tail.atanh_, acosh_=tail.acosh_,
+    asinh_=tail.asinh_, cos_=tail.cos_, cosh_=tail.cosh_,
+    sin_=tail.sin_, sinh_=tail.sinh_, tan_=tail.tan_,
+    expm1_=tail.expm1_, log_=tail.log_, log2_=tail.log2_,
+    log10_=tail.log10_, log1p_=tail.log1p_, digamma_=tail.digamma_,
+    lgamma_=tail.lgamma_, neg_=tail.neg_, frac_=tail.frac_,
+    trunc_=tail.trunc_, divide_=tail.divide_,
+    floor_divide_=tail.floor_divide_, pow_=tail.pow_,
+    nan_to_num_=tail.nan_to_num_, logit_=tail.logit_,
+    hypot_=tail.hypot_, ldexp_=tail.ldexp_, gcd_=tail.gcd_,
+    lcm_=tail.lcm_, cumsum_=tail.cumsum_, cumprod_=tail.cumprod_,
+    renorm_=tail.renorm_, index_add_=tail.index_add_,
+    put_along_axis_=tail.put_along_axis_,
+    masked_scatter_=tail.masked_scatter_, copysign_=tail.copysign_,
+    gammaln_=tail.gammaln_, gammainc_=tail.gammainc_,
+    gammaincc_=tail.gammaincc_, multigammaln_=tail.multigammaln_,
 )
 
 for _name, _fn in _METHODS.items():

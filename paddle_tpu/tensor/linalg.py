@@ -306,3 +306,181 @@ def pdist(x, p=2.0, name=None) -> Tensor:
 
 __all__ += ["mm", "bmm", "mv", "addmm", "inverse", "tensordot", "cdist",
             "pdist"]
+
+
+# ---------------------------------------------------------------------------
+# linalg long tail (ref: python/paddle/tensor/linalg.py — VERDICT r1 item 8)
+# ---------------------------------------------------------------------------
+def matrix_transpose(x, name=None) -> Tensor:
+    return apply("matrix_transpose", lambda a: jnp.swapaxes(a, -2, -1), [x])
+
+
+def vecdot(x, y, axis=-1, name=None) -> Tensor:
+    return apply("vecdot",
+                 lambda a, b: jnp.sum(a * b, axis=axis), [x, y])
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None) -> Tensor:
+    def impl(a):
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis,
+                           keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=axis,
+                       keepdims=keepdim) ** (1.0 / p)
+    return apply("vector_norm", impl, [x])
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None) -> Tensor:
+    def impl(a):
+        r, c = axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a)), axis=axis,
+                                    keepdims=keepdim))
+        kept = sorted(ax % a.ndim for ax in (r, c))
+        if p == "nuc":
+            s = jnp.linalg.svd(jnp.moveaxis(a, (r, c), (-2, -1)),
+                               compute_uv=False)
+            out = jnp.sum(s, -1)
+            return jnp.expand_dims(out, kept) if keepdim else out
+        if p in (1, -1):  # max/min column abs-sum
+            col = jnp.sum(jnp.abs(a), axis=r, keepdims=True)
+            red = jnp.max if p == 1 else jnp.min
+            out = red(col, axis=c, keepdims=True)
+            return out if keepdim else jnp.squeeze(out, axis)
+        if p in (2, -2):
+            s = jnp.linalg.svd(jnp.moveaxis(a, (r, c), (-2, -1)),
+                               compute_uv=False)
+            out = s[..., 0] if p == 2 else s[..., -1]
+            return jnp.expand_dims(out, kept) if keepdim else out
+        if p in (float("inf"), float("-inf")):  # max/min row abs-sum
+            row = jnp.sum(jnp.abs(a), axis=c, keepdims=True)
+            red = jnp.max if p == float("inf") else jnp.min
+            out = red(row, axis=r, keepdims=True)
+            return out if keepdim else jnp.squeeze(out, axis)
+        raise ValueError(f"unsupported matrix norm order {p!r}")
+    return apply("matrix_norm", impl, [x])
+
+
+def svdvals(x, name=None) -> Tensor:
+    return apply("svdvals",
+                 lambda a: jnp.linalg.svd(a, compute_uv=False), [x])
+
+
+def matrix_exp(x, name=None) -> Tensor:
+    import jax.scipy.linalg as jsl
+    return apply("matrix_exp", jsl.expm, [x])
+
+
+def cholesky_inverse(x, upper=False, name=None) -> Tensor:
+    """inv(A) from its Cholesky factor (ref: paddle.linalg.cholesky_inverse)."""
+    def impl(L):
+        n = L.shape[-1]
+        eye = jnp.eye(n, dtype=L.dtype)
+        import jax.scipy.linalg as jsl
+        li = jsl.solve_triangular(L, eye, lower=not upper)
+        return li.T @ li if not upper else li @ li.T
+    return apply("cholesky_inverse", impl, [x])
+
+
+def eig(x, name=None):
+    """General (non-symmetric) eigendecomposition. Eager-only: XLA has
+    no device kernel for the unsymmetric QR algorithm (the reference
+    runs it on CPU too — paddle's eig kernel is host LAPACK)."""
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None) -> Tensor:
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack paddle.linalg.lu output into (P, L, U)."""
+    lu = lu_data._data if isinstance(lu_data, Tensor) else jnp.asarray(lu_data)
+    piv = np.asarray(lu_pivots._data if isinstance(lu_pivots, Tensor)
+                     else lu_pivots).astype(np.int64)
+    m, n = lu.shape[-2], lu.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+    U = jnp.triu(lu[..., :k, :])
+    # pivots are THIS framework's lu convention (0-based sequential row
+    # swaps, scipy lu_factor style — paddle's kernel is 1-based)
+    if piv.ndim > 1:
+        raise NotImplementedError("batched lu_unpack pivots")
+    perm = np.arange(m)
+    for i in range(piv.shape[-1]):
+        j = int(piv[i])
+        perm[[i, j]] = perm[[j, i]]
+    P = jnp.eye(m, dtype=lu.dtype)[:, perm]
+    out = []
+    out.append(Tensor(P) if unpack_pivots else None)
+    out.append(Tensor(L) if unpack_ludata else None)
+    out.append(Tensor(U) if unpack_ludata else None)
+    return tuple(out)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None) -> Tensor:
+    """Multiply `other` by the IMPLICIT m x m Q of a householder QR
+    (ref: paddle.linalg.ormqr). Reflections are applied directly —
+    householder_product's thin Q would be wrong (and shape-invalid) for
+    non-square x."""
+    def impl(a, t, o):
+        m, k = a.shape[-2], a.shape[-1]
+        rows = jnp.arange(m)
+
+        def refl(i, vec):
+            v = jnp.where(rows < i, 0.0,
+                          jnp.where(rows == i, 1.0, a[:, i]))
+            return vec - t[i] * v * jnp.vdot(v, vec)
+
+        def apply_q(vec, trans):
+            # Q = H1...Hk; Qx applies Hk first, Q^T x applies H1 first
+            order = range(k) if trans else range(k - 1, -1, -1)
+            for i in order:
+                vec = refl(i, vec)
+            return vec
+
+        if left:
+            return jax.vmap(lambda col: apply_q(col, transpose),
+                            in_axes=1, out_axes=1)(o)
+        # o @ Q == (Q^T o^T)^T; o @ Q^T == (Q o^T)^T
+        ot = jnp.swapaxes(o, -2, -1)
+        res = jax.vmap(lambda col: apply_q(col, not transpose),
+                       in_axes=1, out_axes=1)(ot)
+        return jnp.swapaxes(res, -2, -1)
+    return apply("ormqr", impl, [x, tau, other])
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (ref: paddle.linalg.svd_lowrank).
+    Differentiable (qr/svd/matmul chain through the dispatch tape); the
+    gaussian sketch is drawn once outside the traced impl."""
+    from ..framework.random import next_key
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    n = xa.shape[-1]
+    g = jax.random.normal(next_key(), xa.shape[:-2] + (n, q), xa.dtype)
+    Ma = None if M is None else (M._data if isinstance(M, Tensor)
+                                 else jnp.asarray(M))
+
+    def impl(a):
+        am = a if Ma is None else a - Ma
+        y = am @ g
+        for _ in range(niter):
+            y = am @ (jnp.swapaxes(am, -2, -1) @ y)
+        qb, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qb, -2, -1) @ am
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qb @ u, s, jnp.swapaxes(vh, -2, -1)
+    return apply("svd_lowrank", impl, [x])
+
+
+__all__ += ["matrix_transpose", "vecdot", "vector_norm", "matrix_norm",
+            "svdvals", "matrix_exp", "cholesky_inverse", "eig", "eigvals",
+            "lu_unpack", "ormqr", "svd_lowrank"]

@@ -1,0 +1,402 @@
+"""Tensor-API long tail, batch 2 (ref surface: python/paddle/tensor/ —
+math.py / manipulation.py / creation.py stragglers plus the in-place
+`*_` family from the generated inplace API; VERDICT r1 item 8).
+
+Same contract as the rest of the surface: differentiable ops dispatch
+through core.dispatch.apply; in-place ops rebind the Tensor's buffer
+(value semantics underneath — the XLA-native reading of the reference's
+inplace kernels) and, like the reference, are meant for no-grad/leaf
+use: they do not record a tape entry.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import manipulation as _manip
+from . import math as _math
+
+__all__ = [
+    # math stragglers
+    "copysign", "gammaln", "gammainc", "gammaincc", "multigammaln",
+    "i0e", "i1e", "frexp", "isin", "isneginf", "isposinf", "isreal",
+    "sigmoid", "baddbmm", "block_diag", "combinations",
+    "cumulative_trapezoid", "histogram_bin_edges", "histogramdd",
+    "bitwise_left_shift", "bitwise_right_shift", "bitwise_invert",
+    "nanargmax", "nanargmin", "positive", "take_along_dim",
+    # stacking / layout
+    "column_stack", "row_stack", "dstack", "hstack", "vstack",
+    "diagonal_scatter", "view_as", "reverse",
+    # random
+    "standard_gamma", "cauchy_", "geometric_",
+    # in-place family
+    "ceil_", "exp_", "fill_", "floor_", "reciprocal_", "round_",
+    "rsqrt_", "sqrt_", "tanh_", "zero_", "erfinv_", "lerp_",
+    "remainder_", "scatter_", "tril_", "triu_", "flatten_", "sigmoid_",
+    "index_fill_", "masked_fill_", "index_put_", "fill_diagonal_",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _unary(name, jfn):
+    def op(x, name_=None):
+        return apply(name, jfn, [x])
+    op.__name__ = name
+    return op
+
+
+# ---------------------------------------------------------------------------
+# math stragglers
+# ---------------------------------------------------------------------------
+def copysign(x, y, name=None):
+    yv = _arr(y)
+    return apply("copysign", lambda a: jnp.copysign(a, yv), [x])
+
+
+gammaln = _unary("gammaln", jax.scipy.special.gammaln)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+positive = _unary("positive", lambda a: a)
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (ref: paddle.gammainc)."""
+    return apply("gammainc", jax.scipy.special.gammainc, [x, y])
+
+
+def gammaincc(x, y, name=None):
+    return apply("gammaincc", jax.scipy.special.gammaincc, [x, y])
+
+
+def multigammaln(x, p, name=None):
+    p = int(p)
+
+    def impl(a):
+        a = a[..., None]
+        j = jnp.arange(1, p + 1, dtype=a.dtype)
+        terms = jax.scipy.special.gammaln(a + (1.0 - j) / 2.0)
+        const = p * (p - 1) / 4.0 * np.log(np.pi)
+        return terms.sum(-1) + const
+    return apply("multigammaln", impl, [x])
+
+
+def frexp(x, name=None):
+    m, e = jnp.frexp(_arr(x))
+    return Tensor(m), Tensor(e.astype(jnp.int32))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    t = _arr(test_x)
+    out = jnp.isin(_arr(x), t, invert=invert)
+    return Tensor(out)
+
+
+def isneginf(x, name=None):
+    return Tensor(jnp.isneginf(_arr(x)))
+
+
+def isposinf(x, name=None):
+    return Tensor(jnp.isposinf(_arr(x)))
+
+
+def isreal(x, name=None):
+    return Tensor(jnp.isreal(_arr(x)))
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * bmm(x, y) (ref: paddle.baddbmm)."""
+    def impl(inp, a, b):
+        return beta * inp + alpha * jnp.matmul(a, b)
+    return apply("baddbmm", impl, [input, x, y])
+
+
+def block_diag(inputs, name=None):
+    import jax.scipy.linalg as jsl
+    return apply("block_diag", lambda *xs: jsl.block_diag(*xs),
+                 list(inputs))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor (ref: paddle.combinations).
+    Index set is static (depends on len(x) only)."""
+    n = int(_arr(x).shape[0])
+    import itertools as it
+    gen = it.combinations_with_replacement(range(n), r) \
+        if with_replacement else it.combinations(range(n), r)
+    idx = np.asarray(list(gen), np.int32).reshape(-1, r)
+
+    def impl(a):
+        return a[jnp.asarray(idx)]
+    return apply("combinations", impl, [x])
+
+
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
+    def impl(yv):
+        y1 = jnp.moveaxis(yv, axis, -1)
+        if x is not None:
+            xs = jnp.moveaxis(_arr(x), axis, -1) \
+                if _arr(x).ndim == yv.ndim else _arr(x)
+            d = jnp.diff(xs, axis=-1)
+        else:
+            d = dx
+        avg = (y1[..., 1:] + y1[..., :-1]) / 2.0
+        return jnp.moveaxis(jnp.cumsum(avg * d, -1), -1, axis)
+    return apply("cumulative_trapezoid", impl, [y])
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    a = np.asarray(_arr(input))
+    rng = None if (min == 0 and max == 0) else (min, max)
+    return Tensor(np.histogram_bin_edges(a, bins=bins, range=rng)
+                  .astype(np.float32))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """Eager (data-dependent bin counts; ref: paddle.histogramdd)."""
+    a = np.asarray(_arr(x))
+    w = None if weights is None else np.asarray(_arr(weights))
+    hist, edges = np.histogramdd(a, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return Tensor(hist.astype(np.float32)), [Tensor(e.astype(np.float32))
+                                             for e in edges]
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    yv = _arr(y)
+    return apply("bitwise_left_shift",
+                 lambda a: jnp.left_shift(a, yv), [x])
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    yv = _arr(y)
+    fn = jnp.right_shift if is_arithmetic else \
+        lambda a, b: jax.lax.shift_right_logical(a, b.astype(a.dtype))
+    return apply("bitwise_right_shift", lambda a: fn(a, yv), [x])
+
+
+def bitwise_invert(x, out=None, name=None):
+    return apply("bitwise_invert", jnp.invert, [x])
+
+
+def nanargmax(x, axis=None, keepdim=False, name=None):
+    out = jnp.nanargmax(_arr(x), axis=axis, keepdims=keepdim)
+    return Tensor(out.astype(jnp.int64))
+
+
+def nanargmin(x, axis=None, keepdim=False, name=None):
+    out = jnp.nanargmin(_arr(x), axis=axis, keepdims=keepdim)
+    return Tensor(out.astype(jnp.int64))
+
+
+def take_along_dim(x, indices, dim=0, name=None):
+    return _manip.take_along_axis(x, indices, dim)
+
+
+# ---------------------------------------------------------------------------
+# stacking / layout
+# ---------------------------------------------------------------------------
+def _stackop(name, jfn):
+    def op(x, name_=None):
+        return apply(name, lambda *xs: jfn(xs), list(x))
+    op.__name__ = name
+    return op
+
+
+column_stack = _stackop("column_stack", jnp.column_stack)
+dstack = _stackop("dstack", jnp.dstack)
+hstack = _stackop("hstack", jnp.hstack)
+vstack = _stackop("vstack", jnp.vstack)
+row_stack = vstack
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def impl(a, b):
+        n = min(a.shape[axis1], a.shape[axis2])
+        i = jnp.arange(b.shape[-1])
+        rows = i - (offset if offset < 0 else 0)
+        cols = i + (offset if offset > 0 else 0)
+        # move the diag axes to front for a vectorized scatter
+        a2 = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        b2 = jnp.moveaxis(b, -1, 0)
+        a2 = a2.at[rows, cols].set(b2)
+        return jnp.moveaxis(a2, (0, 1), (axis1, axis2))
+    return apply("diagonal_scatter", impl, [x, y])
+
+
+def view_as(x, other, name=None):
+    return _manip.view(x, list(_arr(other).shape))
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (ref: paddle.reverse -> paddle.flip)."""
+    return _manip.flip(x, axis)
+
+
+# ---------------------------------------------------------------------------
+# random
+# ---------------------------------------------------------------------------
+def standard_gamma(x, name=None):
+    from ..framework.random import next_key
+    shape_alpha = _arr(x)
+    return Tensor(jax.random.gamma(next_key(), shape_alpha))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    from ..framework.random import next_key
+    u = jax.random.uniform(next_key(), _arr(x).shape,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    x._data = (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(x.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    from ..framework.random import next_key
+    u = jax.random.uniform(next_key(), _arr(x).shape,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    p = _arr(probs) if isinstance(probs, Tensor) else probs
+    x._data = (jnp.floor(jnp.log1p(-u) / jnp.log1p(-p)) + 1).astype(
+        x.dtype)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# in-place family: value-semantics rebind (no tape entry, like the
+# reference's inplace ops outside autograd)
+# ---------------------------------------------------------------------------
+def _inplace_of(fn):
+    def op(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._data = out._data if isinstance(out, Tensor) else out
+        return x
+    return op
+
+
+ceil_ = _inplace_of(_math.ceil)
+exp_ = _inplace_of(_math.exp)
+floor_ = _inplace_of(_math.floor)
+reciprocal_ = _inplace_of(_math.reciprocal)
+round_ = _inplace_of(_math.round)
+rsqrt_ = _inplace_of(_math.rsqrt)
+sqrt_ = _inplace_of(_math.sqrt)
+tanh_ = _inplace_of(_math.tanh)
+erfinv_ = _inplace_of(_math.erfinv)
+lerp_ = _inplace_of(_math.lerp)
+remainder_ = _inplace_of(_math.remainder)
+sigmoid_ = _inplace_of(sigmoid)
+flatten_ = _inplace_of(_manip.flatten)
+scatter_ = _inplace_of(_manip.scatter)
+masked_fill_ = _inplace_of(_manip.masked_fill)
+index_fill_ = _inplace_of(_manip.index_fill)
+
+
+def fill_(x, value, name=None):
+    x._data = jnp.full_like(x._data, value)
+    return x
+
+
+def zero_(x, name=None):
+    return fill_(x, 0)
+
+
+def tril_(x, diagonal=0, name=None):
+    x._data = jnp.tril(x._data, k=diagonal)
+    return x
+
+
+def triu_(x, diagonal=0, name=None):
+    x._data = jnp.triu(x._data, k=diagonal)
+    return x
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    idx = tuple(_arr(i) for i in indices)
+    v = _arr(value)
+    x._data = x._data.at[idx].add(v) if accumulate \
+        else x._data.at[idx].set(v)
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    a = x._data
+    m, n = a.shape[-2], a.shape[-1]
+    if wrap and a.ndim == 2 and m > n:
+        # numpy fill_diagonal(wrap=True) semantics: the diagonal
+        # continues in bands every n+1 flat positions
+        start = offset if offset >= 0 else -offset * n
+        flat = np.arange(start, m * n, n + 1)
+        rows, cols = np.divmod(flat, n)
+    else:
+        i = np.arange(min(m, n))  # static indices — jit-safe
+        rows = i - (offset if offset < 0 else 0)
+        cols = i + (offset if offset > 0 else 0)
+        keep = (rows < m) & (cols < n)
+        rows, cols = rows[keep], cols[keep]
+    x._data = a.at[..., rows, cols].set(value)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# in-place family, batch 2 (ref: the generated inplace API surface,
+# python/paddle/tensor/inplace_apis in paddle 2.6)
+# ---------------------------------------------------------------------------
+abs_ = _inplace_of(_math.abs)
+acos_ = _inplace_of(_math.acos)
+asin_ = _inplace_of(_math.asin)
+atan_ = _inplace_of(_math.atan)
+atanh_ = _inplace_of(_math.atanh)
+acosh_ = _inplace_of(_math.acosh)
+asinh_ = _inplace_of(_math.asinh)
+cos_ = _inplace_of(_math.cos)
+cosh_ = _inplace_of(_math.cosh)
+sin_ = _inplace_of(_math.sin)
+sinh_ = _inplace_of(_math.sinh)
+tan_ = _inplace_of(_math.tan)
+expm1_ = _inplace_of(_math.expm1)
+log_ = _inplace_of(_math.log)
+log2_ = _inplace_of(_math.log2)
+log10_ = _inplace_of(_math.log10)
+log1p_ = _inplace_of(_math.log1p)
+digamma_ = _inplace_of(_math.digamma)
+lgamma_ = _inplace_of(_math.lgamma)
+neg_ = _inplace_of(_math.neg)
+frac_ = _inplace_of(_math.frac)
+trunc_ = _inplace_of(_math.trunc)
+divide_ = _inplace_of(_math.divide)
+floor_divide_ = _inplace_of(_math.floor_divide)
+pow_ = _inplace_of(_math.pow)
+nan_to_num_ = _inplace_of(_math.nan_to_num)
+logit_ = _inplace_of(_math.logit)
+hypot_ = _inplace_of(_math.hypot)
+ldexp_ = _inplace_of(_math.ldexp)
+gcd_ = _inplace_of(_math.gcd)
+lcm_ = _inplace_of(_math.lcm)
+cumsum_ = _inplace_of(_math.cumsum)
+cumprod_ = _inplace_of(_math.cumprod)
+renorm_ = _inplace_of(_math.renorm)
+index_add_ = _inplace_of(_manip.index_add)
+put_along_axis_ = _inplace_of(_manip.put_along_axis)
+masked_scatter_ = _inplace_of(_manip.masked_scatter)
+copysign_ = _inplace_of(copysign)
+gammaln_ = _inplace_of(gammaln)
+gammainc_ = _inplace_of(gammainc)
+gammaincc_ = _inplace_of(gammaincc)
+multigammaln_ = _inplace_of(multigammaln)
+
+__all__ += [
+    "abs_", "acos_", "asin_", "atan_", "atanh_", "acosh_", "asinh_",
+    "cos_", "cosh_", "sin_", "sinh_", "tan_", "expm1_", "log_", "log2_",
+    "log10_", "log1p_", "digamma_", "lgamma_", "neg_", "frac_", "trunc_",
+    "divide_", "floor_divide_", "pow_", "nan_to_num_", "logit_",
+    "hypot_", "ldexp_", "gcd_", "lcm_", "cumsum_", "cumprod_", "renorm_",
+    "index_add_", "put_along_axis_", "masked_scatter_", "copysign_",
+    "gammaln_", "gammainc_", "gammaincc_", "multigammaln_",
+]

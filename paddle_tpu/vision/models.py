@@ -205,7 +205,8 @@ class MobileNetV3Small(nn.Layer):
 
     def __init__(self, num_classes: int = 1000, scale: float = 1.0,
                  with_pool: bool = True, in_channels: int = 3,
-                 feature_only: bool = False, out_indices=(0, 3, 8, 10)):
+                 feature_only: bool = False, out_indices=(0, 3, 8, 10),
+                 rec_mode: bool = False):
         super().__init__()
         self.feature_only = feature_only
         self.out_indices = set(out_indices)
@@ -219,7 +220,13 @@ class MobileNetV3Small(nn.Layer):
         for (k, exp, cout, se, act, s) in self.CFG:
             cmid = _make_divisible(exp * scale)
             co = _make_divisible(cout * scale)
-            blocks.append(InvertedResidual(cin, cmid, co, k, s, se, act))
+            # rec_mode: PaddleOCR's text-recognition variant
+            # (ppocr/modeling/backbones/rec_mobilenet_v3.py) downsamples
+            # HEIGHT only in the blocks — stride 2 -> (2, 1) — so the
+            # CTC time axis keeps W/2 columns
+            stride = (s, 1) if (rec_mode and s == 2) else s
+            blocks.append(InvertedResidual(cin, cmid, co, k, stride, se,
+                                           act))
             cin = co
         self.blocks = nn.LayerList(blocks)
         clast = _make_divisible(576 * scale)
