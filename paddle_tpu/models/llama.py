@@ -196,6 +196,15 @@ class LlamaAttention(nn.Layer):
         B, S, _ = x.shape
         H, KV, D = c.num_attention_heads, c.num_key_value_heads, c.head_dim
         from ..core.dispatch import apply as _apply
+        from ..core.tensor import Tensor as _T
+        # mask is data (non-diff): closed over, not a tape input. Boolean
+        # key-padding masks route to the fused segment-id kernel in sdpa.
+        mask_arr = attn_mask._data if isinstance(attn_mask, _T) \
+            else (None if attn_mask is None else jnp.asarray(attn_mask))
+        if mask_arr is not None and c.context_parallel:
+            raise NotImplementedError(
+                "attn_mask with context_parallel ring attention: pack "
+                "sequences via sdpa_segmented/flashmask instead")
 
         def finish(q, k, v, wo):
             """rope → attention → output projection (shared tail)."""
@@ -212,9 +221,9 @@ class LlamaAttention(nn.Layer):
                 from ..distributed.ring_attention import ring_attention_raw
                 o = ring_attention_raw(q, k, v, axis="sep", causal=True)
             elif c.use_flash_attention:
-                o = sdpa(q, k, v, causal=True)
+                o = sdpa(q, k, v, mask=mask_arr, causal=True)
             else:
-                o = sdpa_reference(q, k, v, causal=True)
+                o = sdpa_reference(q, k, v, mask=mask_arr, causal=True)
             return o.reshape(B, S, -1) @ wo
 
         if c.fuse_attention_qkv:

@@ -15,6 +15,7 @@ from typing import List
 import jax.numpy as jnp
 
 from .. import nn
+from ..core.tensor import Tensor
 from ..nn import functional as F
 from ..tensor.manipulation import concat
 from ..vision.models import MobileNetV3Small, _make_divisible
@@ -164,12 +165,53 @@ class CTCHead(nn.Layer):
         return self.fc(x)  # [B, T, num_classes] logits
 
 
+class SVTRMixerBlock(nn.Layer):
+    """One SVTR mixing block (ref: ppocr/modeling/necks/rn_svtr.py /
+    SVTRNet blocks): pre-LN -> token mixing -> residual -> pre-LN ->
+    MLP -> residual. mixing="Global" is standard MHA over the column
+    sequence; mixing="Local" restricts attention to a +-window band
+    (the SVTR local-mixing mask), capturing stroke-level features."""
+
+    def __init__(self, dim: int, num_heads: int = 8,
+                 mixing: str = "Global", local_k: int = 7,
+                 mlp_ratio: float = 2.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn = nn.MultiHeadAttention(dim, num_heads)
+        self.norm2 = nn.LayerNorm(dim)
+        mid = int(dim * mlp_ratio)
+        self.mlp = nn.Sequential(nn.Linear(dim, mid), nn.GELU(),
+                                 nn.Linear(mid, dim))
+        self.mixing = mixing
+        self.local_k = local_k
+        self._mask_cache = {}
+
+    def _local_mask(self, T: int):
+        if T not in self._mask_cache:  # static per (T, local_k)
+            i = jnp.arange(T)
+            band = jnp.abs(i[:, None] - i[None, :]) <= self.local_k // 2
+            # additive mask, [1, 1, T, T]
+            self._mask_cache[T] = Tensor(
+                jnp.where(band, 0.0, -1e9)[None, None]
+                .astype(jnp.float32))
+        return self._mask_cache[T]
+
+    def forward(self, x):
+        T = x.shape[1]
+        mask = self._local_mask(T) if self.mixing == "Local" else None
+        h = self.norm1(x)
+        x = x + self.attn(h, h, h, attn_mask=mask)
+        return x + self.mlp(self.norm2(x))
+
+
 class PPOCRRec(nn.Layer):
     """Text recognizer: conv backbone squeezing height -> per-column
-    features -> mixer MLP (SVTR-lite flavor) -> CTC head."""
+    features -> SVTR mixing blocks (local + global attention) -> CTC
+    head (ref: PP-OCRv4 rec = backbone + SVTR neck + CTC)."""
 
     def __init__(self, num_classes: int = 97, in_channels: int = 3,
-                 scale: float = 0.5, hidden: int = 120):
+                 scale: float = 0.5, hidden: int = 120,
+                 mixer: tuple = ("Local", "Global"), num_heads: int = 8):
         super().__init__()
         # rec_mode: height-only downsampling in the blocks (PaddleOCR
         # rec backbone) — the CTC time axis is W/2 columns; the old
@@ -180,8 +222,8 @@ class PPOCRRec(nn.Layer):
             rec_mode=True)
         cback = _make_divisible(96 * scale)
         self.squeeze = nn.Conv2D(cback, hidden, 1, bias_attr=False)
-        self.mix = nn.Sequential(nn.Linear(hidden, hidden), nn.GELU(),
-                                 nn.Linear(hidden, hidden))
+        self.mix = nn.Sequential(*[
+            SVTRMixerBlock(hidden, num_heads, mixing=m) for m in mixer])
         self.head = CTCHead(hidden, num_classes)
 
     def forward(self, x):
@@ -189,7 +231,7 @@ class PPOCRRec(nn.Layer):
         f = self.squeeze(f)              # [B, hid, H', W']
         f = f.mean(axis=2)               # pool height -> [B, hid, W']
         f = f.transpose([0, 2, 1])       # [B, T=W', hid]
-        f = f + self.mix(f)
+        f = self.mix(f)
         return self.head(f)              # [B, T, classes]
 
     def loss(self, logits, labels, label_lengths):

@@ -38,6 +38,11 @@ def sdpa_reference(q, k, v, mask=None, causal: bool = False,
         logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
     if mask is not None:
         m = jnp.asarray(mask)
+        # only BOOL (B,Sk) masks are key-padding (matching the fused
+        # _as_key_padding gate); a float (Sq,Sk) additive mask with
+        # B == Sq must keep its broadcast meaning
+        if m.ndim == 2 and m.shape == (B, Sk) and m.dtype == jnp.bool_:
+            m = m[:, None, None, :]
         if m.dtype == jnp.bool_:
             logits = jnp.where(m, logits, jnp.asarray(-1e30, logits.dtype))
         else:

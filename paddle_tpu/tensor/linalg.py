@@ -431,6 +431,12 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None) -> Tensor:
     (ref: paddle.linalg.ormqr). Reflections are applied directly —
     householder_product's thin Q would be wrong (and shape-invalid) for
     non-square x."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if xa.ndim != 2:
+        raise NotImplementedError(
+            "ormqr supports 2-D factors (batched reflections pending, "
+            "like lu_unpack's batched pivots)")
+
     def impl(a, t, o):
         m, k = a.shape[-2], a.shape[-1]
         rows = jnp.arange(m)

@@ -220,7 +220,11 @@ row_stack = vstack
 
 def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
     def impl(a, b):
-        n = min(a.shape[axis1], a.shape[axis2])
+        n = min(a.shape[axis1], a.shape[axis2]) - abs(offset)
+        if b.shape[-1] != n:
+            raise ValueError(
+                f"diagonal_scatter: y length {b.shape[-1]} != diagonal "
+                f"length {n} for offset {offset}")
         i = jnp.arange(b.shape[-1])
         rows = i - (offset if offset < 0 else 0)
         cols = i + (offset if offset > 0 else 0)
@@ -251,6 +255,7 @@ def standard_gamma(x, name=None):
 
 
 def cauchy_(x, loc=0, scale=1, name=None):
+    _guard_inplace(x, "cauchy_")
     from ..framework.random import next_key
     u = jax.random.uniform(next_key(), _arr(x).shape,
                            minval=1e-7, maxval=1.0 - 1e-7)
@@ -259,6 +264,7 @@ def cauchy_(x, loc=0, scale=1, name=None):
 
 
 def geometric_(x, probs, name=None):
+    _guard_inplace(x, "geometric_")
     from ..framework.random import next_key
     u = jax.random.uniform(next_key(), _arr(x).shape,
                            minval=1e-7, maxval=1.0 - 1e-7)
@@ -272,9 +278,24 @@ def geometric_(x, probs, name=None):
 # in-place family: value-semantics rebind (no tape entry, like the
 # reference's inplace ops outside autograd)
 # ---------------------------------------------------------------------------
+def _guard_inplace(x, name):
+    """In-place on a grad-requiring tensor would orphan the tape entry
+    and silently corrupt gradients — refuse, like the reference refuses
+    in-place on leaves that require grad."""
+    from ..core import autograd as _ag
+    if _ag.is_grad_enabled() and isinstance(x, Tensor) \
+            and not x.stop_gradient:
+        raise RuntimeError(
+            f"{name} in-place on a tensor that requires grad is not "
+            f"supported; wrap in no_grad() or use the out-of-place op")
+
+
 def _inplace_of(fn):
     def op(x, *args, **kwargs):
-        out = fn(x, *args, **kwargs)
+        from ..core import autograd as _ag
+        _guard_inplace(x, getattr(fn, "__name__", "op") + "_")
+        with _ag.no_grad():
+            out = fn(x, *args, **kwargs)
         x._data = out._data if isinstance(out, Tensor) else out
         return x
     return op
@@ -299,6 +320,7 @@ index_fill_ = _inplace_of(_manip.index_fill)
 
 
 def fill_(x, value, name=None):
+    _guard_inplace(x, "fill_")
     x._data = jnp.full_like(x._data, value)
     return x
 
@@ -308,16 +330,19 @@ def zero_(x, name=None):
 
 
 def tril_(x, diagonal=0, name=None):
+    _guard_inplace(x, "tril_")
     x._data = jnp.tril(x._data, k=diagonal)
     return x
 
 
 def triu_(x, diagonal=0, name=None):
+    _guard_inplace(x, "triu_")
     x._data = jnp.triu(x._data, k=diagonal)
     return x
 
 
 def index_put_(x, indices, value, accumulate=False, name=None):
+    _guard_inplace(x, "index_put_")
     idx = tuple(_arr(i) for i in indices)
     v = _arr(value)
     x._data = x._data.at[idx].add(v) if accumulate \
@@ -326,6 +351,7 @@ def index_put_(x, indices, value, accumulate=False, name=None):
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    _guard_inplace(x, "fill_diagonal_")
     a = x._data
     m, n = a.shape[-2], a.shape[-1]
     if wrap and a.ndim == 2 and m > n:

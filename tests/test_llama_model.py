@@ -136,3 +136,29 @@ def test_llama_decoupled_head_dim_forward():
     assert att.o_proj.weight.shape == [16, 32]
     assert att.q_proj.weight.grad is not None
     assert float(jnp.abs(att.q_proj.weight.grad._data).sum()) > 0
+
+
+def test_llama_attn_mask_honored():
+    """attn_mask must actually mask (it was silently dropped). Masking a
+    MID-sequence key makes that token invisible to every OTHER row: its
+    content change must not leak (under causality a tail mask would be
+    a no-op, so the middle key is the discriminating probe)."""
+    c = LlamaConfig(**BASE)
+    m = LlamaForCausalLM(c)
+    ids_np = np.random.RandomState(0).randint(0, 64, (2, 16)).astype(
+        np.int32)
+    key_mask = np.ones((2, 16), bool)
+    key_mask[:, 5] = False
+    full = m(paddle.to_tensor(ids_np)).numpy()
+    masked = m(paddle.to_tensor(ids_np),
+               attn_mask=paddle.to_tensor(key_mask)).numpy()
+    # rows after 5 must change when key 5 disappears
+    assert not np.allclose(full[:, 6:], masked[:, 6:], atol=1e-5)
+    # with key 5 masked, CHANGING token 5 must not affect other rows
+    ids2 = ids_np.copy()
+    ids2[:, 5] = (ids2[:, 5] + 7) % 64
+    masked2 = m(paddle.to_tensor(ids2),
+                attn_mask=paddle.to_tensor(key_mask)).numpy()
+    np.testing.assert_allclose(
+        np.delete(masked, 5, axis=1), np.delete(masked2, 5, axis=1),
+        rtol=1e-4, atol=1e-4)

@@ -319,3 +319,28 @@ class TestReviewRegressions:
         ref = np.zeros((7, 3), np.float32)
         np.fill_diagonal(ref, 1.0, wrap=True)
         np.testing.assert_allclose(x.numpy(), ref)
+
+    def test_inplace_on_grad_tensor_raises(self):
+        # silently-corrupted gradients are worse than an error: in-place
+        # on a grad-requiring tensor must refuse
+        w = paddle.to_tensor(np.array([4.0], np.float32))
+        w.stop_gradient = False
+        x = w * 2
+        with pytest.raises(RuntimeError, match="in-place"):
+            x.sqrt_()
+        from paddle_tpu.core import autograd as ag
+        with ag.no_grad():
+            x.sqrt_()  # fine under no_grad
+        np.testing.assert_allclose(x.numpy(), [np.sqrt(8.0)], rtol=1e-6)
+
+    def test_sdpa_reference_float_sq_sk_mask_keeps_broadcast(self):
+        from paddle_tpu.ops.flash_attention import sdpa_reference
+        import jax.numpy as jnp
+        S = 4  # B == Sq == Sk: the ambiguous case
+        q = jnp.asarray(R.standard_normal((S, S, 2, 8)), jnp.float32)
+        add = np.zeros((S, S), np.float32)
+        add[0, 1] = -1e9  # row 0 cannot see key 1
+        out = np.asarray(sdpa_reference(q, q, q, mask=jnp.asarray(add)))
+        ref = np.asarray(sdpa_reference(
+            q, q, q, mask=jnp.asarray(add)[None, None]))
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
