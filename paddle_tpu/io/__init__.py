@@ -332,6 +332,7 @@ class DataLoader:
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        self.use_shared_memory = use_shared_memory
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode {worker_mode!r}: expected "
                              "'thread' or 'process'")
@@ -431,7 +432,8 @@ class DataLoader:
                 target=_process_worker,
                 args=(self.dataset, user_collate, batches[w::W],
                       [i * W + w for i in range(len(batches[w::W]))],
-                      w, W, base_seed, self.worker_init_fn, result_q),
+                      w, W, base_seed, self.worker_init_fn, result_q,
+                      self.use_shared_memory),
                 daemon=True)
             p.start()
             procs.append(p)
@@ -468,6 +470,7 @@ class DataLoader:
                         pending[got[0]] = got[1]
                         continue
                     item = got[1]
+                item = _shm_decode(item)
                 yield item if user_collate is not None \
                     else _tensorize_tree(item)
                 nxt += 1
@@ -476,12 +479,130 @@ class DataLoader:
                 p.terminate()
             for p in procs:
                 p.join(timeout=5)
+            # early exit / error: unlink shm segments of batches never
+            # consumed. NO queue drain here — get_nowait can block
+            # forever on a truncated pickle a terminated worker left in
+            # the pipe; the pid-scoped sweep below covers queued AND
+            # never-enqueued segments, and pickle-mode queue leftovers
+            # hold no resources
+            for it in pending.values():
+                _shm_discard(it)
+            if self.use_shared_memory:
+                import glob as _glob
+                import os as _os
+                for p in procs:
+                    for path in _glob.glob(f"/dev/shm/ppio{p.pid}_*"):
+                        try:
+                            _os.unlink(path)
+                        except OSError:
+                            pass
+
+
+class _ShmBatch:
+    """A collated batch whose array leaves live in ONE shared-memory
+    segment (ref: python/paddle/io/dataloader use_shared_memory — the
+    reference ships _array_to_share_memory_tensor; here the stdlib
+    SharedMemory block is the transport). Only the (name, metadata)
+    tuple crosses the queue; the parent maps + copies + unlinks."""
+
+    def __init__(self, shm_name, leaves, treedef):
+        self.shm_name = shm_name
+        self.leaves = leaves      # [(offset, shape, dtype_str) | raw obj]
+        self.treedef = treedef    # nested structure with _Leaf markers
+
+
+class _Leaf:
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+def _shm_encode(item, name=None):
+    """Pack the numpy leaves of a collated tree into one shm segment.
+    `name` makes the segment attributable (ppio<pid>_<bid>) so the
+    parent can sweep segments a terminated worker never handed over."""
+    from multiprocessing import shared_memory
+    arrays = []
+
+    def strip(x):
+        if isinstance(x, np.ndarray):
+            if x.dtype.hasobject:
+                return x  # PyObject pointers can't cross processes:
+                          # object arrays stay on the pickle path
+            arrays.append(x)
+            return _Leaf(len(arrays) - 1)
+        if isinstance(x, dict):
+            return {k: strip(v) for k, v in x.items()}
+        if isinstance(x, (tuple, list)):
+            return type(x)(strip(v) for v in x)
+        return x
+    tree = strip(item)
+    if not arrays:
+        return item
+    total = sum(int(a.nbytes) for a in arrays)
+    shm = shared_memory.SharedMemory(create=True, size=max(total, 1),
+                                     name=name)
+    metas = []
+    off = 0
+    for a in arrays:
+        view = np.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=off)
+        view[...] = a
+        metas.append((off, a.shape, str(a.dtype)))
+        off += int(a.nbytes)
+    shm.close()
+    # ownership transfers to the parent (it unlinks after copying):
+    # unregister from THIS process's resource tracker or it warns about
+    # (and on exit double-unlinks) a segment it no longer owns
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    return _ShmBatch(shm.name, metas, tree)
+
+
+def _shm_discard(item):
+    """Unlink an unconsumed _ShmBatch segment (early-exit cleanup)."""
+    if isinstance(item, _ShmBatch):
+        from multiprocessing import shared_memory
+        try:
+            shm = shared_memory.SharedMemory(name=item.shm_name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def _shm_decode(item):
+    if not isinstance(item, _ShmBatch):
+        return item
+    from multiprocessing import shared_memory
+    shm = shared_memory.SharedMemory(name=item.shm_name)
+    try:
+        def restore(x):
+            if isinstance(x, _Leaf):
+                off, shape, dt = item.leaves[x.idx]
+                view = np.ndarray(shape, np.dtype(dt), buffer=shm.buf,
+                                  offset=off)
+                return view.copy()  # own the memory before unlink
+            if isinstance(x, dict):
+                return {k: restore(v) for k, v in x.items()}
+            if isinstance(x, (tuple, list)):
+                return type(x)(restore(v) for v in x)
+            return x
+        return restore(item.treedef)
+    finally:
+        shm.close()
+        shm.unlink()
 
 
 def _process_worker(dataset, user_collate, index_batches, batch_ids,
-                    worker_id, num_workers, base_seed, init_fn, out_q):
+                    worker_id, num_workers, base_seed, init_fn, out_q,
+                    use_shared_memory=True):
     """Worker-process body: seed, run init_fn, produce this worker's
-    round-robin share. Sends (global_batch_idx, collated_numpy) tuples,
+    round-robin share. Sends (global_batch_idx, collated_numpy) tuples
+    — array leaves ride a shared-memory segment when use_shared_memory —
     then a (None, exception_or_None) sentinel."""
     import random as _random
     err = None
@@ -505,7 +626,11 @@ def _process_worker(dataset, user_collate, index_batches, batch_ids,
                         "samples; this dataset returned a device "
                         "Tensor — convert to numpy in __getitem__ or "
                         "use worker_mode='thread'")
-            out_q.put((bid, collate(samples)))
+            batch = collate(samples)
+            if use_shared_memory:
+                import os as _os
+                batch = _shm_encode(batch, name=f"ppio{_os.getpid()}_{bid}")
+            out_q.put((bid, batch))
     except BaseException as e:  # noqa: BLE001 — shipped to the parent
         err = e
     out_q.put((None, err))

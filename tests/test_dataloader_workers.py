@@ -112,3 +112,86 @@ class TestProcessWorkers:
                               num_workers=0, collate_fn=collate))
         for a, b in zip(out, ref):
             np.testing.assert_array_equal(a, b)
+
+
+class TestSharedMemoryTransport:
+    def test_shm_matches_pickle(self):
+        ds = SquareDataset(24)
+        shm = _collect(DataLoader(ds, batch_size=4, num_workers=2,
+                                  worker_mode="process",
+                                  use_shared_memory=True))
+        pkl = _collect(DataLoader(ds, batch_size=4, num_workers=2,
+                                  worker_mode="process",
+                                  use_shared_memory=False))
+        assert len(shm) == len(pkl) == 6
+        for a, b in zip(shm, pkl):
+            np.testing.assert_array_equal(a, b)
+
+    def test_shm_dict_batches(self):
+        from paddle_tpu.io import Dataset
+
+        class DictDS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return {"x": np.full((3,), i, np.float32), "tag": str(i)}
+
+        out = list(DataLoader(DictDS(), batch_size=4, num_workers=2,
+                              worker_mode="process",
+                              use_shared_memory=True))
+        assert len(out) == 2
+        np.testing.assert_allclose(np.asarray(out[0]["x"]._data)[:, 0],
+                                   [0, 1, 2, 3])
+        assert out[0]["tag"] == ["0", "1", "2", "3"]
+
+    def test_no_leaked_segments(self):
+        # scope to this loader's attributable names: global /dev/shm
+        # diffs flake against unrelated concurrent processes
+        import glob
+        _collect(DataLoader(SquareDataset(16), batch_size=4,
+                            num_workers=2, worker_mode="process",
+                            use_shared_memory=True))
+        assert glob.glob("/dev/shm/ppio*") == []
+
+    def test_early_break_cleans_up(self):
+        import glob
+        dl = DataLoader(SquareDataset(32), batch_size=4, num_workers=2,
+                        worker_mode="process", use_shared_memory=True)
+        it = iter(dl)
+        next(it)
+        it.close()  # early break — pending batches must be unlinked
+        time.sleep(0.3)
+        leaked = glob.glob("/dev/shm/ppio*")
+        assert leaked == [], leaked
+
+    def test_object_dtype_stays_on_pickle_path(self):
+        from paddle_tpu.io import Dataset
+
+        class ObjDS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return {"x": np.full((2,), i, np.float32),
+                        "meta": np.array([{"id": i}], object)}
+
+        def collate(batch):
+            return {"x": np.stack([b["x"] for b in batch]),
+                    "meta": np.concatenate([b["meta"] for b in batch])}
+        out = list(DataLoader(ObjDS(), batch_size=4, num_workers=2,
+                              worker_mode="process",
+                              use_shared_memory=True,
+                              collate_fn=collate))
+        assert out[0]["meta"][0]["id"] == 0
+        np.testing.assert_allclose(out[1]["x"][:, 0], [4, 5, 6, 7])
+
+    def test_early_break_pickle_mode_does_not_hang(self):
+        ds = SquareDataset(32)
+        dl = DataLoader(ds, batch_size=4, num_workers=2,
+                        worker_mode="process", use_shared_memory=False)
+        it = iter(dl)
+        next(it)
+        t0 = time.perf_counter()
+        it.close()
+        assert time.perf_counter() - t0 < 10
