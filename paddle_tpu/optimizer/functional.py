@@ -73,7 +73,8 @@ class FunctionalAdamW:
                  beta1: float = 0.9, beta2: float = 0.999,
                  epsilon: float = 1e-8, weight_decay: float = 0.01,
                  clip_norm: Optional[float] = None,
-                 decay_mask: Optional[Any] = None):
+                 decay_mask: Optional[Any] = None,
+                 moment_dtype=jnp.float32):
         self.lr = learning_rate
         self.b1, self.b2, self.eps = beta1, beta2, epsilon
         self.weight_decay = weight_decay
@@ -81,12 +82,26 @@ class FunctionalAdamW:
         # decay_mask: optional pytree of bools (same structure as params);
         # None = decay everything (paddle AdamW default)
         self.decay_mask = decay_mask
+        # moment_dtype=bfloat16 halves optimizer-state HBM (the lever
+        # that admits a larger per-chip batch); the update itself stays
+        # f32 — moments are up-cast in, rounded on store
+        self.moment_dtype = jnp.dtype(moment_dtype)
+        if self.moment_dtype != jnp.float32 and beta2 > 0.99:
+            # round-to-nearest bf16 can't represent a (1-b2) < 1% EMA
+            # step: v rounds back to its previous value every update and
+            # the second moment FREEZES. b2 <= 0.99 keeps the per-step
+            # change above bf16's half-ulp.
+            raise ValueError(
+                f"moment_dtype={self.moment_dtype} with beta2={beta2}: "
+                f"the second-moment EMA stalls under bf16 rounding when "
+                f"beta2 > 0.99; lower beta2 or keep float32 moments")
 
     def init(self, params: Any) -> AdamWState:
+        mdt = self.moment_dtype
         leaves, treedef = jax.tree.flatten(params)
         if any(isinstance(l, jax.core.Tracer) for l in leaves):
-            m = [jnp.zeros_like(l, dtype=jnp.float32) for l in leaves]
-            v = [jnp.zeros_like(l, dtype=jnp.float32) for l in leaves]
+            m = [jnp.zeros_like(l, dtype=mdt) for l in leaves]
+            v = [jnp.zeros_like(l, dtype=mdt) for l in leaves]
         else:
             # allocate both moment trees ON DEVICE in one compiled
             # program: no host->device transfer of gigabytes of zeros
@@ -95,7 +110,7 @@ class FunctionalAdamW:
             shapes = [l.shape for l in leaves]
             shardings = [getattr(l, "sharding", None) for l in leaves]
             mk = jax.jit(
-                lambda: tuple([jnp.zeros(s, jnp.float32) for s in shapes]
+                lambda: tuple([jnp.zeros(s, mdt) for s in shapes]
                               for _ in range(2)),
                 out_shardings=(shardings, shardings)
                 if all(s is not None for s in shardings) else None)
@@ -118,6 +133,13 @@ class FunctionalAdamW:
         count = state.count + 1
         t = count.astype(jnp.float32)
         lr = self.lr_at(count)
+        # the update math runs in f32 even when moments are STORED low
+        # precision (bf16 accumulation would drift); rounded on store
+        low = self.moment_dtype != jnp.float32
+        m_in = jax.tree.map(lambda a: a.astype(jnp.float32),
+                            state.moment1) if low else state.moment1
+        v_in = jax.tree.map(lambda a: a.astype(jnp.float32),
+                            state.moment2) if low else state.moment2
 
         if self.decay_mask is not None:
             triples = jax.tree.map(
@@ -125,14 +147,19 @@ class FunctionalAdamW:
                     w, g, m, v, t, lr=lr, b1=self.b1, b2=self.b2,
                     eps=self.eps, weight_decay=self.weight_decay,
                     do_decay=dm),
-                params, grads, state.moment1, state.moment2, self.decay_mask)
+                params, grads, m_in, v_in, self.decay_mask)
         else:
             triples = jax.tree.map(
                 lambda w, g, m, v: adamw_kernel(
                     w, g, m, v, t, lr=lr, b1=self.b1, b2=self.b2,
                     eps=self.eps, weight_decay=self.weight_decay),
-                params, grads, state.moment1, state.moment2)
+                params, grads, m_in, v_in)
         new_params, new_m, new_v = jax.tree.transpose(
             jax.tree.structure(params), jax.tree.structure((0, 0, 0)),
             triples)
+        if low:
+            new_m = jax.tree.map(
+                lambda a: a.astype(self.moment_dtype), new_m)
+            new_v = jax.tree.map(
+                lambda a: a.astype(self.moment_dtype), new_v)
         return new_params, AdamWState(new_m, new_v, count), norm

@@ -46,7 +46,8 @@ class PretrainConfig:
                  param_dtype="bfloat16", grad_clip=1.0,
                  dp=1, mp=1, pp=1, sharding=1, sep=1, vpp=1,
                  scan_layers: bool = True, remat: str = "full",
-                 ce_chunks: int = 4, pp_schedule: str = "compiled"):
+                 ce_chunks: int = 4, pp_schedule: str = "compiled",
+                 moment_dtype: str = "float32"):
         self.model = model
         self.global_batch = global_batch
         self.seq_len = seq_len
@@ -94,6 +95,13 @@ class PretrainConfig:
                              f"pp>1 (got pp={pp}); a single stage has "
                              f"no pipeline to schedule")
         self.pp_schedule = pp_schedule
+        # "bfloat16" halves Adam-state HBM (update math stays f32) —
+        # the knob that admits a larger per-chip batch when optimizer
+        # state crowds out activations
+        if moment_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"moment_dtype must be float32|bfloat16, "
+                             f"got {moment_dtype!r}")
+        self.moment_dtype = moment_dtype
 
 
 def make_hybrid_mesh_for(cfg: PretrainConfig, devices=None) -> Mesh:
@@ -222,7 +230,8 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
 
     tx = FunctionalAdamW(cfg.lr, beta1=0.9, beta2=0.95, epsilon=1e-8,
                          weight_decay=cfg.weight_decay,
-                         clip_norm=cfg.grad_clip)
+                         clip_norm=cfg.grad_clip,
+                         moment_dtype=cfg.moment_dtype)
     opt_state = tx.init(master)
 
     cos, sin = precompute_rope(mc.head_dim, cfg.seq_len, mc.rope_theta)

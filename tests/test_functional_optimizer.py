@@ -103,3 +103,52 @@ class TestFunctionalAdamW:
                                      b2=0.999, eps=0.0, weight_decay=0.0)
         # bias-corrected first step: mhat = g, vhat = g^2 → step = -lr*sign
         np.testing.assert_allclose(np.asarray(new_w), [-0.1], atol=1e-6)
+
+
+class TestMomentDtype:
+    def test_bf16_moments_store_low_compute_f32(self):
+        tree = {"w": jnp.ones((64,)) * 0.5}
+        f32 = FunctionalAdamW(1e-2, weight_decay=0.0, beta2=0.95)
+        b16 = FunctionalAdamW(1e-2, weight_decay=0.0, beta2=0.95,
+                              moment_dtype=jnp.bfloat16)
+        s32, s16 = f32.init(tree), b16.init(tree)
+        assert s16.moment1["w"].dtype == jnp.bfloat16
+        assert s32.moment1["w"].dtype == jnp.float32
+        g = {"w": jnp.full((64,), 0.25)}
+        t32, t16 = dict(tree), dict(tree)
+        for _ in range(20):
+            t32, s32, _ = f32.update(g, s32, t32)
+            t16, s16, _ = b16.update(g, s16, t16)
+        assert s16.moment1["w"].dtype == jnp.bfloat16
+        # constant-gradient trajectory: bf16 moment rounding stays small
+        np.testing.assert_allclose(np.asarray(t16["w"]),
+                                   np.asarray(t32["w"]), rtol=2e-2,
+                                   atol=2e-3)
+
+    def test_bf16_moments_reject_stall_regime_beta2(self):
+        import pytest
+        with pytest.raises(ValueError, match="stalls"):
+            FunctionalAdamW(1e-2, moment_dtype=jnp.bfloat16)  # b2=0.999
+
+    def test_pretrain_knob(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import llama_tiny_config
+        from paddle_tpu.trainer.pretrain import (
+            PretrainConfig, build_llama_pretrain_step,
+            make_hybrid_mesh_for)
+        import pytest
+        with pytest.raises(ValueError):
+            PretrainConfig(llama_tiny_config(), moment_dtype="fp8")
+        paddle.seed(5)
+        mc = llama_tiny_config(num_hidden_layers=2,
+                               max_position_embeddings=64)
+        cfg = PretrainConfig(mc, global_batch=2, seq_len=16,
+                             moment_dtype="bfloat16")
+        mesh = make_hybrid_mesh_for(cfg, devices=jax.devices()[:1])
+        st, step, meta = build_llama_pretrain_step(cfg, mesh)
+        leaf = jax.tree.leaves(st.opt_state.moment1)[0]
+        assert leaf.dtype == jnp.bfloat16
+        ids = jnp.asarray(np.random.RandomState(0).randint(
+            0, mc.vocab_size, (2, 16)), jnp.int32)
+        st, m = step(st, ids, ids)
+        assert np.isfinite(float(m["loss"]))
