@@ -269,17 +269,19 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
 # diversity_rate per token already chosen this step by earlier groups).
 # Fixed-shape: the model always sees [B*num_beams, S0+max_new_tokens].
 # ---------------------------------------------------------------------------
-def _repetition_penalize(logp, seen_tokens, penalty):
-    """logp [R, V]; seen_tokens [R, T] int; CTRL penalty on log-probs:
-    seen tokens' log-probs (always < 0) are multiplied by `penalty`
-    (ref: paddlenlp RepetitionPenaltyLogitsProcessor on logits; applied
-    to log-softmax values the multiply branch is the operative one)."""
+def _repetition_penalize(logits, seen_tokens, penalty):
+    """logits [R, V] (raw, pre-softmax); seen_tokens [R, T] int; CTRL
+    penalty on the logits — seen tokens' negative logits are multiplied
+    by `penalty`, positive ones divided — so the subsequent log_softmax
+    still yields normalized log-probabilities (ref: paddlenlp
+    RepetitionPenaltyLogitsProcessor.__call__)."""
     if penalty == 1.0:
-        return logp
-    R, V = logp.shape
+        return logits
+    R, V = logits.shape
     seen = jnp.zeros((R, V), bool).at[
         jnp.arange(R)[:, None], seen_tokens].set(True)
-    return jnp.where(seen, logp * penalty, logp)
+    penalized = jnp.where(logits < 0, logits * penalty, logits / penalty)
+    return jnp.where(seen, penalized, logits)
 
 
 def _beam_step(scores, finished, logp, num_beams, num_beam_groups,
@@ -336,9 +338,10 @@ def _beam_engine(step_logits, reorder_state, ids, max_new_tokens,
     toks = []  # committed tokens per step, [B, nb] AFTER reordering
     for t in range(S0 - 1, S0 + max_new_tokens - 1):
         logits = step_logits(t)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        logp = _repetition_penalize(
-            logp, reorder_state.current_tokens(t), repetition_penalty)
+        logits = _repetition_penalize(
+            logits.astype(jnp.float32),
+            reorder_state.current_tokens(t), repetition_penalty)
+        logp = jax.nn.log_softmax(logits, -1)
         scores, tok, src = _beam_step(scores, finished, logp, nb,
                                       num_beam_groups, diversity_rate,
                                       pad_token_id, eos_token_id)

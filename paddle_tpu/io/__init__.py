@@ -424,24 +424,36 @@ class DataLoader:
         W = min(self.num_workers, len(batches))
         base_seed = int(np.random.randint(0, 2 ** 31 - 1))
         result_q = ctx.Queue(maxsize=W * self.prefetch_factor)
+        task_q = ctx.Queue()
         user_collate = None if self.collate_fn is default_collate_fn \
             else self.collate_fn
         procs = []
         for w in range(W):
             p = ctx.Process(
                 target=_process_worker,
-                args=(self.dataset, user_collate, batches[w::W],
-                      [i * W + w for i in range(len(batches[w::W]))],
+                args=(self.dataset, user_collate, task_q,
                       w, W, base_seed, self.worker_init_fn, result_q,
                       self.use_shared_memory),
                 daemon=True)
             p.start()
             procs.append(p)
         try:
-            pending: dict = {}
-            done_workers = 0
-            nxt = 0
             total = len(batches)
+            # outstanding-capacity window: only ~W*prefetch_factor index
+            # batches are in flight at once, so a fast worker cannot run
+            # the whole epoch ahead of a slow one — `pending` (and shm
+            # segments) stay bounded by the window, not the dataset
+            window = W * (self.prefetch_factor + 1)
+            dispatched = 0
+            while dispatched < min(window, total):
+                task_q.put((dispatched, batches[dispatched]))
+                dispatched += 1
+            if dispatched == total:
+                for _ in range(W):
+                    task_q.put(None)
+            pending: dict = {}
+            exited: set = set()
+            nxt = 0
             while nxt < total:
                 if nxt in pending:
                     item = pending.pop(nxt)
@@ -449,18 +461,31 @@ class DataLoader:
                     try:
                         got = result_q.get(
                             timeout=self.timeout if self.timeout
-                            else None)
+                            else 5.0)
                     except _queue.Empty:
-                        raise RuntimeError(
-                            f"DataLoader worker timed out after "
-                            f"{self.timeout}s waiting for batch {nxt} "
-                            f"(num_workers={W}, worker_mode='process')"
-                        ) from None
+                        if self.timeout:
+                            raise RuntimeError(
+                                f"DataLoader worker timed out after "
+                                f"{self.timeout}s waiting for batch "
+                                f"{nxt} (num_workers={W}, "
+                                f"worker_mode='process')") from None
+                        # liveness poll: a worker that died without its
+                        # sentinel (segfault / OOM-kill) would otherwise
+                        # block this get() forever
+                        dead = [p for i, p in enumerate(procs)
+                                if i not in exited and not p.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker pid={dead[0].pid} "
+                                f"died (exitcode {dead[0].exitcode}) "
+                                f"before finishing its share") from None
+                        continue
                     if got[0] is None:       # worker finished / failed
-                        done_workers += 1
-                        if got[1] is not None:
-                            raise got[1]
-                        if done_workers == W and nxt not in pending \
+                        w, err = got[1]
+                        exited.add(w)
+                        if err is not None:
+                            raise err
+                        if len(exited) == W and nxt not in pending \
                                 and nxt < total:
                             raise RuntimeError(
                                 "dataloader workers exited before "
@@ -470,11 +495,22 @@ class DataLoader:
                         pending[got[0]] = got[1]
                         continue
                     item = got[1]
+                # one batch consumed -> refill the dispatch window
+                if dispatched < total:
+                    task_q.put((dispatched, batches[dispatched]))
+                    dispatched += 1
+                    if dispatched == total:
+                        for _ in range(W):
+                            task_q.put(None)
                 item = _shm_decode(item)
                 yield item if user_collate is not None \
                     else _tensorize_tree(item)
                 nxt += 1
         finally:
+            # early exit: children may never drain task_q; don't let the
+            # parent's queue feeder thread block interpreter shutdown
+            task_q.cancel_join_thread()
+            task_q.close()
             for p in procs:
                 p.terminate()
             for p in procs:
@@ -597,13 +633,27 @@ def _shm_decode(item):
         shm.unlink()
 
 
-def _process_worker(dataset, user_collate, index_batches, batch_ids,
-                    worker_id, num_workers, base_seed, init_fn, out_q,
+def _has_tensor_leaf(x):
+    """True if any leaf of a sample tree is a device Tensor (the guard
+    must walk tuples/dicts — the common dataset return shapes — not
+    just the top level)."""
+    if isinstance(x, Tensor):
+        return True
+    if isinstance(x, dict):
+        return any(_has_tensor_leaf(v) for v in x.values())
+    if isinstance(x, (tuple, list)):
+        return any(_has_tensor_leaf(v) for v in x)
+    return False
+
+
+def _process_worker(dataset, user_collate, task_q, worker_id,
+                    num_workers, base_seed, init_fn, out_q,
                     use_shared_memory=True):
-    """Worker-process body: seed, run init_fn, produce this worker's
-    round-robin share. Sends (global_batch_idx, collated_numpy) tuples
-    — array leaves ride a shared-memory segment when use_shared_memory —
-    then a (None, exception_or_None) sentinel."""
+    """Worker-process body: seed, run init_fn, then pull (batch_idx,
+    indices) tasks from the shared task queue until a None stop token.
+    Sends (global_batch_idx, collated_numpy) tuples — array leaves ride
+    a shared-memory segment when use_shared_memory — then a
+    (None, (worker_id, exception_or_None)) sentinel."""
     import random as _random
     err = None
     try:
@@ -614,10 +664,14 @@ def _process_worker(dataset, user_collate, index_batches, batch_ids,
             init_fn(worker_id)
         collate = user_collate if user_collate is not None \
             else numpy_collate_fn
-        for bid, indices in zip(batch_ids, index_batches):
+        while True:
+            task = task_q.get()
+            if task is None:
+                break
+            bid, indices = task
             samples = [dataset[i] for i in indices]
             for s in samples:
-                if isinstance(s, Tensor):
+                if _has_tensor_leaf(s):
                     # converting an inherited device array in a forked
                     # child touches the (fork-unsafe) runtime — fail
                     # loudly instead of deadlocking
@@ -633,4 +687,4 @@ def _process_worker(dataset, user_collate, index_batches, batch_ids,
             out_q.put((bid, batch))
     except BaseException as e:  # noqa: BLE001 — shipped to the parent
         err = e
-    out_q.put((None, err))
+    out_q.put((None, (worker_id, err)))

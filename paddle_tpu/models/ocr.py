@@ -235,7 +235,8 @@ class PPOCRRec(nn.Layer):
         return self.head(f)              # [B, T, classes]
 
     def loss(self, logits, labels, label_lengths):
-        """CTC loss (ref: warpctc externals — XLA path via optax)."""
+        """CTC loss (ref: warpctc externals — native extended-label
+        forward lattice in nn.functional.ctc_loss)."""
         B, T, C = logits.shape
         from ..core.tensor import Tensor
         input_lens = Tensor(jnp.full((B,), T, jnp.int32))

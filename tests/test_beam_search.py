@@ -42,14 +42,18 @@ def np_beam_search(W, prompt, max_new, nb, ngroups=1, diversity=0.0,
     for step in range(max_new):
         last = seqs[:, :, -1]
         logits = W[last].astype(np.float64)           # [B, nb, V]
-        logp = logits - np.log(np.exp(
-            logits - logits.max(-1, keepdims=True)).sum(
-                -1, keepdims=True)) - logits.max(-1, keepdims=True)
         if rep_penalty != 1.0:
+            # CTRL penalty on raw logits (multiply negatives, divide
+            # positives), then softmax — scores stay normalized log-probs
             for b in range(B):
                 for n in range(nb):
                     seen = np.unique(seqs[b, n])
-                    logp[b, n, seen] = logp[b, n, seen] * rep_penalty
+                    lv = logits[b, n, seen]
+                    logits[b, n, seen] = np.where(
+                        lv < 0, lv * rep_penalty, lv / rep_penalty)
+        logp = logits - np.log(np.exp(
+            logits - logits.max(-1, keepdims=True)).sum(
+                -1, keepdims=True)) - logits.max(-1, keepdims=True)
         frozen = np.full((V,), -np.inf)
         frozen[pad] = 0.0
         logp = np.where(finished[..., None], frozen[None, None], logp)
