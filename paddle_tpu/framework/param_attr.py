@@ -1,0 +1,76 @@
+"""ParamAttr + standalone create_parameter (ref: python/paddle/base/
+param_attr.py and paddle.create_parameter in tensor/creation.py).
+
+ParamAttr carries construction-time knobs: initializer, a per-param
+learning-rate multiplier, a regularizer, trainability, and clip
+eligibility. nn.Layer.create_parameter already honors `.initializer`;
+the optimizer reads `.learning_rate`/`.regularizer` off the Parameter
+when present (paddle semantics: per-param lr = global lr * multiplier).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = ["ParamAttr", "create_parameter"]
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = True,
+                 need_clip: bool = True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = float(learning_rate)
+        self.regularizer = regularizer
+        self.trainable = bool(trainable)
+        self.do_model_average = bool(do_model_average)
+        self.need_clip = bool(need_clip)
+
+    @staticmethod
+    def _to_attr(arg) -> Optional["ParamAttr"]:
+        """paddle's polymorphic attr argument: None | False | str name |
+        initializer | ParamAttr."""
+        if arg is None or isinstance(arg, ParamAttr):
+            return arg
+        if arg is False:
+            return None
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        # an Initializer instance
+        return ParamAttr(initializer=arg)
+
+    def __repr__(self):
+        return (f"ParamAttr(name={self.name!r}, "
+                f"learning_rate={self.learning_rate}, "
+                f"trainable={self.trainable})")
+
+
+def create_parameter(shape, dtype="float32", name: Optional[str] = None,
+                     attr: Any = None, is_bias: bool = False,
+                     default_initializer=None):
+    """Standalone parameter factory (ref: paddle.create_parameter).
+    Same initializer-resolution order as nn.Layer.create_parameter."""
+    from ..nn.layer.layers import Parameter
+    from ..core.dtypes import convert_dtype
+    from ..nn import initializer as I
+
+    attr = ParamAttr._to_attr(attr)
+    init = default_initializer
+    if attr is not None and attr.initializer is not None:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    dt = convert_dtype(dtype) or "float32"
+    p = Parameter(init(list(shape), dt))
+    p.name = name if name is not None else (
+        attr.name if attr is not None else None)
+    if attr is not None:
+        p.trainable = attr.trainable
+        p.stop_gradient = not attr.trainable
+        if attr.learning_rate != 1.0:
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+    return p

@@ -1,0 +1,58 @@
+"""paddle.hub parity (ref: python/paddle/hapi/hub.py — load models from
+a hubconf.py). Local directories work fully; remote github/gitee sources
+are refused (zero-egress environment)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source != "local":
+        raise NotImplementedError(
+            f"hub source {source!r} needs network access (none in this "
+            f"environment); clone the repo and use source='local'")
+    return _load_hubconf(repo_dir)
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False
+         ) -> List[str]:
+    mod = _resolve(repo_dir, source)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:
+    mod = _resolve(repo_dir, source)
+    entry = getattr(mod, model, None)
+    if entry is None:
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return entry.__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    mod = _resolve(repo_dir, source)
+    entry = getattr(mod, model, None)
+    if entry is None:
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return entry(**kwargs)

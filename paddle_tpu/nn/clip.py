@@ -57,9 +57,13 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def __call__(self, params_grads):
+        # ParamAttr(need_clip=False) excludes a param from both the
+        # global norm and the rescale (paddle semantics)
+        def clippable(p):
+            return getattr(p, "need_clip", True)
         sq = None
         for p, g in params_grads:
-            if g is None:
+            if g is None or not clippable(p):
                 continue
             s = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
             sq = s if sq is None else sq + s
@@ -69,7 +73,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
         for p, g in params_grads:
-            if g is None:
+            if g is None or not clippable(p):
                 out.append((p, g))
                 continue
             out.append((p, Tensor((g._data.astype(jnp.float32) * scale

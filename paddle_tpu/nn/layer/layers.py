@@ -130,6 +130,9 @@ class Layer:
             init = attr.initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        from ...framework.lazy import lazy_enabled, _make_lazy_parameter
+        if lazy_enabled():
+            return _make_lazy_parameter(init, shape, dt)
         return Parameter(init(shape, dt))
 
     def register_buffer(self, name: str, tensor: Optional[Tensor],

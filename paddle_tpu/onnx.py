@@ -1,0 +1,31 @@
+"""ONNX export seam (ref: python/paddle/onnx/export.py → paddle2onnx).
+
+The paddle2onnx converter and the onnx package are not in this
+environment (zero-egress build); the durable serialization path here is
+`paddle_tpu.static.save_inference_model` (jax.export / StableHLO), which
+plays the same deployment role. `export` raises with that pointer unless
+an `onnx` module is importable, in which case a minimal converter would
+be pluggable via `register_exporter`."""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+_exporter = None
+
+
+def register_exporter(fn) -> None:
+    global _exporter
+    _exporter = fn
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    if _exporter is not None:
+        return _exporter(layer, path, input_spec=input_spec,
+                         opset_version=opset_version, **configs)
+    raise NotImplementedError(
+        "ONNX export requires the paddle2onnx/onnx packages (absent in "
+        "this build). Use paddle_tpu.static.save_inference_model "
+        "(StableHLO via jax.export) for deployable serialization, or "
+        "register_exporter() to plug a converter.")

@@ -112,7 +112,19 @@ class Optimizer:
         for p, g in params_grads:
             if g is None:
                 continue
-            self._update_param(p, g._data, lr_v)
+            gd = g._data
+            # a ParamAttr regularizer OVERRIDES the optimizer-level
+            # decay for that parameter (paddle priority rule)
+            reg = getattr(p, "regularizer", None)
+            if reg is not None and hasattr(reg, "grad_term"):
+                w = self._master_weight(p)
+                gd = gd + reg.grad_term(w).astype(gd.dtype)
+                self._wd_skip_param = True
+            # per-param lr multiplier (ParamAttr.learning_rate)
+            attr = getattr(p, "optimize_attr", None) or {}
+            self._update_param(p, gd,
+                               lr_v * float(attr.get("learning_rate", 1.0)))
+            self._wd_skip_param = False
 
     def _update_param(self, p: Tensor, grad, lr_v: float) -> None:
         raise NotImplementedError
@@ -123,17 +135,32 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
+    # set transiently by step() when the current param carries its own
+    # ParamAttr regularizer (which overrides optimizer-level decay)
+    _wd_skip_param = False
+
     def _apply_decoupled_wd(self, w, lr_v):
-        """AdamW-style decoupled weight decay."""
-        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
-        if wd:
-            return w * (1.0 - lr_v * wd)
+        """AdamW-style decoupled weight decay (float coeff, or the coeff
+        of an L2Decay/L1Decay regularizer instance)."""
+        if self._wd_skip_param:
+            return w
+        wd = self._weight_decay
+        coeff = wd if isinstance(wd, (int, float)) \
+            else float(getattr(wd, "coeff", 0.0))
+        if coeff:
+            return w * (1.0 - lr_v * coeff)
         return w
 
     def _coupled_wd_grad(self, w, grad):
-        """L2-regularization-style decay added to the gradient."""
-        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
-        if wd:
+        """Regularization-style decay added to the gradient: float means
+        L2 (wd * w); an L1Decay/L2Decay instance contributes its own
+        grad_term (ref: paddle regularizer applied in the optimizer)."""
+        if self._wd_skip_param:
+            return grad
+        wd = self._weight_decay
+        if hasattr(wd, "grad_term"):
+            return grad + wd.grad_term(w).astype(grad.dtype)
+        if isinstance(wd, (int, float)) and wd:
             return grad + wd * w
         return grad
 

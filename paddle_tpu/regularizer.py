@@ -1,0 +1,44 @@
+"""Weight regularizers (ref: python/paddle/regularizer.py — L1Decay /
+L2Decay attached per-param via ParamAttr.regularizer or passed to the
+optimizer's weight_decay argument)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class _Regularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(_Regularizer):
+    """loss += coeff * sum(|w|); grad contribution coeff * sign(w)."""
+
+    def grad_term(self, param_data):
+        return self._coeff * jnp.sign(param_data)
+
+    def loss_term(self, param_data):
+        return self._coeff * jnp.abs(param_data).sum()
+
+
+class L2Decay(_Regularizer):
+    """loss += coeff * 0.5 * sum(w^2); grad contribution coeff * w
+    (the reference's L2DecayRegularizer; equivalent to decoupled weight
+    decay only when lr-coupled — the optimizers' weight_decay argument
+    implements the AdamW-style decoupled form)."""
+
+    def grad_term(self, param_data):
+        return self._coeff * param_data
+
+    def loss_term(self, param_data):
+        return self._coeff * 0.5 * jnp.square(param_data).sum()

@@ -1,0 +1,19 @@
+"""Build-config queries (ref: python/paddle/sysconfig.py)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_pkg_dir = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of this package's C headers (csrc/)."""
+    return os.path.join(_pkg_dir, "csrc")
+
+
+def get_lib() -> str:
+    """Directory holding the built native shared objects."""
+    return os.path.join(_pkg_dir, "native")

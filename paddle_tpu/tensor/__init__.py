@@ -23,9 +23,10 @@ from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 from .attribute import *  # noqa: F401,F403
 from .tail import *  # noqa: F401,F403
+from .tail3 import *  # noqa: F401,F403
 
 from . import (attribute, creation, einsum as _einsum_mod, linalg, logic,
-               manipulation, math, random, search, stat, tail)
+               manipulation, math, random, search, stat, tail, tail3)
 
 
 # ---------------------------------------------------------------------------
@@ -243,7 +244,43 @@ _METHODS = dict(
     masked_scatter_=tail.masked_scatter_, copysign_=tail.copysign_,
     gammaln_=tail.gammaln_, gammainc_=tail.gammainc_,
     gammaincc_=tail.gammaincc_, multigammaln_=tail.multigammaln_,
+    # in-place batch 3 + paddle-3.x stragglers
+    reduce_as=tail3.reduce_as, bernoulli_=tail3.bernoulli_,
+    log_normal_=tail3.log_normal_, sinc_=tail3.sinc_,
+    square_=tail3.square_, erf_=tail3.erf_, i0_=tail3.i0_, t_=tail3.t_,
+    where_=tail3.where_, mod_=tail3.mod_, floor_mod_=tail3.floor_mod_,
+    addmm_=tail3.addmm_, equal_=tail3.equal_, not_equal_=tail3.not_equal_,
+    greater_equal_=tail3.greater_equal_,
+    greater_than_=tail3.greater_than_, less_equal_=tail3.less_equal_,
+    less_than_=tail3.less_than_, logical_and_=tail3.logical_and_,
+    logical_or_=tail3.logical_or_, logical_xor_=tail3.logical_xor_,
+    logical_not_=tail3.logical_not_, bitwise_and_=tail3.bitwise_and_,
+    bitwise_or_=tail3.bitwise_or_, bitwise_xor_=tail3.bitwise_xor_,
+    bitwise_not_=tail3.bitwise_not_,
+    bitwise_invert_=tail3.bitwise_invert_,
 )
+
+def _tensor_apply(x, func):
+    """Tensor.apply(callable) -> callable(x) (ref: paddle Tensor.apply,
+    which refuses tensors that require grad)."""
+    from ..core import autograd as _ag
+    if _ag.is_grad_enabled() and not x.stop_gradient:
+        raise RuntimeError(
+            "apply is not supported on a tensor that requires grad; "
+            "wrap in no_grad() or set stop_gradient=True")
+    return func(x)
+
+
+def _tensor_apply_(x, func):
+    from .tail import _guard_inplace
+    _guard_inplace(x, "apply_")
+    out = func(x)
+    x._data = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+    return x
+
+
+_METHODS["apply"] = _tensor_apply
+_METHODS["apply_"] = _tensor_apply_
 
 for _name, _fn in _METHODS.items():
     setattr(Tensor, _name, _fn)

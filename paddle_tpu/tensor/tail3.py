@@ -1,0 +1,146 @@
+"""Tensor-API long tail, batch 3 (ref surface: python/paddle/tensor/ —
+the paddle 3.x additions and the remaining generated in-place variants;
+VERDICT r2 item 5).
+
+Same contracts as tail.py: differentiable ops dispatch through
+core.dispatch.apply; in-place ops rebind the buffer and refuse
+grad-requiring tensors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import linalg as _linalg
+from . import logic as _logic
+from . import manipulation as _manip
+from . import math as _math
+from . import tail as _tail
+
+__all__ = [
+    "binomial", "log_normal", "log_normal_", "reduce_as", "bernoulli_",
+    "sinc_", "square_", "erf_", "i0_", "t_", "where_", "mod_",
+    "floor_mod_", "addmm_",
+    "equal_", "not_equal_", "greater_equal_", "greater_than_",
+    "less_equal_", "less_than_",
+    "logical_and_", "logical_or_", "logical_xor_", "logical_not_",
+    "bitwise_and_", "bitwise_or_", "bitwise_xor_", "bitwise_not_",
+    "bitwise_invert_",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# new out-of-place ops
+# ---------------------------------------------------------------------------
+def binomial(count, prob, name=None):
+    """Sample Binomial(count, prob) elementwise (ref: paddle.binomial)."""
+    from ..framework.random import next_key
+    n = _arr(count).astype(jnp.float32)
+    p = _arr(prob).astype(jnp.float32)
+    from ..core.dtypes import convert_dtype
+    out = jax.random.binomial(next_key(), n, p)
+    # "int64" demotes per the framework's x64 policy (core/dtypes.py)
+    return Tensor(out.astype(convert_dtype("int64")))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32", name=None):
+    """exp(Normal(mean, std)) samples (ref: paddle.log_normal — note the
+    mean/std parameterize the UNDERLYING normal, paddle semantics)."""
+    from ..core.dtypes import convert_dtype
+    from ..framework.random import next_key
+    shp = tuple(shape) if shape is not None else ()
+    dt = convert_dtype(dtype) or "float32"
+    z = jax.random.normal(next_key(), shp, jnp.float32)
+    return Tensor(jnp.exp(_arr(mean) + _arr(std) * z).astype(dt))
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x over the broadcast dims so its shape matches target
+    (ref: paddle.reduce_as — the gradient-of-broadcast helper)."""
+    tgt = _arr(target).shape
+
+    def impl(a):
+        extra = a.ndim - len(tgt)
+        if extra:
+            a = a.sum(axis=tuple(range(extra)))
+        keep = tuple(i for i, (s, t) in enumerate(zip(a.shape, tgt))
+                     if s != t)
+        if keep:
+            a = a.sum(axis=keep, keepdims=True)
+        return a
+    return apply("reduce_as", impl, [x])
+
+
+# ---------------------------------------------------------------------------
+# in-place family, batch 3
+# ---------------------------------------------------------------------------
+_guard_inplace = _tail._guard_inplace
+_inplace_of = _tail._inplace_of
+
+
+def bernoulli_(x, p=0.5, name=None):
+    _guard_inplace(x, "bernoulli_")
+    from ..framework.random import next_key
+    pr = _arr(p) if isinstance(p, Tensor) else p
+    x._data = jax.random.bernoulli(next_key(), pr, _arr(x).shape).astype(
+        x.dtype)
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    _guard_inplace(x, "log_normal_")
+    from ..framework.random import next_key
+    z = jax.random.normal(next_key(), _arr(x).shape, jnp.float32)
+    x._data = jnp.exp(_arr(mean) + _arr(std) * z).astype(x.dtype)
+    return x
+
+
+def t_(x, name=None):
+    _guard_inplace(x, "t_")
+    x._data = _linalg.t(Tensor(x._data))._data
+    return x
+
+
+def where_(condition, x, y, name=None):
+    """In-place where: x keeps its value where condition, takes y
+    elsewhere (ref: paddle.where_)."""
+    _guard_inplace(x, "where_")
+    x._data = jnp.where(_arr(condition), _arr(x), _arr(y))
+    return x
+
+
+def addmm_(input, x, y, beta=1.0, alpha=1.0, name=None):
+    _guard_inplace(input, "addmm_")
+    input._data = beta * _arr(input) + alpha * jnp.matmul(_arr(x), _arr(y))
+    return input
+
+
+sinc_ = _inplace_of(_math.sinc)
+square_ = _inplace_of(_math.square)
+erf_ = _inplace_of(_math.erf)
+i0_ = _inplace_of(_math.i0)
+mod_ = _inplace_of(_math.remainder)
+floor_mod_ = _inplace_of(_math.remainder)
+equal_ = _inplace_of(_logic.equal)
+not_equal_ = _inplace_of(_logic.not_equal)
+greater_equal_ = _inplace_of(_logic.greater_equal)
+greater_than_ = _inplace_of(_logic.greater_than)
+less_equal_ = _inplace_of(_logic.less_equal)
+less_than_ = _inplace_of(_logic.less_than)
+logical_and_ = _inplace_of(_logic.logical_and)
+logical_or_ = _inplace_of(_logic.logical_or)
+logical_xor_ = _inplace_of(_logic.logical_xor)
+logical_not_ = _inplace_of(_logic.logical_not)
+bitwise_and_ = _inplace_of(_logic.bitwise_and)
+bitwise_or_ = _inplace_of(_logic.bitwise_or)
+bitwise_xor_ = _inplace_of(_logic.bitwise_xor)
+bitwise_not_ = _inplace_of(_logic.bitwise_not)
+bitwise_invert_ = _inplace_of(_tail.bitwise_invert)
