@@ -148,11 +148,35 @@ class ParallelCrossEntropy(nn.Layer):
         return apply("parallel_cross_entropy", impl, [logits])
 
 
+import threading as _threading
+
+_sp_state = _threading.local()
+
+
+class suppress_sequence_parallel_annotations:
+    """Trace-time switch: inside the timetable pipeline executor
+    (distributed.pp_exec), per-block seq-dim resharding hints sit inside
+    lax.switch branches, where the reshard can lower to a full-mesh
+    collective-permute — a collective only some devices reach, i.e. a
+    deadlock (the branch-collective rule). The executor suppresses the
+    hints during its trace; GSPMD sharding propagation covers the region
+    instead. Thread-local so concurrent traces don't leak suppression."""
+
+    def __enter__(self):
+        self._prev = getattr(_sp_state, "off", False)
+        _sp_state.off = True
+        return self
+
+    def __exit__(self, *exc):
+        _sp_state.off = self._prev
+        return False
+
+
 def annotate_sequence_parallel(x: Tensor, axis: str = MP_AXIS) -> Tensor:
     """Megatron-SP parity (ref: sequence_parallel_utils.py ScatterOp/
     GatherOp): shard the sequence dim (dim 1 of [B,S,H]) on the mp axis
     between blocks. One annotation replaces the allreduce→rs/ag rewrite."""
-    if not _mesh_has(axis):
+    if getattr(_sp_state, "off", False) or not _mesh_has(axis):
         return x
     spec = [None] * x.ndim
     spec[1] = axis

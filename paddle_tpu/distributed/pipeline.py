@@ -43,26 +43,31 @@ def _pp_shard_map(f, mesh, in_specs, out_specs):
                          axis_names=frozenset({PP_AXIS}), check_vma=True)
 
 
-def _cpu_f32_upcast(stacked_params, microbatches, extra_args):
-    """XLA CPU crashes ("Invalid binary instruction opcode copy") on sub-f32
-    psum under partial-manual sharding — both our output psum and the psums
-    AD inserts when transposing pvary. On the CPU backend (simulated-mesh
-    tests / dryrun) run the whole pipelined region in f32; TPU keeps bf16.
-    Returns (params, mbs, extra, restore_fn) when the upcast applies."""
-    if jax.default_backend() != "cpu" or not any(
-            jnp.issubdtype(x.dtype, jnp.floating)
-            and jnp.dtype(x.dtype).itemsize < 4
-            for x in jax.tree_util.tree_leaves(
-                (stacked_params, microbatches, extra_args))):
-        return None
-    out_dtype = microbatches.dtype
-    up = lambda x: x.astype(jnp.float32) if (
-        jnp.issubdtype(x.dtype, jnp.floating)
-        and jnp.dtype(x.dtype).itemsize < 4) else x
-    return (jax.tree_util.tree_map(up, stacked_params),
-            up(microbatches),
-            tuple(jax.tree_util.tree_map(up, e) for e in extra_args),
-            lambda out: out.astype(out_dtype))
+@jax.custom_vjp
+def _pvary_safe(x):
+    """pvary whose TRANSPOSE we own: AD's transpose of pvary is a
+    psum_invariant on the cotangent, and a sub-f32 psum crashes XLA CPU
+    under partial-manual sharding ("Invalid binary instruction opcode
+    copy"). Routing the transpose through an f32 psum keeps the stage
+    compute (and the carried activations) genuinely bf16 on every
+    backend — this replaces the old whole-region _cpu_f32_upcast for
+    the compiled pipeline paths."""
+    return jax.lax.pvary(x, PP_AXIS)
+
+
+def _pvary_safe_fwd(x):
+    return jax.lax.pvary(x, PP_AXIS), None
+
+
+def _pvary_safe_bwd(_, g):
+    if jnp.issubdtype(g.dtype, jnp.floating) \
+            and jnp.dtype(g.dtype).itemsize < 4:
+        return (jax.lax.psum(g.astype(jnp.float32),
+                             PP_AXIS).astype(g.dtype),)
+    return (jax.lax.psum(g, PP_AXIS),)
+
+
+_pvary_safe.defvjp(_pvary_safe_fwd, _pvary_safe_bwd)
 
 
 def _gather_last_stage(out_buf, stage, S):
@@ -105,14 +110,6 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
         return _no_pp_fallback(stage_fn, stacked_params, microbatches,
                                extra_args)
 
-    upcast = _cpu_f32_upcast(stacked_params, microbatches, extra_args)
-    if upcast is not None:
-        stacked_params, microbatches, extra_args, restore = upcast
-        out = spmd_pipeline(stage_fn, stacked_params, microbatches, mesh,
-                            n_microbatches, extra_args=extra_args,
-                            remat=remat)
-        return restore(out)
-
     body = stage_fn
     if remat:
         body = jax.checkpoint(stage_fn)
@@ -127,11 +124,14 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Dict[str, Any],
         # params: {name: [1, L/S, ...]} local stage slice
         params = {k: v[0] for k, v in params.items()}
         stage = jax.lax.axis_index(PP_AXIS)
+        # _pvary_safe: mbs' cotangent re-invariants through OUR f32 psum
+        # instead of an AD-inserted sub-f32 one (XLA-CPU crash)
+        mbs = _pvary_safe(mbs)
         mb_shape = mbs.shape[1:]
         # pvary: the carry is device-varying over pp from tick 1 on (ppermute
         # output), so the initial carry must carry the same vma type
-        state = jax.lax.pvary(jnp.zeros(mb_shape, mbs.dtype), PP_AXIS)
-        out_buf = jax.lax.pvary(jnp.zeros((M,) + mb_shape, mbs.dtype), PP_AXIS)
+        state = _pvary_safe(jnp.zeros(mb_shape, mbs.dtype))
+        out_buf = _pvary_safe(jnp.zeros((M,) + mb_shape, mbs.dtype))
 
         def tick(carry, t):
             state, out_buf = carry
@@ -238,14 +238,6 @@ def spmd_pipeline_interleaved(stage_fn, stacked_params: Dict[str, Any],
         return _no_pp_fallback(stage_fn, merged, microbatches, extra_args)
     V = S * v
 
-    upcast = _cpu_f32_upcast(stacked_params, microbatches, extra_args)
-    if upcast is not None:
-        stacked_params, microbatches, extra_args, restore = upcast
-        out = spmd_pipeline_interleaved(
-            stage_fn, stacked_params, microbatches, mesh, M, v,
-            extra_args=extra_args, remat=remat)
-        return restore(out)
-
     body = jax.checkpoint(stage_fn) if remat else stage_fn
     inject, total = _vpp_injection_schedule(S, v, M)
     inject_t = jnp.asarray(inject, jnp.int32)
@@ -258,13 +250,13 @@ def spmd_pipeline_interleaved(stage_fn, stacked_params: Dict[str, Any],
     def per_device(params, mbs, *extra):
         params = {k: x[0] for k, x in params.items()}  # [v, L/V, ...]
         stage = jax.lax.axis_index(PP_AXIS)
+        mbs = _pvary_safe(mbs)
         mb_shape = mbs.shape[1:]
         zero = jnp.zeros(mb_shape, mbs.dtype)
-        state = jax.lax.pvary(zero, PP_AXIS)
+        state = _pvary_safe(zero)
         h0 = jax.lax.pvary(jnp.zeros((), jnp.int32), PP_AXIS)
         m0 = jax.lax.pvary(jnp.zeros((), jnp.int32), PP_AXIS)
-        out_buf = jax.lax.pvary(jnp.zeros((M,) + mb_shape, mbs.dtype),
-                                PP_AXIS)
+        out_buf = _pvary_safe(jnp.zeros((M,) + mb_shape, mbs.dtype))
 
         def tick(carry, t):
             state, h, m, out_buf = carry

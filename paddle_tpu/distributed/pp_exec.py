@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .pipeline import PP_AXIS, _cpu_f32_upcast, _pp_shard_map
+from .pipeline import PP_AXIS, _pp_shard_map
 from .pp_schedule import Schedule
 
 __all__ = ["scheduled_pipeline_loss", "schedule_buffer_bounds"]
@@ -46,43 +46,53 @@ _PHASES = {"F": 1, "B": 2, "W": 3}  # 0 = bubble
 
 
 def _tables(schedule: Schedule):
-    """timeline -> (phase[S,T], mb[S,T]) int32 numpy tables."""
+    """timeline -> (phase[S,T], mb[S,T], chunk[S,T]) int32 numpy tables."""
     S, T = schedule.n_stages, schedule.n_ticks
     phase = np.zeros((S, T), np.int32)
     mb = np.zeros((S, T), np.int32)
+    chunk = np.zeros((S, T), np.int32)
     for s, row in enumerate(schedule.timeline):
         for t, op in enumerate(row):
             if op is not None:
                 phase[s, t] = _PHASES[op.phase]
                 mb[s, t] = op.mb
-    return phase, mb
+                chunk[s, t] = op.chunk
+    return phase, mb, chunk
 
 
 def _stage_intervals(schedule: Schedule):
-    """Per-stage liveness intervals derived from the timetable — the ONE
-    source both the buffer sizing and the slot-collision guard use.
-    Yields (stage, {"in_buf": [(mb, start, end)], "cot_buf": ...,
+    """Per-(stage, chunk) liveness intervals derived from the timetable —
+    the ONE source both the buffer sizing and the slot-collision guard
+    use. Virtual stage v = chunk*S + stage (Megatron ordering); v's F
+    input arrives from vstage v-1 (device (s-1) mod S, wrapping chunk),
+    its cotangent from vstage v+1. Yields
+    (stage, chunk, {"in_buf": [(mb, start, end)], "cot_buf": ...,
     "w_buf": ...})."""
-    S, M = schedule.n_stages, schedule.n_microbatches
+    S, M, C = schedule.n_stages, schedule.n_microbatches, schedule.n_chunks
+    V = S * C
     fin: Dict[Tuple[str, int, int], int] = {}
     start: Dict[Tuple[str, int, int], int] = {}
     for s, row in enumerate(schedule.timeline):
         for t, op in enumerate(row):
             if op is not None:
-                fin[(op.phase, s, op.mb)] = t + 1
-                start[(op.phase, s, op.mb)] = t
+                v = op.chunk * S + s
+                fin[(op.phase, v, op.mb)] = t + 1
+                start[(op.phase, v, op.mb)] = t
     for s in range(S):
-        iv = {"in_buf": [], "cot_buf": [], "w_buf": []}
-        for m in range(M):
-            arr = fin[("F", s - 1, m)] if s > 0 else start[("F", s, m)]
-            iv["in_buf"].append((m, arr, fin[("B", s, m)]))
-            if s < S - 1:
-                iv["cot_buf"].append((m, fin[("B", s + 1, m)],
-                                      fin[("B", s, m)]))
-            if schedule.split_w:
-                iv["w_buf"].append((m, fin[("B", s, m)],
-                                    fin[("W", s, m)]))
-        yield s, iv
+        for c in range(C):
+            v = c * S + s
+            iv = {"in_buf": [], "cot_buf": [], "w_buf": []}
+            for m in range(M):
+                arr = fin[("F", v - 1, m)] if v > 0 \
+                    else start[("F", v, m)]
+                iv["in_buf"].append((m, arr, fin[("B", v, m)]))
+                if v < V - 1:
+                    iv["cot_buf"].append((m, fin[("B", v + 1, m)],
+                                          fin[("B", v, m)]))
+                if schedule.split_w:
+                    iv["w_buf"].append((m, fin[("B", v, m)],
+                                        fin[("W", v, m)]))
+            yield s, c, iv
 
 
 def schedule_buffer_bounds(schedule: Schedule) -> Dict[str, int]:
@@ -108,7 +118,7 @@ def schedule_buffer_bounds(schedule: Schedule) -> Dict[str, int]:
             best = max(best, live)
         return best
     out = {"in_buf": 0, "cot_buf": 1, "w_buf": 0}
-    for _, iv in _stage_intervals(schedule):
+    for _, _, iv in _stage_intervals(schedule):
         for name in out:
             out[name] = max(out[name], peak(iv[name]))
     if not schedule.split_w:
@@ -121,7 +131,7 @@ def _check_slots(schedule: Schedule, K: int, KC: int, KW: int) -> None:
     m % K while a DIFFERENT live microbatch occupies it is a hard error
     (would corrupt an activation). Guards the contiguous-window assumption
     the modulo slotting relies on."""
-    def check(intervals, nslots, name, stage):
+    def check(intervals, nslots, name, stage, chunk):
         occupied: Dict[int, Tuple[int, int]] = {}
         for m, a, b in sorted(intervals, key=lambda iv: iv[1]):
             slot = m % nslots
@@ -129,21 +139,23 @@ def _check_slots(schedule: Schedule, K: int, KC: int, KW: int) -> None:
                 m0, b0 = occupied[slot]
                 if a < b0 and m0 != m:
                     raise AssertionError(
-                        f"{name} slot collision at stage {stage}: mb {m} "
-                        f"overwrites live mb {m0} (slots={nslots})")
+                        f"{name} slot collision at stage {stage} chunk "
+                        f"{chunk}: mb {m} overwrites live mb {m0} "
+                        f"(slots={nslots})")
             occupied[slot] = (m, b)
     sizes = {"in_buf": K, "cot_buf": KC, "w_buf": KW}
-    for s, iv in _stage_intervals(schedule):
+    for s, c, iv in _stage_intervals(schedule):
         for name, nslots in sizes.items():
             if name == "w_buf" and not schedule.split_w:
                 continue
-            check(iv[name], nslots, name, s)
+            check(iv[name], nslots, name, s, c)
 
 
 def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,
                             head_fn: Callable, mesh: Mesh,
                             stacked_params: Dict[str, Any], head_params,
-                            microbatches, labels, extra_args=()):
+                            microbatches, labels, extra_args=(),
+                            mb_auto_spec: Any = None):
     """Execute `schedule` over the pp axis of `mesh`; returns the SUMMED
     loss (caller normalizes). Differentiable in (stacked_params,
     head_params, microbatches).
@@ -154,27 +166,37 @@ def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,
     stacked_params: {name: [S, L/S, ...]}, dim 0 on pp.
     microbatches: [M, mb, ...] stage-0 inputs (already embedded).
     labels: [M, mb, ...] int labels per microbatch.
+    mb_auto_spec: optional PartitionSpec giving ONE microbatch's sharding
+      over the AUTO (non-pp) mesh axes, e.g. P(("dp","sharding"), "sep",
+      None) for [mb, S, H]. Required when microbatches arrive sharded on
+      an auto axis like `sep`: the lax.switch branches each produce
+      mb-shaped values (real activations vs. fresh zeros) whose inferred
+      shardings differ, and the SPMD partitioner cannot unify branch
+      outputs under partial-manual sharding (CHECK at
+      spmd_partitioner_util.cc:495). Pinning every mb-shaped value to one
+      explicit sharding keeps the branches consistent.
     """
     S = mesh.shape[PP_AXIS]
     M = schedule.n_microbatches
+    C = schedule.n_chunks
     if schedule.n_stages != S:
         raise ValueError(f"schedule has {schedule.n_stages} stages, "
                          f"mesh pp={S}")
-    if schedule.n_chunks != 1:
-        raise ValueError("scheduled executor supports n_chunks=1; use "
-                         "spmd_pipeline_interleaved for VPP")
+    if C > 1 and schedule.split_w:
+        raise ValueError("chunked (VPP) timetables with split wgrad are "
+                         "not supported (upstream VPP is F/B only)")
+    if C > 1:
+        # interleaved layout contract: {name: [S, C, L/(S*C), ...]}
+        for k, v in stacked_params.items():
+            if v.ndim < 2 or v.shape[1] != C:
+                raise ValueError(
+                    f"VPP executor expects stacked_params[{k!r}] with "
+                    f"chunk dim {C} at axis 1 (got shape {v.shape}); "
+                    f"stack with stack_layer_params_interleaved")
     if S == 1:
         raise ValueError("pp=1 needs no schedule; use spmd_pipeline")
 
-    upcast = _cpu_f32_upcast(stacked_params, microbatches, extra_args)
-    if upcast is not None:
-        stacked_params, microbatches, extra_args, _ = upcast
-        head_params = jax.tree.map(
-            lambda v: v.astype(jnp.float32)
-            if jnp.issubdtype(v.dtype, jnp.floating)
-            and jnp.dtype(v.dtype).itemsize < 4 else v, head_params)
-
-    phase_np, mb_np = _tables(schedule)
+    phase_np, mb_np, chunk_np = _tables(schedule)
     bounds = schedule_buffer_bounds(schedule)
     K = bounds["in_buf"] + 1          # +1: write-before-read margin
     KC = bounds["cot_buf"] + 1
@@ -183,6 +205,7 @@ def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,
     T = schedule.n_ticks
     phase_tab = jnp.asarray(phase_np)
     mb_tab = jnp.asarray(mb_np)
+    chunk_tab = jnp.asarray(chunk_np)
     down = [(i, (i + 1) % S) for i in range(S)]
     up = [((i + 1) % S, i) for i in range(S)]
 
@@ -192,13 +215,70 @@ def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,
     def _f32_psum(x):
         return jax.lax.psum(x.astype(jnp.float32), PP_AXIS).astype(x.dtype)
 
+    if mb_auto_spec is not None:
+        from jax.sharding import AxisType, NamedSharding
+        # with_sharding_constraint inside the pp-manual shard_map needs
+        # the pp axis TYPED Manual on the sharding's mesh (vma axes must
+        # be Manual); the auto axes keep their Auto type
+        _mesh_mpp = Mesh(
+            mesh.devices, mesh.axis_names,
+            axis_types=tuple(AxisType.Manual if n == PP_AXIS
+                             else AxisType.Auto for n in mesh.axis_names))
+        _mb_shd = NamedSharding(_mesh_mpp, mb_auto_spec)
+
+        def _pin(v):
+            """Pin an mb-shaped value to the caller's auto-axes sharding."""
+            return jax.lax.with_sharding_constraint(v, _mb_shd)
+
+        def _pin_buf(v):
+            """Same, for buffers with extra leading (slot/chunk) dims."""
+            lead = v.ndim - len(mb_shape)
+            shd = NamedSharding(
+                _mesh_mpp, P(*([None] * lead), *tuple(mb_auto_spec)))
+            return jax.lax.with_sharding_constraint(v, shd)
+    else:
+        _pin = _pin_buf = lambda v: v
+
+    # COMPOSITION LIMIT (measured, round 3): a NON-batch microbatch dim
+    # sharded on an auto axis (seq on `sep`) cannot enter this executor.
+    # Attention inside the lax.switch branches then needs seq
+    # all-gathers, which XLA lowers to collective-permutes whose CPU
+    # rendezvous wants every local device — devices in other branches
+    # never arrive (runtime deadlock), and some variants die earlier in
+    # the SPMD partitioner (CHECK spmd_partitioner_util.cc:495). Callers
+    # must gather such axes at the boundary (trainer/pretrain.py does);
+    # in-executor sequence parallelism rides the mp axis (Megatron SP),
+    # and ring/Ulysses context parallelism composes with the COMPILED
+    # pipeline path instead.
+    if mb_auto_spec is not None:
+        for _d, _entry in enumerate(tuple(mb_auto_spec)):
+            if _d == 0 or _entry is None:
+                continue
+            for _ax in (_entry if isinstance(_entry, tuple) else (_entry,)):
+                if mesh.shape.get(_ax, 1) > 1:
+                    raise ValueError(
+                        f"mb_auto_spec {mb_auto_spec} shards non-batch "
+                        f"dim {_d} on axis {_ax!r}: unsupported inside "
+                        f"the timetable executor (in-branch seq "
+                        f"collectives deadlock); gather it at the "
+                        f"boundary first")
+
     def per_device(params, head_p, mbs, labels_, *extra):
-        local = {k: v[0] for k, v in params.items()}   # [L/S, ...]
+        # local slice: [L/S, ...] for C==1, [C, L/(S*C), ...] for VPP
+        local = {k: v[0] for k, v in params.items()}
         stage = jax.lax.axis_index(PP_AXIS)
         zero_mb = jnp.zeros(mb_shape, cdt)
 
         def stage_f(p, x):
             return stage_fn(p, x, *extra)
+
+        def chunk_params(ch):
+            """The chunk's layer-parameter slice (identity for C==1)."""
+            if C == 1:
+                return local
+            return {k: jax.lax.dynamic_index_in_dim(v_, ch, 0,
+                                                    keepdims=False)
+                    for k, v_ in local.items()}
 
         def pv(a):
             """pvary, idempotent: no-op when already device-varying."""
@@ -210,72 +290,90 @@ def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,
         # took other branches. Mark the replicated head params varying
         # BEFORE any vjp; grads are psum'd once at the end instead.
         head_v = jax.tree.map(pv, head_p)
+        # message tuples: (payload, mb, receiver_chunk, valid)
+        zmsg = (pv(jnp.zeros((), jnp.int32)), pv(jnp.zeros((), jnp.int32)),
+                pv(jnp.zeros((), jnp.bool_)))
         carry0 = dict(
-            in_buf=pv(jnp.zeros((K,) + mb_shape, cdt)),
-            cot_buf=pv(jnp.zeros((KC,) + mb_shape, cdt)),
-            wx_buf=pv(jnp.zeros((KW,) + mb_shape, cdt)),
-            wg_buf=pv(jnp.zeros((KW,) + mb_shape, cdt)),
-            dmbs=pv(jnp.zeros((M,) + mb_shape, cdt)),
+            in_buf=_pin_buf(pv(jnp.zeros((C, K) + mb_shape, cdt))),
+            cot_buf=_pin_buf(pv(jnp.zeros((C, KC) + mb_shape, cdt))),
+            wx_buf=_pin_buf(pv(jnp.zeros((C, KW) + mb_shape, cdt))),
+            wg_buf=_pin_buf(pv(jnp.zeros((C, KW) + mb_shape, cdt))),
+            dmbs=_pin_buf(pv(jnp.zeros((M,) + mb_shape, cdt))),
             accp=jax.tree.map(
                 lambda v: pv(jnp.zeros(v.shape, jnp.float32)), local),
             acch=jax.tree.map(
                 lambda v: pv(jnp.zeros(v.shape, jnp.float32)), head_p),
             loss=pv(jnp.zeros((), jnp.float32)),
-            fmsg=(pv(zero_mb), pv(jnp.zeros((), jnp.int32)),
-                  pv(jnp.zeros((), jnp.bool_))),
-            bmsg=(pv(zero_mb), pv(jnp.zeros((), jnp.int32)),
-                  pv(jnp.zeros((), jnp.bool_))),
+            fmsg=(_pin(pv(zero_mb)),) + zmsg,
+            bmsg=(_pin(pv(zero_mb)),) + zmsg,
         )
 
         def tick(carry, t):
             c = dict(carry)
-            # 1) deliver last tick's messages (1-tick p2p latency)
-            fy, fm, fv = c["fmsg"]
-            recv_f = jnp.logical_and(fv, stage > 0)
-            c["in_buf"] = jax.lax.dynamic_update_index_in_dim(
-                c["in_buf"],
-                jnp.where(recv_f, fy, c["in_buf"][fm % K]), fm % K, 0)
-            by, bm, bv = c["bmsg"]
-            recv_b = jnp.logical_and(bv, stage < S - 1)
-            c["cot_buf"] = jax.lax.dynamic_update_index_in_dim(
-                c["cot_buf"],
-                jnp.where(recv_b, by, c["cot_buf"][bm % KC]), bm % KC, 0)
+            # 1) deliver last tick's messages (1-tick p2p latency).
+            # Sender-side validity decides delivery: the flag rides the
+            # same ppermute, so it arrives exactly at the receiver.
+            fy, fm, frc, fv = c["fmsg"]
+            frc = jnp.clip(frc, 0, C - 1)
+            c["in_buf"] = _pin_buf(c["in_buf"].at[frc, fm % K].set(
+                jnp.where(fv, fy, c["in_buf"][frc, fm % K])))
+            by, bm, brc, bv = c["bmsg"]
+            brc = jnp.clip(brc, 0, C - 1)
+            c["cot_buf"] = _pin_buf(c["cot_buf"].at[brc, bm % KC].set(
+                jnp.where(bv, by, c["cot_buf"][brc, bm % KC])))
 
             ph = phase_tab[stage, t]
             m = mb_tab[stage, t]
-            no_f = (pv(zero_mb), pv(jnp.zeros((), jnp.int32)),
-                    pv(jnp.zeros((), jnp.bool_)))
-            no_b = (pv(zero_mb), pv(jnp.zeros((), jnp.int32)),
-                    pv(jnp.zeros((), jnp.bool_)))
+            ch = chunk_tab[stage, t]
+            vstage = ch * S + stage
+            v_first = vstage == 0           # feeds from mbs, writes dmbs
+            v_last = vstage == S * C - 1    # runs the loss head
+            # hoist every gather of a (possibly auto-sharded) global
+            # buffer OUT of the switch: gathers/reshards of sep-sharded
+            # operands inside a branch either trip the SPMD partitioner
+            # CHECK or deadlock at the resharding collective (devices in
+            # other branches never arrive)
+            mbs_m = _pin(mbs[m])
+            labels_m = labels_[m]
+            local_c = chunk_params(ch)
+            no_f = (_pin(pv(zero_mb)),) + zmsg
+            no_b = (_pin(pv(zero_mb)),) + zmsg
 
             def do_idle(c):
                 return c, no_f, no_b
 
+            # NOTE: no _pin inside the branches below — a sharding
+            # constraint can lower to a collective(-permute), and a
+            # collective inside one switch branch deadlocks the devices
+            # that took other branches (same rule as the pvary note
+            # above). All pins live outside the switch.
             def do_f(c):
-                x = jnp.where(stage == 0, mbs[m], c["in_buf"][m % K])
+                x = jnp.where(v_first, mbs_m, c["in_buf"][ch, m % K])
                 c = dict(c)
-                c["in_buf"] = jax.lax.dynamic_update_index_in_dim(
-                    c["in_buf"], x, m % K, 0)
-                y = stage_f(local, x)
-                fmsg = (y, m, stage < S - 1)
+                c["in_buf"] = c["in_buf"].at[ch, m % K].set(x)
+                y = stage_f(local_c, x)
+                # receiver = virtual stage vstage+1, on device
+                # (stage+1) % S — chunk increments on the S-1 -> 0 hop
+                rc = ch + jnp.where(stage == S - 1, 1, 0)
+                fmsg = (y, m, rc, vstage < S * C - 1)
                 return c, fmsg, no_b
 
             def do_b(c):
-                x = c["in_buf"][m % K]
-                last = stage == S - 1
+                x = c["in_buf"][ch, m % K]
+                last = v_last
                 # ONE stage forward, residuals shared with the backward
                 # (ZBH1 keeps the x-only vjp so W can be deferred)
                 if schedule.split_w:
-                    y, vjp_x = jax.vjp(lambda xx: stage_f(local, xx), x)
+                    y, vjp_x = jax.vjp(lambda xx: stage_f(local_c, xx), x)
                 else:
-                    y, vjp_px = jax.vjp(stage_f, local, x)
+                    y, vjp_px = jax.vjp(stage_f, local_c, x)
                 # the loss head runs ONLY on the last stage (lax.cond is
                 # safe here: with head_v pre-pvary'd no branch contains a
                 # collective); elsewhere the cotangent arrived upstream
 
                 def head_branch():
                     loss, vjp = jax.vjp(
-                        lambda hp_, y_: head_fn(hp_, y_, labels_[m]),
+                        lambda hp_, y_: head_fn(hp_, y_, labels_m),
                         head_v, y)
                     dhp, dy_ = vjp(pv(jnp.ones((), loss.dtype)))
                     return loss.astype(jnp.float32), dy_, dhp
@@ -287,49 +385,69 @@ def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,
                                          head_v))
                 loss_l, dy_l, dhp_l = jax.lax.cond(last, head_branch,
                                                    skip_branch)
-                dy = jnp.where(last, dy_l, c["cot_buf"][m % KC])
+                dy = jnp.where(last, dy_l, c["cot_buf"][ch, m % KC])
                 c = dict(c)
                 c["loss"] = c["loss"] + loss_l
+
+                def acc_params(acc, dp):
+                    """Accumulate the chunk's param grads (full-slice add
+                    for C==1, chunk-row scatter-add for VPP)."""
+                    if C == 1:
+                        return jax.tree.map(
+                            lambda a, g: a + g.astype(jnp.float32),
+                            acc, dp)
+                    return jax.tree.map(
+                        lambda a, g: a.at[ch].set(
+                            a[ch] + g.astype(jnp.float32)), acc, dp)
+
                 if schedule.split_w:
                     # ZBH1: dgrad now (critical path), wgrad deferred
                     (dx,) = vjp_x(dy)
-                    c["wx_buf"] = jax.lax.dynamic_update_index_in_dim(
-                        c["wx_buf"], x, m % KW, 0)
-                    c["wg_buf"] = jax.lax.dynamic_update_index_in_dim(
-                        c["wg_buf"], dy, m % KW, 0)
+                    c["wx_buf"] = c["wx_buf"].at[ch, m % KW].set(x)
+                    c["wg_buf"] = c["wg_buf"].at[ch, m % KW].set(dy)
                 else:
                     dp, dx = vjp_px(dy)
-                    c["accp"] = jax.tree.map(
-                        lambda a, g: a + g.astype(jnp.float32),
-                        c["accp"], dp)
+                    c["accp"] = acc_params(c["accp"], dp)
                 c["acch"] = jax.tree.map(
                     lambda a, g: a + g.astype(jnp.float32),
                     c["acch"], dhp_l)
                 c["dmbs"] = jax.lax.dynamic_update_index_in_dim(
                     c["dmbs"],
-                    jnp.where(stage == 0, dx, c["dmbs"][m]), m, 0)
-                bmsg = (dx, m, stage > 0)
+                    jnp.where(v_first, dx, c["dmbs"][m]), m, 0)
+                # receiver = vstage-1 on device (stage-1) % S — chunk
+                # decrements on the 0 -> S-1 hop
+                rc = ch - jnp.where(stage == 0, 1, 0)
+                bmsg = (dx, m, rc, vstage > 0)
                 return c, no_f, bmsg
 
             def do_w(c):
-                x = c["wx_buf"][m % KW]
-                dy = c["wg_buf"][m % KW]
-                _, vjp_p = jax.vjp(lambda p: stage_f(p, x), local)
+                x = c["wx_buf"][ch, m % KW]
+                dy = c["wg_buf"][ch, m % KW]
+                _, vjp_p = jax.vjp(lambda p: stage_f(p, x), local_c)
                 (dp,) = vjp_p(dy)
                 c = dict(c)
-                c["accp"] = jax.tree.map(
-                    lambda a, g: a + g.astype(jnp.float32), c["accp"], dp)
+                if C == 1:
+                    c["accp"] = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32),
+                        c["accp"], dp)
+                else:
+                    c["accp"] = jax.tree.map(
+                        lambda a, g: a.at[ch].set(
+                            a[ch] + g.astype(jnp.float32)),
+                        c["accp"], dp)
                 return c, no_f, no_b
 
             c, fmsg, bmsg = jax.lax.switch(
                 ph, [do_idle, do_f, do_b, do_w], c)
             # 3) rotate messages
-            c["fmsg"] = (jax.lax.ppermute(fmsg[0], PP_AXIS, down),
-                         jax.lax.ppermute(fmsg[1], PP_AXIS, down),
-                         jax.lax.ppermute(fmsg[2], PP_AXIS, down))
-            c["bmsg"] = (jax.lax.ppermute(bmsg[0], PP_AXIS, up),
-                         jax.lax.ppermute(bmsg[1], PP_AXIS, up),
-                         jax.lax.ppermute(bmsg[2], PP_AXIS, up))
+            c["fmsg"] = tuple(
+                (_pin if i == 0 else (lambda z: z))(
+                    jax.lax.ppermute(v_, PP_AXIS, down))
+                for i, v_ in enumerate(fmsg))
+            c["bmsg"] = tuple(
+                (_pin if i == 0 else (lambda z: z))(
+                    jax.lax.ppermute(v_, PP_AXIS, up))
+                for i, v_ in enumerate(bmsg))
             return c, None
 
         c, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
@@ -375,4 +493,6 @@ def scheduled_pipeline_loss(schedule: Schedule, stage_fn: Callable,
                 scale(dmbs))
 
     run.defvjp(run_fwd, run_bwd)
-    return run(stacked_params, head_params, microbatches)
+    from .parallel_layers import suppress_sequence_parallel_annotations
+    with suppress_sequence_parallel_annotations():
+        return run(stacked_params, head_params, microbatches)

@@ -47,6 +47,26 @@ class ParamAttr:
                 f"trainable={self.trainable})")
 
 
+def apply_param_attr(p, attr: Optional["ParamAttr"],
+                     name: Optional[str] = None):
+    """Bind a ParamAttr's non-initializer fields onto a Parameter —
+    shared by paddle.create_parameter AND nn.Layer.create_parameter so
+    need_clip / learning_rate / regularizer / trainable work for layer
+    weights too (the optimizer and ClipGradByGlobalNorm read them)."""
+    if name is not None:
+        p.name = name
+    elif attr is not None and attr.name is not None:
+        p.name = attr.name
+    if attr is not None:
+        p.trainable = attr.trainable
+        p.stop_gradient = not attr.trainable
+        if attr.learning_rate != 1.0:
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+    return p
+
+
 def create_parameter(shape, dtype="float32", name: Optional[str] = None,
                      attr: Any = None, is_bias: bool = False,
                      default_initializer=None):
@@ -64,13 +84,4 @@ def create_parameter(shape, dtype="float32", name: Optional[str] = None,
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
     dt = convert_dtype(dtype) or "float32"
     p = Parameter(init(list(shape), dt))
-    p.name = name if name is not None else (
-        attr.name if attr is not None else None)
-    if attr is not None:
-        p.trainable = attr.trainable
-        p.stop_gradient = not attr.trainable
-        if attr.learning_rate != 1.0:
-            p.optimize_attr = {"learning_rate": attr.learning_rate}
-        p.regularizer = attr.regularizer
-        p.need_clip = attr.need_clip
-    return p
+    return apply_param_attr(p, attr, name)

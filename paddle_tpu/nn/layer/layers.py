@@ -132,8 +132,13 @@ class Layer:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         from ...framework.lazy import lazy_enabled, _make_lazy_parameter
         if lazy_enabled():
-            return _make_lazy_parameter(init, shape, dt)
-        return Parameter(init(shape, dt))
+            p = _make_lazy_parameter(init, shape, dt)
+        else:
+            p = Parameter(init(shape, dt))
+        # honor the non-initializer ParamAttr fields (need_clip,
+        # learning_rate, regularizer, trainable) on layer weights too
+        from ...framework.param_attr import ParamAttr, apply_param_attr
+        return apply_param_attr(p, ParamAttr._to_attr(attr))
 
     def register_buffer(self, name: str, tensor: Optional[Tensor],
                         persistable: bool = True) -> None:

@@ -139,14 +139,20 @@ class Optimizer:
     # ParamAttr regularizer (which overrides optimizer-level decay)
     _wd_skip_param = False
 
+    def _decoupled_wd_coeff(self) -> float:
+        """The effective decoupled-decay coefficient for the CURRENT
+        param: 0 when its ParamAttr regularizer overrides, else the
+        float weight_decay or an L1/L2Decay instance's coeff."""
+        if self._wd_skip_param:
+            return 0.0
+        wd = self._weight_decay
+        return float(wd) if isinstance(wd, (int, float)) \
+            else float(getattr(wd, "coeff", 0.0))
+
     def _apply_decoupled_wd(self, w, lr_v):
         """AdamW-style decoupled weight decay (float coeff, or the coeff
         of an L2Decay/L1Decay regularizer instance)."""
-        if self._wd_skip_param:
-            return w
-        wd = self._weight_decay
-        coeff = wd if isinstance(wd, (int, float)) \
-            else float(getattr(wd, "coeff", 0.0))
+        coeff = self._decoupled_wd_coeff()
         if coeff:
             return w * (1.0 - lr_v * coeff)
         return w
@@ -354,7 +360,7 @@ class AdamW(Adam):
         self._decay_pids = None
 
     def _update_param(self, p, grad, lr_v):
-        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
+        wd = self._decoupled_wd_coeff()
         do_decay = True
         if self._apply_decay_fn is not None:
             do_decay = self._apply_decay_fn(p.name) if p.name else True
@@ -423,7 +429,7 @@ class Lamb(Optimizer):
         mhat = m / (1 - self._b1 ** t)
         vhat = v / (1 - self._b2 ** t)
         r = mhat / (jnp.sqrt(vhat) + self._eps)
-        wd = self._weight_decay if isinstance(self._weight_decay, float) else 0.0
+        wd = self._decoupled_wd_coeff()
         if self._exclude_fn is not None and self._exclude_fn(p):
             wd = 0.0
         upd = r + wd * w

@@ -85,11 +85,15 @@ class PretrainConfig:
         #                live activations by stage depth, ZBH1 also fills
         #                bubbles with deferred weight-grads). Timetable
         #                modes imply stage-level remat and require vpp=1.
-        if pp_schedule not in ("compiled", "1F1B", "ZBH1", "FThenB"):
+        if pp_schedule not in ("compiled", "1F1B", "ZBH1", "FThenB", "VPP"):
             raise ValueError(f"unknown pp_schedule {pp_schedule!r}")
-        if pp_schedule != "compiled" and vpp > 1:
-            raise ValueError("timetable pp_schedule requires vpp=1 "
-                             "(interleaving is the compiled path's job)")
+        if pp_schedule == "VPP" and vpp <= 1:
+            raise ValueError("pp_schedule='VPP' needs vpp>1 virtual "
+                             "chunks per stage")
+        if vpp > 1 and pp_schedule not in ("compiled", "VPP", "1F1B"):
+            raise ValueError(f"pp_schedule={pp_schedule!r} does not "
+                             f"support vpp>1 (use 'VPP' for the "
+                             f"interleaved timetable executor)")
         if pp_schedule != "compiled" and pp <= 1:
             raise ValueError(f"pp_schedule={pp_schedule!r} requires "
                              f"pp>1 (got pp={pp}); a single stage has "
@@ -272,13 +276,26 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
     if use_timetable:
         from ..distributed.pp_exec import scheduled_pipeline_loss
         from ..distributed.pp_schedule import generate_schedule
-        pp_timetable = generate_schedule(cfg.pp_schedule, n_stages, M)
+        # vpp>1 with a timetable mode runs the interleaved (VPP)
+        # schedule through the chunked executor
+        if cfg.vpp > 1:
+            pp_timetable = generate_schedule("VPP", n_stages, M,
+                                             n_chunks=cfg.vpp)
+        else:
+            pp_timetable = generate_schedule(cfg.pp_schedule, n_stages, M)
         pp_timetable.validate()
 
-    def _rms_head_loss(norm_w, w_head, h, labels_h, constrain=False):
+    def _rms_head_loss(norm_w, w_head, h, labels_h, constrain=False,
+                       onehot_pick=False):
         """final RMSNorm + chunked-CE SUM over h [.., S, H]. constrain
         adds the logits sharding hint (outer-graph path only — inside the
-        timetable executor's shard_map the pp axis is manual)."""
+        timetable executor's shard_map the pp axis is manual).
+        onehot_pick replaces the label-pick gather with a one-hot
+        contraction: under the executor's partial-manual sharding a
+        take_along_axis on sep-sharded logits trips the SPMD
+        partitioner's device-group factorization CHECK
+        (spmd_partitioner_util.cc:495); the contraction partitions
+        cleanly (and rides the MXU)."""
         h32 = h.astype(jnp.float32)
         hn = (h32 * jax.lax.rsqrt(
             jnp.mean(jnp.square(h32), -1, keepdims=True) + mc.rms_norm_eps)
@@ -293,8 +310,13 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
                     NamedSharding(mesh, P(("dp", "sharding"), None, "mp")))
             logits32 = logits.astype(jnp.float32)
             lse = jax.scipy.special.logsumexp(logits32, axis=-1)
-            picked = jnp.take_along_axis(
-                logits32, labels_c[..., None], axis=-1)[..., 0]
+            if onehot_pick:
+                oh = jax.nn.one_hot(labels_c, logits32.shape[-1],
+                                    dtype=logits32.dtype)
+                picked = (logits32 * oh).sum(-1)
+            else:
+                picked = jnp.take_along_axis(
+                    logits32, labels_c[..., None], axis=-1)[..., 0]
             return (lse - picked).sum()
 
         n_chunks = min(cfg.ce_chunks, S)
@@ -310,11 +332,18 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
         x = jnp.take(emb, ids, axis=0)  # [B,S,H]
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(("dp", "sharding"), "sep", None)))
-        mbs = x.reshape((M, B // M) + x.shape[1:])
         if use_timetable:
             # 1F1B/ZBH1/FThenB: the loss head runs ON the last stage
             # inside the executor (the cotangent seeds the interleaved
-            # backward); embedding still differentiates through d_mbs
+            # backward); embedding still differentiates through d_mbs.
+            # The sep axis is GATHERED at this boundary: seq-sharded
+            # operands inside the executor's switch branches deadlock
+            # (see pp_exec composition-limit note); in-executor seq
+            # parallelism rides mp (Megatron SP), ring context
+            # parallelism composes with the compiled path instead.
+            x_pp = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(("dp", "sharding"), None, None)))
+            mbs = x_pp.reshape((M, B // M) + x_pp.shape[1:])
             if head_key in compute_params["outer"]:
                 w_head = compute_params["outer"][head_key]
             else:
@@ -322,13 +351,20 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
             hp = {"norm": compute_params["outer"][norm_key],
                   "head": w_head}
             labels_mb = labels.reshape((M, B // M, S))
+            # one-hot label pick only where it's needed (sep axis in the
+            # mesh): it dodges the partitioner CHECK on the gather but
+            # costs an O(tokens x vocab) one-hot per CE chunk
+            use_onehot = mesh.shape.get("sep", 1) > 1
             total = scheduled_pipeline_loss(
                 pp_timetable, stage_fn,
                 lambda hp_, y, lab: _rms_head_loss(hp_["norm"],
-                                                   hp_["head"], y, lab),
+                                                   hp_["head"], y, lab,
+                                                   onehot_pick=use_onehot),
                 mesh, compute_params["stacked"], hp, mbs, labels_mb,
-                extra_args=(cos.astype(x.dtype), sin.astype(x.dtype)))
+                extra_args=(cos.astype(x.dtype), sin.astype(x.dtype)),
+                mb_auto_spec=P(("dp", "sharding"), None, None))
             return total / (B * S)
+        mbs = x.reshape((M, B // M) + x.shape[1:])
         # remat="full" keeps the stage-level checkpoint (per-tick
         # residual = stage input only, GPipe footprint); for "dots"/"none"
         # the stage body owns the policy — an outer checkpoint would

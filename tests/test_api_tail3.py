@@ -277,6 +277,52 @@ class TestReviewRegressions:
         with pytest.raises(RuntimeError, match="apply"):
             x.apply(lambda t: t * 2)
 
+    def test_adamw_honors_l2decay_object_and_param_override(self):
+        # AdamW(weight_decay=L2Decay(c)) must decay with coeff c...
+        p1 = paddle.create_parameter([2], attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Constant(10.0)))
+        p1._grad = paddle.zeros([2])
+        opt1 = paddle.optimizer.AdamW(
+            learning_rate=1.0, parameters=[p1],
+            weight_decay=paddle.regularizer.L2Decay(0.1))
+        opt1.step()
+        np.testing.assert_allclose(np.asarray(p1.numpy()), 9.0, rtol=1e-5)
+        # ...and a param-level regularizer OVERRIDES the decoupled decay
+        # (L2Decay(0.0) = "no decay on this param", the paddle idiom)
+        p2 = paddle.create_parameter([2], attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Constant(10.0),
+            regularizer=paddle.regularizer.L2Decay(0.0)))
+        p2._grad = paddle.zeros([2])
+        opt2 = paddle.optimizer.AdamW(learning_rate=1.0, parameters=[p2],
+                                      weight_decay=0.1)
+        opt2.step()
+        np.testing.assert_allclose(np.asarray(p2.numpy()), 10.0,
+                                   rtol=1e-6)
+
+    def test_layer_weight_attr_fields_bound(self):
+        lin = paddle.nn.Linear(
+            4, 2, weight_attr=paddle.ParamAttr(
+                learning_rate=0.5, need_clip=False,
+                regularizer=paddle.regularizer.L2Decay(1e-3)))
+        w = lin.weight
+        assert w.need_clip is False
+        assert w.optimize_attr["learning_rate"] == 0.5
+        assert isinstance(w.regularizer, paddle.regularizer.L2Decay)
+
+    def test_sp_suppression_is_thread_local(self):
+        import threading
+        from paddle_tpu.distributed.parallel_layers import (
+            _sp_state, suppress_sequence_parallel_annotations)
+        seen = {}
+
+        def other_thread():
+            seen["off"] = getattr(_sp_state, "off", False)
+        with suppress_sequence_parallel_annotations():
+            t = threading.Thread(target=other_thread)
+            t.start()
+            t.join()
+        assert seen["off"] is False
+
 
 class TestRegularizer:
     def test_l1_l2_terms(self):

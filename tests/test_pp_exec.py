@@ -189,3 +189,142 @@ def test_pretrain_step_zbh1_runs():
         meta["data_sharding"])
     st, m = step(st, ids, ids)
     assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# Chunked (interleaved VPP) timetable executor — VERDICT r2 item 2
+# ---------------------------------------------------------------------------
+def test_vpp_executor_matches_sequential_autodiff(mesh):
+    """Interleaved schedule (n_chunks=2) through the chunked executor:
+    loss + grads vs sequential autodiff over the vstage-ordered stack."""
+    from paddle_tpu.distributed.pp_schedule import interleaved_1f1b_schedule
+    CH = 2
+    schedule = interleaved_1f1b_schedule(S, M, CH)
+    schedule.validate()
+    rng = np.random.RandomState(3)
+    # [S, CH, 1, H, H]: vstage v = c*S + s applies stacked[:, c][s]
+    stacked = {
+        "w": jnp.asarray(rng.standard_normal((S, CH, 1, H, H)) * 0.3,
+                         jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((S, CH, 1, H)) * 0.1,
+                         jnp.float32),
+    }
+    head = {"wout": jnp.asarray(rng.standard_normal((H, C)) * 0.3,
+                                jnp.float32)}
+    mbs = jnp.asarray(rng.standard_normal((M, MB, H)), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, C, (M, MB)), jnp.int32)
+
+    def ref(sp, hp, xb):
+        total = 0.0
+        for m in range(M):
+            x = xb[m]
+            for v in range(S * CH):
+                s, c = v % S, v // S
+                x = stage_fn({"w": sp["w"][s, c], "b": sp["b"][s, c]}, x)
+            total = total + head_fn(hp, x, labels[m])
+        return total
+
+    ref_l, ref_g = jax.value_and_grad(ref, argnums=(0, 1, 2))(
+        stacked, head, mbs)
+
+    def run(sp, hp, xb):
+        return scheduled_pipeline_loss(schedule, stage_fn, head_fn, mesh,
+                                       sp, hp, xb, labels)
+    got_l, got_g = jax.value_and_grad(run, argnums=(0, 1, 2))(
+        stacked, head, mbs)
+    np.testing.assert_allclose(float(got_l), float(ref_l),
+                               rtol=1e-5, atol=1e-5)
+    for rg, gg, part in zip(ref_g, got_g, ["stacked", "head", "mbs"]):
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-4,
+            err_msg=part), rg, gg)
+
+
+def test_vpp_schedule_shrinks_warmup_bubble():
+    from paddle_tpu.distributed.pp_schedule import interleaved_1f1b_schedule
+    s1 = one_f_one_b_schedule(S, 8)
+    s2 = interleaved_1f1b_schedule(S, 8, 2)
+    assert s2.bubble_ratio() < s1.bubble_ratio()
+
+
+def test_pretrain_step_vpp_timetable_matches_compiled():
+    """pp_schedule='VPP' (chunked timetable executor) vs the compiled
+    interleaved pipeline on the flagship step."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import llama_tiny_config
+    from paddle_tpu.trainer.pretrain import (PretrainConfig,
+                                             build_llama_pretrain_step,
+                                             make_hybrid_mesh_for)
+
+    def build(pp_schedule):
+        paddle.seed(77)
+        mc = llama_tiny_config(num_hidden_layers=4,
+                               max_position_embeddings=64,
+                               sequence_parallel=False)
+        cfg = PretrainConfig(mc, global_batch=4, seq_len=32,
+                             n_microbatches=4, dp=1, mp=2, pp=2,
+                             sharding=1, sep=1, vpp=2,
+                             pp_schedule=pp_schedule)
+        mesh = make_hybrid_mesh_for(cfg, devices=jax.devices()[:4])
+        return mc, build_llama_pretrain_step(cfg, mesh)
+
+    mc, (st_a, step_a, meta_a) = build("compiled")
+    _, (st_b, step_b, meta_b) = build("VPP")
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, mc.vocab_size, (4, 32)), jnp.int32)
+    ids_a = jax.device_put(ids, meta_a["data_sharding"])
+    ids_b = jax.device_put(ids, meta_b["data_sharding"])
+    st_a, ma = step_a(st_a, ids_a, ids_a)
+    st_b, mb = step_b(st_b, ids_b, ids_b)
+    np.testing.assert_allclose(float(mb["loss"]), float(ma["loss"]),
+                               rtol=5e-4)
+
+
+def test_pretrain_step_1f1b_composes_with_sep_axis():
+    """1F1B x mp x sep (VERDICT r2 item 2): the timetable executor on a
+    mesh WITH a sep axis + Megatron-SP annotations. The executor gathers
+    the sep sharding at its boundary (in-branch seq collectives deadlock
+    — see pp_exec composition note), so the loss must match the sep-less
+    run bit-for-bit-ish."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import llama_tiny_config
+    from paddle_tpu.trainer.pretrain import (PretrainConfig,
+                                             build_llama_pretrain_step,
+                                             make_hybrid_mesh_for)
+
+    def build(sep, ndev):
+        paddle.seed(55)
+        mc = llama_tiny_config(num_hidden_layers=4,
+                               max_position_embeddings=64,
+                               sequence_parallel=True)
+        cfg = PretrainConfig(mc, global_batch=4, seq_len=32,
+                             n_microbatches=4, dp=1, mp=2, pp=2,
+                             sharding=1, sep=sep, pp_schedule="1F1B")
+        mesh = make_hybrid_mesh_for(cfg, devices=jax.devices()[:ndev])
+        return mc, build_llama_pretrain_step(cfg, mesh)
+
+    mc, (st_a, step_a, meta_a) = build(1, 4)
+    _, (st_b, step_b, meta_b) = build(2, 8)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, mc.vocab_size, (4, 32)), jnp.int32)
+    ids_a = jax.device_put(ids, meta_a["data_sharding"])
+    ids_b = jax.device_put(ids, meta_b["data_sharding"])
+    st_a, ma = step_a(st_a, ids_a, ids_a)
+    st_b, mb = step_b(st_b, ids_b, ids_b)
+    np.testing.assert_allclose(float(mb["loss"]), float(ma["loss"]),
+                               rtol=1e-4)
+
+
+def test_seq_sharded_mb_auto_spec_rejected():
+    """The composition limit is a loud error, not a hang."""
+    from jax.sharding import PartitionSpec as P
+    schedule = one_f_one_b_schedule(2, M)
+    stacked, head, mbs, labels = _setup()
+    stacked = {k: v.reshape((2, 2 * LS) + v.shape[2:])
+               for k, v in stacked.items()}
+    mesh8 = build_hybrid_mesh(pp_degree=2, sep_degree=2, mp_degree=2,
+                              devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="gather it at the boundary"):
+        scheduled_pipeline_loss(
+            schedule, stage_fn, head_fn, mesh8, stacked, head, mbs,
+            labels, mb_auto_spec=P(None, "sep"))
