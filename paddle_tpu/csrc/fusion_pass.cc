@@ -1,0 +1,693 @@
+// ptpu_fusion — C++ StableHLO pattern-fusion pass (CINN parity).
+//
+// Reference capability: paddle/cinn/hlir/dialect/operator/transforms/ —
+// ApplyCinnPass pattern-matches fusible subgraphs on the static program
+// and swaps them for compiled JIT-kernel ops (SURVEY §2.1 "CINN fusion
+// compiler", §7.1 L8). TPU-native reading: the static program IS the
+// StableHLO module jax lowers; this pass pattern-matches attention /
+// rmsnorm / swiglu regions in the MODULE TEXT, and rewrites the matched
+// region into a func.call to a (Pallas) kernel function the Python
+// driver lowers and hands in. The rewritten module is re-verified by
+// MLIR (ir.Module.parse on the Python side) and compiled by PJRT.
+//
+// Two C entry points, driven by paddle_tpu/jit/fusion_cc.py:
+//   ptpu_fusion_analyze(text)        -> JSON match report
+//   ptpu_fusion_rewrite(text, plan)  -> rewritten module text
+// The pass is dependency-free (no MLIR libs in this environment): it
+// parses the one-op-per-line textual form the jax printer emits and is
+// conservative — anything it does not recognize is left untouched.
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Op {
+  std::string result;                // "%13" ("" for return/non-value)
+  std::string name;                  // "stablehlo.dot_general"
+  std::vector<std::string> operands; // every %id on the rhs
+  std::string line;                  // original text
+  int idx = -1;                      // index into lines[]
+};
+
+struct Func {
+  std::string header;  // the func.func line
+  int begin = -1;      // line index of header
+  int end = -1;        // line index of closing brace
+  std::vector<Op> ops;
+  std::map<std::string, int> def;       // %id -> op index in ops
+  std::map<std::string, int> nuses;     // %id -> use count (incl. return)
+  std::map<std::string, std::string> argtype;  // %argN -> tensor<...>
+};
+
+static std::string trim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+// every %identifier in `s`
+static std::vector<std::string> percent_ids(const std::string& s) {
+  std::vector<std::string> out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] != '%') continue;
+    size_t j = i + 1;
+    while (j < s.size() &&
+           (isalnum((unsigned char)s[j]) || s[j] == '_')) j++;
+    if (j > i + 1) out.push_back(s.substr(i, j - i));
+    i = j - 1;
+  }
+  return out;
+}
+
+static std::string op_name_of(const std::string& rhs) {
+  size_t i = 0;
+  while (i < rhs.size() && rhs[i] != ' ' && rhs[i] != '(') i++;
+  return rhs.substr(0, i);
+}
+
+// trailing result type: text after the last "-> " or after " : " for
+// same-type ops ("%9 = stablehlo.exponential %8 : tensor<...>")
+static std::string result_type_of(const std::string& line) {
+  size_t arrow = line.rfind("-> ");
+  if (arrow != std::string::npos) {
+    std::string t = trim(line.substr(arrow + 3));
+    if (!t.empty() && t[0] == '(') {  // multi-result "(tensor<..>, ..)"
+      return t;
+    }
+    return t;
+  }
+  size_t colon = line.rfind(" : ");
+  if (colon != std::string::npos) return trim(line.substr(colon + 3));
+  return "";
+}
+
+// parse "func.func public @main(%arg0: tensor<...>, ...)" arg types
+static void parse_args(const std::string& header, Func* f) {
+  size_t lp = header.find('(');
+  if (lp == std::string::npos) return;
+  // walk to matching ')' at depth 0 (types contain no parens)
+  int depth = 0;
+  size_t rp = lp;
+  for (size_t i = lp; i < header.size(); ++i) {
+    if (header[i] == '(') depth++;
+    if (header[i] == ')') { depth--; if (depth == 0) { rp = i; break; } }
+  }
+  std::string args = header.substr(lp + 1, rp - lp - 1);
+  std::stringstream ss(args);
+  std::string piece;
+  // split on commas at angle-bracket depth 0
+  std::vector<std::string> pieces;
+  int adepth = 0; std::string cur;
+  for (char c : args) {
+    if (c == '<' || c == '{') adepth++;
+    if (c == '>' || c == '}') adepth--;
+    if (c == ',' && adepth == 0) { pieces.push_back(cur); cur.clear(); }
+    else cur += c;
+  }
+  if (!trim(cur).empty()) pieces.push_back(cur);
+  for (auto& p : pieces) {
+    std::string t = trim(p);
+    size_t colon = t.find(':');
+    if (colon == std::string::npos) continue;
+    std::string id = trim(t.substr(0, colon));
+    std::string ty = trim(t.substr(colon + 1));
+    size_t brace = ty.find(" {");
+    if (brace != std::string::npos) ty = ty.substr(0, brace);
+    f->argtype[id] = ty;
+  }
+}
+
+struct Module {
+  std::vector<std::string> lines;
+  std::vector<Func> funcs;
+  int module_close = -1;  // index of final '}'
+};
+
+static Module parse_module(const std::string& text) {
+  Module m;
+  std::stringstream ss(text);
+  std::string line;
+  while (std::getline(ss, line)) m.lines.push_back(line);
+  for (int i = (int)m.lines.size() - 1; i >= 0; --i) {
+    if (trim(m.lines[i]) == "}") { m.module_close = i; break; }
+  }
+  for (int i = 0; i < (int)m.lines.size(); ++i) {
+    std::string t = trim(m.lines[i]);
+    if (t.rfind("func.func", 0) != 0) continue;
+    Func f;
+    f.header = t;
+    f.begin = i;
+    parse_args(t, &f);
+    // body until the matching close — jax prints one brace depth
+    for (int j = i + 1; j < (int)m.lines.size(); ++j) {
+      std::string b = trim(m.lines[j]);
+      if (b == "}") { f.end = j; break; }
+      Op op;
+      op.idx = j;
+      op.line = m.lines[j];
+      if (!b.empty() && b[0] == '%') {
+        size_t eq = b.find(" = ");
+        if (eq != std::string::npos) {
+          op.result = trim(b.substr(0, eq));
+          std::string rhs = b.substr(eq + 3);
+          op.name = op_name_of(rhs);
+          op.operands = percent_ids(rhs);
+        }
+      } else {
+        op.name = op_name_of(b);
+        op.operands = percent_ids(b);
+      }
+      if (!op.result.empty()) f.def[op.result] = (int)f.ops.size();
+      for (auto& o : op.operands) f.nuses[o]++;
+      f.ops.push_back(op);
+    }
+    m.funcs.push_back(f);
+    i = f.end;
+  }
+  return m;
+}
+
+// ---------------------------------------------------------------------------
+// matching
+// ---------------------------------------------------------------------------
+struct Match {
+  std::string pattern;
+  std::vector<std::string> operands;       // SSA ids, call order
+  std::vector<std::string> operand_types;  // tensor<...>
+  std::string result;                      // SSA id of final op
+  std::string result_type;
+  int final_line = -1;
+  std::vector<int> chain_lines;            // interior lines to delete
+  double scale = 1.0;
+  double eps = 0.0;
+};
+
+struct Ctx {
+  const Func& f;
+  explicit Ctx(const Func& f_) : f(f_) {}
+  const Op* def(const std::string& id) const {
+    auto it = f.def.find(id);
+    return it == f.def.end() ? nullptr : &f.ops[it->second];
+  }
+  int uses(const std::string& id) const {
+    auto it = f.nuses.find(id);
+    return it == f.nuses.end() ? 0 : it->second;
+  }
+  std::string type_of(const std::string& id) const {
+    auto at = f.argtype.find(id);
+    if (at != f.argtype.end()) return at->second;
+    const Op* d = def(id);
+    return d ? result_type_of(d->line) : "";
+  }
+};
+
+// producer of `id` if its op name matches; single-use enforced for
+// interior links so deleting the chain is safe
+static const Op* follow(const Ctx& c, const std::string& id,
+                        const char* opname, bool need_single_use = true) {
+  const Op* d = c.def(id);
+  if (!d || d->name != std::string(opname)) return nullptr;
+  if (need_single_use && c.uses(id) != 1) return nullptr;
+  return d;
+}
+
+// resolve through stablehlo.convert (bf16 modules), collecting lines
+static std::string through_converts(const Ctx& c, std::string id,
+                                    std::vector<int>* chain) {
+  for (;;) {
+    const Op* d = c.def(id);
+    if (!d || d->name != "stablehlo.convert" || c.uses(id) != 1) return id;
+    if (chain) chain->push_back(d->idx);
+    id = d->operands[0];
+  }
+}
+
+static bool const_value(const Ctx& c, const std::string& id, double* out) {
+  const Op* d = c.def(id);
+  if (!d) return false;
+  std::string src = d->line;
+  if (d->name == "stablehlo.broadcast_in_dim") {
+    const Op* k = c.def(d->operands[0]);
+    if (!k) return false;
+    src = k->line;
+    if (k->name != "stablehlo.constant") return false;
+  } else if (d->name != "stablehlo.constant") {
+    return false;
+  }
+  size_t l = src.find("dense<");
+  if (l == std::string::npos) return false;
+  size_t r = src.find('>', l);
+  std::string v = src.substr(l + 6, r - l - 6);
+  // -inf bit patterns across dtypes: f32 0xFF800000, bf16 0xFF80,
+  // f16 0xFC00
+  if (v == "0xFF800000" || v == "0xFF80" || v == "0xFC00") {
+    *out = -1.0 / 0.0;
+    return true;
+  }
+  char* end = nullptr;
+  *out = strtod(v.c_str(), &end);
+  return end != v.c_str();
+}
+
+// contracting dims of a dot_general line: "contracting_dims = [3] x [2]"
+static bool contracting_dims(const std::string& line, int* lhs, int* rhs) {
+  size_t p = line.find("contracting_dims = [");
+  if (p == std::string::npos) return false;
+  *lhs = atoi(line.c_str() + p + 20);
+  size_t x = line.find("] x [", p);
+  if (x == std::string::npos) return false;
+  *rhs = atoi(line.c_str() + x + 5);
+  return true;
+}
+
+static void match_sdpa(const Ctx& c, std::vector<Match>* out) {
+  for (const Op& fin : c.f.ops) {
+    if (fin.name != "stablehlo.dot_general") continue;
+    int cl, cr;
+    if (!contracting_dims(fin.line, &cl, &cr) || cl != 3 || cr != 2)
+      continue;
+    if (fin.operands.size() < 2) continue;
+    std::vector<int> chain;
+    std::string probs = through_converts(c, fin.operands[0], &chain);
+    std::string v_id = fin.operands[1];
+    const Op* div = follow(c, probs, "stablehlo.divide");
+    if (!div) continue;
+    chain.push_back(div->idx);
+    std::string exp_id = div->operands[0];
+    // denom: broadcast([convert] broadcast([convert] reduce_add(exp)))
+    // — bf16 modules interleave f32-accumulation converts
+    std::string den = through_converts(c, div->operands[1], &chain);
+    const Op* b1 = follow(c, den, "stablehlo.broadcast_in_dim");
+    if (!b1) continue;
+    chain.push_back(b1->idx);
+    den = through_converts(c, b1->operands[0], &chain);
+    const Op* b2 = follow(c, den, "stablehlo.broadcast_in_dim");
+    if (!b2) continue;
+    chain.push_back(b2->idx);
+    den = through_converts(c, b2->operands[0], &chain);
+    const Op* red = follow(c, den, "stablehlo.reduce");
+    if (!red || red->line.find("applies stablehlo.add") == std::string::npos)
+      continue;
+    chain.push_back(red->idx);
+    if (red->operands.empty()) continue;
+    std::string red_src = red->operands[0];
+    {
+      // the reduce may read exp through an f32 convert; that convert is
+      // USED only by the reduce, so it joins the chain
+      const Op* cd = c.def(red_src);
+      if (cd && cd->name == "stablehlo.convert" && c.uses(red_src) == 1 &&
+          cd->operands[0] == exp_id) {
+        chain.push_back(cd->idx);
+        red_src = cd->operands[0];
+      }
+    }
+    if (red_src != exp_id) continue;
+    // exp_id used by divide AND reduce => 2 uses
+    const Op* ex = c.def(exp_id);
+    if (!ex || ex->name != "stablehlo.exponential" || c.uses(exp_id) != 2)
+      continue;
+    chain.push_back(ex->idx);
+    const Op* sub = follow(c, ex->operands[0], "stablehlo.subtract");
+    if (!sub) continue;
+    chain.push_back(sub->idx);
+    std::string logits = sub->operands[0];
+    // max side: bcast(bcast(maximum(bcast(-inf), reduce_max(logits))))
+    const Op* mb1 = follow(c, sub->operands[1], "stablehlo.broadcast_in_dim");
+    if (!mb1) continue;
+    chain.push_back(mb1->idx);
+    const Op* mb2 = follow(c, mb1->operands[0], "stablehlo.broadcast_in_dim");
+    if (!mb2) continue;
+    chain.push_back(mb2->idx);
+    std::string mx = mb2->operands[0];
+    const Op* mxop = c.def(mx);
+    if (!mxop) continue;
+    if (mxop->name == "stablehlo.maximum") {
+      if (c.uses(mx) != 1) continue;
+      chain.push_back(mxop->idx);
+      // one side is broadcast(-inf) constant, other the reduce
+      std::string r;
+      double cv;
+      if (const_value(c, mxop->operands[0], &cv) && cv < -1e30)
+        r = mxop->operands[1];
+      else if (const_value(c, mxop->operands[1], &cv) && cv < -1e30)
+        r = mxop->operands[0];
+      else continue;
+      mx = r;
+      mxop = c.def(mx);
+      if (!mxop) continue;
+    }
+    if (mxop->name != "stablehlo.reduce" ||
+        mxop->line.find("applies stablehlo.maximum") == std::string::npos ||
+        c.uses(mx) != 1)
+      continue;
+    chain.push_back(mxop->idx);
+    if (mxop->operands.empty() || mxop->operands[0] != logits) continue;
+    // logits used by subtract AND reduce_max => 2 uses
+    const Op* lg = c.def(logits);
+    if (!lg || c.uses(logits) != 2) continue;
+    double scale = 1.0;
+    if (lg->name == "stablehlo.multiply") {
+      double cv;
+      std::string other;
+      if (const_value(c, lg->operands[1], &cv)) other = lg->operands[0];
+      else if (const_value(c, lg->operands[0], &cv)) other = lg->operands[1];
+      else continue;
+      scale = cv;
+      chain.push_back(lg->idx);
+      logits = other;
+      lg = c.def(logits);
+      if (!lg || c.uses(logits) != 1) continue;
+    }
+    if (lg->name != "stablehlo.dot_general") continue;
+    int dl, dr;
+    if (!contracting_dims(lg->line, &dl, &dr) || dl != 3 || dr != 3)
+      continue;
+    chain.push_back(lg->idx);
+    Match m;
+    m.pattern = "sdpa";
+    m.operands = {lg->operands[0], lg->operands[1], v_id};
+    for (auto& o : m.operands) m.operand_types.push_back(c.type_of(o));
+    m.result = fin.result;
+    m.result_type = result_type_of(fin.line);
+    m.final_line = fin.idx;
+    m.chain_lines = chain;
+    m.scale = scale;
+    out->push_back(m);
+  }
+}
+
+static void match_rmsnorm(const Ctx& c, std::vector<Match>* out) {
+  for (const Op& rs : c.f.ops) {
+    if (rs.name != "stablehlo.rsqrt") continue;
+    std::vector<int> chain;
+    chain.push_back(rs.idx);
+    const Op* add = follow(c, rs.operands[0], "stablehlo.add");
+    if (!add) continue;
+    chain.push_back(add->idx);
+    double eps;
+    std::string varid;
+    if (const_value(c, add->operands[1], &eps)) varid = add->operands[0];
+    else if (const_value(c, add->operands[0], &eps)) varid = add->operands[1];
+    else continue;
+    const Op* div = follow(c, varid, "stablehlo.divide");
+    if (!div) continue;
+    chain.push_back(div->idx);
+    double n;
+    if (!const_value(c, div->operands[1], &n)) continue;
+    const Op* bc = follow(c, div->operands[0], "stablehlo.broadcast_in_dim");
+    if (!bc) continue;
+    chain.push_back(bc->idx);
+    const Op* red = follow(c, bc->operands[0], "stablehlo.reduce");
+    if (!red || red->line.find("applies stablehlo.add") == std::string::npos)
+      continue;
+    chain.push_back(red->idx);
+    const Op* sq = c.def(red->operands[0]);
+    // chlo.square or multiply(x, x)
+    if (!sq || c.uses(red->operands[0]) != 1) continue;
+    std::string x32;
+    if (sq->name == "chlo.square") x32 = sq->operands[0];
+    else if (sq->name == "stablehlo.multiply" &&
+             sq->operands.size() >= 2 &&
+             sq->operands[0] == sq->operands[1]) x32 = sq->operands[0];
+    else continue;
+    chain.push_back(sq->idx);
+    std::vector<int> cchain;
+    std::string x_root = through_converts(c, x32, &cchain);
+    // x32 may be used by square AND the normalize multiply
+    // forward: rsqrt -> broadcast -> multiply(x, .) -> multiply(., w)
+    if (c.uses(rs.result) != 1) continue;
+    // find the broadcast consumer of rsqrt
+    const Op* nb = nullptr;
+    for (const Op& o : c.f.ops)
+      for (auto& oid : o.operands)
+        if (oid == rs.result) { nb = &o; break; }
+    if (!nb || nb->name != "stablehlo.broadcast_in_dim" ||
+        c.uses(nb->result) != 1)
+      continue;
+    chain.push_back(nb->idx);
+    const Op* mul1 = nullptr;
+    for (const Op& o : c.f.ops)
+      for (auto& oid : o.operands)
+        if (oid == nb->result) { mul1 = &o; break; }
+    if (!mul1 || mul1->name != "stablehlo.multiply") continue;
+    std::string xs = mul1->operands[0] == nb->result ? mul1->operands[1]
+                                                     : mul1->operands[0];
+    if (through_converts(c, xs, nullptr) != x_root && xs != x32) continue;
+    if (c.uses(mul1->result) != 1) continue;
+    chain.push_back(mul1->idx);
+    // optional convert then multiply by broadcast(w)
+    const Op* nxt = nullptr;
+    std::string cur = mul1->result;
+    for (const Op& o : c.f.ops)
+      for (auto& oid : o.operands)
+        if (oid == cur) { nxt = &o; break; }
+    if (nxt && nxt->name == "stablehlo.convert" && c.uses(cur) == 1) {
+      chain.push_back(nxt->idx);
+      cur = nxt->result;
+      const Op* nn = nullptr;
+      for (const Op& o : c.f.ops)
+        for (auto& oid : o.operands)
+          if (oid == cur) { nn = &o; break; }
+      nxt = nn;
+    }
+    if (!nxt || nxt->name != "stablehlo.multiply" || c.uses(cur) != 1)
+      continue;
+    std::string wside = nxt->operands[0] == cur ? nxt->operands[1]
+                                                : nxt->operands[0];
+    std::string w_id = wside;
+    // peel the (possibly stacked) broadcasts jax emits for rank-lift
+    for (;;) {
+      const Op* wb = c.def(w_id);
+      if (!wb || wb->name != "stablehlo.broadcast_in_dim" ||
+          c.uses(w_id) != 1)
+        break;
+      chain.push_back(wb->idx);
+      w_id = wb->operands[0];
+    }
+    // weight must be rank-1
+    std::string wt = c.type_of(w_id);
+    int commas = 0;
+    size_t lt = wt.find('<');
+    for (size_t i = lt; i < wt.size() && wt[i] != '>'; ++i)
+      if (wt[i] == 'x') commas++;
+    if (commas != 1) continue;  // tensor<Nxf32> has exactly one 'x'
+    // the mean divisor must equal the hidden (last) dim of x — anything
+    // else is NOT an RMS mean and must not be fused (semantics differ)
+    {
+      std::string xt = c.type_of(x_root);
+      size_t gt = xt.rfind('x');
+      size_t open = xt.find('<');
+      if (gt == std::string::npos || open == std::string::npos) continue;
+      size_t prev = xt.rfind('x', gt - 1);
+      size_t dim_start = (prev == std::string::npos || prev < open)
+                             ? open + 1 : prev + 1;
+      int last_dim = atoi(xt.substr(dim_start, gt - dim_start).c_str());
+      if (last_dim <= 0 || (double)last_dim != n) continue;
+    }
+    for (int ci : cchain) chain.push_back(ci);
+    Match m;
+    m.pattern = "rmsnorm";
+    m.operands = {x_root, w_id};
+    m.operand_types = {c.type_of(x_root), wt};
+    m.result = nxt->result;
+    m.result_type = result_type_of(nxt->line);
+    m.final_line = nxt->idx;
+    m.chain_lines = chain;
+    m.eps = eps;
+    out->push_back(m);
+  }
+}
+
+static void match_swiglu(const Ctx& c, std::vector<Match>* out) {
+  for (const Op& mul : c.f.ops) {
+    if (mul.name != "stablehlo.multiply" || mul.operands.size() < 2)
+      continue;
+    for (int side = 0; side < 2; ++side) {
+      const Op* call = c.def(mul.operands[side]);
+      if (!call || call->name != "call") continue;
+      if (call->line.find("@silu") == std::string::npos) continue;
+      if (c.uses(mul.operands[side]) != 1) continue;
+      std::string up = mul.operands[1 - side];
+      Match m;
+      m.pattern = "swiglu";
+      m.operands = {call->operands[0], up};
+      m.operand_types = {c.type_of(call->operands[0]), c.type_of(up)};
+      m.result = mul.result;
+      m.result_type = result_type_of(mul.line);
+      m.final_line = mul.idx;
+      m.chain_lines = {call->idx};
+      out->push_back(m);
+      break;
+    }
+  }
+}
+
+// interior results must not be used outside the chain+final
+static bool chain_is_closed(const Ctx& c, const Match& m) {
+  std::set<int> span(m.chain_lines.begin(), m.chain_lines.end());
+  span.insert(m.final_line);
+  // count uses of each interior result across ALL ops; they must all
+  // come from ops inside the span
+  for (int li : m.chain_lines) {
+    const Op* op = nullptr;
+    for (const Op& o : c.f.ops) if (o.idx == li) { op = &o; break; }
+    if (!op || op->result.empty()) continue;
+    int inside = 0;
+    for (const Op& o : c.f.ops) {
+      if (!span.count(o.idx)) continue;
+      for (auto& oid : o.operands) if (oid == op->result) inside++;
+    }
+    if (inside != c.uses(op->result)) return false;
+  }
+  return true;
+}
+
+static std::string json_escape(const std::string& s) {
+  std::string o;
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') { o += '\\'; o += ch; }
+    else o += ch;
+  }
+  return o;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ptpu_free(char* p) { free(p); }
+
+// JSON report: {"matches": [{"id":0,"pattern":"sdpa","operands":[...],
+//   "operand_types":[...],"result":"%14","result_type":"tensor<..>",
+//   "final_line":N,"chain_lines":[...],"scale":..,"eps":..}]}
+char* ptpu_fusion_analyze(const char* module_text) {
+  Module m = parse_module(module_text ? module_text : "");
+  std::vector<Match> all;
+  for (const Func& f : m.funcs) {
+    // every function, not just @main: jax.export wraps the program in a
+    // private func; helper funcs (e.g. @silu) are skipped by dint of
+    // containing no full pattern
+    Ctx c(f);
+    std::vector<Match> ms;
+    match_sdpa(c, &ms);
+    match_rmsnorm(c, &ms);
+    match_swiglu(c, &ms);
+    std::set<int> claimed;
+    for (auto& mt : ms) {
+      if (!chain_is_closed(c, mt)) continue;
+      bool overlap = claimed.count(mt.final_line) > 0;
+      for (int li : mt.chain_lines) overlap |= claimed.count(li) > 0;
+      if (overlap) continue;
+      claimed.insert(mt.final_line);
+      for (int li : mt.chain_lines) claimed.insert(li);
+      all.push_back(mt);
+    }
+  }
+  std::ostringstream js;
+  js << "{\"matches\": [";
+  for (size_t i = 0; i < all.size(); ++i) {
+    const Match& mt = all[i];
+    if (i) js << ", ";
+    js << "{\"id\": " << i << ", \"pattern\": \"" << mt.pattern << "\"";
+    js << ", \"operands\": [";
+    for (size_t j = 0; j < mt.operands.size(); ++j)
+      js << (j ? ", " : "") << "\"" << json_escape(mt.operands[j]) << "\"";
+    js << "], \"operand_types\": [";
+    for (size_t j = 0; j < mt.operand_types.size(); ++j)
+      js << (j ? ", " : "") << "\""
+         << json_escape(mt.operand_types[j]) << "\"";
+    js << "], \"result\": \"" << json_escape(mt.result) << "\"";
+    js << ", \"result_type\": \"" << json_escape(mt.result_type) << "\"";
+    js << ", \"final_line\": " << mt.final_line;
+    js << ", \"chain_lines\": [";
+    for (size_t j = 0; j < mt.chain_lines.size(); ++j)
+      js << (j ? ", " : "") << mt.chain_lines[j];
+    js << "], \"scale\": " << mt.scale << ", \"eps\": " << mt.eps << "}";
+  }
+  js << "]}";
+  return strdup(js.str().c_str());
+}
+
+// plan format (one block per match, in analyze id order):
+//   #MATCH <final_line> <funcname> <n_deleted_lines> <d0> <d1> ...
+//   <replacement function text ... >
+//   #END
+// The call op is synthesized here from the analyze metadata re-derived
+// from the final_line (operand list is passed in-line after funcname as
+// comma-separated ids inside []).
+char* ptpu_fusion_rewrite(const char* module_text, const char* plan) {
+  Module m = parse_module(module_text ? module_text : "");
+  std::vector<std::string> lines = m.lines;
+  std::set<int> deleted;
+  std::map<int, std::string> replacement;  // final_line -> call text
+  std::string funcs_accum;
+
+  std::stringstream ps(plan ? plan : "");
+  std::string pl;
+  while (std::getline(ps, pl)) {
+    if (pl.rfind("#MATCH ", 0) != 0) continue;
+    // #MATCH <final_line> <funcname> <result> <result_type> \t <operands
+    // comma-joined> \t <operand_types comma-joined> \t <deleted
+    // space-joined>
+    std::string rest = pl.substr(7);
+    std::vector<std::string> tabs;
+    {
+      std::string cur;
+      for (char ch : rest) {
+        if (ch == '\t') { tabs.push_back(cur); cur.clear(); }
+        else cur += ch;
+      }
+      tabs.push_back(cur);
+    }
+    if (tabs.size() < 5) continue;
+    std::stringstream h(tabs[0]);
+    int final_line; std::string fname, result, rtype;
+    h >> final_line >> fname >> result;
+    rtype = tabs[1];
+    std::string ops_join = tabs[2], tys_join = tabs[3], dels = tabs[4];
+    // collect function text until #END
+    std::string ftext, fl;
+    while (std::getline(ps, fl)) {
+      if (fl == "#END") break;
+      ftext += fl; ftext += "\n";
+    }
+    funcs_accum += ftext;
+    // deleted lines
+    std::stringstream ds(dels);
+    int d;
+    while (ds >> d) deleted.insert(d);
+    // synthesize the call
+    std::ostringstream call;
+    call << "    " << result << " = call @" << fname << "(";
+    // ops_join comma-separated
+    call << ops_join;
+    call << ") : (";
+    call << tys_join;
+    call << ") -> " << rtype;
+    replacement[final_line] = call.str();
+  }
+
+  std::ostringstream out;
+  for (int i = 0; i < (int)lines.size(); ++i) {
+    if (i == m.module_close && !funcs_accum.empty()) {
+      out << funcs_accum;
+    }
+    if (deleted.count(i)) continue;
+    auto rit = replacement.find(i);
+    if (rit != replacement.end()) {
+      out << rit->second << "\n";
+      continue;
+    }
+    out << lines[i] << "\n";
+  }
+  return strdup(out.str().c_str());
+}
+
+}  // extern "C"

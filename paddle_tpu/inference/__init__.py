@@ -136,6 +136,26 @@ class Predictor:
             n: PredictorTensor(n, jax.ShapeDtypeStruct(s.shape, s.dtype))
             for n, s in zip(self._output_names, self._exported.out_avals)}
         self._call = jax.jit(self._exported.call)
+        # FLAGS_use_fusion_compiler: run the program through the C++
+        # StableHLO fusion pass pipeline (jit/fusion_cc.py — the CINN
+        # ApplyCinnPass analog on the inference path); falls back to the
+        # plain jit path when nothing fuses or the pass is unavailable
+        from ..flags import get_flags
+        if get_flags("FLAGS_use_fusion_compiler")[
+                "FLAGS_use_fusion_compiler"]:
+            try:
+                from ..jit import fusion_cc
+                # ShapeDtypeStructs: lowering needs no device buffers
+                fused = fusion_cc.fuse_compile(self._exported.call,
+                                               *self._in_specs)
+                if fused.n_fused:
+                    self._call = fused
+            except Exception as e:  # explicit opt-in -> observable fallback
+                import warnings
+                warnings.warn(
+                    f"FLAGS_use_fusion_compiler: C++ fusion pipeline "
+                    f"unavailable ({type(e).__name__}: {e}); running the "
+                    f"plain jit path", RuntimeWarning)
 
     # --- paddle_infer API surface ---
     def get_input_names(self) -> List[str]:

@@ -295,10 +295,22 @@ def save(layer: Layer, path: str, input_spec=None, **config) -> None:
     from ..framework.io import save as _save
     _save(layer.state_dict(), path + ".pdparams")
     if input_spec:
+        from ..core.dtypes import convert_dtype
         specs = []
         for s in input_spec:
             if isinstance(s, Tensor):
                 specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+            elif hasattr(s, "shape") and hasattr(s, "dtype"):
+                # static.InputSpec (paddle signature) — dynamic (-1) dims
+                # are not exportable without shape polymorphism; concrete
+                # shapes only
+                shp = tuple(s.shape)
+                if any(d is None or d < 0 for d in shp):
+                    raise ValueError(
+                        f"jit.save needs concrete dims in InputSpec, got "
+                        f"{shp}")
+                specs.append(jax.ShapeDtypeStruct(
+                    shp, convert_dtype(s.dtype) or s.dtype))
             else:
                 specs.append(jax.ShapeDtypeStruct(tuple(s[0]), s[1]))
         # remember EVERY sublayer's mode: a blanket layer.train() on restore

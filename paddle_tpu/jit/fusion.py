@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax.extend import core as jcore
 
 __all__ = ["fuse", "match_sdpa_patterns", "match_rmsnorm_patterns",
-           "match_swiglu_patterns", "PATTERNS"]
+           "match_swiglu_patterns", "match_bias_residual_ln_patterns",
+           "match_moe_dispatch_patterns", "PATTERNS"]
 
 
 def _only_consumer(uses: Dict[Any, List[int]], var, eqn_idx: int) -> bool:
@@ -374,6 +375,223 @@ def _external_uses_keep(eqns, uses, producer, chain: Set[int],
     return remaining if remaining else None
 
 
+def match_bias_residual_ln_patterns(jaxpr) -> List[dict]:
+    """[x + bcast(bias)] + residual -> layer_norm chain (the eval-mode
+    form of the reference's fused_bias_dropout_residual_layer_norm —
+    dropout is identity at inference). Rewritten to the one-kernel
+    ops.fused.fused_bias_residual_layer_norm.
+
+    Chain (as incubate functional traces it):
+        h = add([add(x, bcast(b))], r)
+        mu = div(bcast(reduce_sum(h)), N)
+        var = div(bcast(reduce_sum(square(sub(h, mu)))), N)
+        y = mul(sub(h, mu), rsqrt(var + eps)) [* bcast(w)] [+ bcast(lb)]
+    """
+    eqns = jaxpr.eqns
+    producer: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+    uses = _build_use_map(jaxpr)
+
+    def prod(v):
+        # Literals are unhashable — they also never have producers
+        if isinstance(v, jcore.Literal):
+            return None
+        return eqns[producer[v]] if v in producer else None
+
+    def is_mean_of(var, chain):
+        """div(bcast(reduce_sum(src)), N) -> (src, N) or None."""
+        e_div = prod(var)
+        if e_div is None or e_div.primitive.name != "div" or \
+                not isinstance(e_div.invars[1], jcore.Literal):
+            return None
+        e_bc = prod(e_div.invars[0])
+        if e_bc is None or e_bc.primitive.name != "broadcast_in_dim":
+            return None
+        e_sum = prod(e_bc.invars[0])
+        if e_sum is None or e_sum.primitive.name != "reduce_sum":
+            return None
+        chain.update({producer[var], producer[e_div.invars[0]],
+                      producer[e_bc.invars[0]]})
+        return e_sum.invars[0], float(e_div.invars[1].val)
+
+    matches = []
+    for i, eqn in enumerate(eqns):
+        if eqn.primitive.name != "rsqrt":
+            continue
+        chain: Set[int] = {i}
+        e_add = prod(eqn.invars[0])
+        if e_add is None or e_add.primitive.name != "add":
+            continue
+        lit = [x for x in e_add.invars if isinstance(x, jcore.Literal)]
+        varin = [x for x in e_add.invars if not isinstance(x, jcore.Literal)]
+        if len(lit) != 1 or len(varin) != 1:
+            continue
+        eps = float(lit[0].val)
+        chain.add(producer[eqn.invars[0]])
+        got = is_mean_of(varin[0], chain)
+        if got is None:
+            continue
+        sq_var, n = got
+        e_sq = prod(sq_var)
+        if e_sq is None or e_sq.primitive.name != "square":
+            continue
+        chain.add(producer[sq_var])
+        e_sub = prod(e_sq.invars[0])
+        if e_sub is None or e_sub.primitive.name != "sub":
+            continue
+        chain.add(producer[e_sq.invars[0]])
+        h_var, mu_var = e_sub.invars
+        got2 = is_mean_of(mu_var, chain)
+        if got2 is None or got2[0] is not h_var or got2[1] != n:
+            continue
+        if float(n) != float(h_var.aval.shape[-1]):
+            continue
+        # forward: mul(sub(h, mu), rsqrt) — the sub may be a distinct eqn
+        r_uses = uses.get(eqn.outvars[0], [])
+        if len(r_uses) != 1 or r_uses[0] == -1:
+            continue
+        e_mul = eqns[r_uses[0]]
+        if e_mul.primitive.name != "mul":
+            continue
+        other = [v for v in e_mul.invars if v is not eqn.outvars[0]]
+        if not other or isinstance(other[0], jcore.Literal):
+            continue
+        e_q = prod(other[0])
+        if e_q is None or e_q.primitive.name != "sub" or \
+                e_q.invars[0] is not h_var or e_q.invars[1] is not mu_var:
+            continue
+        chain.add(producer[other[0]])
+        chain.add(r_uses[0])
+        final = r_uses[0]
+        nv = e_mul.outvars[0]
+
+        def bcast_vec(var):
+            if isinstance(var, jcore.Literal):
+                return None, None
+            e = prod(var)
+            if e is not None and e.primitive.name == "broadcast_in_dim" \
+                    and not isinstance(e.invars[0], jcore.Literal) \
+                    and len(e.invars[0].aval.shape) == 1:
+                return e.invars[0], producer[var]
+            return None, None
+
+        w_var = lnb_var = None
+        u2 = uses.get(nv, [])
+        if len(u2) == 1 and u2[0] != -1 and \
+                eqns[u2[0]].primitive.name == "mul":
+            e_w = eqns[u2[0]]
+            side = [v for v in e_w.invars if v is not nv]
+            wv, widx = bcast_vec(side[0]) if side else (None, None)
+            if wv is not None:
+                w_var = wv
+                chain.add(u2[0])
+                chain.add(widx)
+                final = u2[0]
+                nv = e_w.outvars[0]
+                u2 = uses.get(nv, [])
+        if len(u2) == 1 and u2[0] != -1 and \
+                eqns[u2[0]].primitive.name == "add":
+            e_b = eqns[u2[0]]
+            side = [v for v in e_b.invars if v is not nv]
+            bv, bidx = bcast_vec(side[0]) if side else (None, None)
+            if bv is not None:
+                lnb_var = bv
+                chain.add(u2[0])
+                chain.add(bidx)
+                final = u2[0]
+        # upstream: h = add(g, r); g = add(x, bcast(bias)) optional
+        e_h = prod(h_var)
+        if e_h is None or e_h.primitive.name != "add":
+            continue  # need at least the residual add to beat plain LN
+        a0, a1 = e_h.invars
+        if isinstance(a0, jcore.Literal) or isinstance(a1, jcore.Literal):
+            continue
+        if a0.aval.shape != a1.aval.shape:
+            continue
+        chain.add(producer[h_var])
+        x_var, r_var, b_var = a0, a1, None
+        e_g = prod(a0)
+        if e_g is not None and e_g.primitive.name == "add":
+            bv, bidx = bcast_vec(e_g.invars[1])
+            if bv is None:
+                bv, bidx = bcast_vec(e_g.invars[0])
+                other_side = e_g.invars[1]
+            else:
+                other_side = e_g.invars[0]
+            if bv is not None:
+                x_var, r_var, b_var = other_side, a1, bv
+                chain.add(producer[a0])
+                chain.add(bidx)
+        chain.discard(final)  # the final eqn is replaced, not deleted
+        kept = _external_uses_keep(eqns, uses, producer, chain, final)
+        if kept is None:
+            continue
+        matches.append({"pattern": "bias_residual_ln", "final": final,
+                        "chain": kept, "x": x_var, "residual": r_var,
+                        "bias": b_var, "w": w_var, "lnb": lnb_var,
+                        "eps": eps})
+    return matches
+
+
+def match_moe_dispatch_patterns(jaxpr) -> List[dict]:
+    """The GShard gate's dispatch/combine einsum pair:
+
+        dispatch = dot_general(keep, ohk)   # tke,tkc->tec
+        combine  = dot_general(keep, gv*ohk)
+
+    (gv*ohk traces as a batch-batch dot_general). Both contractions plus
+    the scale run in ONE Pallas kernel
+    (ops.fused.fused_moe_dispatch_combine) — a two-output match."""
+    eqns = jaxpr.eqns
+    producer: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+    uses = _build_use_map(jaxpr)
+    pair_dn = (((1,), (1,)), ((0,), (0,)))
+    scale_dn = (((), ()), ((0, 1), (0, 1)))
+    matches = []
+    for i, eqn in enumerate(eqns):
+        # `combine`: its rhs comes from the gv scale dot
+        if eqn.primitive.name != "dot_general" or \
+                eqn.params.get("dimension_numbers") != pair_dn:
+            continue
+        keep_var, bp_var = eqn.invars
+        if isinstance(bp_var, jcore.Literal) or bp_var not in producer:
+            continue
+        e_scale = eqns[producer[bp_var]]
+        if e_scale.primitive.name != "dot_general" or \
+                e_scale.params.get("dimension_numbers") != scale_dn:
+            continue
+        gv_var, ohk_var = e_scale.invars
+        if len(gv_var.aval.shape) != 2:
+            continue
+        # find the sibling dispatch dot: same keep, rhs = ohk directly
+        disp_idx = None
+        for j, ej in enumerate(eqns):
+            if j == i or ej.primitive.name != "dot_general":
+                continue
+            if ej.params.get("dimension_numbers") != pair_dn:
+                continue
+            if ej.invars[0] is keep_var and ej.invars[1] is ohk_var:
+                disp_idx = j
+                break
+        if disp_idx is None:
+            continue
+        # the scale dot is interior; its output must feed only `combine`
+        if uses.get(bp_var, []) != [i]:
+            continue
+        matches.append({
+            "pattern": "moe_dispatch", "final": i,
+            "finals": {disp_idx: 0, i: 1},
+            "chain": {producer[bp_var]},
+            "keep": keep_var, "ohk": ohk_var, "gv": gv_var,
+        })
+    return matches
+
+
 def _flash_eligible_shapes(q_aval, k_aval) -> bool:
     """Shapes the Pallas kernel accepts. Off-TPU the pass still fuses
     (substituting the reference composite) so the rewrite is testable on
@@ -416,6 +634,22 @@ def _exec_swiglu(m, read):
     return _swiglu(read(m["gate"]), read(m["up"]))
 
 
+def _exec_brln(m, read):
+    from ..ops.fused import fused_bias_residual_layer_norm
+    return fused_bias_residual_layer_norm(
+        read(m["x"]), read(m["residual"]),
+        bias=None if m["bias"] is None else read(m["bias"]),
+        weight=None if m["w"] is None else read(m["w"]),
+        ln_bias=None if m["lnb"] is None else read(m["lnb"]),
+        eps=m["eps"])
+
+
+def _exec_moe_dispatch(m, read):
+    from ..ops.fused import fused_moe_dispatch_combine
+    return tuple(fused_moe_dispatch_combine(
+        read(m["keep"]), read(m["ohk"]), read(m["gv"])))
+
+
 def _sdpa_shape_ok(m):
     return _flash_eligible_shapes(m["q"].aval, m["k"].aval)
 
@@ -432,12 +666,26 @@ def _lane_ok(m, key):
 # The CINN-parity pattern table (ref: paddle/cinn/operator_fusion/ —
 # pattern registry + replace-with-kernel): matcher, eligibility filter,
 # executor. Extending the pass = adding a row.
+def _moe_lane_ok(m):
+    import jax as _jax
+    if _jax.default_backend() != "tpu":
+        return True
+    # kernel block layout: keep [.,k,E], ohk [.,k,C], outs [.,E,C]
+    E = m["keep"].aval.shape[-1]
+    C = m["ohk"].aval.shape[-1]
+    return E % 128 == 0 and C % 128 == 0
+
+
 PATTERNS = {
     "sdpa": (match_sdpa_patterns, _sdpa_shape_ok, _exec_sdpa),
     "rmsnorm": (match_rmsnorm_patterns,
                 lambda m: _lane_ok(m, "x"), _exec_rmsnorm),
     "swiglu": (match_swiglu_patterns,
                lambda m: _lane_ok(m, "gate"), _exec_swiglu),
+    "bias_residual_ln": (match_bias_residual_ln_patterns,
+                         lambda m: _lane_ok(m, "x"), _exec_brln),
+    "moe_dispatch": (match_moe_dispatch_patterns, _moe_lane_ok,
+                     _exec_moe_dispatch),
 }
 
 
@@ -458,17 +706,31 @@ def _run_fused(closed, matches, consts, *flat_args):
     for v, a in zip(jaxpr.invars, flat_args):
         write(v, a)
 
-    by_final = {m["final"]: m for m in matches}
+    # single-output matches: {"final": i}; multi-output matches carry
+    # {"finals": {eqn_idx: tuple_position}} (e.g. moe_dispatch emits
+    # dispatch AND combine from one kernel call)
+    by_final: Dict[int, Any] = {}
+    for m in matches:
+        for fi in m.get("finals", {m["final"]: None}):
+            by_final[fi] = m
     skip: Set[int] = set()
     for m in matches:
         skip |= m["chain"]
+
+    fused_cache: Dict[int, Any] = {}
 
     for i, eqn in enumerate(jaxpr.eqns):
         if i in skip:
             continue
         if i in by_final:
             m = by_final[i]
-            out = PATTERNS[m["pattern"]][2](m, read)
+            finals = m.get("finals")
+            if finals is None:
+                out = PATTERNS[m["pattern"]][2](m, read)
+            else:
+                if id(m) not in fused_cache:
+                    fused_cache[id(m)] = PATTERNS[m["pattern"]][2](m, read)
+                out = fused_cache[id(m)][finals[i]]
             write(eqn.outvars[0], out.astype(eqn.outvars[0].aval.dtype))
             continue
         vals = [read(x) for x in eqn.invars]
@@ -501,7 +763,7 @@ def fuse(fn):
             for m in matcher(closed.jaxpr):
                 if not eligible(m):
                     continue
-                span = m["chain"] | {m["final"]}
+                span = m["chain"] | set(m.get("finals", {m["final"]: 0}))
                 if span & claimed:
                     continue  # first pattern wins on overlapping regions
                 claimed |= span

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["fused_rms_norm", "fused_rope", "swiglu", "fused_layer_norm"]
+__all__ = ["fused_rms_norm", "fused_rope", "swiglu", "fused_layer_norm",
+           "fused_bias_residual_layer_norm", "fused_moe_dispatch_combine"]
 
 
 def _interpret() -> bool:
@@ -128,6 +129,163 @@ def fused_layer_norm(x, weight, bias, eps: float = 1e-5):
         interpret=_interpret(),
     )(x2, weight, bias)
     return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# bias + residual + layer_norm (ref: FusedBiasDropoutResidualLnKernel,
+# paddle/phi/kernels/fusion/gpu/fused_bias_dropout_residual_layer_norm*.
+# Eval-mode form — dropout is identity; the whole add+add+LN chain runs
+# in ONE kernel / one HBM round-trip instead of three.)
+# ---------------------------------------------------------------------------
+
+def _brln_kernel(x_ref, r_ref, b_ref, w_ref, lb_ref, o_ref, *, eps: float):
+    h = (x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+         + r_ref[:].astype(jnp.float32))
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    hc = h - mu
+    var = jnp.mean(hc * hc, axis=-1, keepdims=True)
+    y = hc * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (y * w_ref[:].astype(jnp.float32)
+                + lb_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _brln_forward(x2, r2, b, w, lb, eps):
+    T, H = x2.shape
+    bt = _row_block(T)
+    return pl.pallas_call(
+        functools.partial(_brln_kernel, eps=float(eps)),
+        grid=(T // bt,),
+        in_specs=[pl.BlockSpec((bt, H), lambda i: (i, 0)),
+                  pl.BlockSpec((bt, H), lambda i: (i, 0)),
+                  pl.BlockSpec((H,), lambda i: (0,)),
+                  pl.BlockSpec((H,), lambda i: (0,)),
+                  pl.BlockSpec((H,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((bt, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, H), x2.dtype),
+        interpret=_interpret(),
+    )(x2, r2, b, w, lb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _brln(x2, r2, b, w, lb, eps):
+    return _brln_forward(x2, r2, b, w, lb, eps)
+
+
+def _brln_fwd(x2, r2, b, w, lb, eps):
+    return _brln_forward(x2, r2, b, w, lb, eps), (x2, r2, b, w, lb)
+
+
+def _brln_bwd(eps, res, g):
+    # standard layer-norm backward over h = x + b + r, in plain XLA math
+    x2, r2, b, w, lb = res
+    h = (x2.astype(jnp.float32) + b.astype(jnp.float32)
+         + r2.astype(jnp.float32))
+    gf = g.astype(jnp.float32)
+    mu = jnp.mean(h, -1, keepdims=True)
+    hc = h - mu
+    var = jnp.mean(hc * hc, -1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = hc * rstd
+    wf = w.astype(jnp.float32)
+    dlb = jnp.sum(gf, axis=0).astype(lb.dtype)
+    dw = jnp.sum(gf * xhat, axis=0).astype(w.dtype)
+    gx = gf * wf
+    dh = rstd * (gx - jnp.mean(gx, -1, keepdims=True)
+                 - xhat * jnp.mean(gx * xhat, -1, keepdims=True))
+    dx = dh.astype(x2.dtype)
+    db = jnp.sum(dh, axis=0).astype(b.dtype)
+    return dx, dh.astype(r2.dtype), db, dw, dlb
+
+
+_brln.defvjp(_brln_fwd, _brln_bwd)
+
+
+def fused_bias_residual_layer_norm(x, residual, bias=None, weight=None,
+                                   ln_bias=None, eps: float = 1e-5):
+    """layer_norm((x + bias) + residual) in one Pallas kernel (custom
+    VJP: plain-XLA LN backward). bias / weight / ln_bias optional
+    (zeros/ones substituted)."""
+    shape = x.shape
+    H = shape[-1]
+    x2 = x.reshape(-1, H)
+    r2 = residual.reshape(-1, H)
+    b = jnp.zeros((H,), x2.dtype) if bias is None else bias
+    w = jnp.ones((H,), x2.dtype) if weight is None else weight
+    lb = jnp.zeros((H,), x2.dtype) if ln_bias is None else ln_bias
+    return _brln(x2, r2, b, w, lb, float(eps)).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# MoE dispatch/combine mask build (ref: CINN fusing the GShard gate's
+# one-hot/scale/einsum chain — paddle/cinn/operator_fusion; the two
+# [T,k,E]x[T,k,C] contractions plus the gate-value scale run in ONE
+# kernel, reading keep/one-hot once instead of twice.)
+# ---------------------------------------------------------------------------
+
+def _moe_dc_kernel(keep_ref, oh_ref, gv_ref, d_ref, c_ref):
+    keep = keep_ref[:].astype(jnp.float32)      # [bt, k, E]
+    oh = oh_ref[:].astype(jnp.float32)          # [bt, k, C]
+    gv = gv_ref[:].astype(jnp.float32)          # [bt, k]
+    disp = jax.lax.dot_general(
+        keep, oh, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)     # [bt, E, C]
+    comb = jax.lax.dot_general(
+        keep * gv[..., None], oh, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    d_ref[:] = disp.astype(d_ref.dtype)
+    c_ref[:] = comb.astype(c_ref.dtype)
+
+
+def _moe_dc_forward(keep, oh_loc, gv):
+    T, K, E = keep.shape
+    C = oh_loc.shape[-1]
+    bt = _row_block(T)
+    return pl.pallas_call(
+        _moe_dc_kernel,
+        grid=(T // bt,),
+        in_specs=[pl.BlockSpec((bt, K, E), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((bt, K, C), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((bt, K), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((bt, E, C), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((bt, E, C), lambda i: (i, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((T, E, C), keep.dtype),
+                   jax.ShapeDtypeStruct((T, E, C), keep.dtype)],
+        interpret=_interpret(),
+    )(keep, oh_loc, gv)
+
+
+@jax.custom_vjp
+def fused_moe_dispatch_combine(keep, oh_loc, gv):
+    """keep [T,k,E], oh_loc [T,k,C], gv [T,k] ->
+    (dispatch [T,E,C], combine [T,E,C]) — the GShard gate's final
+    einsum pair in one kernel (custom VJP: the pair is bilinear, the
+    backward is three small einsums XLA fuses)."""
+    return _moe_dc_forward(keep, oh_loc, gv)
+
+
+def _moe_dc_fwd(keep, oh_loc, gv):
+    return _moe_dc_forward(keep, oh_loc, gv), (keep, oh_loc, gv)
+
+
+def _moe_dc_bwd(res, gs):
+    keep, oh, gv = res
+    dd, dc = gs
+    ddf = dd.astype(jnp.float32)
+    dcf = dc.astype(jnp.float32)
+    kf = keep.astype(jnp.float32)
+    of = oh.astype(jnp.float32)
+    gf = gv.astype(jnp.float32)
+    kg = kf * gf[..., None]
+    dkeep = (jnp.einsum("tec,tkc->tke", ddf, of)
+             + gf[..., None] * jnp.einsum("tec,tkc->tke", dcf, of))
+    doh = (jnp.einsum("tec,tke->tkc", ddf, kf)
+           + jnp.einsum("tec,tke->tkc", dcf, kg))
+    dgv = jnp.einsum("tke,tkc,tec->tk", kf, of, dcf)
+    return (dkeep.astype(keep.dtype), doh.astype(oh.dtype),
+            dgv.astype(gv.dtype))
+
+
+fused_moe_dispatch_combine.defvjp(_moe_dc_fwd, _moe_dc_bwd)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,208 @@
+"""C++ StableHLO fusion pass (csrc/fusion_pass.cc + jit/fusion_cc.py) —
+VERDICT r2 item 3: the CINN-parity pass pipeline ported to C++ over the
+lowered StableHLO text, verified by the MLIR parser and compiled by
+PJRT. Mirrors the jaxpr-pass suite (tests/test_fusion_pass.py):
+matcher precision, numerics equivalence, negative cases, full-block
+multi-pattern fusion, and the flag-gated Predictor integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.jit import fusion_cc
+
+pytestmark = pytest.mark.skipif(not fusion_cc.available(),
+                                reason="g++/so unavailable")
+
+
+def _sdpa(q, k, v):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _rms(x, w):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), -1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * w
+
+
+def _text(fn, *args):
+    return jax.jit(fn).lower(*args).as_text()
+
+
+class TestMatcher:
+    def test_finds_sdpa_with_scale(self):
+        q = jnp.ones((2, 4, 64, 64), jnp.float32)
+        ms = fusion_cc.analyze_text(_text(_sdpa, q, q, q))
+        assert [m["pattern"] for m in ms] == ["sdpa"]
+        assert ms[0]["scale"] == pytest.approx(0.125)
+        assert len(ms[0]["operands"]) == 3
+
+    def test_finds_bf16_sdpa_through_converts(self):
+        q = jnp.ones((2, 2, 64, 64), jnp.bfloat16)
+        ms = fusion_cc.analyze_text(_text(_sdpa, q, q, q))
+        assert [m["pattern"] for m in ms] == ["sdpa"]
+
+    def test_finds_rmsnorm_with_eps(self):
+        x = jnp.ones((4, 256), jnp.float32)
+        w = jnp.ones((256,), jnp.float32)
+        ms = fusion_cc.analyze_text(_text(_rms, x, w))
+        assert [m["pattern"] for m in ms] == ["rmsnorm"]
+        assert ms[0]["eps"] == pytest.approx(1e-6, rel=1e-3)
+
+    def test_escaping_interior_rejected(self):
+        def leaky(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v), p
+        q = jnp.ones((2, 2, 64, 64), jnp.float32)
+        assert fusion_cc.analyze_text(_text(leaky, q, q, q)) == []
+
+    def test_wrong_divisor_rejected(self):
+        def bad(x, w):
+            var = jnp.sum(jnp.square(x), -1, keepdims=True) / 7.0
+            return x * jax.lax.rsqrt(var + 1e-6) * w
+        x = jnp.ones((4, 256), jnp.float32)
+        w = jnp.ones((256,), jnp.float32)
+        assert fusion_cc.analyze_text(_text(bad, x, w)) == []
+
+    def test_plain_matmul_untouched(self):
+        def mm(a, b):
+            return a @ b
+        a = jnp.ones((8, 8), jnp.float32)
+        assert fusion_cc.analyze_text(_text(mm, a, a)) == []
+
+
+class TestRewriteAndExecute:
+    def test_sdpa_numerics_and_region_removed(self):
+        rng = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.standard_normal((2, 4, 64, 64)),
+                               jnp.float32) for _ in range(3))
+        f = fusion_cc.fuse_compile(_sdpa, q, k, v)
+        assert f.n_fused == 1
+        np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                                   np.asarray(_sdpa(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+        main = f.module_text.split("func.func private")[0]
+        assert "stablehlo.exponential" not in main
+        assert "stablehlo.reduce" not in main
+        assert "call @ptpu_fused_sdpa" in main
+
+    def test_rmsnorm_numerics(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.standard_normal((4, 256)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+        f = fusion_cc.fuse_compile(_rms, x, w)
+        assert f.n_fused == 1
+        np.testing.assert_allclose(np.asarray(f(x, w)),
+                                   np.asarray(_rms(x, w)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_swiglu_numerics(self):
+        rng = np.random.RandomState(2)
+        g = jnp.asarray(rng.standard_normal((4, 256)), jnp.float32)
+        u = jnp.asarray(rng.standard_normal((4, 256)), jnp.float32)
+
+        def swig(g, u):
+            return jax.nn.silu(g) * u
+        f = fusion_cc.fuse_compile(swig, g, u)
+        assert f.n_fused == 1
+        np.testing.assert_allclose(np.asarray(f(g, u)),
+                                   np.asarray(swig(g, u)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_full_block_fuses_all_three(self):
+        def block(x, w, wg, wu):
+            h = x.astype(jnp.float32)
+            var = jnp.mean(jnp.square(h), -1, keepdims=True)
+            h = (h * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype) * w
+            B, S, H = h.shape
+            q = h.reshape(B, S, 2, H // 2).transpose(0, 2, 1, 3)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, q) * 0.3
+            p = jax.nn.softmax(s, -1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, q)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
+            return jax.nn.silu(o @ wg) * (o @ wu)
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.standard_normal((2, 64, 128)) * 0.3,
+                        jnp.float32)
+        w = jnp.asarray(rng.standard_normal((128,)), jnp.float32)
+        wg = jnp.asarray(rng.standard_normal((128, 256)) * 0.1,
+                         jnp.float32)
+        wu = jnp.asarray(rng.standard_normal((128, 256)) * 0.1,
+                         jnp.float32)
+        f = fusion_cc.fuse_compile(block, x, w, wg, wu)
+        assert sorted(m["pattern"] for m in f.matches) == \
+            ["rmsnorm", "sdpa", "swiglu"]
+        np.testing.assert_allclose(np.asarray(f(x, w, wg, wu)),
+                                   np.asarray(block(x, w, wg, wu)),
+                                   rtol=5e-5, atol=5e-5)
+
+    def test_rewritten_module_reverifies(self):
+        """The rewritten text must parse under the MLIR verifier (the
+        compile in fuse_compile implies it; this pins it explicitly)."""
+        q = jnp.ones((2, 2, 64, 64), jnp.float32)
+        f = fusion_cc.fuse_compile(_sdpa, q, q, q)
+        from jax._src.interpreters import mlir
+        from jax._src.lib.mlir import ir
+        with mlir.make_ir_context():
+            ir.Module.parse(f.module_text)
+
+    def test_no_match_falls_back(self):
+        def plain(a, b):
+            return jnp.tanh(a) + b
+        a = jnp.ones((4, 4), jnp.float32)
+        f = fusion_cc.fuse_compile(plain, a, a)
+        assert f.n_fused == 0
+        np.testing.assert_allclose(np.asarray(f(a, a)),
+                                   np.asarray(plain(a, a)), rtol=1e-6)
+
+
+class TestPredictorIntegration:
+    def test_flag_gated_predictor_uses_cc_pass(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu import inference, nn
+        from paddle_tpu.core.tensor import Tensor
+
+        class TinyAttn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.q = nn.Linear(64, 64, bias_attr=False)
+
+            def forward(self, x):
+                B, S, H = x.shape
+                q = self.q(x).reshape([B, S, 1, 64]).transpose([0, 2, 1, 3])
+                qd = q._data
+                s = jnp.einsum("bhqd,bhkd->bhqk", qd, qd) * 0.125
+                p = jax.nn.softmax(s, -1)
+                o = jnp.einsum("bhqk,bhkd->bhqd", p, qd)
+                return Tensor(o.reshape(B, S, H))
+
+        paddle.seed(5)
+        layer = TinyAttn()
+        from paddle_tpu import jit as pjit
+        from paddle_tpu.static import InputSpec
+        prefix = str(tmp_path / "attn")
+        pjit.save(layer, prefix,
+                  input_spec=[InputSpec([2, 64, 64], "float32")])
+
+        x = np.random.RandomState(0).standard_normal(
+            (2, 64, 64)).astype(np.float32)
+        paddle.set_flags({"FLAGS_use_fusion_compiler": True})
+        try:
+            cfg = inference.Config(prefix)
+            pred = inference.create_predictor(cfg)
+            assert getattr(pred._call, "n_fused", 0) >= 1, \
+                "predictor did not route through the C++ pass"
+            h = pred.get_input_handle(pred.get_input_names()[0])
+            h.copy_from_cpu(x)
+            pred.run()
+            out = pred.get_output_handle(
+                pred.get_output_names()[0]).copy_to_cpu()
+        finally:
+            paddle.set_flags({"FLAGS_use_fusion_compiler": False})
+        ref = layer(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)

@@ -76,11 +76,59 @@ def main() -> None:
     t_fused = bench(fused)
     d = np.abs(np.asarray(plain(x, layers), np.float32)
                - np.asarray(fused(x, layers), np.float32)).max()
+
+    # --- round-3 patterns: bias+residual+LN and the MoE gate pair ---
+    def brln(xh, r, b, w, lb):
+        h = xh + b[None, :] + r
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.mean(jnp.square(h - mu), -1, keepdims=True)
+        return ((h - mu) * jax.lax.rsqrt(var + 1e-5) * w[None, :]
+                + lb[None, :])
+
+    Tb, Hb = 8192, 4096
+    xb = jnp.asarray(rng.standard_normal((Tb, Hb)), dt)
+    rb = jnp.asarray(rng.standard_normal((Tb, Hb)), dt)
+    vb = jnp.asarray(rng.standard_normal((Hb,)), dt)
+
+    def bench1(f, args, n=20):
+        float(f(*args).sum())
+        t0 = time.perf_counter()
+        for _ in range(n):
+            o = f(*args)
+        float(o.sum())
+        return (time.perf_counter() - t0) / n * 1e3
+
+    brln_args = (xb, rb, vb, vb, vb)
+    t_brln_plain = bench1(jax.jit(brln), brln_args)
+    t_brln_fused = bench1(jax.jit(fuse(brln)), brln_args)
+
+    from paddle_tpu.incubate.moe import top_k_gating
+    Tg, Eg, Cg = 8192, 128, 128
+
+    def gate(g):
+        d_, c_, _ = top_k_gating(g, 2, Cg)
+        return d_.sum() + c_.sum()
+
+    gg = jax.nn.softmax(jnp.asarray(
+        rng.standard_normal((Tg, Eg)), jnp.float32), -1)
+    t_gate_plain = bench1(jax.jit(gate), (gg,))
+    t_gate_fused = bench1(jax.jit(fuse(gate)), (gg,))
+
     out = {"device": str(jax.devices()[0].device_kind),
            "shape": dict(B=B, S=S, H=H, D=D, F=F, layers=L),
            "plain_ms": round(t_plain, 2), "fused_ms": round(t_fused, 2),
            "speedup": round(t_plain / t_fused, 3),
-           "max_abs_diff": float(d)}
+           "max_abs_diff": float(d),
+           "bias_residual_ln": {
+               "shape": [Tb, Hb],
+               "plain_ms": round(t_brln_plain, 3),
+               "fused_ms": round(t_brln_fused, 3),
+               "speedup": round(t_brln_plain / t_brln_fused, 3)},
+           "moe_gate_pair": {
+               "shape": dict(T=Tg, E=Eg, C=Cg, k=2),
+               "plain_ms": round(t_gate_plain, 3),
+               "fused_ms": round(t_gate_fused, 3),
+               "speedup": round(t_gate_plain / t_gate_fused, 3)}}
     path = os.path.join(os.path.dirname(__file__), "..", "docs",
                         "FUSION_BENCH.json")
     with open(path, "w") as f:
