@@ -138,6 +138,12 @@ define_flag("FLAGS_deterministic", False,
             "force deterministic lowering choices (parity: FLAGS_cudnn_deterministic)")
 define_flag("FLAGS_use_fusion_compiler", False,
             "enable the CINN-parity fusion pass pipeline (parity: FLAGS_use_cinn)")
+define_flag("FLAGS_flash_impl", "intree",
+            "which flash-attention kernel sdpa routes to when eligible: "
+            "'intree' (ops/pallas_flash.py, authored+tunable), 'bundled' "
+            "(jax.experimental.pallas.ops.tpu.flash_attention), or "
+            "'composite' (never take a fused kernel)",
+            validator=lambda v: v in ("intree", "bundled", "composite"))
 define_flag("FLAGS_eager_op_cache_size", 4096,
             "max entries in the per-op jitted computation cache")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity (higher = chattier)")

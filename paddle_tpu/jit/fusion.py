@@ -580,6 +580,12 @@ def match_moe_dispatch_patterns(jaxpr) -> List[dict]:
                 break
         if disp_idx is None:
             continue
+        # the fused kernel executes at the FIRST final reached and reads
+        # gv there — gv must already be computed at that point (a user
+        # program may order the gate-value math after the dispatch dot)
+        if not isinstance(gv_var, jcore.Literal) and \
+                producer.get(gv_var, -1) > min(disp_idx, i):
+            continue
         # the scale dot is interior; its output must feed only `combine`
         if uses.get(bp_var, []) != [i]:
             continue

@@ -104,9 +104,12 @@ def rewrite_text(module_text: str, plan: str) -> str:
 # ---------------------------------------------------------------------------
 # type parsing + replacement kernels
 # ---------------------------------------------------------------------------
+# only dtypes we can lower replacement kernels at FAITHFULLY — an f64/i64
+# module must not silently get f32/i32 kernels spliced in (the synthesized
+# call keeps the original operand types and the module would fail MLIR
+# verification, or worse, lose precision)
 _DT = {"f32": jnp.float32, "f16": jnp.float16, "bf16": jnp.bfloat16,
-       "f64": jnp.float32, "i32": jnp.int32, "i64": jnp.int32,
-       "i8": jnp.int8, "i1": jnp.bool_}
+       "i32": jnp.int32, "i8": jnp.int8, "i1": jnp.bool_}
 
 
 def _parse_tensor_type(t: str) -> jax.ShapeDtypeStruct:
@@ -205,6 +208,21 @@ def fuse_compile(fn, *example_args):
 
     matches = [m for m in analyze_text(text) if _eligible(m)]
 
+    if not matches:
+        # nothing to rewrite: return the plain jitted fn (no second
+        # compile of an identical module; Predictor keeps its jit path)
+        wrapped0 = jax.jit(fn)
+
+        @functools.wraps(fn)
+        def passthrough(*args):
+            flat, tree = jax.tree_util.tree_flatten(args)
+            flat = [x._data if hasattr(x, "_data") else x for x in flat]
+            return wrapped0(*jax.tree_util.tree_unflatten(tree, flat))
+        passthrough.module_text = text
+        passthrough.matches = []
+        passthrough.n_fused = 0
+        return passthrough
+
     if matches:
         plan_parts = []
         for m in matches:
@@ -243,9 +261,11 @@ def fuse_compile(fn, *example_args):
         bufs = [jax.device_put(x._data if hasattr(x, "_data") else x)
                 for x in flat]
         res = exe.execute_sharded(bufs)
-        outs = res.consume_with_handlers(
-            [(lambda shards: np.asarray(shards[0]))] * n_out)
-        arrs = [jnp.asarray(np.asarray(o)).astype(l.dtype)
+        # keep results as device arrays: a np.asarray handler here would
+        # force a device->host->device round-trip on every call
+        outs = res.consume_with_handlers([
+            (lambda shards: shards[0])] * n_out)
+        arrs = [jnp.asarray(o).astype(l.dtype)
                 for o, l in zip(outs, out_leaves)]
         return jax.tree_util.tree_unflatten(out_tree, arrs)
 

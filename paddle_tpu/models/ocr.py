@@ -2,9 +2,9 @@
 
 Reference capability: PaddleOCR PP-OCRv4 det+rec — MobileNetV3/PP-LCNet
 backbones, DB (Differentiable Binarization) detection head, CTC recognition
-head (SVTR-lite style), warpctc loss (here: optax CTC via
-paddle_tpu.nn.functional.ctc_loss — the XLA path replaces the warpctc
-external). These conv-heavy CNNs are the non-transformer canary for the
+head (SVTR-lite style), warpctc loss (here: the native extended-label
+forward-lattice CTC in paddle_tpu.nn.functional.ctc_loss — an XLA scan
+over the 2S+1 lattice replaces the warpctc external). These conv-heavy CNNs are the non-transformer canary for the
 framework (SURVEY §7.2 item 5): NCHW user API, XLA retiles for the MXU.
 """
 
@@ -21,7 +21,7 @@ from ..tensor.manipulation import concat
 from ..vision.models import MobileNetV3Small, _make_divisible
 
 __all__ = ["DBHead", "DBFPN", "PPOCRDet", "CTCHead", "PPOCRRec",
-           "db_postprocess"]
+           "db_postprocess", "db_loss"]
 
 
 # ---------------------------------------------------------------------------
@@ -110,6 +110,55 @@ class PPOCRDet(nn.Layer):
     def forward(self, x):
         feats = self.backbone(x)
         return self.head(self.neck(feats))
+
+
+def db_loss(preds, shrink_map, shrink_mask, thresh_map=None,
+            thresh_mask=None, alpha: float = 5.0, beta: float = 10.0,
+            ohem_ratio: float = 3.0, eps: float = 1e-6):
+    """DB training loss (ref: ppocr/losses/det_db_loss.py +
+    det_basic_loss.py): hard-negative-mined BCE on the probability map
+    (x alpha), masked L1 on the threshold map (x beta), dice loss on the
+    differentiable binary map. `preds` is the training-mode DBHead output
+    ([B, 3, H, W] prob/thresh/binary); shrink_map is the {0,1} text-region
+    target, shrink_mask the valid-pixel mask, thresh_map/thresh_mask the
+    border-band threshold target (both optional — without them only the
+    prob + binary terms apply, as when the head runs prob-only)."""
+    from ..core.dispatch import apply
+
+    def arr(v):
+        return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+    sm = arr(shrink_map).astype(jnp.float32)
+    mk = arr(shrink_mask).astype(jnp.float32)
+    tm = None if thresh_map is None else arr(thresh_map).astype(jnp.float32)
+    tk = None if thresh_mask is None else arr(thresh_mask).astype(jnp.float32)
+
+    def impl(maps):
+        p = maps[:, 0].astype(jnp.float32)
+        bce = -(sm * jnp.log(jnp.clip(p, eps, None))
+                + (1 - sm) * jnp.log(jnp.clip(1 - p, eps, None)))
+        pos = sm * mk
+        neg = (1 - sm) * mk
+        n_pos = pos.sum()
+        # OHEM: keep the ohem_ratio*n_pos hardest negatives (jit-safe
+        # rank-mask over the sorted losses — no dynamic shapes)
+        k = jnp.minimum(neg.sum(), ohem_ratio * n_pos)
+        neg_sorted = jnp.sort((bce * neg).reshape(-1))[::-1]
+        neg_sum = jnp.where(jnp.arange(neg_sorted.size) < k,
+                            neg_sorted, 0.0).sum()
+        loss_prob = ((bce * pos).sum() + neg_sum) / (n_pos + k + eps)
+        total = alpha * loss_prob
+        if maps.shape[1] >= 3:
+            b = maps[:, 2].astype(jnp.float32)
+            inter = (b * sm * mk).sum()
+            union = (b * mk).sum() + (sm * mk).sum()
+            total = total + (1.0 - 2.0 * inter / (union + eps))
+            if tm is not None:
+                t = maps[:, 1].astype(jnp.float32)
+                w = tk if tk is not None else jnp.ones_like(tm)
+                total = total + beta * ((jnp.abs(t - tm) * w).sum()
+                                        / (w.sum() + eps))
+        return total
+    return apply("db_loss", impl, [preds])
 
 
 def db_postprocess(prob_map, thresh: float = 0.3, min_area: int = 4):

@@ -2,11 +2,14 @@
 
 Reference parity: paddle/phi/kernels/gpu/flash_attn_kernel.cu (FlashAttention2
 fwd/bwd) and python/paddle/nn/functional/flash_attention.py. On TPU the fused
-path is a Pallas flash kernel (added at the L6 milestone in
-paddle_tpu/ops/pallas/); this module always provides `sdpa_reference`, the
-XLA composite that (a) is the correctness oracle for the Pallas kernel per
-SURVEY §4.1, and (b) is already MXU-efficient for moderate sequence lengths
-because XLA fuses the softmax chain.
+path defaults to the IN-TREE authored Pallas flash kernel
+(ops/pallas_flash.py — causal incl. unequal Sq/Sk, segment ids, tunable
+blocks); FLAGS_flash_impl selects 'bundled'
+(jax.experimental.pallas.ops.tpu.flash_attention) or 'composite' instead.
+This module always provides `sdpa_reference`, the XLA composite that (a) is
+the correctness oracle for the Pallas kernels per SURVEY §4.1, and (b) is
+already MXU-efficient for moderate sequence lengths because XLA fuses the
+softmax chain.
 
 Layout convention (paddle): [batch, seq, num_heads, head_dim].
 """
@@ -81,12 +84,24 @@ def _flash_block_sizes(Sq: int, Sk: int):
         block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
 
 
+def _flash_impl() -> str:
+    """FLAGS_flash_impl: 'intree' (default; ops/pallas_flash.py) /
+    'bundled' / 'composite'."""
+    from ..flags import flag
+    return flag("FLAGS_flash_impl")
+
+
 def _flash_eligible(q, k, causal: bool = False) -> bool:
-    """Shared Pallas-kernel eligibility gate: TPU backend, block-divisible
-    seq lengths (equal when causal — the kernel's causal offset assumes
-    aligned diagonals), MXU-friendly head dim."""
+    """Pallas-kernel eligibility gate for the selected impl: TPU backend,
+    block-divisible seq lengths, MXU-friendly head dim. The in-tree
+    kernel accepts causal Sq != Sk (bottom-right aligned); the bundled
+    kernel's causal offset assumes aligned diagonals, so unequal lengths
+    are only eligible under FLAGS_flash_impl='intree'."""
+    impl = _flash_impl()
+    if impl == "composite":
+        return False
     D = q.shape[-1]
-    if causal and q.shape[1] != k.shape[1]:
+    if causal and q.shape[1] != k.shape[1] and impl != "intree":
         return False
     return (_tpu_flash_available()
             and _largest_dividing_block(q.shape[1]) > 0
@@ -144,6 +159,11 @@ def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
         scale = D ** -0.5
     path = sdpa_path(q, k, mask=mask, causal=causal, dropout_p=dropout_p)
     if path == "flash":
+        if _flash_impl() == "intree":
+            from .pallas_flash import flash_sdpa
+            return flash_sdpa(q, k, v, causal=causal, scale=scale,
+                              block_q=_largest_dividing_block(Sq),
+                              block_k=_largest_dividing_block(Sk))
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _pallas_flash)
         qh = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
@@ -199,6 +219,12 @@ def sdpa_segmented(q, k, v, segment_ids, kv_segment_ids=None, causal=True,
     seg_kv = (seg_q if kv_segment_ids is None
               else kv_segment_ids.astype(jnp.int32))
     if dropout_p == 0.0 and _flash_eligible(q, k, causal):
+        if _flash_impl() == "intree":
+            from .pallas_flash import flash_sdpa
+            return flash_sdpa(q, k, v, causal=causal, scale=scale,
+                              segment_ids_q=seg_q, segment_ids_kv=seg_kv,
+                              block_q=_largest_dividing_block(q.shape[1]),
+                              block_k=_largest_dividing_block(k.shape[1]))
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _pallas_flash, SegmentIds)
         out = _pallas_flash(

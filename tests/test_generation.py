@@ -138,3 +138,45 @@ def test_cached_generation_eos_and_limits():
     with pytest.raises(ValueError, match="max_position_embeddings"):
         generate_cached(model, ids,
                         max_new_tokens=c.max_position_embeddings)
+
+
+def test_compiled_decode_loop_matches_cached():
+    """The one-XLA-program decode loop (generate_compiled) must produce
+    exactly generate_cached's greedy tokens, and respect eos padding."""
+    from paddle_tpu.generation import generate_cached, generate_compiled
+    paddle.seed(0)
+    c = llama_tiny_config(num_hidden_layers=2)
+    model = LlamaForCausalLM(c)
+    model.eval()
+    ids = _prompt(2, 6, c.vocab_size, seed=11)
+    ref, ref_scores = generate_cached(model, ids, max_new_tokens=6,
+                                      decode_strategy="greedy_search")
+    got, got_scores = generate_compiled(model, ids, max_new_tokens=6,
+                                        decode_strategy="greedy_search")
+    np.testing.assert_array_equal(ref.numpy(), got.numpy())
+    np.testing.assert_allclose(ref_scores.numpy(), got_scores.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    # eos: once a row finishes it emits pad (fixed trip count, no early exit)
+    eos = int(ref.numpy()[0, 0])
+    gen, _ = generate_compiled(model, ids[:1], max_new_tokens=5,
+                               decode_strategy="greedy_search",
+                               eos_token_id=eos)
+    g = gen.numpy()[0]
+    assert g[0] == eos
+    np.testing.assert_array_equal(g[1:], 0)
+
+
+def test_compiled_decode_sampling_valid():
+    from paddle_tpu.generation import generate_compiled
+    paddle.seed(3)
+    c = llama_tiny_config(num_hidden_layers=1)
+    model = LlamaForCausalLM(c)
+    model.eval()
+    ids = _prompt(2, 4, c.vocab_size, seed=12)
+    gen, scores = generate_compiled(model, ids, max_new_tokens=4,
+                                    decode_strategy="sampling",
+                                    top_k=8, temperature=0.9)
+    g = gen.numpy()
+    assert g.shape == (2, 4) and (g >= 0).all() and (g < c.vocab_size).all()
+    s = scores.numpy()
+    assert np.all(np.isfinite(s)) and np.all(s <= 1e-6)

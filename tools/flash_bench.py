@@ -1,0 +1,120 @@
+"""In-tree flash kernel vs bundled kernel on the local chip (VERDICT r2
+item 9 'done' bar: within 5% on the bench shapes, plus coverage the
+bundled kernel refuses). Prints a table and writes docs/FLASH_BENCH.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _fetch(out):
+    """block_until_ready is a no-op on the axon tunnel; a host fetch of
+    one element is the only honest barrier."""
+    leaf = out
+    while isinstance(leaf, (tuple, list)):
+        leaf = leaf[0]
+    np.asarray(leaf[(0,) * leaf.ndim])
+
+
+def _timeit(fn, *args, reps=20):
+    out = fn(*args)
+    _fetch(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+    _fetch(out)
+    return (time.time() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_flash import flash_sdpa
+    from paddle_tpu.ops.flash_attention import (_flash_block_sizes,
+                                                sdpa_reference)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        print("WARNING: not on TPU; numbers meaningless", file=sys.stderr)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as bundled)
+
+    rows = []
+    # bench shapes: flagship shard attention (4 q-heads d128) and a
+    # fatter 8-head case, causal, plus D=64 and unequal-length rows the
+    # bundled kernel refuses
+    shapes = [
+        ("8b_shard_s2048", 4, 2048, 2048, 4, 128, True),
+        ("8b_shard_s8192", 1, 8192, 8192, 4, 128, True),
+        ("h8_s4096", 2, 4096, 4096, 8, 128, True),
+        ("noncausal_s2048", 4, 2048, 2048, 4, 128, False),
+        ("D64_s4096", 2, 4096, 4096, 8, 64, True),
+        ("cross_causal_1k_to_8k", 1, 1024, 8192, 4, 128, True),  # bundled refuses
+    ]
+    for name, B, Sq, Sk, H, D, causal in shapes:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, Sq, H, D), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, Sk, H, D), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, Sk, H, D), jnp.bfloat16)
+
+        intree_fwd = jax.jit(lambda q, k, v: flash_sdpa(
+            q, k, v, causal=causal))
+        t_intree = _timeit(intree_fwd, q, k, v)
+
+        t_bundled = None
+        if Sq == Sk or not causal:
+            qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+            bundled_fwd = jax.jit(lambda qh, kh, vh: bundled(
+                qh, kh, vh, causal=causal, sm_scale=D ** -0.5,
+                block_sizes=_flash_block_sizes(Sq, Sk)))
+            t_bundled = _timeit(bundled_fwd, qh, kh, vh)
+
+        # fwd+bwd
+        def loss_intree(q, k, v):
+            return jnp.sum(flash_sdpa(q, k, v, causal=causal)
+                           .astype(jnp.float32) ** 2)
+        g_intree = jax.jit(jax.grad(loss_intree, (0, 1, 2)))
+        t_intree_bwd = _timeit(g_intree, q, k, v)
+        t_bundled_bwd = None
+        if Sq == Sk or not causal:
+            def loss_bundled(qh, kh, vh):
+                return jnp.sum(bundled(
+                    qh, kh, vh, causal=causal, sm_scale=D ** -0.5,
+                    block_sizes=_flash_block_sizes(Sq, Sk))
+                    .astype(jnp.float32) ** 2)
+            g_bundled = jax.jit(jax.grad(loss_bundled, (0, 1, 2)))
+            qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+            t_bundled_bwd = _timeit(g_bundled, qh, kh, vh)
+
+        row = dict(shape=name, B=B, Sq=Sq, Sk=Sk, H=H, D=D, causal=causal,
+                   intree_fwd_us=round(t_intree * 1e6, 1),
+                   bundled_fwd_us=(None if t_bundled is None
+                                   else round(t_bundled * 1e6, 1)),
+                   intree_fwdbwd_us=round(t_intree_bwd * 1e6, 1),
+                   bundled_fwdbwd_us=(None if t_bundled_bwd is None
+                                      else round(t_bundled_bwd * 1e6, 1)))
+        if t_bundled:
+            row["fwd_ratio_intree_over_bundled"] = round(
+                t_intree / t_bundled, 3)
+        if t_bundled_bwd:
+            row["fwdbwd_ratio_intree_over_bundled"] = round(
+                t_intree_bwd / t_bundled_bwd, 3)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    out = os.path.join(os.path.dirname(__file__), "..", "docs",
+                       "FLASH_BENCH.json")
+    if on_tpu:
+        with open(out, "w") as f:
+            json.dump(dict(device=str(jax.devices()[0].device_kind),
+                           rows=rows), f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

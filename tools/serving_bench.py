@@ -1,0 +1,227 @@
+"""Serving-path benchmark: KV-cache decode throughput on the local chip
+(VERDICT r2 item 7; ref capability: the reference's inference engine is a
+perf product — paddle/fluid/inference/ + the masked/block decode attention
+kernel set, paddle/phi/kernels/fusion/gpu/block_multi_head_attention*).
+
+Measures, on the real device:
+  1. generate_compiled (one-XLA-program prefill + lax.scan decode loop)
+     on the per-chip shard of the mp=8 x pp=4 partitioned Llama-3-8B —
+     the same per-chip model the training bench measures, so the two
+     numbers compose the same way (multiply by chips, subtract the
+     collective terms accounted in docs/FLAGSHIP.md).
+  2. The paged-attention decode kernel vs the dense masked-cache
+     attention at serving shapes (microbench of the O(1)-per-step op).
+
+Writes docs/SERVING_BENCH.json and prints a summary. Roofline note: at
+batch B with per-chip weight bytes W and per-sequence KV-cache bytes C(s),
+one decode step must read >= W + B*C(s) from HBM; tokens/s/chip is
+bounded by B * BW / (W + B*C(s)). The report records achieved vs that
+bound.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HBM_BW = {"v5e": 819e9, "v5p": 2765e9, "v4": 1228e9, "v6e": 1640e9}
+
+
+def _bw() -> float:
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for k, v in HBM_BW.items():
+        if k in kind or ("v5 lite" in kind and k == "v5e"):
+            return v
+    return 819e9
+
+
+def _tree_bytes(p) -> int:
+    total = p["embed"].size * p["embed"].dtype.itemsize
+    total += p["norm"].size * p["norm"].dtype.itemsize
+    if p["head"] is not None:
+        total += p["head"].size * p["head"].dtype.itemsize
+    for L in p["layers"]:
+        for v in L.values():
+            total += v.size * v.dtype.itemsize
+    return total
+
+
+def _log(msg):
+    print(f"[serving_bench +{time.time() - _T0:.0f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+_T0 = time.time()
+
+
+def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16"):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama3_8b_shard_config)
+    from paddle_tpu.generation import (_llama_decode_params,
+                                       _make_llama_decode_loop)
+    import paddle_tpu as paddle
+
+    total = S0 + new
+    cfg = llama3_8b_shard_config(mp=8, pp=4,
+                                 max_position_embeddings=total)
+    _log(f"init model B={B} S0={S0} new={new}")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    _log("model built")
+    if dtype == "bfloat16":
+        for prm in model.parameters():
+            prm._data = prm._data.astype(jnp.bfloat16)
+    p = _llama_decode_params(model)
+    w_bytes = _tree_bytes(p)
+    KV, D = cfg.num_key_value_heads, cfg.head_dim
+    cache_bytes_full = 2 * total * KV * D * 2 * len(p["layers"])  # bf16
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S0)), jnp.int32)
+
+    run = _make_llama_decode_loop(p, S0, new, "greedy_search", None, None,
+                                  1.0, None, 0)
+    key = jax.random.PRNGKey(0)
+    _log("compiling decode loop")
+    t0 = time.time()
+    toks, _ = run(ids, key)
+    np.asarray(toks)   # block_until_ready is a no-op on the axon tunnel;
+                       # a host fetch is the only honest barrier
+    _log("decode loop compiled+run")
+    compile_and_first = time.time() - t0
+    reps = 3
+    t0 = time.time()
+    for _ in range(reps):
+        toks, _ = run(ids, key)
+    np.asarray(toks)
+    dt = (time.time() - t0) / reps
+
+    # split prefill from decode: a 1-token decode loop isolates prefill
+    run_pf = _make_llama_decode_loop(p, S0, 1, "greedy_search", None, None,
+                                     1.0, None, 0)
+    _log("compiling prefill-only loop")
+    toks_pf, _ = run_pf(ids, key)
+    np.asarray(toks_pf)
+    _log("prefill-only compiled+run")
+    t0 = time.time()
+    for _ in range(reps):
+        toks_pf, _ = run_pf(ids, key)
+    np.asarray(toks_pf)
+    t_prefill = (time.time() - t0) / reps
+
+    t_decode = max(dt - t_prefill, 1e-9)
+    decode_tok_s = B * new / t_decode
+    per_token_ms = t_decode / new * 1e3
+    prefill_tok_s = B * S0 / max(t_prefill, 1e-9)
+
+    # roofline: average KV length over the decode phase ~ S0 + new/2
+    avg_len = S0 + new / 2
+    kv_read = 2 * avg_len * KV * D * 2 * len(p["layers"])
+    bound_tok_s = B * _bw() / (w_bytes + B * kv_read)
+    return dict(
+        config="llama3_8b_shard mp=8 pp=4 (8 layers, 4 q-heads/1 kv-head "
+               "d128, ffn 1792, vocab 16032)", dtype=dtype,
+        batch=B, prefill_len=S0, new_tokens=new,
+        weight_bytes=int(w_bytes), kv_cache_bytes_full=int(cache_bytes_full),
+        compile_plus_first_s=round(compile_and_first, 2),
+        prefill_tokens_per_s=round(prefill_tok_s),
+        decode_tokens_per_s_per_chip=round(decode_tok_s, 1),
+        decode_ms_per_token_per_seq=round(per_token_ms, 3),
+        roofline_tokens_per_s=round(bound_tok_s, 1),
+        roofline_fraction=round(decode_tok_s / bound_tok_s, 3))
+
+
+def bench_paged_kernel(B=8, ctx=4096, page_size=16):
+    """Decode-attention op microbench: paged kernel vs dense masked cache
+    at serving shapes (per-chip shard heads)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.paged_attention import paged_attention
+
+    H, KV, D = 4, 1, 128           # the mp=8 shard's head layout
+    layers = 8
+    rng = np.random.RandomState(0)
+    pages_per_seq = ctx // page_size
+    total_pages = B * pages_per_seq
+    q = jnp.asarray(rng.randn(B, H, D), jnp.bfloat16)
+    kp = jnp.asarray(rng.randn(KV, total_pages, page_size, D), jnp.bfloat16)
+    vp = jnp.asarray(rng.randn(KV, total_pages, page_size, D), jnp.bfloat16)
+    lengths = jnp.full((B,), ctx, jnp.int32)
+    page_idx = jnp.arange(total_pages, dtype=jnp.int32).reshape(
+        B, pages_per_seq)
+
+    CHAIN = 50
+
+    def chain(fn):
+        # run the op CHAIN times inside ONE program (output feeds the
+        # next query) so per-call tunnel RTT doesn't dominate the time
+        def chained(q, *args):
+            def it(carry, _):
+                o = fn(carry, *args)
+                return o.astype(carry.dtype), ()
+            out, _ = jax.lax.scan(it, q, None, length=CHAIN)
+            return out
+        return jax.jit(chained)
+
+    paged = chain(lambda q, kp, vp: paged_attention(
+        q, kp, vp, lengths, page_idx))
+
+    def dense_fn(q, k, v):
+        s = jnp.einsum("bhd,bthd->bht", q, k) * (D ** -0.5)
+        pos = jnp.arange(ctx)
+        s = jnp.where(pos[None, None, :] < lengths[:, None, None],
+                      s.astype(jnp.float32), -1e30)
+        w = jax.nn.softmax(s, -1).astype(v.dtype)
+        return jnp.einsum("bht,bthd->bhd", w, v)
+
+    k_dense = jnp.asarray(rng.randn(B, ctx, H, D), jnp.bfloat16)
+    v_dense = jnp.asarray(rng.randn(B, ctx, H, D), jnp.bfloat16)
+    dense = chain(dense_fn)
+
+    def timeit(fn, *args, reps=4):
+        out = fn(*args)
+        np.asarray(out)
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn(*args)
+        np.asarray(out)
+        return (time.time() - t0) / reps / CHAIN
+
+    t_paged = timeit(paged, q, kp, vp)
+    t_dense = timeit(dense, q, k_dense, v_dense)
+    # per-layer op; a full decode step runs `layers` of these
+    return dict(batch=B, context=ctx, page_size=page_size,
+                heads=f"{H}q/{KV}kv d{D}", layers_note=f"x{layers}/step",
+                paged_us=round(t_paged * 1e6, 1),
+                dense_us=round(t_dense * 1e6, 1),
+                paged_vs_dense=round(t_dense / t_paged, 2))
+
+
+def main():
+    import jax
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if not on_tpu:
+        print("WARNING: no TPU — numbers are CPU-host and not the record",
+              file=sys.stderr)
+    report = dict(device=str(jax.devices()[0].device_kind),
+                  hbm_bw_used=_bw(),
+                  decode=bench_decode(),
+                  paged_attention_op=bench_paged_kernel())
+    out = os.path.join(os.path.dirname(__file__), "..", "docs",
+                       "SERVING_BENCH.json")
+    if on_tpu:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
