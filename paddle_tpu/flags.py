@@ -144,6 +144,12 @@ define_flag("FLAGS_flash_impl", "intree",
             "(jax.experimental.pallas.ops.tpu.flash_attention), or "
             "'composite' (never take a fused kernel)",
             validator=lambda v: v in ("intree", "bundled", "composite"))
+define_flag("FLAGS_paged_impl", "intree",
+            "paged-attention decode kernel: 'intree' "
+            "(ops/pallas_paged.py, authored+tunable), 'bundled' "
+            "(jax.experimental paged_attention), or 'reference' (XLA "
+            "gather composite)",
+            validator=lambda v: v in ("intree", "bundled", "reference"))
 define_flag("FLAGS_eager_op_cache_size", 4096,
             "max entries in the per-op jitted computation cache")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity (higher = chattier)")

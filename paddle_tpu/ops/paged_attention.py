@@ -4,10 +4,12 @@ Reference capability (SURVEY §2.1 fused kernels): BlockMultiheadAttention /
 masked_multihead_attention (paged KV cache decoding kernels,
 paddle/phi/kernels/fusion/gpu/block_multi_head_attention*).
 
-TPU-native: routes to the in-tree Pallas TPU paged-attention kernel
-(jax.experimental.pallas.ops.tpu.paged_attention — the Ragged-Paged-
-Attention lineage from PAPERS.md) on TPU; elsewhere a gather-based XLA
-reference implements identical semantics for tests and CPU serving.
+TPU-native: routes to the in-tree AUTHORED Pallas decode kernel
+(ops/pallas_paged.py — scalar-prefetched page table, online softmax,
+GQA-native query groups) by default; FLAGS_paged_impl selects the
+bundled jax.experimental kernel (the Ragged-Paged-Attention lineage
+from PAPERS.md) or the gather-based XLA reference, which also remains
+the correctness oracle and the fallback for ineligible shapes.
 """
 
 from __future__ import annotations
@@ -61,8 +63,21 @@ def paged_attention_reference(q, k_pages, v_pages, lengths, page_indices,
 
 def paged_attention(q, k_pages, v_pages, lengths, page_indices,
                     scale: Optional[float] = None):
-    """TPU: Pallas paged-attention kernel; else: XLA reference."""
-    if jax.default_backend() == "tpu":
+    """Routing paged decode attention: the in-tree authored kernel
+    (ops/pallas_paged.py) by default, the bundled jax.experimental
+    kernel or the XLA gather composite via FLAGS_paged_impl; ineligible
+    shapes fall back to the composite."""
+    from ..flags import flag
+    impl = flag("FLAGS_paged_impl")
+    H, D = q.shape[1], q.shape[2]
+    KV, page_size = k_pages.shape[0], k_pages.shape[2]
+    if impl == "intree":
+        from .pallas_paged import paged_decode_attention, \
+            paged_kernel_eligible
+        if paged_kernel_eligible(H, KV, D, page_size):
+            return paged_decode_attention(q, k_pages, v_pages,
+                                          lengths, page_indices, scale)
+    elif impl == "bundled" and jax.default_backend() == "tpu":
         try:
             from jax.experimental.pallas.ops.tpu.paged_attention import (
                 paged_attention as _kernel)

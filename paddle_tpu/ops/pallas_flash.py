@@ -41,6 +41,12 @@ __all__ = ["flash_sdpa", "flash_kernel_eligible"]
 
 _NEG = -1e30
 
+# B/H/outer-block grid dims are independent; only the innermost dim
+# carries the online-softmax / accumulator state. Marking them parallel
+# lets Mosaic split them across TensorCores (megacore parts)
+_CPARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -253,6 +259,7 @@ def _flash_fwd_impl(q, k, v, seg_q, seg_kv, scale, causal, bq, bk,
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32)],
+        compiler_params=_CPARAMS,
         interpret=_interpret(),
     )(seg_q, seg_kv, q, k, v)
     return o, lse
@@ -286,6 +293,7 @@ def _flash_vjp_bwd(scale, causal, bq, bk, use_seg, res, do):
         out_specs=pl.BlockSpec((1, 1, bq, D), qmap),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_CPARAMS,
         interpret=_interpret(),
     )(seg_q, seg_kv, q, k, v, do, lse, di)
 
@@ -304,6 +312,7 @@ def _flash_vjp_bwd(scale, causal, bq, bk, use_seg, res, do):
                    jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=_CPARAMS,
         interpret=_interpret(),
     )(seg_q, seg_kv, q, k, v, do, lse, di)
     return dq, dk, dv, None, None

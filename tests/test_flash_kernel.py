@@ -137,7 +137,6 @@ class TestEligibilityAndRouting:
         with flags_guard(flash_impl="bundled"):
             # bundled refuses unequal causal; intree (default) accepts
             qs, ks, _ = _qkv(128, 256, 128)
-            on_tpu = jax.default_backend() == "tpu"
             assert sdpa_path(qs, ks, causal=True) == "composite"
         if jax.default_backend() == "tpu":
             qs, ks, _ = _qkv(128, 256, 128)

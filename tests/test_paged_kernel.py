@@ -1,0 +1,96 @@
+"""In-tree paged-attention decode kernel (ops/pallas_paged.py — VERDICT
+r2 Missing #7; ref: paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention*). The XLA gather composite
+(paged_attention_reference) is the correctness oracle. Runs in Pallas
+interpret mode on CPU: same kernel logic as the TPU path."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.paged_attention import (paged_attention,
+                                            paged_attention_reference)
+from paddle_tpu.ops.pallas_paged import (paged_decode_attention,
+                                         paged_kernel_eligible)
+
+
+def _setup(B, H, KV, D, psz, pages_per_seq, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    total = B * pages_per_seq
+    q = jnp.asarray(rng.randn(B, H, D), dtype)
+    kp = jnp.asarray(rng.randn(KV, total, psz, D), dtype)
+    vp = jnp.asarray(rng.randn(KV, total, psz, D), dtype)
+    tab = jnp.asarray(rng.permutation(total).reshape(B, pages_per_seq),
+                      jnp.int32)
+    lens = jnp.asarray(rng.randint(1, pages_per_seq * psz + 1, (B,)),
+                       jnp.int32)
+    return q, kp, vp, lens, tab
+
+
+class TestPagedKernelParity:
+    @pytest.mark.parametrize("B,H,KV,D,psz,pps", [
+        (3, 8, 2, 128, 16, 8),    # GQA rep=4, random table, ragged lens
+        (2, 4, 1, 64, 16, 4),     # MQA, D=64
+        (2, 4, 4, 128, 32, 4),    # MHA (rep=1), bigger pages
+    ])
+    def test_matches_reference(self, B, H, KV, D, psz, pps):
+        q, kp, vp, lens, tab = _setup(B, H, KV, D, psz, pps)
+        out = paged_decode_attention(q, kp, vp, lens, tab)
+        ref = paged_attention_reference(q, kp, vp, lens, tab)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_single_token_length(self):
+        # lens=1: only the first slot of the first page is visible
+        q, kp, vp, _, tab = _setup(2, 4, 2, 128, 16, 4, seed=3)
+        lens = jnp.asarray([1, 1], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, lens, tab)
+        ref = paged_attention_reference(q, kp, vp, lens, tab)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_bf16(self):
+        q, kp, vp, lens, tab = _setup(2, 8, 2, 128, 16, 4, seed=5,
+                                      dtype=jnp.bfloat16)
+        out = paged_decode_attention(q, kp, vp, lens, tab)
+        ref = paged_attention_reference(q, kp, vp, lens, tab)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=3e-2, rtol=3e-2)
+
+    def test_custom_scale(self):
+        q, kp, vp, lens, tab = _setup(2, 4, 2, 128, 16, 4, seed=7)
+        out = paged_decode_attention(q, kp, vp, lens, tab, scale=0.05)
+        ref = paged_attention_reference(q, kp, vp, lens, tab, scale=0.05)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestRouting:
+    def test_default_routes_intree(self):
+        from paddle_tpu.flags import flag
+        assert flag("FLAGS_paged_impl") == "intree"
+        q, kp, vp, lens, tab = _setup(2, 4, 2, 128, 16, 4)
+        out = paged_attention(q, kp, vp, lens, tab)
+        ref = paged_attention_reference(q, kp, vp, lens, tab)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_ineligible_falls_back(self):
+        # D=96 is not MXU-eligible; the route must still be correct
+        q, kp, vp, lens, tab = _setup(2, 4, 2, 96, 16, 4)
+        assert not paged_kernel_eligible(4, 2, 96, 16)
+        out = paged_attention(q, kp, vp, lens, tab)
+        ref = paged_attention_reference(q, kp, vp, lens, tab)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_flag_reference_impl(self):
+        from paddle_tpu.flags import flags_guard
+        q, kp, vp, lens, tab = _setup(2, 4, 2, 128, 16, 4)
+        with flags_guard(paged_impl="reference"):
+            out = paged_attention(q, kp, vp, lens, tab)
+        ref = paged_attention_reference(q, kp, vp, lens, tab)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)

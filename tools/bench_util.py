@@ -1,0 +1,32 @@
+"""Shared measurement helpers for the on-chip bench tools.
+
+block_until_ready is a NO-OP on the axon-tunneled TPU this image exposes
+— a host fetch of one element is the only honest barrier. Every bench
+must use these helpers so a future barrier fix lands in one place.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def fetch(out):
+    """Force device completion by fetching one element to the host."""
+    leaf = out
+    while isinstance(leaf, (tuple, list, dict)):
+        leaf = next(iter(leaf.values())) if isinstance(leaf, dict) \
+            else leaf[0]
+    np.asarray(leaf[(0,) * leaf.ndim])
+
+
+def timeit(fn, *args, reps: int = 20) -> float:
+    """Seconds per call, steady-state (one warmup/compile call first)."""
+    out = fn(*args)
+    fetch(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+    fetch(out)
+    return (time.time() - t0) / reps

@@ -13,23 +13,10 @@ import time
 import numpy as np
 
 
-def _fetch(out):
-    """block_until_ready is a no-op on the axon tunnel; a host fetch of
-    one element is the only honest barrier."""
-    leaf = out
-    while isinstance(leaf, (tuple, list)):
-        leaf = leaf[0]
-    np.asarray(leaf[(0,) * leaf.ndim])
-
-
-def _timeit(fn, *args, reps=20):
-    out = fn(*args)
-    _fetch(out)
-    t0 = time.time()
-    for _ in range(reps):
-        out = fn(*args)
-    _fetch(out)
-    return (time.time() - t0) / reps
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from bench_util import timeit as _timeit  # noqa: E402
 
 
 def main():

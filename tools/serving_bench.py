@@ -28,6 +28,8 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 HBM_BW = {"v5e": 819e9, "v5p": 2765e9, "v4": 1228e9, "v6e": 1640e9}
 
 
@@ -171,8 +173,15 @@ def bench_paged_kernel(B=8, ctx=4096, page_size=16):
             return out
         return jax.jit(chained)
 
-    paged = chain(lambda q, kp, vp: paged_attention(
+    from paddle_tpu.ops.pallas_paged import paged_decode_attention
+    from paddle_tpu.flags import flags_guard
+    paged = chain(lambda q, kp, vp: paged_decode_attention(
         q, kp, vp, lengths, page_idx))
+
+    def _bundled(q, kp, vp):
+        with flags_guard(paged_impl="bundled"):
+            return paged_attention(q, kp, vp, lengths, page_idx)
+    paged_bundled = chain(_bundled)
 
     def dense_fn(q, k, v):
         s = jnp.einsum("bhd,bthd->bht", q, k) * (D ** -0.5)
@@ -186,23 +195,22 @@ def bench_paged_kernel(B=8, ctx=4096, page_size=16):
     v_dense = jnp.asarray(rng.randn(B, ctx, H, D), jnp.bfloat16)
     dense = chain(dense_fn)
 
+    from bench_util import timeit as _shared_timeit
+
     def timeit(fn, *args, reps=4):
-        out = fn(*args)
-        np.asarray(out)
-        t0 = time.time()
-        for _ in range(reps):
-            out = fn(*args)
-        np.asarray(out)
-        return (time.time() - t0) / reps / CHAIN
+        return _shared_timeit(fn, *args, reps=reps) / CHAIN
 
     t_paged = timeit(paged, q, kp, vp)
+    t_bundled = timeit(paged_bundled, q, kp, vp)
     t_dense = timeit(dense, q, k_dense, v_dense)
     # per-layer op; a full decode step runs `layers` of these
     return dict(batch=B, context=ctx, page_size=page_size,
                 heads=f"{H}q/{KV}kv d{D}", layers_note=f"x{layers}/step",
-                paged_us=round(t_paged * 1e6, 1),
+                paged_intree_us=round(t_paged * 1e6, 1),
+                paged_bundled_us=round(t_bundled * 1e6, 1),
                 dense_us=round(t_dense * 1e6, 1),
-                paged_vs_dense=round(t_dense / t_paged, 2))
+                intree_vs_dense=round(t_dense / t_paged, 2),
+                intree_vs_bundled=round(t_bundled / t_paged, 2))
 
 
 def main():
