@@ -150,6 +150,13 @@ define_flag("FLAGS_paged_impl", "intree",
             "(jax.experimental paged_attention), or 'reference' (XLA "
             "gather composite)",
             validator=lambda v: v in ("intree", "bundled", "reference"))
+define_flag("FLAGS_gmm_impl", "auto",
+            "grouped-GEMM (MoE expert compute): 'auto' (fastest-first: "
+            "ragged_dot -> in-tree ops/pallas_gmm.py -> bundled "
+            "megablox -> einsum), or pin 'xla'/'intree'/'bundled'/"
+            "'einsum'",
+            validator=lambda v: v in ("auto", "xla", "intree", "bundled",
+                                      "einsum"))
 define_flag("FLAGS_eager_op_cache_size", 4096,
             "max entries in the per-op jitted computation cache")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity (higher = chattier)")

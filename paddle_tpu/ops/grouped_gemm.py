@@ -3,10 +3,13 @@
 Reference capability: CUTLASS grouped-gemm fused MoE kernels
 (paddle/phi/kernels/fusion/cutlass/ moe/weight-only gemm — SURVEY §2.3 P7).
 
-TPU-native realization: `jax.lax.ragged_dot` — XLA's native ragged matmul
-lowers onto the MXU with one kernel over all expert groups (the megablocks
-"dropless" pattern). A pure-einsum fallback keeps the op correct on backends
-or shapes where ragged_dot is unavailable.
+TPU-native realization, fastest-first (v5e measurements in README /
+tools-bench notes): `jax.lax.ragged_dot` (XLA's native ragged matmul —
+fastest fwd, ties bwd), then the in-tree authored Pallas kernel
+(ops/pallas_gmm.py — beats the bundled megablox kernel 1.5-1.6x on the
+benched MoE shapes and runs everywhere incl. interpret-mode CPU), then
+bundled megablox, then a pure-einsum fallback. FLAGS_gmm_impl pins one
+('auto'/'xla'/'intree'/'bundled'/'einsum').
 """
 
 from __future__ import annotations
@@ -20,20 +23,51 @@ __all__ = ["grouped_gemm", "sort_by_group", "unsort_by_group"]
 def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
     """lhs [M, K] rows grouped contiguously; rhs [G, K, N]; group_sizes [G]
     (sum == M). Returns [M, N] where row m is multiplied by its group's rhs.
+
+    Routing: FLAGS_gmm_impl 'auto' tries fastest-first and falls through
+    on ANY kernel failure; a PINNED impl ('xla'/'intree'/'bundled'/
+    'einsum') runs exactly that one and lets its errors surface —
+    pinning exists to benchmark/validate a specific kernel, so silent
+    degradation would defeat it. prefer_ragged=False (legacy knob) only
+    applies in 'auto' mode, where it means einsum-only.
     """
+    from ..flags import flag
+    impl = flag("FLAGS_gmm_impl")
     G = rhs.shape[0]
-    if prefer_ragged:
-        if jax.default_backend() == "tpu":
-            try:
-                # megablox gmm: the Pallas TPU grouped-GEMM kernel
-                from jax.experimental.pallas.ops.tpu.megablox import gmm
-                return gmm(lhs, rhs, group_sizes.astype(jnp.int32))
-            except Exception:  # pragma: no cover - kernel constraints
-                pass
+    gs32 = group_sizes.astype(jnp.int32)
+    if impl == "xla":
+        return jax.lax.ragged_dot(lhs, rhs, gs32)
+    if impl == "intree":
+        from .pallas_gmm import gmm, gmm_kernel_eligible
+        if not gmm_kernel_eligible(lhs.shape[0], lhs.shape[1],
+                                   rhs.shape[2]):
+            raise ValueError(
+                f"FLAGS_gmm_impl='intree' pinned but shape M={lhs.shape[0]} "
+                f"K={lhs.shape[1]} N={rhs.shape[2]} is not kernel-eligible "
+                "(N and K must be 128-multiples)")
+        return gmm(lhs, rhs, gs32)
+    if impl == "bundled":
+        from jax.experimental.pallas.ops.tpu.megablox import gmm as mb_gmm
+        return mb_gmm(lhs, rhs, gs32)
+    if impl == "auto" and prefer_ragged:
         try:
-            return jax.lax.ragged_dot(lhs, rhs, group_sizes.astype(jnp.int32))
+            return jax.lax.ragged_dot(lhs, rhs, gs32)
         except Exception:  # pragma: no cover - backend-specific gaps
             pass
+        from .pallas_gmm import gmm, gmm_kernel_eligible
+        if gmm_kernel_eligible(lhs.shape[0], lhs.shape[1], rhs.shape[2]):
+            try:
+                return gmm(lhs, rhs, gs32)
+            except Exception:  # pragma: no cover - e.g. VMEM overflow
+                pass
+        if jax.default_backend() == "tpu":
+            try:
+                # megablox gmm: the bundled Pallas TPU grouped-GEMM kernel
+                from jax.experimental.pallas.ops.tpu.megablox import gmm \
+                    as mb_gmm
+                return mb_gmm(lhs, rhs, gs32)
+            except Exception:  # pragma: no cover - kernel constraints
+                pass
     # fallback: one-hot group membership -> batched einsum (static shapes)
     M = lhs.shape[0]
     ends = jnp.cumsum(group_sizes)
