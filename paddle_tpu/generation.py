@@ -129,26 +129,41 @@ def generate(model, input_ids, max_new_tokens: int = 20,
 # kernels — paddle/phi/kernels/fusion/gpu/masked_multihead_attention)
 # ---------------------------------------------------------------------------
 def _llama_decode_params(model):
+    """Extract the cached-decode weight tree from a Llama-family CausalLM
+    (LlamaForCausalLM, Qwen2ForCausalLM — same GQA backbone; Qwen2 adds
+    q/k/v biases, carried as optional leaves)."""
     cfg = model.config
-    if cfg.fuse_attention_qkv or cfg.fuse_attention_ffn:
+    inner = getattr(model, "llama", None)
+    if inner is None:
+        inner = getattr(model, "qwen2", None)
+    if inner is None:
+        raise NotImplementedError(
+            "KV-cache generation: expected a Llama-family model "
+            "(model.llama / model.qwen2)")
+    if getattr(cfg, "fuse_attention_qkv", False) or \
+            getattr(cfg, "fuse_attention_ffn", False):
         raise NotImplementedError(
             "use_cache generation supports the unfused Llama layout; the "
             "fused qkv/ffn packs are pretrain perf knobs")
-    llama = model.llama
     layers = []
-    for lyr in llama.layers:
+    for lyr in inner.layers:
         a, m = lyr.self_attn, lyr.mlp
-        layers.append(dict(
+        d = dict(
             ln1=lyr.input_layernorm.weight._data,
             wq=a.q_proj.weight._data, wk=a.k_proj.weight._data,
             wv=a.v_proj.weight._data, wo=a.o_proj.weight._data,
             ln2=lyr.post_attention_layernorm.weight._data,
             wg=m.gate_proj.weight._data, wu=m.up_proj.weight._data,
-            wd=m.down_proj.weight._data))
+            wd=m.down_proj.weight._data)
+        if getattr(a.q_proj, "bias", None) is not None:
+            d["bq"] = a.q_proj.bias._data
+            d["bk"] = a.k_proj.bias._data
+            d["bv"] = a.v_proj.bias._data
+        layers.append(d)
     head = model.lm_head.weight._data if model.lm_head is not None else None
-    return dict(cfg=cfg, embed=llama.embed_tokens.weight._data,
-                layers=layers, norm=llama.norm.weight._data, head=head,
-                cos=llama.rope_cos._data, sin=llama.rope_sin._data)
+    return dict(cfg=cfg, embed=inner.embed_tokens.weight._data,
+                layers=layers, norm=inner.norm.weight._data, head=head,
+                cos=inner.rope_cos._data, sin=inner.rope_sin._data)
 
 
 def _llama_weights(p):
@@ -187,9 +202,12 @@ def _llama_cached_step_body(cfg, max_len: int):
         vis = pos_k[None, :] <= q_pos[:, None]            # [S, max_len]
         for L, (ck, cv) in zip(w["layers"], caches):
             h = rms(x, L["ln1"])
-            q = (h @ L["wq"]).reshape(B, S, Hh, D)
-            k = (h @ L["wk"]).reshape(B, S, KV, D)
-            v = (h @ L["wv"]).reshape(B, S, KV, D)
+            q, k, v = h @ L["wq"], h @ L["wk"], h @ L["wv"]
+            if "bq" in L:                      # Qwen2 qkv biases
+                q, k, v = q + L["bq"], k + L["bk"], v + L["bv"]
+            q = q.reshape(B, S, Hh, D)
+            k = k.reshape(B, S, KV, D)
+            v = v.reshape(B, S, KV, D)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
             ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))

@@ -180,3 +180,23 @@ def test_compiled_decode_sampling_valid():
     assert g.shape == (2, 4) and (g >= 0).all() and (g < c.vocab_size).all()
     s = scores.numpy()
     assert np.all(np.isfinite(s)) and np.all(s <= 1e-6)
+
+
+def test_qwen2_cached_and_compiled_decode():
+    """The cached/compiled decode family covers Qwen2 (qkv biases, tied
+    head) — tokens must match the padded-buffer path exactly."""
+    from paddle_tpu.models.qwen2 import Qwen2ForCausalLM, qwen2_tiny_config
+    from paddle_tpu.generation import generate_cached, generate_compiled
+    paddle.seed(0)
+    c = qwen2_tiny_config(num_hidden_layers=2)
+    model = Qwen2ForCausalLM(c)
+    model.eval()
+    ids = _prompt(2, 5, c.vocab_size, seed=21)
+    ref, _ = generate(model, ids, max_new_tokens=5,
+                      decode_strategy="greedy_search")
+    got_c, _ = generate_cached(model, ids, max_new_tokens=5,
+                               decode_strategy="greedy_search")
+    got_k, _ = generate_compiled(model, ids, max_new_tokens=5,
+                                 decode_strategy="greedy_search")
+    np.testing.assert_array_equal(ref.numpy(), got_c.numpy())
+    np.testing.assert_array_equal(ref.numpy(), got_k.numpy())
