@@ -222,6 +222,11 @@ def main():
     report = dict(device=str(jax.devices()[0].device_kind),
                   hbm_bw_used=_bw(),
                   decode=bench_decode(),
+                  # latency point (B=1) and a fatter-batch point: decode
+                  # tok/s scales with B until the KV reads pass the
+                  # weight reads in the roofline denominator
+                  decode_b1=bench_decode(B=1, S0=1024, new=256),
+                  decode_b16=bench_decode(B=16, S0=1024, new=256),
                   paged_attention_op=bench_paged_kernel())
     out = os.path.join(os.path.dirname(__file__), "..", "docs",
                        "SERVING_BENCH.json")
