@@ -161,9 +161,38 @@ def _llama_decode_params(model):
             d["bv"] = a.v_proj.bias._data
         layers.append(d)
     head = model.lm_head.weight._data if model.lm_head is not None else None
-    return dict(cfg=cfg, embed=inner.embed_tokens.weight._data,
+    return dict(cfg=cfg, family="llama",
+                embed=inner.embed_tokens.weight._data,
                 layers=layers, norm=inner.norm.weight._data, head=head,
                 cos=inner.rope_cos._data, sin=inner.rope_sin._data)
+
+
+def _gpt_decode_params(model):
+    """GPT family: fused qkv (+bias), LayerNorms with biases, GELU MLP,
+    learned positions, no rope."""
+    gpt = model.gpt
+    layers = []
+    for blk in gpt.h:
+        layers.append(dict(
+            ln1w=blk.ln_1.weight._data, ln1b=blk.ln_1.bias._data,
+            wqkv=blk.attn.qkv.weight._data, bqkv=blk.attn.qkv.bias._data,
+            wo=blk.attn.proj.weight._data, bo=blk.attn.proj.bias._data,
+            ln2w=blk.ln_2.weight._data, ln2b=blk.ln_2.bias._data,
+            wi=blk.mlp.fc_in.weight._data, bi=blk.mlp.fc_in.bias._data,
+            wf=blk.mlp.fc_out.weight._data, bf=blk.mlp.fc_out.bias._data))
+    head = model.lm_head.weight._data if model.lm_head is not None else None
+    return dict(cfg=model.config, family="gpt",
+                embed=gpt.embed_tokens.weight._data,
+                pos=gpt.embed_positions.weight._data,
+                layers=layers, normw=gpt.ln_f.weight._data,
+                normb=gpt.ln_f.bias._data, head=head)
+
+
+def _decode_params(model):
+    """Family dispatch for the cached/compiled decode paths."""
+    if getattr(model, "gpt", None) is not None:
+        return _gpt_decode_params(model)
+    return _llama_decode_params(model)
 
 
 def _llama_weights(p):
@@ -172,8 +201,7 @@ def _llama_weights(p):
     embedded in the lowered module as a literal constant, and at 8B-shard
     scale (~0.5 GB) that makes XLA chew through the weights at compile
     time (~5 s/MB measured on the axon remote-compile path)."""
-    return {k: p[k] for k in ("embed", "layers", "norm", "head",
-                              "cos", "sin")}
+    return {k: v for k, v in p.items() if k not in ("cfg", "family")}
 
 
 def _llama_cached_step_body(cfg, max_len: int):
@@ -234,11 +262,66 @@ def _llama_cached_step_body(cfg, max_len: int):
     return step
 
 
+def _gpt_cached_step_body(cfg, max_len: int):
+    """GPT analog of _llama_cached_step_body: learned positions, LN with
+    bias, fused qkv, GELU MLP; MHA cache (KV heads == q heads)."""
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    eps = cfg.layer_norm_eps
+
+    def ln(h, wt, b):
+        h32 = h.astype(jnp.float32)
+        mu = jnp.mean(h32, -1, keepdims=True)
+        var = jnp.var(h32, -1, keepdims=True)
+        return (((h32 - mu) * jax.lax.rsqrt(var + eps))
+                .astype(h.dtype) * wt + b)
+
+    def step(w, ids, caches, start):
+        B, S = ids.shape
+        x = w["embed"][ids] + jax.lax.dynamic_slice_in_dim(
+            w["pos"], start, S, 0)[None]
+        pos_k = jnp.arange(max_len)
+        q_pos = start + jnp.arange(S)
+        vis = pos_k[None, :] <= q_pos[:, None]            # [S, max_len]
+        new_caches = []
+        for L, (ck, cv) in zip(w["layers"], caches):
+            h = ln(x, L["ln1w"], L["ln1b"])
+            qkv = h @ L["wqkv"] + L["bqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, S, nh, hd)
+            k = k.reshape(B, S, nh, hd)
+            v = v.reshape(B, S, nh, hd)
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
+            new_caches.append((ck, cv))
+            scores = jnp.einsum("bshd,bthd->bhst", q, ck) * (hd ** -0.5)
+            scores = jnp.where(vis[None, None],
+                               scores.astype(jnp.float32), -1e30)
+            aw = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+            o = jnp.einsum("bhst,bthd->bshd", aw, cv).reshape(B, S, -1)
+            x = x + (o @ L["wo"] + L["bo"])
+            h2 = ln(x, L["ln2w"], L["ln2b"])
+            x = x + (jax.nn.gelu(h2 @ L["wi"] + L["bi"],
+                                 approximate=True) @ L["wf"] + L["bf"])
+        x = ln(x, w["normw"], w["normb"])
+        last = x[:, -1]
+        logits = last @ (w["head"] if w["head"] is not None
+                         else w["embed"].T)
+        return logits, new_caches
+
+    return step
+
+
+def _cached_step_body(p, max_len: int):
+    if p["family"] == "gpt":
+        return _gpt_cached_step_body(p["cfg"], max_len)
+    return _llama_cached_step_body(p["cfg"], max_len)
+
+
 def _make_llama_cached_step(p, max_len: int):
     """Jitted cached step: one compile per distinct step width (prefill
     S0, decode 1). Weights ride as jit arguments (see _llama_weights)."""
     w = _llama_weights(p)
-    jitted = jax.jit(_llama_cached_step_body(p["cfg"], max_len))
+    jitted = jax.jit(_cached_step_body(p, max_len))
     return lambda ids, caches, start: jitted(w, ids, caches, start)
 
 
