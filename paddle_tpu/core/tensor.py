@@ -144,6 +144,16 @@ class Tensor:
     def __int__(self):
         return int(self._data)
 
+    def __index__(self):
+        # lets a concrete 0-d integer Tensor drive range()/slicing, matching
+        # the reference Tensor's __index__ (dygraph scalar protocol)
+        import numpy as _np
+        if not _np.issubdtype(self._data.dtype, _np.integer):
+            raise TypeError(
+                f"only integer Tensors can be used as an index, got "
+                f"{self._data.dtype}")
+        return int(self._data)
+
     def __bool__(self):
         return bool(self._data)
 

@@ -109,7 +109,15 @@ def _find_layers(fn) -> List[Layer]:
             pass
     if code is not None:
         g = getattr(fn, "__globals__", {})
-        for name in code.co_names:
+        # walk nested code objects too (lambdas / inner defs reference
+        # globals through their OWN co_names — e.g. cond/while_loop branch
+        # closures naming a module-level Layer)
+        stack, names = [code], set()
+        while stack:
+            c = stack.pop()
+            names.update(c.co_names)
+            stack.extend(k for k in c.co_consts if isinstance(k, type(code)))
+        for name in names:
             add(g.get(name))
     return layers
 
@@ -237,13 +245,16 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):
-    """@paddle.jit.to_static parity decorator."""
+    """@paddle.jit.to_static parity decorator. Python ``if``/``while``/
+    ``for range()`` on traced tensors are captured by the dy2static AST
+    pass (``jit/dy2static.py``) into lax control flow before tracing."""
     def wrap(fn):
+        from . import dy2static
         if isinstance(fn, Layer):
-            sf = StaticFunction(fn.forward, layers=[fn])
+            sf = StaticFunction(dy2static.convert(fn.forward), layers=[fn])
             fn.forward = sf
             return fn
-        return StaticFunction(fn)
+        return StaticFunction(dy2static.convert(fn))
     if function is not None:
         return wrap(function)
     return wrap

@@ -1,0 +1,681 @@
+"""dy2static — AST control-flow capture for ``to_static`` (ref:
+python/paddle/jit/dy2static/ — IfElseTransformer / LoopTransformer /
+convert_ifelse / convert_while_loop rewrite python ``if``/``while``/``for``
+into cond/while ops on the captured program; SURVEY §2.2 jit row).
+
+TPU-native rework: the reference needs a full source-to-source translator
+because its static graph has no Python execution at all. Here the traced
+program IS Python execution, so the transform is far smaller: every
+``if``/``while``/``for range()`` statement is rewritten into a call to a
+runtime dispatcher (``_jst.run_if`` / ``run_while`` / ``run_for_range``)
+that checks the predicate at run time —
+
+* concrete predicate → execute the original Python branch/loop (identical
+  semantics, taken path only, exact tape autograd),
+* traced predicate (under ``jit``/``to_static``) → lower through
+  ``paddle_tpu.static.nn.cond`` / ``while_loop`` so XLA compiles a real
+  conditional/while region instead of the trace failing.
+
+This runtime dual-dispatch replaces the reference's static analysis: no
+type inference is needed because the decision is made on the live value
+(the same trick as convert_ifelse's ``paddle.jit.dy2static.convert_*``
+wrappers, which also dispatch on Variable-ness at run time).
+
+Scope (documented limitations, each falls back to the untransformed
+statement, which still works for concrete predicates):
+* ``return`` / ``break`` / ``continue`` inside a tensor-dependent branch
+  or loop body are not captured (the reference rewrites these with flag
+  variables; here the statement is left as plain Python),
+* in-place Tensor mutation of closure variables inside a traced branch is
+  dropped (branch outputs must flow through the returned loop/branch vars),
+* loops with a traced predicate are forward-only unless
+  ``FLAGS_dy2static_max_iter`` is set (bounded differentiable scan).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Any, Callable, List, Optional, Sequence, Set
+
+import jax
+
+from ..core.tensor import Tensor
+from ..flags import define_flag, flag
+
+try:
+    define_flag("FLAGS_dy2static_max_iter", 0,
+                "if >0, tensor-dependent loops converted by dy2static lower "
+                "to a bounded differentiable scan of this length instead of "
+                "a forward-only lax.while_loop")
+except ValueError:
+    pass
+
+__all__ = ["convert", "Undefined", "run_if", "run_while", "run_for_range",
+           "ld"]
+
+
+# ---------------------------------------------------------------------------
+# runtime dispatchers (the convert_* ops of the reference)
+# ---------------------------------------------------------------------------
+
+class Undefined:
+    """Sentinel for a name unbound at the control-flow statement. Any use
+    raises the NameError python would have raised."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            f"variable '{self.name}' is not defined on every path through a "
+            "dy2static-converted control-flow statement")
+
+    __bool__ = __call__ = __add__ = __radd__ = __mul__ = _raise
+    __sub__ = __truediv__ = __getitem__ = __iter__ = __len__ = _raise
+    __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __neg__ = __rsub__ = __rmul__ = __rtruediv__ = __mod__ = _raise
+    __hash__ = object.__hash__
+
+    def __getattr__(self, name):
+        # dunder probes (getattr(v, "__closure__", None), pickling, etc.)
+        # must see a plain AttributeError, not the use-error
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        self._raise()
+
+    def __repr__(self):
+        return f"<undefined '{self.name}'>"
+
+
+def ld(thunk: Callable, name: str):
+    """Safe load of a possibly-unbound local for threading into branch fns."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return Undefined(name)
+
+
+def _is_traced(x) -> bool:
+    arr = x._data if isinstance(x, Tensor) else x
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _truthy(p) -> bool:
+    if isinstance(p, Tensor):
+        return bool(p._data)
+    return bool(p)
+
+
+def _check_defined(cur: Sequence[Any], what: str):
+    for v in cur:
+        if isinstance(v, Undefined):
+            raise NameError(
+                f"dy2static: variable '{v.name}' must be assigned before a "
+                f"tensor-dependent {what} (every branch/loop variable needs "
+                "an initial value to lower to lax control flow)")
+
+
+def run_if(test_thunk: Callable, true_fn: Callable, false_fn: Callable,
+           cur: tuple):
+    """Dispatcher for a converted ``if`` statement. ``true_fn``/``false_fn``
+    take and return the tuple of written names. A name need not exist
+    before the ``if`` as long as BOTH branches assign it (reference
+    semantics: conditional_block output vars)."""
+    pred = test_thunk()
+    if _is_traced(pred):
+        from ..static import control_flow as cf
+
+        def _chk(vals, branch):
+            for v in vals:
+                if isinstance(v, Undefined):
+                    raise NameError(
+                        f"dy2static: variable '{v.name}' is assigned in only "
+                        f"one branch of a tensor-dependent if (missing in the "
+                        f"{branch} branch); assign it in both branches or "
+                        "before the if to lower to lax.cond")
+            return vals
+
+        out = cf.cond(pred, lambda: _chk(tuple(true_fn(*cur)), "true"),
+                      lambda: _chk(tuple(false_fn(*cur)), "false"))
+        return tuple(out)
+    return tuple(true_fn(*cur)) if _truthy(pred) else tuple(false_fn(*cur))
+
+
+def run_while(cond_fn: Callable, body_fn: Callable, cur: tuple,
+              names: tuple = (), n_carried: Optional[int] = None):
+    """Dispatcher for a converted ``while`` statement. ``cur`` is ordered
+    carried-variables-first; ``cur[n_carried:]`` are loop temps (assigned
+    before read each iteration — the reference LoopTransformer's
+    create-in-loop vars) which are NOT threaded through the lax carry. A
+    temp's post-loop value under trace is Undefined (reads raise; python
+    path returns the real last value)."""
+    if n_carried is None:
+        n_carried = len(cur)
+    first = cond_fn(*cur)
+    if _is_traced(first):
+        from ..static import control_flow as cf
+        carried, temps = cur[:n_carried], cur[n_carried:]
+        _check_defined(carried, "while loop")
+        mx = flag("FLAGS_dy2static_max_iter") or None
+        out = cf.while_loop(
+            lambda *c: cond_fn(*c, *temps),
+            lambda *c: tuple(body_fn(*c, *temps))[:n_carried],
+            list(carried), max_iter=mx)
+        tail = tuple(Undefined(names[n_carried + j] if names else "<temp>")
+                     for j in range(len(temps)))
+        return tuple(out) + tail
+    vals = cur
+    while _truthy(first):
+        vals = tuple(body_fn(*vals))
+        first = cond_fn(*vals)
+    return vals
+
+
+def run_for_range(range_thunk: Callable, body_fn: Callable, cur: tuple,
+                  names: tuple = (), n_carried: Optional[int] = None):
+    """Dispatcher for a converted ``for <name> in range(...)`` statement.
+    ``cur[0]`` is the prior value of the index name (possibly Undefined);
+    ``body_fn(i, *vars) -> (i, *vars)`` with vars ordered carried-first
+    (see :func:`run_while`). Traced-bound loops lower to while_loop; the
+    returned index is the python last-iteration value (``start - step``
+    for a dynamically zero-trip traced loop)."""
+    args = range_thunk()
+    prior_i, rest = cur[0], tuple(cur[1:])
+    if n_carried is None:
+        n_carried = len(rest)
+    if any(_is_traced(a) for a in args):
+        from ..static import control_flow as cf
+        import jax.numpy as jnp
+        carried, temps = rest[:n_carried], rest[n_carried:]
+        _check_defined(carried, "for loop")
+        if len(args) == 1:
+            start, stop, step = 0, args[0], 1
+        elif len(args) == 2:
+            (start, stop), step = args, 1
+        else:
+            start, stop, step = args
+        if isinstance(step, Tensor):
+            raise ValueError(
+                "dy2static for-range: step must be a python int when the "
+                "bounds are tensors (XLA needs the loop direction "
+                "statically)")
+        step = int(step)
+        if step == 0:
+            raise ValueError("range() arg 3 must not be zero")
+        i0 = start if isinstance(start, Tensor) else Tensor(jnp.asarray(start))
+        stop_t = stop if isinstance(stop, Tensor) else Tensor(jnp.asarray(stop))
+
+        def cnd(i, _s, *vs):
+            return (i < _s) if step > 0 else (i > _s)
+
+        def body(i, _s, *vs):
+            out = body_fn(i, *vs, *temps)
+            # python rebinds the index from the iterator each pass — a body
+            # assignment to it must not change the iteration count
+            return (i + step, _s) + tuple(out[1:1 + n_carried])
+
+        mx = flag("FLAGS_dy2static_max_iter") or None
+        out = cf.while_loop(cnd, body, [i0, stop_t] + list(carried),
+                            max_iter=mx)
+        tail = tuple(Undefined(names[1 + n_carried + j] if names else "<temp>")
+                     for j in range(len(temps)))
+        return (out[0] - step,) + tuple(out[2:]) + tail
+    vals = rest
+    i = prior_i
+    for i in range(*[int(a) if isinstance(a, Tensor) else a for a in args]):
+        out = body_fn(i, *vals)
+        i, vals = out[0], tuple(out[1:])
+    return (i,) + vals
+
+
+# ---------------------------------------------------------------------------
+# written-name analysis
+# ---------------------------------------------------------------------------
+
+def _written_names(stmts: Sequence[ast.stmt]) -> Set[str]:
+    """Names bound by the statements, at this function's scope (does not
+    descend into nested function/class/lambda/comprehension scopes)."""
+    out: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                out.add(node.id)
+
+        def visit_NamedExpr(self, node):
+            if isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+            self.visit(node.value)
+
+        def visit_FunctionDef(self, node):
+            out.add(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            out.add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+        def _comp(self, node):
+            # py3 comprehensions have their own scope; only the walrus leaks
+            for gen in node.generators:
+                self.visit(gen.iter)
+
+        visit_ListComp = visit_SetComp = visit_DictComp = _comp
+        visit_GeneratorExp = _comp
+
+        def visit_Import(self, node):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+
+        visit_ImportFrom = visit_Import
+
+    for s in stmts:
+        V().visit(s)
+    return {n for n in out
+            if not n.startswith(("_pt_", "__pt_")) and n != "_jst"}
+
+
+def _stored_names(targets) -> Set[str]:
+    out: Set[str] = set()
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                out.add(sub.id)
+    return out
+
+
+def _carried_names(test: Optional[ast.expr], body: Sequence[ast.stmt],
+                   written: Set[str], pre_assigned: Set[str] = frozenset()) \
+        -> Set[str]:
+    """Subset of ``written`` whose value may flow across loop iterations:
+    read by the loop test, or possibly read before (re)assignment inside one
+    iteration. The complement — names always assigned before read — are
+    loop temps (the reference LoopTransformer's create-in-loop vars) and
+    stay out of the lax carry. Conservative: unknown constructs count their
+    loads as reads."""
+    reads: Set[str] = set()
+
+    def expr(node, assigned, skip: Set[str] = frozenset()):
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                    and sub.id in written and sub.id not in assigned
+                    and sub.id not in skip):
+                reads.add(sub.id)
+
+    def block(stmts, assigned):
+        for s in stmts:
+            stmt(s, assigned)
+
+    def stmt(s, assigned):
+        if isinstance(s, ast.Assign):
+            expr(s.value, assigned)
+            for t in s.targets:
+                if not isinstance(t, ast.Name):
+                    expr(t, assigned)          # subscript/attribute bases
+            assigned |= _stored_names(s.targets)
+        elif isinstance(s, ast.AugAssign):
+            expr(s.value, assigned)
+            expr(s.target, assigned | set())   # target is read too
+            if isinstance(s.target, ast.Name):
+                if s.target.id in written and s.target.id not in assigned:
+                    reads.add(s.target.id)
+                assigned.add(s.target.id)
+        elif isinstance(s, ast.AnnAssign):
+            expr(s.value, assigned)
+            if isinstance(s.target, ast.Name) and s.value is not None:
+                assigned.add(s.target.id)
+        elif isinstance(s, ast.If):
+            expr(s.test, assigned)
+            a1, a2 = set(assigned), set(assigned)
+            block(s.body, a1)
+            block(s.orelse, a2)
+            assigned |= (a1 & a2)
+        elif isinstance(s, ast.While):
+            expr(s.test, assigned)
+            a1 = set(assigned)
+            block(s.body, a1)                  # may run zero times
+            block(s.orelse, assigned)
+        elif isinstance(s, ast.For):
+            expr(s.iter, assigned)
+            a1 = set(assigned) | _stored_names([s.target])
+            block(s.body, a1)
+            block(s.orelse, assigned)
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                expr(item.context_expr, assigned)
+                if item.optional_vars is not None:
+                    assigned |= _stored_names([item.optional_vars])
+            block(s.body, assigned)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {a.arg for a in (s.args.posonlyargs + s.args.args
+                                      + s.args.kwonlyargs)}
+            for sub in s.body:
+                expr(sub, assigned, skip=params)
+            assigned.add(s.name)
+        elif isinstance(s, (ast.Expr, ast.Return, ast.Raise, ast.Assert,
+                            ast.Delete)):
+            expr(s, assigned)
+        else:
+            # Try, Match, imports, ...: conservative — all loads are reads,
+            # nothing definitely assigned
+            expr(s, assigned)
+    expr(test, set(pre_assigned))
+    block(list(body), set(pre_assigned))
+    return reads & written
+
+
+class _Disallowed(ast.NodeVisitor):
+    """Detects constructs the v1 transform can't capture inside a branch or
+    loop body: return, break/continue that target the statement being
+    transformed (or an enclosing loop), del, global/nonlocal. Nested
+    function scopes own their returns; fully-nested loops own their
+    breaks."""
+
+    def __init__(self, is_loop_body: bool):
+        self.bad = False
+        self._base = 1 if is_loop_body else 0
+        self._loop_depth = self._base
+
+    def visit_Return(self, node):
+        self.bad = True
+
+    def visit_Yield(self, node):
+        self.bad = True
+
+    visit_YieldFrom = visit_Await = visit_Yield
+
+    def visit_Delete(self, node):
+        self.bad = True
+
+    def visit_Global(self, node):
+        self.bad = True
+
+    visit_Nonlocal = visit_Global
+
+    def visit_Break(self, node):
+        if self._loop_depth <= self._base:
+            self.bad = True
+
+    visit_Continue = visit_Break
+
+    def visit_While(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While
+
+    def visit_FunctionDef(self, node):
+        pass                      # nested scopes own their returns
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _branch_ok(stmts, is_loop_body=False) -> bool:
+    d = _Disallowed(is_loop_body)
+    for s in stmts:
+        d.visit(s)
+    return not d.bad
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+def _n(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _ns(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _jst_attr(name):
+    return ast.Attribute(value=_n("__pt_jst__"), attr=name, ctx=ast.Load())
+
+
+def _lambda0(body_expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=body_expr)
+
+
+def _ld_tuple(names):
+    """( _jst.ld(lambda: w, 'w'), ... )"""
+    return ast.Tuple(
+        elts=[ast.Call(func=_jst_attr("ld"),
+                       args=[_lambda0(_n(w)), ast.Constant(w)], keywords=[])
+              for w in names],
+        ctx=ast.Load())
+
+
+def _fn_def(name, argnames, body):
+    ret = ast.Return(value=ast.Tuple(
+        elts=[_n(a) for a in argnames], ctx=ast.Load()))
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=a) for a in argnames],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=list(body) + [ret],
+        decorator_list=[], type_params=[])
+
+
+def _unpack(names, call):
+    """w1, ..., wk = call   (or a bare expression statement when k == 0)"""
+    if not names:
+        return ast.Expr(value=call)
+    target = ast.Tuple(elts=[_ns(w) for w in names], ctx=ast.Store())
+    return ast.Assign(targets=[target], value=call)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.applied = 0
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # do not transform statements inside nested function scopes: they run
+    # with their own locals and convert() can be applied to them separately
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_If(self, node: ast.If):
+        node = self.generic_visit(node)
+        if _has_walrus(node.test):
+            # a walrus in the test binds in the enclosing scope; moving the
+            # test into a lambda would silently change that — leave as is
+            return node
+        if not (_branch_ok(node.body) and _branch_ok(node.orelse)):
+            return node
+        written = sorted(_written_names(node.body) |
+                         _written_names(node.orelse))
+        k = self._uid()
+        tname, fname = f"_pt_true_{k}", f"_pt_false_{k}"
+        tdef = _fn_def(tname, written, node.body)
+        fdef = _fn_def(fname, written, node.orelse or [ast.Pass()])
+        call = ast.Call(
+            func=_jst_attr("run_if"),
+            args=[_lambda0(node.test), _n(tname), _n(fname),
+                  _ld_tuple(written)],
+            keywords=[])
+        self.applied += 1
+        return [tdef, fdef, _unpack(written, call)]
+
+    def visit_While(self, node: ast.While):
+        node = self.generic_visit(node)
+        if (node.orelse or _has_walrus(node.test)
+                or not _branch_ok(node.body, is_loop_body=True)):
+            return node
+        written = _written_names(node.body)
+        carried = sorted(_carried_names(node.test, node.body, written))
+        temps = sorted(written - set(carried))
+        ordered = carried + temps
+        k = self._uid()
+        cname, bname = f"_pt_wcond_{k}", f"_pt_wbody_{k}"
+        cdef = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=a) for a in ordered],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], type_params=[])
+        bdef = _fn_def(bname, ordered, node.body)
+        call = ast.Call(
+            func=_jst_attr("run_while"),
+            args=[_n(cname), _n(bname), _ld_tuple(ordered),
+                  ast.Constant(tuple(ordered)), ast.Constant(len(carried))],
+            keywords=[])
+        self.applied += 1
+        return [cdef, bdef, _unpack(ordered, call)]
+
+    def visit_For(self, node: ast.For):
+        node = self.generic_visit(node)
+        if (node.orelse or _has_walrus(node.iter)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or not _branch_ok(node.body, is_loop_body=True)):
+            return node
+        idx = node.target.id
+        written = _written_names(node.body) - {idx}
+        carried = sorted(_carried_names(None, node.body, written,
+                                        pre_assigned={idx}))
+        temps = sorted(written - set(carried))
+        ordered = carried + temps
+        k = self._uid()
+        bname = f"_pt_fbody_{k}"
+        bdef = _fn_def(bname, [idx] + ordered, node.body)
+        range_args = ast.Tuple(elts=list(node.iter.args), ctx=ast.Load())
+        call = ast.Call(
+            func=_jst_attr("run_for_range"),
+            args=[_lambda0(range_args), _n(bname),
+                  _ld_tuple([idx] + ordered),
+                  ast.Constant(tuple([idx] + ordered)),
+                  ast.Constant(len(carried))],
+            keywords=[])
+        self.applied += 1
+        return [bdef, _unpack([idx] + ordered, call)]
+
+
+# ---------------------------------------------------------------------------
+# convert()
+# ---------------------------------------------------------------------------
+
+def _has_nonlocal_or_global(tree) -> bool:
+    return any(isinstance(n, (ast.Nonlocal, ast.Global))
+               for n in ast.walk(tree))
+
+
+def _has_walrus(node) -> bool:
+    return node is not None and any(
+        isinstance(n, ast.NamedExpr) for n in ast.walk(node))
+
+
+def convert(fn: Callable) -> Callable:
+    """Return ``fn`` with python control flow rewritten to the runtime
+    dispatchers, or ``fn`` unchanged if the source is unavailable or the
+    transform does not apply. Bound methods are converted and re-bound."""
+    if isinstance(fn, types.MethodType):
+        inner = convert(fn.__func__)
+        if inner is fn.__func__:
+            return fn
+        return types.MethodType(inner, fn.__self__)
+    if getattr(fn, "__pt_dy2static__", False):
+        return fn
+    # wrapper callables (functools.lru_cache, partial, C functions) have no
+    # __code__/__globals__ — leave them for StaticFunction to trace directly
+    if (getattr(fn, "__code__", None) is None
+            or getattr(fn, "__globals__", None) is None):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, IndentationError, SyntaxError):
+        return fn
+    fndef = next((n for n in tree.body
+                  if isinstance(n, ast.FunctionDef)
+                  and n.name == fn.__name__), None)
+    if fndef is None or _has_nonlocal_or_global(fndef):
+        return fn
+
+    tr = _ControlFlowTransformer()
+    fndef = tr.visit(fndef)
+    # visit_FunctionDef skips the top-level def itself; walk its body
+    new_body = []
+    for s in fndef.body:
+        r = tr.visit(s) if not isinstance(s, ast.FunctionDef) else s
+        new_body.extend(r if isinstance(r, list) else [r])
+    fndef.body = new_body
+    if tr.applied == 0:
+        return fn
+    fndef.decorator_list = []
+
+    freevars = fn.__code__.co_freevars
+    module = ast.Module(body=[fndef], type_ignores=[])
+    if freevars:
+        outer = ast.FunctionDef(
+            name="_pt_make",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[fndef, ast.Return(value=_n(fndef.name))],
+            decorator_list=[], type_params=[])
+        module = ast.Module(body=[outer], type_ignores=[])
+    ast.fix_missing_locations(module)
+
+    # execute against the LIVE module globals (a later rebinding of a global
+    # the function reads must stay visible, exactly as in the original fn);
+    # only the reserved dispatcher name is injected
+    from . import dy2static as _self
+    glob = fn.__globals__
+    if glob.get("__pt_jst__", _self) is not _self:
+        glob = dict(fn.__globals__)       # unlikely collision: fall back
+    glob["__pt_jst__"] = _self
+    fname = f"<dy2static {getattr(fn, '__module__', '?')}." \
+            f"{fn.__qualname__}>"
+    try:
+        code = compile(module, filename=fname, mode="exec")
+        ns: dict = {}
+        exec(code, glob, ns)
+        if freevars:
+            # rebuild with the ORIGINAL closure cells so later rebindings of
+            # the enclosing scope's variables stay visible
+            make = ns["_pt_make"]
+            inner_code = next(
+                c for c in make.__code__.co_consts
+                if isinstance(c, types.CodeType) and c.co_name == fndef.name)
+            cellmap = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))
+            closure = tuple(cellmap[v] for v in inner_code.co_freevars)
+            new_fn = types.FunctionType(inner_code, glob, fn.__name__,
+                                        fn.__defaults__, closure)
+        else:
+            new_fn = ns[fndef.name]
+    except Exception:
+        return fn
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(new_fn, fn, updated=[])
+    new_fn.__pt_dy2static__ = True
+    return new_fn

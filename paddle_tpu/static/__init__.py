@@ -325,6 +325,10 @@ class _StaticNN:
                  "batch_norm": self._batch_norm}
         if name in fnmap:
             return fnmap[name]
+        # control-flow capture ops (ref: python/paddle/static/nn/control_flow.py)
+        from . import control_flow as _cf
+        if name in _cf.__all__ or name == "control_flow":
+            return _cf if name == "control_flow" else getattr(_cf, name)
         raise AttributeError(name)
 
     @staticmethod

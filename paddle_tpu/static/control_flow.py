@@ -1,0 +1,479 @@
+"""Control-flow capture ops — ``cond`` / ``while_loop`` / ``case`` /
+``switch_case`` (ref: python/paddle/static/nn/control_flow.py, which lowers
+these to ``conditional_block`` / ``while`` ops executed by the
+StandaloneExecutor; SURVEY §2.2 static row, §3.3).
+
+TPU-native rework: ``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` ARE the
+control-flow ops — XLA compiles them to predicated/looping HLO regions, so no
+block/scope machinery is needed. The semantics split the same way the
+reference's do:
+
+* **Concrete predicate** (eager, outside any trace): run the taken branch as
+  plain Python — the reference's dygraph path. The tape sees the branch's ops
+  directly, so autograd is exact and side effects (BN stats, prints) work.
+* **Traced predicate** (under ``jit`` / ``to_static`` / static capture): lower
+  to the ``lax`` primitive through ``core.dispatch.apply`` so the in-trace
+  tape records one GradNode whose vjp differentiates through both branches
+  (``lax.cond`` is differentiable; ``lax.while_loop`` is forward-only — see
+  ``while_loop``'s ``max_iter`` for the differentiable bounded form).
+
+Branch functions are nullary closures (reference signature). Tensors they
+read via closure — including Layer parameters — are discovered and threaded
+through the traced call as real operands, so gradients reach them; this is
+the closure-capture analog of the reference's block live-in analysis
+(``conditional_block``'s input var list).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core import autograd, dispatch
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Assert"]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _is_tracer(x) -> bool:
+    arr = x._data if isinstance(x, Tensor) else x
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _tensor_leaf(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def _flatten_out(out):
+    """Flatten a branch result into (array leaves, treedef). Tensor leaves
+    are unwrapped; raw arrays / python scalars become arrays."""
+    leaves, treedef = jax.tree_util.tree_flatten(out, is_leaf=_tensor_leaf)
+    arrs = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+            for l in leaves]
+    return arrs, treedef
+
+
+def _scan_value(v, add, depth=0):
+    """Shallow scan of a closure cell / global for Tensors (directly, inside
+    Layers, or one container level deep)."""
+    from ..nn.layer.layers import Layer
+    if isinstance(v, Tensor):
+        add(v)
+    elif isinstance(v, Layer):
+        for t in v.state_dict().values():
+            add(t)
+    elif depth < 2 and isinstance(v, (list, tuple)):
+        for x in v[:64]:
+            _scan_value(x, add, depth + 1)
+    elif depth < 2 and isinstance(v, dict):
+        for x in list(v.values())[:64]:
+            _scan_value(x, add, depth + 1)
+
+
+def _captured_tensors(fns: Sequence[Optional[Callable]],
+                      exclude: Sequence[Tensor] = ()) -> List[Tensor]:
+    """Tensors the branch fns can read: closure cells, bound self, and
+    globals named by their code — followed transitively through
+    function-valued cells (a dispatcher lambda wrapping the real branch fn
+    must expose the inner fn's captures too). This is the live-in set of
+    the reference's conditional_block. ``exclude`` drops tensors already
+    passed as explicit operands."""
+    seen = {id(t) for t in exclude}
+    out: List[Tensor] = []
+    seen_fns = set()
+    work: List[Callable] = [f for f in fns if f is not None]
+
+    def add(t):
+        if isinstance(t, Tensor) and id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+
+    def maybe_fn(v):
+        if callable(v) and (getattr(v, "__closure__", None)
+                            or getattr(v, "__code__", None) is not None
+                            or getattr(v, "__self__", None) is not None):
+            if id(v) not in seen_fns:
+                seen_fns.add(id(v))
+                work.append(v)
+
+    while work:
+        fn = work.pop()
+        _scan_value(getattr(fn, "__self__", None), add)
+        for cell in (getattr(fn, "__closure__", None) or ()):
+            try:
+                v = cell.cell_contents
+            except ValueError:          # empty cell
+                continue
+            _scan_value(v, add)
+            maybe_fn(v)
+            if isinstance(v, (list, tuple)):
+                for x in v[:64]:
+                    maybe_fn(x)
+        code = getattr(fn, "__code__", None)
+        if code is not None:
+            g = getattr(fn, "__globals__", {})
+            for name in code.co_names:
+                if name in g:
+                    _scan_value(g[name], add)
+                    maybe_fn(g[name])
+    return out
+
+
+class _rebind:
+    """Temporarily swap the ``_data`` of captured Tensors for trace arrays
+    while a branch fn runs (the in-branch view of the threaded operands)."""
+
+    def __init__(self, tensors: Sequence[Tensor], arrs):
+        self.tensors, self.arrs = tensors, arrs
+
+    def __enter__(self):
+        self._saved = [t._data for t in self.tensors]
+        for t, a in zip(self.tensors, self.arrs):
+            t._data = a
+        return self
+
+    def __exit__(self, *exc):
+        for t, s in zip(self.tensors, self._saved):
+            t._data = s
+        return False
+
+
+def _call_and_flatten(fn, var_arrs, caps, cap_arrs, treedef):
+    """Run a loop body fn on raw arrays and return its flat array outputs
+    (used both for abstract dtype pre-promotion and the real carry step)."""
+    vars_t = jax.tree_util.tree_unflatten(
+        treedef, [Tensor(a) for a in var_arrs])
+    with _rebind(caps, cap_arrs), autograd.no_grad():
+        out = fn(*vars_t)
+    if not isinstance(out, (list, tuple)):
+        out = (out,)
+    arrs, _ = _flatten_out(list(out))
+    return tuple(arrs)
+
+
+def _run_branch(fn, caps, cap_arrs):
+    """Execute a nullary branch fn with captured tensors rebound; returns
+    (flat arrays, treedef). Runs under no_grad: the outer jax.vjp of the
+    whole control-flow op differentiates the raw jnp computation, so the
+    per-op tape inside the branch would be redundant work."""
+    with _rebind(caps, cap_arrs), autograd.no_grad():
+        out = fn()
+    return _flatten_out(out)
+
+
+def _wrap_results(flat, treedef, requires_grad):
+    if not isinstance(flat, (tuple, list)):
+        flat = (flat,)
+    leaves = list(flat)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name: Optional[str] = None,
+         return_names=None):
+    """``paddle.static.nn.cond`` parity (ref: control_flow.py cond → two
+    conditional_block ops + select_input).
+
+    ``true_fn`` / ``false_fn`` are nullary callables returning the same
+    nested structure. With a concrete ``pred`` the taken branch simply runs
+    (dygraph path); with a traced ``pred`` both branches lower into
+    ``lax.cond`` and gradients flow to every closure-captured Tensor.
+    """
+    del name, return_names
+    if true_fn is None and false_fn is None:
+        return None
+    pred_t = pred if isinstance(pred, Tensor) else Tensor(jnp.asarray(pred))
+
+    if not _is_tracer(pred_t):
+        taken = true_fn if bool(pred_t._data) else false_fn
+        return taken() if taken is not None else None
+
+    # traced path
+    if true_fn is None or false_fn is None:
+        raise ValueError(
+            "cond: under trace both true_fn and false_fn are required "
+            "(branch outputs must have identical structure)")
+    caps = _captured_tensors([true_fn, false_fn])
+    aux = {}
+
+    def impl(pred_arr, *cap_arrs):
+        def t_branch(ca):
+            arrs, td = _run_branch(true_fn, caps, ca)
+            aux.setdefault("treedef", td)
+            if td != aux["treedef"]:
+                raise ValueError("cond: branch output structures differ: "
+                                 f"{td} vs {aux['treedef']}")
+            return tuple(arrs)
+
+        def f_branch(ca):
+            arrs, td = _run_branch(false_fn, caps, ca)
+            if "treedef" in aux and td != aux["treedef"]:
+                raise ValueError(
+                    "cond: true_fn and false_fn returned different "
+                    f"structures: {aux['treedef']} vs {td}")
+            aux.setdefault("treedef", td)
+            return tuple(arrs)
+
+        p = jnp.reshape(pred_arr, ()).astype(bool)
+        res = lax.cond(p, t_branch, f_branch, tuple(cap_arrs))
+        return res[0] if len(res) == 1 else res
+
+    out = dispatch.apply("cond", impl, [pred_t] + caps)
+    return _wrap_results(out, aux["treedef"], True)
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars,
+               is_test: bool = False, name: Optional[str] = None,
+               max_iter: Optional[int] = None):
+    """``paddle.static.nn.while_loop`` parity (ref: control_flow.py
+    while_loop → while op with block; SURVEY §2.2).
+
+    ``cond_fn(*loop_vars) -> bool Tensor``; ``body_fn(*loop_vars)`` returns
+    the next loop_vars (same structure). Concrete predicates run a Python
+    loop (dygraph path, exact tape autograd). Traced predicates lower to
+    ``lax.while_loop`` — forward-only, matching XLA's while semantics; pass
+    ``max_iter=N`` (TPU extension) to lower to a masked ``lax.scan`` of
+    fixed length N instead, which IS reverse-differentiable and replaces the
+    reference's while-backward program.
+    """
+    del name
+    if not isinstance(loop_vars, (list, tuple)):
+        raise TypeError("while_loop: loop_vars must be a list or tuple")
+    seq_type = type(loop_vars)
+
+    first_pred = cond_fn(*loop_vars)
+    pred_t = (first_pred if isinstance(first_pred, Tensor)
+              else Tensor(jnp.asarray(first_pred)))
+
+    if not _is_tracer(pred_t):
+        # dygraph path: plain python loop; unrolls if reached under a trace
+        # with a concrete (static) predicate — reference parity.
+        vars_now = loop_vars
+        p = bool(pred_t._data)
+        while p:
+            vars_now = body_fn(*vars_now)
+            if not isinstance(vars_now, (list, tuple)):
+                vars_now = (vars_now,)
+            pred = cond_fn(*vars_now)
+            p = bool(pred._data if isinstance(pred, Tensor) else pred)
+        return seq_type(vars_now)
+
+    # traced path
+    flat_in, treedef = jax.tree_util.tree_flatten(list(loop_vars),
+                                                  is_leaf=_tensor_leaf)
+    in_tensors = [l if isinstance(l, Tensor) else Tensor(jnp.asarray(l))
+                  for l in flat_in]
+    caps = _captured_tensors([cond_fn, body_fn], exclude=in_tensors)
+    n_vars = len(in_tensors)
+
+    def _call_user(fn, var_arrs, cap_arrs):
+        vars_t = jax.tree_util.tree_unflatten(
+            treedef, [Tensor(a) for a in var_arrs])
+        with _rebind(caps, cap_arrs), autograd.no_grad():
+            return fn(*vars_t)
+
+    def _body_arrs(var_arrs, cap_arrs):
+        out = _call_user(body_fn, var_arrs, cap_arrs)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        arrs, td = _flatten_out(list(out))
+        if td != treedef:
+            raise ValueError(
+                "while_loop: body_fn output structure differs from "
+                f"loop_vars: {td} vs {treedef}")
+        for a, i in zip(arrs, var_arrs):
+            if a.dtype != i.dtype:
+                raise TypeError(
+                    f"while_loop: body_fn changed a loop var dtype "
+                    f"{i.dtype} -> {a.dtype}; the XLA while carry must be "
+                    "type-stable (initialize the loop var with the dtype "
+                    "the body produces)")
+        return tuple(arrs)
+
+    # the carry must be type-stable, but a python-int-style init (s = 0)
+    # whose body produces floats is legitimate eager code — pre-promote the
+    # inits to the body's output dtypes (abstract eval, runs nothing)
+    cap_arrs_now = tuple(t._data for t in caps)
+    for _ in range(3):
+        init_arrs = tuple(t._data for t in in_tensors)
+        outs = jax.eval_shape(
+            lambda vs: _call_and_flatten(body_fn, vs, caps, cap_arrs_now,
+                                         treedef), init_arrs)
+        promoted = [jnp.promote_types(i.dtype, o.dtype)
+                    for i, o in zip(init_arrs, outs)]
+        if all(p == i.dtype for p, i in zip(promoted, init_arrs)):
+            break
+        # cast through the dispatch so the tape keeps the grad edge from
+        # the original carry producer (review fix: a raw astype-wrapped
+        # Tensor would sever backward through the promoted var)
+        from ..tensor.manipulation import cast as _cast
+        in_tensors = [t if p == t._data.dtype else _cast(t, p)
+                      for t, p in zip(in_tensors, promoted)]
+
+    def _pred_arr(var_arrs, cap_arrs):
+        p = _call_user(cond_fn, var_arrs, cap_arrs)
+        p = p._data if isinstance(p, Tensor) else jnp.asarray(p)
+        return jnp.reshape(p, ()).astype(bool)
+
+    if max_iter is not None:
+        # differentiable bounded form: fixed-length scan, body masked by the
+        # live predicate (lax.cond keeps the dead iterations cheap and the
+        # whole loop reverse-differentiable).
+        def impl(*arrs):
+            var_arrs, cap_arrs = arrs[:n_vars], arrs[n_vars:]
+
+            def step(carry, _):
+                alive = _pred_arr(carry, cap_arrs)
+                nxt = lax.cond(alive,
+                               lambda c: _body_arrs(c, cap_arrs),
+                               lambda c: tuple(c), tuple(carry))
+                return nxt, None
+
+            final, _ = lax.scan(step, tuple(var_arrs), None,
+                                length=int(max_iter))
+            return final[0] if len(final) == 1 else tuple(final)
+    else:
+        @jax.custom_vjp
+        def _while(*arrs):
+            var_arrs, cap_arrs = arrs[:n_vars], arrs[n_vars:]
+            final = lax.while_loop(
+                lambda c: _pred_arr(c, cap_arrs),
+                lambda c: _body_arrs(c, cap_arrs), tuple(var_arrs))
+            return final[0] if len(final) == 1 else tuple(final)
+
+        def _fwd(*arrs):
+            return _while(*arrs), None
+
+        def _bwd(res, g):
+            raise RuntimeError(
+                "while_loop backward: XLA's while is forward-only. Pass "
+                "max_iter=N for the reverse-differentiable bounded form, or "
+                "run the loop under paddle_tpu.no_grad().")
+
+        _while.defvjp(_fwd, _bwd)
+        impl = _while
+
+    out = dispatch.apply("while_loop", impl, in_tensors + caps)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    res_vars = jax.tree_util.tree_unflatten(treedef, list(out[:n_vars]))
+    return seq_type(res_vars)
+
+
+# ---------------------------------------------------------------------------
+# case / switch_case
+# ---------------------------------------------------------------------------
+
+def case(pred_fn_pairs, default: Optional[Callable] = None,
+         name: Optional[str] = None):
+    """``paddle.static.nn.case``: run the fn of the FIRST true predicate,
+    else ``default`` (ref: control_flow.py case → chained cond). Lowered as
+    a right-folded chain of :func:`cond`."""
+    del name
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    for p, f in pairs:
+        if not callable(f):
+            raise TypeError("case: each pair must be (pred, callable)")
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+        if not pairs:
+            return default()
+
+    def build(i):
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        rest = build(i + 1)
+        return lambda: cond(pred, fn, rest)
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name: Optional[str] = None):
+    """``paddle.static.nn.switch_case`` parity (ref: control_flow.py
+    switch_case). ``branch_fns`` is a dict {int: fn}, a list of (int, fn)
+    pairs, or a list of fns (implicit 0..n-1 keys). A traced index lowers to
+    ``lax.switch`` over the sorted key table with the default fn in the
+    fall-through slot."""
+    del name
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items(), key=lambda kv: kv[0])
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted(((int(k), f) for k, f in branch_fns),
+                       key=lambda kv: kv[0])
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [int(k) for k, _ in items]
+    fns = [f for _, f in items]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"switch_case: duplicate branch keys {keys}")
+    if default is None:
+        default = fns[-1]
+
+    idx_t = (branch_index if isinstance(branch_index, Tensor)
+             else Tensor(jnp.asarray(branch_index)))
+
+    if not _is_tracer(idx_t):
+        i = int(idx_t._data)
+        taken = dict(zip(keys, fns)).get(i, default)
+        return taken()
+
+    all_fns = fns + [default]
+    caps = _captured_tensors(all_fns)
+    aux = {}
+
+    def impl(idx_arr, *cap_arrs):
+        def mk(fn):
+            def branch(ca):
+                arrs, td = _run_branch(fn, caps, ca)
+                if "treedef" in aux and td != aux["treedef"]:
+                    raise ValueError(
+                        "switch_case: branch output structures differ: "
+                        f"{aux['treedef']} vs {td}")
+                aux.setdefault("treedef", td)
+                return tuple(arrs)
+            return branch
+
+        keys_arr = jnp.asarray(keys, dtype=jnp.int32)
+        idx = jnp.reshape(idx_arr, ()).astype(jnp.int32)
+        hit = keys_arr == idx
+        sel = jnp.where(jnp.any(hit), jnp.argmax(hit), len(keys))
+        res = lax.switch(sel, [mk(f) for f in all_fns], tuple(cap_arrs))
+        return res[0] if len(res) == 1 else res
+
+    out = dispatch.apply("switch_case", impl, [idx_t] + caps)
+    return _wrap_results(out, aux["treedef"], True)
+
+
+def Assert(cond_val, data=None, summarize: int = 20, name: Optional[str] = None):
+    """``paddle.static.nn.control_flow.Assert`` parity: raise on a false
+    concrete condition; traced conditions use jax's checkify-free best
+    effort (no-op under trace, matching the reference's graph Assert being
+    executor-checked, not trace-checked)."""
+    del summarize, name
+    c = cond_val._data if isinstance(cond_val, Tensor) else cond_val
+    if isinstance(c, jax.core.Tracer):
+        return
+    if not bool(jnp.all(jnp.asarray(c))):
+        vals = [d.numpy() if isinstance(d, Tensor) else d
+                for d in (data or [])]
+        raise AssertionError(f"Assert failed; data={vals}")
