@@ -188,10 +188,115 @@ def _gpt_decode_params(model):
                 normb=gpt.ln_f.bias._data, head=head)
 
 
+def _mlp_params(lyr):
+    """Per-layer FFN weights: (weight dict, static routing knobs or None).
+    Dense SwiGLU (llama layout) or routed MoE (dropless per-token routing —
+    serving never drops tokens; the capacity factor is a training
+    regularizer, ref fused MoE serving kernels). Static knobs must stay out
+    of the weight tree: it rides through jit as arguments."""
+    m = lyr.mlp
+    from .incubate.moe import MoELayer
+    if isinstance(m, MoELayer):
+        if m.activation != "swiglu":
+            raise NotImplementedError(
+                "cached MoE decode supports swiglu experts (the LM configs)")
+        if not m.dropless:
+            import warnings
+            warnings.warn(
+                "cached/compiled MoE decode always routes DROPLESS (no "
+                "capacity drops — serving never discards tokens); this "
+                "model trains in capacity mode, so cached decode can "
+                "diverge from generate() near capacity overflow. Exactness "
+                "vs the buffer path holds for moe_dropless=True models.",
+                stacklevel=3)
+        d = dict(moe=dict(
+            gate=m.gate_weight._data,
+            wge=m.w_gate._data if m.w_gate is not None else None,
+            wup=m.w_up._data, wdn=m.w_down._data))
+        if m.shared_up is not None:
+            d["moe"]["shared"] = dict(sg=m.shared_gate.weight._data,
+                                      su=m.shared_up.weight._data,
+                                      sd=m.shared_down.weight._data)
+        return d, dict(top_k=m.top_k, renorm=m.renormalize)
+    return dict(wg=m.gate_proj.weight._data, wu=m.up_proj.weight._data,
+                wd=m.down_proj.weight._data), None
+
+
+def _moe_decode_params(model):
+    """MoEForCausalLM (Qwen2-MoE/DeepSeekMoE pattern): llama attention
+    backbone, per-layer dense-or-routed FFN."""
+    inner = model.model
+    cfg = model.config
+    layers = []
+    moe_static = []
+    for lyr in inner.layers:
+        a = lyr.self_attn
+        d = dict(
+            ln1=lyr.input_layernorm.weight._data,
+            wq=a.q_proj.weight._data, wk=a.k_proj.weight._data,
+            wv=a.v_proj.weight._data, wo=a.o_proj.weight._data,
+            ln2=lyr.post_attention_layernorm.weight._data)
+        mlp_w, mlp_st = _mlp_params(lyr)
+        d.update(mlp_w)
+        layers.append(d)
+        moe_static.append(mlp_st)
+    head = model.lm_head.weight._data if model.lm_head is not None else None
+    return dict(cfg=cfg, family="moe",
+                embed=inner.embed_tokens.weight._data,
+                layers=layers, norm=inner.norm.weight._data, head=head,
+                cos=inner.rope_cos._data, sin=inner.rope_sin._data,
+                moe_static=tuple(moe_static))
+
+
+def _mla_decode_params(model):
+    """DeepSeekV2ForCausalLM: multi-head latent attention with the
+    ABSORBED decode formulation — the KV cache stores only the normalized
+    latent [r] + shared rope key [dr] per token, and kv_b is folded into
+    the query/output projections (DeepSeek-V2 matrix absorption; ref
+    capability: PaddleNLP deepseek_v2 fused MLA decode)."""
+    inner = model.model
+    cfg = model.config
+    layers = []
+    moe_static = []
+    for lyr in inner.layers:
+        a = lyr.self_attn
+        d = dict(
+            ln1=lyr.input_layernorm.weight._data,
+            wkva=a.kv_a_proj_with_mqa.weight._data,
+            gkv=a.kv_a_layernorm.weight._data,
+            wkvb=a.kv_b_proj.weight._data,
+            wo=a.o_proj.weight._data,
+            ln2=lyr.post_attention_layernorm.weight._data)
+        if cfg.q_lora_rank:
+            d["wqa"] = a.q_a_proj.weight._data
+            d["gq"] = a.q_a_layernorm.weight._data
+            d["wqb"] = a.q_b_proj.weight._data
+        else:
+            d["wq"] = a.q_proj.weight._data
+        mlp_w, mlp_st = _mlp_params(lyr)
+        d.update(mlp_w)
+        layers.append(d)
+        moe_static.append(mlp_st)
+    head = model.lm_head.weight._data if model.lm_head is not None else None
+    return dict(cfg=cfg, family="mla",
+                embed=inner.embed_tokens.weight._data,
+                layers=layers, norm=inner.norm.weight._data, head=head,
+                cos=inner.rope_cos._data, sin=inner.rope_sin._data,
+                moe_static=tuple(moe_static))
+
+
 def _decode_params(model):
     """Family dispatch for the cached/compiled decode paths."""
     if getattr(model, "gpt", None) is not None:
         return _gpt_decode_params(model)
+    inner = getattr(model, "model", None)
+    if inner is not None:
+        from .models.deepseek import DeepSeekV2Model
+        from .models.moe_llm import MoEModel
+        if isinstance(inner, DeepSeekV2Model):
+            return _mla_decode_params(model)
+        if isinstance(inner, MoEModel):
+            return _moe_decode_params(model)
     return _llama_decode_params(model)
 
 
@@ -201,10 +306,38 @@ def _llama_weights(p):
     embedded in the lowered module as a literal constant, and at 8B-shard
     scale (~0.5 GB) that makes XLA chew through the weights at compile
     time (~5 s/MB measured on the axon remote-compile path)."""
-    return {k: v for k, v in p.items() if k not in ("cfg", "family")}
+    return {k: v for k, v in p.items()
+            if k not in ("cfg", "family", "moe_static")}
 
 
-def _llama_cached_step_body(cfg, max_len: int):
+def _ffn_apply(L, h2, st=None):
+    """Per-layer FFN on [B, S, H]: dense SwiGLU or routed-MoE (dropless
+    per-token top-k — numerics match MoELayer._dropless exactly so the
+    cached path exact-matches a moe_dropless buffer model). ``st`` holds
+    the layer's STATIC routing knobs (top_k, renorm) from _mlp_params."""
+    if "moe" not in L:
+        gate = h2 @ L["wg"]
+        return (jax.nn.silu(gate) * (h2 @ L["wu"])) @ L["wd"]
+    mo = L["moe"]
+    B, S, H = h2.shape
+    T = B * S
+    xt = h2.reshape(T, H)
+    gates = jax.nn.softmax(
+        xt.astype(jnp.float32) @ mo["gate"].astype(jnp.float32), axis=-1)
+    from .incubate.moe import dropless_expert_ffn
+    y, _ = dropless_expert_ffn(xt, gates, mo["wge"], mo["wup"], mo["wdn"],
+                               top_k=st["top_k"],
+                               renormalize=st["renorm"],
+                               activation="swiglu")
+    y = y.reshape(B, S, H).astype(h2.dtype)
+    if "shared" in mo:
+        sh = mo["shared"]
+        s = jax.nn.silu(h2 @ sh["sg"]) * (h2 @ sh["su"])
+        y = y + s @ sh["sd"]
+    return y
+
+
+def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
     """Un-jitted (weights, ids_step, caches, start_pos) ->
     (last_logits, caches) body — jitted per-call-width by
     _make_llama_cached_step for the host-loop path, traced inside one
@@ -228,7 +361,8 @@ def _llama_cached_step_body(cfg, max_len: int):
         q_pos = start + jnp.arange(S)
         # key j visible to query i iff j <= start + i
         vis = pos_k[None, :] <= q_pos[:, None]            # [S, max_len]
-        for L, (ck, cv) in zip(w["layers"], caches):
+        sts = moe_static or (None,) * len(w["layers"])
+        for L, (ck, cv), st in zip(w["layers"], caches, sts):
             h = rms(x, L["ln1"])
             q, k, v = h @ L["wq"], h @ L["wk"], h @ L["wv"]
             if "bq" in L:                      # Qwen2 qkv biases
@@ -251,8 +385,7 @@ def _llama_cached_step_body(cfg, max_len: int):
             o = jnp.einsum("bhst,bthd->bshd", aw, vv).reshape(B, S, Hh * D)
             x = x + o @ L["wo"]
             h2 = rms(x, L["ln2"])
-            gate = h2 @ L["wg"]
-            x = x + ((jax.nn.silu(gate) * (h2 @ L["wu"])) @ L["wd"])
+            x = x + _ffn_apply(L, h2, st)
         x = rms(x, w["norm"])
         last = x[:, -1]
         logits = last @ (w["head"] if w["head"] is not None
@@ -311,18 +444,114 @@ def _gpt_cached_step_body(cfg, max_len: int):
     return step
 
 
+def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
+    """DeepSeek-V2 MLA cached decode with matrix absorption: the cache per
+    token is (normalized latent [r], rope key [dr]) — kv_lora_rank + dr
+    floats instead of nh*(dn+dv). kv_b is folded into the score (q_nope @
+    W_k absorbed onto the latent) and the output (attention over latents,
+    W_v applied after). Ref: DeepSeek-V2 inference optimization; PaddleNLP
+    deepseek_v2 decode (SURVEY §2.4)."""
+    nh = cfg.num_attention_heads
+    dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                  cfg.v_head_dim)
+    r = cfg.kv_lora_rank
+    eps = cfg.rms_norm_eps
+    from .models.llama import apply_rope
+
+    def rms(h, w):
+        var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
+        return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * w
+
+    def step(w, ids, caches, start):
+        B, S = ids.shape
+        x = w["embed"][ids]
+        cos = jax.lax.dynamic_slice_in_dim(w["cos"], start, S, 0)
+        sin = jax.lax.dynamic_slice_in_dim(w["sin"], start, S, 0)
+        pos_k = jnp.arange(max_len)
+        q_pos = start + jnp.arange(S)
+        vis = pos_k[None, :] <= q_pos[:, None]            # [S, max_len]
+        scale = 1.0 / float(np.sqrt(dn + dr))
+        new_caches = []
+        sts = moe_static or (None,) * len(w["layers"])
+        for L, (c_lat, c_pe), st in zip(w["layers"], caches, sts):
+            h = rms(x, L["ln1"])
+            if "wqa" in L:
+                q = rms(h @ L["wqa"], L["gq"]) @ L["wqb"]
+            else:
+                q = h @ L["wq"]
+            q = q.reshape(B, S, nh, dn + dr)
+            q_nope, q_pe = q[..., :dn], q[..., dn:]
+            q_pe = apply_rope(q_pe, cos, sin)
+
+            kv_a = h @ L["wkva"]                          # [B, S, r+dr]
+            lat = rms(kv_a[..., :r], L["gkv"])            # normalized latent
+            k_pe = apply_rope(kv_a[..., r:][:, :, None, :], cos, sin)[:, :, 0]
+
+            c_lat = jax.lax.dynamic_update_slice(c_lat, lat, (0, start, 0))
+            c_pe = jax.lax.dynamic_update_slice(c_pe, k_pe, (0, start, 0))
+            new_caches.append((c_lat, c_pe))
+
+            wkb = L["wkvb"].reshape(r, nh, dn + dv)
+            w_k, w_v = wkb[..., :dn], wkb[..., dn:]
+            # absorb W_k onto the query: score = q_eff . latent + q_pe . k_pe
+            q_eff = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_k)
+            scores = (jnp.einsum("bsnr,btr->bnst", q_eff, c_lat)
+                      + jnp.einsum("bsnd,btd->bnst", q_pe, c_pe)) * scale
+            scores = jnp.where(vis[None, None], scores.astype(jnp.float32),
+                               -1e30)
+            aw = jax.nn.softmax(scores, axis=-1).astype(c_lat.dtype)
+            o_lat = jnp.einsum("bnst,btr->bsnr", aw, c_lat)
+            o = jnp.einsum("bsnr,rnv->bsnv", o_lat, w_v)
+            x = x + o.reshape(B, S, nh * dv) @ L["wo"]
+            h2 = rms(x, L["ln2"])
+            x = x + _ffn_apply(L, h2, st)
+        x = rms(x, w["norm"])
+        last = x[:, -1]
+        logits = last @ (w["head"] if w["head"] is not None
+                         else w["embed"].T)
+        return logits, new_caches
+
+    return step
+
+
 def _cached_step_body(p, max_len: int):
     if p["family"] == "gpt":
         return _gpt_cached_step_body(p["cfg"], max_len)
-    return _llama_cached_step_body(p["cfg"], max_len)
+    if p["family"] == "mla":
+        return _mla_cached_step_body(p["cfg"], max_len,
+                                     p.get("moe_static"))
+    return _llama_cached_step_body(p["cfg"], max_len, p.get("moe_static"))
 
 
-def _make_llama_cached_step(p, max_len: int):
+def _init_caches(p, B: int, total: int):
+    """Family-shaped zero KV caches for one sequence batch."""
+    cfg = p["cfg"]
+    dt = p["embed"].dtype
+    n_layers = len(p["layers"])
+    if p["family"] == "gpt":
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        return [(jnp.zeros((B, total, nh, hd), dt),
+                 jnp.zeros((B, total, nh, hd), dt))
+                for _ in range(n_layers)]
+    if p["family"] == "mla":
+        return [(jnp.zeros((B, total, cfg.kv_lora_rank), dt),
+                 jnp.zeros((B, total, cfg.qk_rope_head_dim), dt))
+                for _ in range(n_layers)]
+    KV, D = cfg.num_key_value_heads, cfg.head_dim
+    return [(jnp.zeros((B, total, KV, D), dt),
+             jnp.zeros((B, total, KV, D), dt))
+            for _ in range(n_layers)]
+
+
+def _make_cached_step(p, max_len: int):
     """Jitted cached step: one compile per distinct step width (prefill
     S0, decode 1). Weights ride as jit arguments (see _llama_weights)."""
     w = _llama_weights(p)
     jitted = jax.jit(_cached_step_body(p, max_len))
     return lambda ids, caches, start: jitted(w, ids, caches, start)
+
+
+_make_llama_cached_step = _make_cached_step     # serving_bench compat
 
 
 def generate_cached(model, input_ids, max_new_tokens: int = 20,
@@ -337,12 +566,15 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
     Numerics note: matches the buffer path exactly under f32 matmul
     precision; under the TPU bf16 default the two paths may argmax-flip
     near-tied logits (same situation as the reference's fp16 decode
-    kernels vs the fp32 training graph).
+    kernels vs the fp32 training graph). MoE models: decode always routes
+    DROPLESS (serving never discards tokens), so exactness vs generate()
+    holds for moe_dropless=True models; capacity-mode models get a
+    warning (drops are a training-time regularizer).
     """
     if decode_strategy not in ("greedy_search", "sampling"):
         raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
                          "'greedy_search' or 'sampling'")
-    p = _llama_decode_params(model)
+    p = _decode_params(model)
     cfg = p["cfg"]
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
@@ -351,12 +583,8 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
     total = S0 + max_new_tokens
     if total > cfg.max_position_embeddings:
         raise ValueError(f"{total} tokens exceed max_position_embeddings")
-    KV, D = cfg.num_key_value_heads, cfg.head_dim
-    dt = p["embed"].dtype
-    caches = [(jnp.zeros((B, total, KV, D), dt),
-               jnp.zeros((B, total, KV, D), dt))
-              for _ in p["layers"]]
-    step = _make_llama_cached_step(p, total)
+    caches = _init_caches(p, B, total)
+    step = _make_cached_step(p, total)
     finished = jnp.zeros((B,), bool)
     out_tokens, out_scores = [], []
     with ag.no_grad():
@@ -388,9 +616,9 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
     return Tensor(gen), Tensor(sc)
 
 
-def _make_llama_decode_loop(p, S0: int, max_new_tokens: int,
-                            decode_strategy: str, top_k, top_p,
-                            temperature: float, eos_token_id, pad_token_id):
+def _make_decode_loop(p, S0: int, max_new_tokens: int,
+                      decode_strategy: str, top_k, top_p,
+                      temperature: float, eos_token_id, pad_token_id):
     """Compile prefill + the ENTIRE decode loop into one XLA program:
     a lax.scan over max_new_tokens cached decode steps. No host round-trip
     per token — on a tunneled/remote TPU the host-loop path pays
@@ -401,16 +629,11 @@ def _make_llama_decode_loop(p, S0: int, max_new_tokens: int,
     keeps the loop compiled; finished rows emit pad_token_id."""
     total = S0 + max_new_tokens
     cfg = p["cfg"]
-    body = _llama_cached_step_body(cfg, total)
-    B_KV_D = (cfg.num_key_value_heads, cfg.head_dim)
+    body = _cached_step_body(p, total)
 
     def run(w, ids, key):
         B = ids.shape[0]
-        KV, D = B_KV_D
-        dt = w["embed"].dtype
-        caches = [(jnp.zeros((B, total, KV, D), dt),
-                   jnp.zeros((B, total, KV, D), dt))
-                  for _ in w["layers"]]
+        caches = _init_caches(p, B, total)
         logits, caches = body(w, ids, caches, 0)         # prefill
         finished = jnp.zeros((B,), bool)
 
@@ -438,10 +661,22 @@ def _make_llama_decode_loop(p, S0: int, max_new_tokens: int,
             jnp.arange(max_new_tokens))
         return toks.T, scores.T                          # [B, max_new]
 
-    cfg_key = (cfg.num_hidden_layers, cfg.hidden_size,
-               cfg.num_attention_heads, cfg.num_key_value_heads,
-               cfg.head_dim, cfg.intermediate_size, cfg.vocab_size,
-               cfg.rms_norm_eps)   # eps is baked into the traced body
+    cfg_key = (p["family"], cfg.num_hidden_layers, cfg.hidden_size,
+               cfg.num_attention_heads,
+               getattr(cfg, "num_key_value_heads", 0),
+               getattr(cfg, "head_dim", 0), cfg.vocab_size,
+               getattr(cfg, "intermediate_size", 0),
+               getattr(cfg, "rms_norm_eps", 0.0),  # eps bakes into the body
+               # MoE / MLA program-shaping knobs
+               getattr(cfg, "num_experts", 0), getattr(cfg, "top_k", 0),
+               getattr(cfg, "moe_intermediate_size", 0),
+               getattr(cfg, "shared_expert_intermediate_size", 0),
+               getattr(cfg, "first_k_dense_replace", 0),
+               getattr(cfg, "kv_lora_rank", 0),
+               getattr(cfg, "q_lora_rank", 0) or 0,
+               getattr(cfg, "qk_nope_head_dim", 0),
+               getattr(cfg, "qk_rope_head_dim", 0),
+               getattr(cfg, "v_head_dim", 0))
     prog_key = (cfg_key, S0, max_new_tokens, decode_strategy, top_k,
                 top_p, temperature, eos_token_id, pad_token_id)
     jitted = _DECODE_LOOP_CACHE.get(prog_key)
@@ -452,6 +687,9 @@ def _make_llama_decode_loop(p, S0: int, max_new_tokens: int,
         _DECODE_LOOP_CACHE[prog_key] = jitted
     weights = _llama_weights(p)
     return lambda ids, key: jitted(weights, ids, key)
+
+
+_make_llama_decode_loop = _make_decode_loop     # serving_bench compat
 
 
 # compiled decode loops keyed on everything that shapes the program: the
@@ -475,7 +713,7 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
     if decode_strategy not in ("greedy_search", "sampling"):
         raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
                          "'greedy_search' or 'sampling'")
-    p = _llama_decode_params(model)
+    p = _decode_params(model)
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
@@ -483,9 +721,9 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
     if S0 + max_new_tokens > p["cfg"].max_position_embeddings:
         raise ValueError(f"{S0 + max_new_tokens} tokens exceed "
                          "max_position_embeddings")
-    run = _make_llama_decode_loop(p, S0, max_new_tokens, decode_strategy,
-                                  top_k, top_p, temperature, eos_token_id,
-                                  pad_token_id)
+    run = _make_decode_loop(p, S0, max_new_tokens, decode_strategy,
+                            top_k, top_p, temperature, eos_token_id,
+                            pad_token_id)
     with ag.no_grad():
         gen, sc = run(ids, next_key())
     return Tensor(gen), Tensor(sc)
@@ -672,7 +910,7 @@ class _CachedBeamState:
     permutation every step (the reference's cache reorder on beam_idx)."""
 
     def __init__(self, model, ids, nb, max_new_tokens):
-        p = _llama_decode_params(model)
+        p = _decode_params(model)
         self.p = p
         cfg = p["cfg"]
         B, S0 = ids.shape
@@ -681,13 +919,8 @@ class _CachedBeamState:
         if total > cfg.max_position_embeddings:
             raise ValueError(
                 f"{total} tokens exceed max_position_embeddings")
-        KV, D = cfg.num_key_value_heads, cfg.head_dim
-        dt = p["embed"].dtype
-        R = B * nb
-        self.caches = [(jnp.zeros((R, total, KV, D), dt),
-                        jnp.zeros((R, total, KV, D), dt))
-                       for _ in p["layers"]]
-        self.step = _make_llama_cached_step(p, total)
+        self.caches = _init_caches(p, B * nb, total)
+        self.step = _make_cached_step(p, total)
         self.buf = jnp.repeat(
             jnp.concatenate([ids, jnp.zeros((B, max_new_tokens),
                                             jnp.int32)], 1), nb, 0)

@@ -135,6 +135,35 @@ def global_gather(expert_out, combine, expert_axis: Optional[str] = None):
 # layers
 # ---------------------------------------------------------------------------
 
+def dropless_expert_ffn(xt, gates, wg, wu, wd, *, top_k: int,
+                        renormalize: bool, activation: str = "swiglu"):
+    """Per-token top-k routed expert FFN, dropless (megablocks pattern:
+    flatten (token, choice) rows, sort by expert, one ragged grouped GEMM,
+    unsort, weighted-combine). SINGLE SOURCE OF TRUTH for the routing
+    numerics — MoELayer's training forward and the cached-decode serving
+    path (generation._ffn_apply) both call this, so the serving exact-match
+    contract cannot drift. Returns (y [T, H], topi [T, k])."""
+    E = wu.shape[0]
+    T = xt.shape[0]
+    topv, topi = jax.lax.top_k(gates, top_k)                # [T, k]
+    gv = topv
+    if renormalize:
+        gv = gv / jnp.maximum(jnp.sum(gv, -1, keepdims=True), 1e-9)
+    rows = jnp.repeat(xt, top_k, axis=0)                    # [T*k, H]
+    eids = topi.reshape(-1)                                 # [T*k]
+    srt, sizes, inv = sort_by_group(rows, eids, E)
+    up = grouped_gemm(srt, wu, sizes)
+    if activation == "swiglu":
+        g = grouped_gemm(srt, wg, sizes)
+        act = jax.nn.silu(g) * up
+    else:
+        act = jax.nn.gelu(up)
+    down = grouped_gemm(act, wd, sizes)
+    down = unsort_by_group(down, inv).reshape(T, top_k, -1)
+    y = jnp.einsum("tk,tkh->th", gv.astype(down.dtype), down)
+    return y, topi
+
+
 class MoELayer(nn.Layer):
     """Top-k routed MoE FFN (GShard/Qwen2-MoE pattern).
 
@@ -251,23 +280,9 @@ class MoELayer(nn.Layer):
         """Megablocks pattern: flatten (token, choice) rows, sort by expert,
         one ragged grouped GEMM, unsort, weighted-combine."""
         k, E = self.top_k, self.num_experts
-        T = xt.shape[0]
-        topv, topi = jax.lax.top_k(gates, k)                # [T, k]
-        gv = topv
-        if self.renormalize:
-            gv = gv / jnp.maximum(jnp.sum(gv, -1, keepdims=True), 1e-9)
-        rows = jnp.repeat(xt, k, axis=0)                    # [T*k, H]
-        eids = topi.reshape(-1)                             # [T*k]
-        srt, sizes, inv = sort_by_group(rows, eids, E)
-        up = grouped_gemm(srt, wu, sizes)
-        if self.activation == "swiglu":
-            g = grouped_gemm(srt, wg, sizes)
-            act = jax.nn.silu(g) * up
-        else:
-            act = jax.nn.gelu(up)
-        down = grouped_gemm(act, wd, sizes)
-        down = unsort_by_group(down, inv).reshape(T, k, -1)
-        y = jnp.einsum("tk,tkh->th", gv.astype(down.dtype), down)
+        y, topi = dropless_expert_ffn(xt, gates, wg, wu, wd, top_k=k,
+                                      renormalize=self.renormalize,
+                                      activation=self.activation)
         mask1 = jax.nn.one_hot(topi[:, 0], E, dtype=gates.dtype)
         return y, load_balance_loss(gates, mask1)
 

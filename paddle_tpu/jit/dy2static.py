@@ -93,6 +93,15 @@ class Undefined:
         return f"<undefined '{self.name}'>"
 
 
+def is_undef(v) -> bool:
+    """Runtime check used by generated scrub guards: a loop temp whose
+    post-loop value is unavailable under trace is DELETED after the loop,
+    so any later read raises UnboundLocalError (python semantics for an
+    unbound name) instead of silently passing the sentinel through a
+    return/argument position."""
+    return isinstance(v, Undefined)
+
+
 def ld(thunk: Callable, name: str):
     """Safe load of a possibly-unbound local for threading into branch fns."""
     try:
@@ -482,6 +491,21 @@ def _unpack(names, call):
     return ast.Assign(targets=[target], value=call)
 
 
+def _scrub_guards(names):
+    """One `if __pt_jst__.is_undef(w): del w` per name: an Undefined loop
+    temp must not leak through pass-through positions (return, argument,
+    container) — deleting it makes any later read raise, matching the
+    documented 'reads raise' contract."""
+    out = []
+    for w in names:
+        out.append(ast.If(
+            test=ast.Call(func=_jst_attr("is_undef"), args=[_n(w)],
+                          keywords=[]),
+            body=[ast.Delete(targets=[ast.Name(id=w, ctx=ast.Del())])],
+            orelse=[]))
+    return out
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
@@ -546,7 +570,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Constant(tuple(ordered)), ast.Constant(len(carried))],
             keywords=[])
         self.applied += 1
-        return [cdef, bdef, _unpack(ordered, call)]
+        return [cdef, bdef, _unpack(ordered, call)] + _scrub_guards(temps)
 
     def visit_For(self, node: ast.For):
         node = self.generic_visit(node)
@@ -576,7 +600,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Constant(len(carried))],
             keywords=[])
         self.applied += 1
-        return [bdef, _unpack([idx] + ordered, call)]
+        return ([bdef, _unpack([idx] + ordered, call)]
+                + _scrub_guards(temps))
 
 
 # ---------------------------------------------------------------------------

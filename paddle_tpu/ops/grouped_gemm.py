@@ -50,17 +50,24 @@ def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
         from jax.experimental.pallas.ops.tpu.megablox import gmm as mb_gmm
         return mb_gmm(lhs, rhs, gs32)
     if impl == "auto" and prefer_ragged:
+        # NOTE: the try/excepts below only catch TRACE-time rejections
+        # (unsupported primitive/shape raised while tracing). Failures that
+        # surface at XLA/Mosaic compile time escape them, so the chain is
+        # gated on static predicates first — kernel eligibility and a VMEM
+        # block-footprint bound — and the excepts are just a second fence.
         try:
             return jax.lax.ragged_dot(lhs, rhs, gs32)
         except Exception:  # pragma: no cover - backend-specific gaps
             pass
         from .pallas_gmm import gmm, gmm_kernel_eligible
-        if gmm_kernel_eligible(lhs.shape[0], lhs.shape[1], rhs.shape[2]):
+        if (gmm_kernel_eligible(lhs.shape[0], lhs.shape[1], rhs.shape[2])
+                and _gmm_vmem_ok(lhs.shape[1], rhs.shape[2], lhs.dtype)):
             try:
                 return gmm(lhs, rhs, gs32)
-            except Exception:  # pragma: no cover - e.g. VMEM overflow
+            except Exception:  # pragma: no cover - trace-time only
                 pass
-        if jax.default_backend() == "tpu":
+        if (jax.default_backend() == "tpu"
+                and _gmm_vmem_ok(lhs.shape[1], rhs.shape[2], lhs.dtype)):
             try:
                 # megablox gmm: the bundled Pallas TPU grouped-GEMM kernel
                 from jax.experimental.pallas.ops.tpu.megablox import gmm \
@@ -79,6 +86,18 @@ def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
     per_g = jnp.einsum("gm,mk->gmk", member.astype(lhs.dtype), lhs)
     out_g = jnp.einsum("gmk,gkn->gmn", per_g, rhs)
     return jnp.sum(out_g, axis=0)
+
+
+def _gmm_vmem_ok(K: int, N: int, dtype, block_m: int = 128,
+                 block_n: int = 128, budget_bytes: int = 64 << 20) -> bool:
+    """Static VMEM bound for the Pallas grouped-GEMM kernels: one grid cell
+    holds an lhs block [bm, K], an rhs block [K, bn] and the f32 accumulator
+    [bm, bn]. Mosaic VMEM overflow is a COMPILE-time error the auto chain
+    cannot catch, so shapes that would overflow are routed past the kernels
+    up front (half the ~128MB v5 VMEM, leaving room for double-buffering)."""
+    esize = jnp.dtype(dtype).itemsize
+    need = (block_m * K + K * block_n) * esize + block_m * block_n * 4
+    return need <= budget_bytes
 
 
 def sort_by_group(x, group_ids, num_groups: int):

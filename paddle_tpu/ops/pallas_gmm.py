@@ -199,7 +199,19 @@ def _tgmm_impl(lhs, dout, group_sizes, bm, bn):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def gmm(lhs, rhs, group_sizes, block_m: int = 128, block_n: int = 128):
     """Grouped matmul: rows of lhs hit their group's rhs (see module
-    docstring). Differentiable; bf16-in/f32-accumulate."""
+    docstring). Differentiable; bf16-in/f32-accumulate.
+
+    Shapes must satisfy :func:`gmm_kernel_eligible` (N % block_n == 0 and
+    K % 128 == 0): the kernel floor-divides N by block_n, so a ragged N
+    would leave trailing columns unwritten, and the backward pass re-runs
+    the kernel with K in the N position."""
+    _, K = lhs.shape
+    _, _, N = rhs.shape
+    if not gmm_kernel_eligible(lhs.shape[0], K, N, block_m, block_n):
+        raise ValueError(
+            f"gmm: shapes K={K}, N={N} not eligible for the in-tree kernel "
+            f"(need N % {block_n} == 0 and K % 128 == 0, both fwd and bwd); "
+            "use ops.grouped_gemm.grouped_matmul for the routed fallback")
     return _gmm_fwd_impl(lhs, rhs, group_sizes, block_m, block_n)
 
 

@@ -43,9 +43,13 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _page_map(b, h, j, lens, tab, *, page_size):
+def _page_map(b, h, j, lens, tab, *, page_size, total_pages):
     jmax = jnp.maximum(lens[b] - 1, 0) // page_size
-    return (h, tab[b, jnp.minimum(j, jmax)], 0, 0)
+    # clamp the table value too: lengths[b]==0 rows and sentinel entries
+    # (-1 for unallocated slots) must not emit an out-of-range physical
+    # page for the prefetch DMA, even though compute is pl.when-skipped
+    phys = jnp.clip(tab[b, jnp.minimum(j, jmax)], 0, total_pages - 1)
+    return (h, phys, 0, 0)
 
 
 def _kernel(lengths_ref, page_tab_ref,      # scalar prefetch
@@ -125,9 +129,9 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
             # re-reference the previous block and Pallas elides the copy
             # (otherwise skipped pages still pay their HBM DMA)
             pl.BlockSpec((1, 1, page_size, D), functools.partial(
-                _page_map, page_size=page_size)),
+                _page_map, page_size=page_size, total_pages=_total)),
             pl.BlockSpec((1, 1, page_size, D), functools.partial(
-                _page_map, page_size=page_size)),
+                _page_map, page_size=page_size, total_pages=_total)),
         ],
         out_specs=pl.BlockSpec((1, 1, rep, D),
                                lambda b, h, j, lens, tab: (b, h, 0, 0)),

@@ -119,7 +119,16 @@ def _captured_tensors(fns: Sequence[Optional[Callable]],
         code = getattr(fn, "__code__", None)
         if code is not None:
             g = getattr(fn, "__globals__", {})
-            for name in code.co_names:
+            # walk nested code objects too: a branch fn that only touches a
+            # global Tensor from an inner def/lambda must still thread it
+            # (same fix as jit._find_layers' nested co_names walk)
+            stack, names = [code], set()
+            while stack:
+                c = stack.pop()
+                names.update(c.co_names)
+                stack.extend(k for k in c.co_consts
+                             if isinstance(k, type(code)))
+            for name in names:
                 if name in g:
                     _scan_value(g[name], add)
                     maybe_fn(g[name])

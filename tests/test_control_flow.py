@@ -464,6 +464,41 @@ class TestDy2Static:
 
         assert dy2static.convert(cached) is cached
 
+    def test_loop_temp_read_after_traced_loop_raises(self):
+        # a temp (assigned-before-read each iteration) has no post-loop
+        # value under lax lowering; reading it after the loop must raise,
+        # not silently return the Undefined sentinel (review fix)
+        def f(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                y = paddle.to_tensor(1.0)
+                while i < n:
+                    t = y * 2.0
+                    y = t - 1.0
+                    i = i + 1
+            return t
+
+        sf = paddle.jit.to_static(f)
+        with pytest.raises(Exception) as ei:
+            sf(paddle.to_tensor(3))
+        assert isinstance(ei.value, (NameError, UnboundLocalError))
+        # python semantics preserved for the untraced fn
+        assert float(f(3)) == 2.0
+
+    def test_nested_def_global_tensor_captured(self):
+        # a branch fn touching a global Tensor only via an inner def must
+        # still thread it through the traced cond (review fix)
+        from paddle_tpu.static.control_flow import _captured_tensors
+        t = paddle.to_tensor([1.0])
+        glob = {"_CF_W": t}
+
+        src = "def branch():\n    def inner():\n        return _CF_W * 2\n" \
+              "    return inner()\n"
+        ns = {}
+        exec(compile(src, "<t>", "exec"), glob, ns)
+        caps = _captured_tensors([ns["branch"]])
+        assert any(c is t for c in caps)
+
     def test_convert_noop_without_control_flow(self):
         def plain(x):
             return x + 1
