@@ -10,6 +10,8 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+from . import _bootstrap  # noqa: F401  multi-host join BEFORE backend init
+
 from . import flags as _flags_mod
 from .flags import get_flags, set_flags
 

@@ -1,0 +1,50 @@
+"""Early jax.distributed bootstrap (ref: the reference initialises its
+collective context from the PADDLE_* env at import/bring-up time —
+SURVEY §3.1). MUST be the first import in paddle_tpu/__init__.py: package
+import builds jnp values, which initialises the XLA backend, after which
+``jax.distributed.initialize`` refuses to run. The launcher
+(distributed/launch) exports COORDINATOR_ADDRESS (the jax coordination
+port published through the TCPStore rendezvous) + PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ID; any worker that imports paddle_tpu joins the pod
+automatically. ``init_parallel_env()`` stays the explicit-API parity
+surface and is a no-op when this already ran."""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_initialize() -> bool:
+    """Join the jax distributed pod if the launcher env says we are one of
+    N>1 processes. Idempotent. Returns True if this process is (now)
+    initialized as part of a multi-process pod."""
+    n = os.environ.get("PADDLE_TRAINERS_NUM", "1")
+    coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
+        "PADDLE_MASTER")
+    if n == "1" or not coord:
+        return False
+    # a worker's own subprocesses (dataloader workers, helpers) inherit the
+    # launcher env; they must NOT join the pod as a duplicate of the
+    # parent's rank — the marker records which pid actually joined
+    joined_pid = os.environ.get("PADDLE_DIST_JOINED_PID")
+    if joined_pid is not None and joined_pid != str(os.getpid()):
+        return False
+    import jax
+    if jax.distributed.is_initialized():
+        return True
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # cross-process CPU collectives need gloo (the simulated
+        # multi-host path; TPU pods ride ICI/DCN natively)
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(n),
+        process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    os.environ["PADDLE_DIST_JOINED_PID"] = str(os.getpid())
+    return True
+
+
+maybe_initialize()

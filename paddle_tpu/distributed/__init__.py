@@ -36,14 +36,11 @@ def init_parallel_env():
     Single-host (this dev environment): no-op beyond returning the env; on
     pods, jax.distributed.initialize is driven by the launcher (SURVEY §3.1
     TCPStore rendezvous ⇒ coordination service)."""
-    import jax
-    import os
-    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
-        "COORDINATOR_ADDRESS")
-    if coord and jax.process_count() == 1 and os.environ.get(
-            "PADDLE_TRAINERS_NUM", "1") != "1":
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    # the real join happens in paddle_tpu._bootstrap at package import
+    # (before any jnp value initialises the backend — COORDINATOR_ADDRESS
+    # is the jax coordination port the launcher published through the
+    # TCPStore, distinct from the PADDLE_MASTER store port); this explicit
+    # call is the parity surface and a late-env fallback
+    from .._bootstrap import maybe_initialize
+    maybe_initialize()
     return None

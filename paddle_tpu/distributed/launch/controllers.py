@@ -89,8 +89,10 @@ class CollectiveController:
             "PADDLE_MASTER": self.master_endpoint,
             "PADDLE_LOCAL_RANK": str(local_rank),
             "PADDLE_NNODES": str(self.nnodes),
-            # jax.distributed bridge (multi-host TPU bring-up)
-            "COORDINATOR_ADDRESS": self.master_endpoint,
+            # jax.distributed bridge (multi-host TPU bring-up): a separate
+            # port from the rendezvous store (see _publish_jax_coordinator;
+            # AttributeError here means spawn() ordering broke — fail fast)
+            "COORDINATOR_ADDRESS": self.jax_coordinator,
             "JAX_PROCESS_ID": str(rank),
             "JAX_NUM_PROCESSES": str(self.world_size),
         })
@@ -99,7 +101,38 @@ class CollectiveController:
         return env
 
     # -- spawn / watch -------------------------------------------------------
+    def _publish_jax_coordinator(self):
+        """Pick + publish the jax coordination-service endpoint (its OWN
+        port — the store already owns master_endpoint's). Called at spawn
+        time, not rendezvous, to shrink the free-port TOCTOU window to the
+        child's startup; the port is drawn BELOW the Linux ephemeral range
+        (32768+) so workers' own outbound connections can't land on it."""
+        import random
+        import socket
+        host = self.master_endpoint.split(":")[0]
+        if self.node_rank == 0:
+            rnd = random.Random()
+            jport = None
+            for _ in range(64):
+                cand = rnd.randrange(20000, 30000)
+                s = socket.socket()
+                try:
+                    s.bind((host if host != "127.0.0.1" else "", cand))
+                    jport = cand
+                    break
+                except OSError:
+                    continue
+                finally:
+                    s.close()
+            if jport is None:
+                raise RuntimeError("no free port for the jax coordinator")
+            self.store.set("jax/coordinator", f"{host}:{jport}")
+        self.jax_coordinator = self.store.wait(
+            "jax/coordinator", timeout=self.args.rdzv_timeout).decode()
+
     def spawn(self):
+        if not hasattr(self, "jax_coordinator"):
+            self._publish_jax_coordinator()
         os.makedirs(self.args.log_dir, exist_ok=True)
         self.procs = []
         for lr in range(self.nproc):
@@ -187,3 +220,11 @@ class ElasticManager:
 
     def membership_changed(self, expected: int) -> bool:
         return len(self.alive_nodes(expected)) != expected
+
+    def regenerate_ranks(self, nnodes: int) -> dict:
+        """Compacted old-rank -> new-rank map over the surviving members
+        (ref: ElasticManager's rank regeneration on a scale-in event). The
+        relaunch then re-runs the launcher with nnodes=len(map) and each
+        survivor's new node_rank."""
+        alive = sorted(self.alive_nodes(nnodes))
+        return {old: new for new, old in enumerate(alive)}

@@ -551,9 +551,6 @@ def _make_cached_step(p, max_len: int):
     return lambda ids, caches, start: jitted(w, ids, caches, start)
 
 
-_make_llama_cached_step = _make_cached_step     # serving_bench compat
-
-
 def generate_cached(model, input_ids, max_new_tokens: int = 20,
                     decode_strategy: str = "sampling",
                     top_k: Optional[int] = None, top_p: Optional[float] = None,
@@ -666,7 +663,8 @@ def _make_decode_loop(p, S0: int, max_new_tokens: int,
                getattr(cfg, "num_key_value_heads", 0),
                getattr(cfg, "head_dim", 0), cfg.vocab_size,
                getattr(cfg, "intermediate_size", 0),
-               getattr(cfg, "rms_norm_eps", 0.0),  # eps bakes into the body
+               getattr(cfg, "rms_norm_eps", 0.0),
+               getattr(cfg, "layer_norm_eps", 0.0),  # eps bakes into the body
                # MoE / MLA program-shaping knobs
                getattr(cfg, "num_experts", 0), getattr(cfg, "top_k", 0),
                getattr(cfg, "moe_intermediate_size", 0),
@@ -687,9 +685,6 @@ def _make_decode_loop(p, S0: int, max_new_tokens: int,
         _DECODE_LOOP_CACHE[prog_key] = jitted
     weights = _llama_weights(p)
     return lambda ids, key: jitted(weights, ids, key)
-
-
-_make_llama_decode_loop = _make_decode_loop     # serving_bench compat
 
 
 # compiled decode loops keyed on everything that shapes the program: the

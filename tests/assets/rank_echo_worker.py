@@ -1,0 +1,7 @@
+"""Elastic-relaunch worker: records the rank env it was (re)launched with."""
+import os
+
+with open(os.path.join(os.environ["MH_OUT"],
+                       f"rank.{os.environ['PADDLE_TRAINER_ID']}"), "w") as f:
+    f.write(os.environ["PADDLE_TRAINER_ID"] + "/" +
+            os.environ["PADDLE_TRAINERS_NUM"])

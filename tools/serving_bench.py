@@ -64,8 +64,7 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16"):
     import jax.numpy as jnp
     from paddle_tpu.models.llama import (LlamaForCausalLM,
                                          llama3_8b_shard_config)
-    from paddle_tpu.generation import (_llama_decode_params,
-                                       _make_llama_decode_loop)
+    from paddle_tpu.generation import _decode_params, _make_decode_loop
     import paddle_tpu as paddle
 
     total = S0 + new
@@ -79,7 +78,7 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16"):
     if dtype == "bfloat16":
         for prm in model.parameters():
             prm._data = prm._data.astype(jnp.bfloat16)
-    p = _llama_decode_params(model)
+    p = _decode_params(model)
     w_bytes = _tree_bytes(p)
     KV, D = cfg.num_key_value_heads, cfg.head_dim
     cache_bytes_full = 2 * total * KV * D * 2 * len(p["layers"])  # bf16
@@ -87,7 +86,7 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16"):
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S0)), jnp.int32)
 
-    run = _make_llama_decode_loop(p, S0, new, "greedy_search", None, None,
+    run = _make_decode_loop(p, S0, new, "greedy_search", None, None,
                                   1.0, None, 0)
     key = jax.random.PRNGKey(0)
     _log("compiling decode loop")
@@ -105,7 +104,7 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16"):
     dt = (time.time() - t0) / reps
 
     # split prefill from decode: a 1-token decode loop isolates prefill
-    run_pf = _make_llama_decode_loop(p, S0, 1, "greedy_search", None, None,
+    run_pf = _make_decode_loop(p, S0, 1, "greedy_search", None, None,
                                      1.0, None, 0)
     _log("compiling prefill-only loop")
     toks_pf, _ = run_pf(ids, key)
