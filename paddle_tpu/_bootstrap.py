@@ -19,8 +19,12 @@ def maybe_initialize() -> bool:
     N>1 processes. Idempotent. Returns True if this process is (now)
     initialized as part of a multi-process pod."""
     n = os.environ.get("PADDLE_TRAINERS_NUM", "1")
-    coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
-        "PADDLE_MASTER")
+    # ONLY the launcher-published coordinator endpoint triggers the join:
+    # PADDLE_MASTER is the TCPStore's port, and the jax coordination
+    # service can never share it (rank 0 would fail to bind / everyone
+    # else would hang talking the wrong protocol) — so it must not be
+    # used as a fallback here
+    coord = os.environ.get("COORDINATOR_ADDRESS")
     if n == "1" or not coord:
         return False
     # a worker's own subprocesses (dataloader workers, helpers) inherit the

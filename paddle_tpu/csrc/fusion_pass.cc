@@ -187,6 +187,7 @@ struct Match {
   std::vector<int> chain_lines;            // interior lines to delete
   double scale = 1.0;
   double eps = 0.0;
+  std::string extra_json;                  // raw JSON tail, e.g. "prog"
 };
 
 struct Ctx {
@@ -530,6 +531,138 @@ static void match_swiglu(const Ctx& c, std::vector<Match>* out) {
 }
 
 // interior results must not be used outside the chain+final
+// ---------------------------------------------------------------------------
+// generic producer-consumer fusion (CINN trivial-op parity; VERDICT r3
+// item 4). Reference: paddle/cinn/operator_fusion/ merges ARBITRARY
+// same-shape elementwise producer-consumer regions, not a pattern table.
+// Here: grow maximal single-use-edge regions of same-type elementwise ops
+// (the constraints that make deleting the region and calling one generated
+// Pallas loop safe), require exactly one escaping value, and report the
+// region's program so the Python driver can synthesize the kernel.
+// ---------------------------------------------------------------------------
+static std::string json_escape(const std::string& s);
+
+static const std::set<std::string>& ew_ops() {
+  static const std::set<std::string> s = {
+      "stablehlo.add",         "stablehlo.subtract",
+      "stablehlo.multiply",    "stablehlo.divide",
+      "stablehlo.maximum",     "stablehlo.minimum",
+      "stablehlo.exponential", "stablehlo.log",
+      "stablehlo.tanh",        "stablehlo.logistic",
+      "stablehlo.rsqrt",       "stablehlo.sqrt",
+      "stablehlo.negate",      "stablehlo.abs",
+      "stablehlo.power"};
+  return s;
+}
+
+static void match_generic(const Ctx& c, const std::set<int>& taken,
+                          std::vector<Match>* out) {
+  // consumer index: ssa id -> indices of ops (incl. return) that read it
+  std::map<std::string, std::vector<int>> cons;
+  for (int i = 0; i < (int)c.f.ops.size(); ++i)
+    for (auto& o : c.f.ops[i].operands) cons[o].push_back(i);
+
+  std::set<int> visited;
+  for (int i0 = 0; i0 < (int)c.f.ops.size(); ++i0) {
+    const Op& seed = c.f.ops[i0];
+    if (visited.count(i0) || taken.count(seed.idx)) continue;
+    if (!ew_ops().count(seed.name)) continue;
+    std::string T = result_type_of(seed.line);
+    if (T.empty()) continue;
+
+    std::set<int> region{i0};
+    std::vector<int> work{i0};
+    while (!work.empty()) {
+      int oi = work.back();
+      work.pop_back();
+      const Op& op = c.f.ops[oi];
+      for (auto& id : op.operands) {       // grow towards producers
+        auto it = c.f.def.find(id);
+        if (it == c.f.def.end()) continue;
+        int pi = it->second;
+        const Op& p = c.f.ops[pi];
+        if (region.count(pi) || taken.count(p.idx)) continue;
+        if (!ew_ops().count(p.name)) continue;
+        if (result_type_of(p.line) != T) continue;
+        if (c.uses(id) != 1) continue;     // interior edges single-use
+        region.insert(pi);
+        work.push_back(pi);
+      }
+      if (op.result.empty() || c.uses(op.result) != 1) continue;
+      for (int qi : cons[op.result]) {     // grow towards consumers
+        const Op& q = c.f.ops[qi];
+        if (region.count(qi) || taken.count(q.idx)) continue;
+        if (!ew_ops().count(q.name)) continue;
+        if (result_type_of(q.line) != T) continue;
+        region.insert(qi);
+        work.push_back(qi);
+      }
+    }
+    for (int oi : region) visited.insert(oi);
+    if ((int)region.size() < 3) continue;  // not worth a kernel call
+
+    // exactly one escaping value
+    int fin = -1, n_escape = 0;
+    for (int oi : region) {
+      const Op& op = c.f.ops[oi];
+      if (op.result.empty()) continue;
+      int outside = 0;
+      for (int qi : cons[op.result])
+        if (!region.count(qi)) outside++;
+      if (outside > 0) {
+        fin = oi;
+        n_escape++;
+      }
+    }
+    if (n_escape != 1) continue;
+
+    Match mt;
+    mt.pattern = "generic";
+    std::vector<std::string> ext;
+    std::map<std::string, int> extidx;
+    std::ostringstream prog;
+    prog << "[";
+    bool first = true;
+    for (int oi = 0; oi < (int)c.f.ops.size(); ++oi) {  // SSA text order
+      if (!region.count(oi)) continue;
+      const Op& op = c.f.ops[oi];
+      if (oi != fin) mt.chain_lines.push_back(op.idx);
+      if (!first) prog << ", ";
+      first = false;
+      prog << "{\"op\": \"" << json_escape(op.name.substr(10))
+           << "\", \"ins\": [";
+      for (size_t k = 0; k < op.operands.size(); ++k) {
+        const std::string& id = op.operands[k];
+        auto dit = c.f.def.find(id);
+        bool internal = dit != c.f.def.end() && region.count(dit->second);
+        std::string tok;
+        if (internal) {
+          tok = id;
+        } else {
+          if (!extidx.count(id)) {
+            extidx[id] = (int)ext.size();
+            ext.push_back(id);
+          }
+          std::ostringstream es;
+          es << "#" << extidx[id];
+          tok = es.str();
+        }
+        prog << (k ? ", " : "") << "\"" << json_escape(tok) << "\"";
+      }
+      prog << "], \"out\": \"" << json_escape(op.result) << "\"}";
+    }
+    prog << "]";
+    const Op& fop = c.f.ops[fin];
+    mt.result = fop.result;
+    mt.result_type = result_type_of(fop.line);
+    mt.final_line = fop.idx;
+    mt.operands = ext;
+    for (auto& id : ext) mt.operand_types.push_back(c.type_of(id));
+    mt.extra_json = std::string(", \"prog\": ") + prog.str();
+    out->push_back(mt);
+  }
+}
+
 static bool chain_is_closed(const Ctx& c, const Match& m) {
   std::set<int> span(m.chain_lines.begin(), m.chain_lines.end());
   span.insert(m.final_line);
@@ -589,6 +722,19 @@ char* ptpu_fusion_analyze(const char* module_text) {
       for (int li : mt.chain_lines) claimed.insert(li);
       all.push_back(mt);
     }
+    // generic regions run AFTER the named patterns so a region never eats
+    // the interior of an sdpa/rmsnorm/swiglu chain
+    std::vector<Match> gs;
+    match_generic(c, claimed, &gs);
+    for (auto& mt : gs) {
+      if (!chain_is_closed(c, mt)) continue;
+      bool overlap = claimed.count(mt.final_line) > 0;
+      for (int li : mt.chain_lines) overlap |= claimed.count(li) > 0;
+      if (overlap) continue;
+      claimed.insert(mt.final_line);
+      for (int li : mt.chain_lines) claimed.insert(li);
+      all.push_back(mt);
+    }
   }
   std::ostringstream js;
   js << "{\"matches\": [";
@@ -609,7 +755,8 @@ char* ptpu_fusion_analyze(const char* module_text) {
     js << ", \"chain_lines\": [";
     for (size_t j = 0; j < mt.chain_lines.size(); ++j)
       js << (j ? ", " : "") << mt.chain_lines[j];
-    js << "], \"scale\": " << mt.scale << ", \"eps\": " << mt.eps << "}";
+    js << "], \"scale\": " << mt.scale << ", \"eps\": " << mt.eps
+       << mt.extra_json << "}";
   }
   js << "]}";
   return strdup(js.str().c_str());

@@ -145,11 +145,13 @@ define_flag("FLAGS_flash_impl", "intree",
             "'composite' (never take a fused kernel)",
             validator=lambda v: v in ("intree", "bundled", "composite"))
 define_flag("FLAGS_paged_impl", "intree",
-            "paged-attention decode kernel: 'intree' "
-            "(ops/pallas_paged.py, authored+tunable), 'bundled' "
+            "paged-attention decode kernel: 'intree' (the grouped-DMA v2 "
+            "kernel, ops/pallas_paged.py), 'intree_v1' (the per-page "
+            "BlockSpec kernel, kept for comparison), 'bundled' "
             "(jax.experimental paged_attention), or 'reference' (XLA "
             "gather composite)",
-            validator=lambda v: v in ("intree", "bundled", "reference"))
+            validator=lambda v: v in ("intree", "intree_v1", "bundled",
+                                      "reference"))
 define_flag("FLAGS_gmm_impl", "auto",
             "grouped-GEMM (MoE expert compute): 'auto' (fastest-first: "
             "ragged_dot -> in-tree ops/pallas_gmm.py -> bundled "

@@ -128,10 +128,15 @@ def generate(model, input_ids, max_new_tokens: int = 20,
 # PaddleNLP use_cache generation over the masked/block decode attention
 # kernels — paddle/phi/kernels/fusion/gpu/masked_multihead_attention)
 # ---------------------------------------------------------------------------
-def _llama_decode_params(model):
+def _llama_decode_params(model, weight_only_int8: bool = False):
     """Extract the cached-decode weight tree from a Llama-family CausalLM
     (LlamaForCausalLM, Qwen2ForCausalLM — same GQA backbone; Qwen2 adds
-    q/k/v biases, carried as optional leaves)."""
+    q/k/v biases, carried as optional leaves).
+
+    ``weight_only_int8``: store every 2-D matmul weight as (int8 values,
+    per-output-channel f32 scale) — ops/quant.weight_quantize — halving
+    the HBM weight reads that bound decode; the body dequantizes in VMEM
+    (ref: paddle/nn/quant weight-only deploy path)."""
     cfg = model.config
     inner = getattr(model, "llama", None)
     if inner is None:
@@ -145,6 +150,14 @@ def _llama_decode_params(model):
         raise NotImplementedError(
             "use_cache generation supports the unfused Llama layout; the "
             "fused qkv/ffn packs are pretrain perf knobs")
+    def q8(d, key):
+        if not weight_only_int8:
+            return
+        from .ops.quant import weight_quantize
+        qw, sc = weight_quantize(d.pop(key))
+        d[key + "_q"] = qw
+        d[key + "_s"] = sc.astype(jnp.float32)
+
     layers = []
     for lyr in inner.layers:
         a, m = lyr.self_attn, lyr.mlp
@@ -159,12 +172,18 @@ def _llama_decode_params(model):
             d["bq"] = a.q_proj.bias._data
             d["bk"] = a.k_proj.bias._data
             d["bv"] = a.v_proj.bias._data
+        for k in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
+            q8(d, k)
         layers.append(d)
     head = model.lm_head.weight._data if model.lm_head is not None else None
-    return dict(cfg=cfg, family="llama",
-                embed=inner.embed_tokens.weight._data,
-                layers=layers, norm=inner.norm.weight._data, head=head,
-                cos=inner.rope_cos._data, sin=inner.rope_sin._data)
+    p = dict(cfg=cfg, family="llama",
+             embed=inner.embed_tokens.weight._data,
+             layers=layers, norm=inner.norm.weight._data, head=head,
+             cos=inner.rope_cos._data, sin=inner.rope_sin._data)
+    if weight_only_int8 and head is not None:
+        q8(p, "head")
+        p["head"] = None
+    return p
 
 
 def _gpt_decode_params(model):
@@ -340,7 +359,7 @@ def _ffn_apply(L, h2, st=None):
 def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
     """Un-jitted (weights, ids_step, caches, start_pos) ->
     (last_logits, caches) body — jitted per-call-width by
-    _make_llama_cached_step for the host-loop path, traced inside one
+    _make_cached_step for the host-loop path, traced inside one
     scan by generate_compiled."""
     Hh, KV, D = (cfg.num_attention_heads, cfg.num_key_value_heads,
                  cfg.head_dim)
@@ -350,6 +369,15 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
     def rms(h, w):
         var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
         return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * w
+
+    def mm(h, L, key):
+        # weight-only int8: dequant in VMEM right before the matmul — the
+        # HBM read is int8 (half the bf16 bytes that bound decode)
+        if key + "_q" in L:
+            w8 = L[key + "_q"]
+            return h @ (w8.astype(h.dtype)
+                        * L[key + "_s"].astype(h.dtype)[None, :])
+        return h @ L[key]
 
     def step(w, ids, caches, start):
         B, S = ids.shape
@@ -364,7 +392,7 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
         sts = moe_static or (None,) * len(w["layers"])
         for L, (ck, cv), st in zip(w["layers"], caches, sts):
             h = rms(x, L["ln1"])
-            q, k, v = h @ L["wq"], h @ L["wk"], h @ L["wv"]
+            q, k, v = mm(h, L, "wq"), mm(h, L, "wk"), mm(h, L, "wv")
             if "bq" in L:                      # Qwen2 qkv biases
                 q, k, v = q + L["bq"], k + L["bk"], v + L["bv"]
             q = q.reshape(B, S, Hh, D)
@@ -383,13 +411,20 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
                                -1e30)
             aw = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
             o = jnp.einsum("bhst,bthd->bshd", aw, vv).reshape(B, S, Hh * D)
-            x = x + o @ L["wo"]
+            x = x + mm(o, L, "wo")
             h2 = rms(x, L["ln2"])
-            x = x + _ffn_apply(L, h2, st)
+            if "moe" in L or "wg" in L:
+                x = x + _ffn_apply(L, h2, st)
+            else:   # weight-only int8 dense FFN
+                x = x + mm(jax.nn.silu(mm(h2, L, "wg"))
+                           * mm(h2, L, "wu"), L, "wd")
         x = rms(x, w["norm"])
         last = x[:, -1]
-        logits = last @ (w["head"] if w["head"] is not None
-                         else w["embed"].T)
+        if "head_q" in w:
+            logits = mm(last, w, "head")
+        else:
+            logits = last @ (w["head"] if w["head"] is not None
+                             else w["embed"].T)
         return logits, new_caches
 
     return step
@@ -702,7 +737,7 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
                       eos_token_id: Optional[int] = None,
                       pad_token_id: int = 0):
     """KV-cache generation with the whole decode loop compiled (see
-    _make_llama_decode_loop). Same contract (and defaults) as
+    _make_decode_loop). Same contract (and defaults) as
     generate_cached; sampling draws from the framework RNG stream once
     per call (the per-step keys are split on-device)."""
     if decode_strategy not in ("greedy_search", "sampling"):

@@ -147,6 +147,71 @@ def _swiglu_kernel():
     return fn
 
 
+# stablehlo elementwise op -> jnp impl (the generic-region interpreter's
+# instruction set; mirror of fusion_pass.cc ew_ops())
+_EW_IMPL = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "exponential": jnp.exp, "log": jnp.log, "tanh": jnp.tanh,
+    "logistic": jax.nn.sigmoid, "rsqrt": jax.lax.rsqrt, "sqrt": jnp.sqrt,
+    "negate": jnp.negative, "abs": jnp.abs, "power": jnp.power,
+}
+
+
+def _run_generic_prog(prog, vals):
+    """Execute a reported region program on concrete/traced arrays."""
+    env = {}
+
+    def get(tok):
+        if tok.startswith("#"):
+            return vals[int(tok[1:])]
+        return env[tok]
+
+    out = None
+    for st in prog:
+        out = _EW_IMPL[st["op"]](*[get(t) for t in st["ins"]])
+        env[st["out"]] = out
+    return out
+
+
+def _generic_kernel(match: Dict[str, Any]):
+    """Synthesize ONE Pallas loop for an arbitrary matched elementwise
+    region (CINN generic-fusion parity): flatten to [M, 128] lanes, tile
+    the rows, and run the region program on each tile in VMEM."""
+    import numpy as _np
+    from jax.experimental import pallas as pl
+
+    prog = match["prog"]
+    out_aval = _parse_tensor_type(match["result_type"])
+    shape = out_aval.shape
+    total = int(_np.prod(shape)) if shape else 1
+    M = total // 128
+
+    def fn(*xs):
+        bm = min(M, 256)
+        while M % bm:
+            bm //= 2
+
+        def kernel(*refs):
+            ins, out = refs[:-1], refs[-1]
+            out[:] = _run_generic_prog(
+                prog, [r[:] for r in ins]).astype(out.dtype)
+
+        flat = [x.reshape(M, 128) for x in xs]
+        out = pl.pallas_call(
+            kernel,
+            grid=(M // bm,),
+            in_specs=[pl.BlockSpec((bm, 128), lambda i: (i, 0))
+                      for _ in xs],
+            out_specs=pl.BlockSpec((bm, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((M, 128), out_aval.dtype),
+            interpret=jax.default_backend() != "tpu",
+        )(*flat)
+        return out.reshape(shape)
+
+    return fn
+
+
 def _replacement_fn(match: Dict[str, Any]):
     p = match["pattern"]
     if p == "sdpa":
@@ -155,6 +220,8 @@ def _replacement_fn(match: Dict[str, Any]):
         return _rmsnorm_kernel(float(match["eps"]))
     if p == "swiglu":
         return _swiglu_kernel()
+    if p == "generic":
+        return _generic_kernel(match)
     raise ValueError(f"unknown pattern {p!r}")
 
 
@@ -167,6 +234,21 @@ def _eligible(match: Dict[str, Any]) -> bool:
     if match["pattern"] == "sdpa":
         from .fusion import _flash_eligible_shapes
         return _flash_eligible_shapes(avals[0], avals[1])
+    if match["pattern"] == "generic":
+        import numpy as _np
+        try:
+            out_aval = _parse_tensor_type(match["result_type"])
+        except (ValueError, KeyError):
+            return False
+        if not _np.issubdtype(out_aval.dtype, _np.floating):
+            return False
+        # one flattened [M, 128] Pallas view must fit every operand: the
+        # matcher guarantees same-type interiors, so same shape throughout
+        total = int(_np.prod(out_aval.shape)) if out_aval.shape else 1
+        if total % 128 != 0 or total < 128 * 8:
+            return False
+        return all(a.shape == out_aval.shape and a.dtype == out_aval.dtype
+                   for a in avals)
     if jax.default_backend() == "tpu":
         return avals[0].shape[-1] % 128 == 0
     return True

@@ -72,8 +72,15 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices,
     H, D = q.shape[1], q.shape[2]
     KV, page_size = k_pages.shape[0], k_pages.shape[2]
     if impl == "intree":
-        from .pallas_paged import paged_decode_attention, \
-            paged_kernel_eligible
+        from .pallas_paged import (paged_decode_attention_v2,
+                                   paged_kernel_eligible)
+        if paged_kernel_eligible(H, KV, D, page_size):
+            return paged_decode_attention_v2(q, k_pages, v_pages,
+                                             lengths, page_indices, scale)
+    elif impl == "intree_v1":
+        # the per-page BlockSpec kernel, kept for comparison benching
+        from .pallas_paged import (paged_decode_attention,
+                                   paged_kernel_eligible)
         if paged_kernel_eligible(H, KV, D, page_size):
             return paged_decode_attention(q, k_pages, v_pages,
                                           lengths, page_indices, scale)
@@ -81,9 +88,20 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices,
         try:
             from jax.experimental.pallas.ops.tpu.paged_attention import (
                 paged_attention as _kernel)
-            sq = q if scale is None else q * (scale * q.shape[-1] ** 0.5)
+            from .pallas_paged import default_pages_per_group
+            # the bundled kernel applies NO internal scaling: pre-scale q
+            # (default 1/sqrt(D)); it also requires an explicit
+            # pages_per_compute_block or it raises and we'd silently fall
+            # back to the composite (round-4 fix: that fallback made
+            # "bundled" benchmarks measure the composite instead)
+            sq = q * (q.shape[-1] ** -0.5 if scale is None else scale)
+            nj = page_indices.shape[1]
+            ppcb = min(default_pages_per_group(nj, page_size), nj)
+            while nj % ppcb:
+                ppcb //= 2
             return _kernel(sq, k_pages, v_pages, lengths.astype(jnp.int32),
-                           page_indices.astype(jnp.int32))
+                           page_indices.astype(jnp.int32),
+                           pages_per_compute_block=max(ppcb, 1))
         except Exception:
             pass
     return paged_attention_reference(q, k_pages, v_pages, lengths,

@@ -18,7 +18,9 @@ from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from .. import nn
 
-__all__ = ["AbsmaxObserver", "FakeQuanterWithAbsMax", "QuantConfig", "QAT",
+__all__ = ["AbsmaxObserver", "PerChannelAbsmaxObserver", "HistObserver",
+           "KLObserver", "FakeQuanterWithAbsMax",
+           "FakeQuanterChannelWiseAbsMax", "QuantConfig", "QAT",
            "PTQ", "QuantedLinear", "quanted_linear_from"]
 
 
@@ -39,6 +41,141 @@ class AbsmaxObserver:
         return self.absmax / qmax if self.absmax else 1.0
 
 
+class PerChannelAbsmaxObserver:
+    """Per-channel absmax (ref: observers AbsMaxChannelWiseWeightObserver):
+    one scale per slice along ``axis`` — the weight-quant default upstream
+    (per-output-channel keeps the matmul error per column independent)."""
+
+    def __init__(self, quant_bits: int = 8, axis: int = -1):
+        self.quant_bits = quant_bits
+        self.axis = axis
+        self.absmax = None               # jnp [C]
+
+    def observe(self, x):
+        xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        ax = self.axis % xa.ndim
+        reduce_dims = tuple(i for i in range(xa.ndim) if i != ax)
+        cur = jnp.max(jnp.abs(xa), axis=reduce_dims)
+        self.absmax = cur if self.absmax is None else \
+            jnp.maximum(self.absmax, cur)
+        return x
+
+    def scale(self):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        if self.absmax is None:
+            return jnp.asarray(1.0)
+        return jnp.maximum(self.absmax / qmax, 1e-8)
+
+
+class HistObserver:
+    """Histogram observer with percentile calibration (ref: observers/
+    hist.py HistObserver). Collects |x| into ``bins`` buckets over a
+    growing range (bucket contents are merged by an integer factor when
+    the range expands, the standard re-binning trick), and calibrates the
+    scale at the given percentile of the observed mass — robust to the
+    outliers that make plain absmax scales waste int8 resolution."""
+
+    def __init__(self, quant_bits: int = 8, bins: int = 2048,
+                 percent: float = 0.9999):
+        self.quant_bits = quant_bits
+        self.bins = bins
+        self.percent = percent
+        self.hist = None                 # np [bins]
+        self.hist_max = 0.0
+
+    def observe(self, x):
+        import numpy as np
+        xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        a = np.abs(np.asarray(xa, dtype=np.float32)).ravel()
+        amax = float(a.max()) if a.size else 0.0
+        if amax == 0.0:
+            return x
+        if self.hist is None:
+            self.hist_max = amax
+            self.hist, _ = np.histogram(a, bins=self.bins,
+                                        range=(0.0, self.hist_max))
+            self.hist = self.hist.astype(np.float64)
+            return x
+        if amax > self.hist_max:
+            # grow the range by an integer factor and merge buckets
+            factor = int(np.ceil(amax / self.hist_max))
+            new_max = self.hist_max * factor
+            merged = np.zeros(self.bins, np.float64)
+            idx = (np.arange(self.bins) / factor).astype(int)
+            np.add.at(merged, idx, self.hist)
+            self.hist = merged
+            self.hist_max = new_max
+        h, _ = np.histogram(a, bins=self.bins, range=(0.0, self.hist_max))
+        self.hist += h
+        return x
+
+    def _threshold(self) -> float:
+        import numpy as np
+        if self.hist is None:
+            return 0.0
+        cum = np.cumsum(self.hist)
+        total = cum[-1]
+        if total == 0:
+            return 0.0
+        k = int(np.searchsorted(cum, self.percent * total))
+        k = min(k, self.bins - 1)
+        return (k + 1) / self.bins * self.hist_max
+
+    def scale(self) -> float:
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        t = self._threshold()
+        return t / qmax if t > 0 else 1.0
+
+
+class KLObserver(HistObserver):
+    """Entropy (KL-divergence) calibration over the collected histogram
+    (ref: observers/kl.py; the TensorRT calibration recipe): choose the
+    clip threshold whose clipped-and-requantized distribution diverges
+    least from the observed one."""
+
+    def __init__(self, quant_bits: int = 8, bins: int = 2048):
+        super().__init__(quant_bits=quant_bits, bins=bins)
+
+    def _threshold(self) -> float:
+        import numpy as np
+        if self.hist is None:
+            return 0.0
+        hist = self.hist
+        nq = 2 ** (self.quant_bits - 1)   # 128 target levels for int8
+        if hist.sum() == 0:
+            return 0.0
+        best_i, best_kl = self.bins, float("inf")
+        start = max(nq, self.bins // 16)
+        for i in range(start, self.bins + 1, max(1, self.bins // 256)):
+            p = hist[:i].copy()
+            p[i - 1] += hist[i:].sum()        # clamp outliers into edge
+            if p.sum() == 0:
+                continue
+            # quantize p's support down to nq buckets, then expand back
+            idx = (np.arange(i) * nq // i)
+            q_small = np.zeros(nq, np.float64)
+            np.add.at(q_small, idx, hist[:i])
+            counts = np.zeros(nq, np.float64)
+            nonzero = (hist[:i] > 0).astype(np.float64)
+            np.add.at(counts, idx, nonzero)
+            q = np.zeros(i, np.float64)
+            live = counts[idx] > 0
+            ratio = np.divide(q_small[idx], counts[idx],
+                              out=np.zeros(i, np.float64), where=live)
+            q[live] = ratio[live] * (hist[:i] > 0)[live]
+            ps = p / p.sum()
+            qsum = q.sum()
+            if qsum == 0:
+                continue
+            qs = q / qsum
+            mask = ps > 0
+            kl = float(np.sum(ps[mask] * np.log(
+                ps[mask] / np.maximum(qs[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        return best_i / self.bins * self.hist_max
+
+
 class FakeQuanterWithAbsMax(nn.Layer):
     """QAT fake-quant with straight-through gradients (ref:
     quanters/abs_max.py FakeQuanterWithAbsMaxObserver)."""
@@ -57,6 +194,29 @@ class FakeQuanterWithAbsMax(nn.Layer):
             # straight-through: forward q, backward identity
             return a + jax.lax.stop_gradient(q - a)
         return apply("fake_quant_absmax", impl, [x])
+
+
+class FakeQuanterChannelWiseAbsMax(nn.Layer):
+    """Per-channel QAT fake-quant (ref: quanters FakeQuanterChannelWise
+    AbsMaxObserver): one scale per output channel of the weight."""
+
+    def __init__(self, quant_bits: int = 8, axis: int = -1):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.axis = axis
+
+    def forward(self, x):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        ax = self.axis
+
+        def impl(a):
+            axis = ax % a.ndim
+            reduce_dims = tuple(i for i in range(a.ndim) if i != axis)
+            scale = jnp.max(jnp.abs(a), axis=reduce_dims, keepdims=True)
+            scale = jnp.maximum(scale / qmax, 1e-8)
+            q = jnp.clip(jnp.round(a / scale), -qmax, qmax) * scale
+            return a + jax.lax.stop_gradient(q - a)
+        return apply("fake_quant_channel_absmax", impl, [x])
 
 
 class QuantConfig:
@@ -152,13 +312,14 @@ class PTQ:
 
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
-        self.observers: Dict[str, AbsmaxObserver] = {}
+        self.observers: Dict[str, object] = {}
 
     def quantize(self, model, inplace: bool = False):
         self._hooks = []
+        obs_cls = self.config.activation or AbsmaxObserver
         for name, sub in model.named_sublayers():
             if isinstance(sub, nn.Linear):
-                obs = AbsmaxObserver()
+                obs = obs_cls()
                 self.observers[name] = obs
 
                 def mk(o):
@@ -172,10 +333,22 @@ class PTQ:
     def convert(self, model, inplace: bool = False):
         for h in getattr(self, "_hooks", []):
             h.remove()
+        obs_by_layer = {}
+        for name, sub in model.named_sublayers():
+            if name in self.observers:
+                obs_by_layer[id(sub)] = self.observers[name]
+
         def convert_children(parent):
             for cname, child in list(parent.__dict__["_sub_layers"].items()):
                 if isinstance(child, nn.Linear):
-                    parent.add_sublayer(cname, quanted_linear_from(child))
+                    ql = quanted_linear_from(child)
+                    obs = obs_by_layer.get(id(child))
+                    if obs is not None:
+                        # calibrated activation scale rides with the layer
+                        # (consumed by a full-int8 deploy; recorded even on
+                        # the weight-only path so calibration is auditable)
+                        ql.act_scale = obs.scale()
+                    parent.add_sublayer(cname, ql)
                 else:
                     convert_children(child)
         convert_children(model)

@@ -66,7 +66,10 @@ class TestMatcher:
             return x * jax.lax.rsqrt(var + 1e-6) * w
         x = jnp.ones((4, 256), jnp.float32)
         w = jnp.ones((256,), jnp.float32)
-        assert fusion_cc.analyze_text(_text(bad, x, w)) == []
+        ms = fusion_cc.analyze_text(_text(bad, x, w))
+        # the NAMED rmsnorm pattern must reject the wrong divisor; the
+        # generic region matcher may still fuse the elementwise tail
+        assert not [m for m in ms if m["pattern"] == "rmsnorm"], ms
 
     def test_plain_matmul_untouched(self):
         def mm(a, b):
@@ -108,7 +111,10 @@ class TestRewriteAndExecute:
         def swig(g, u):
             return jax.nn.silu(g) * u
         f = fusion_cc.fuse_compile(swig, g, u)
-        assert f.n_fused == 1
+        # the named swiglu fires in @main; the silu helper func's interior
+        # decomposition may additionally fuse generically
+        assert any(m["pattern"] == "swiglu" for m in f.matches)
+        assert f.n_fused >= 1
         np.testing.assert_allclose(np.asarray(f(g, u)),
                                    np.asarray(swig(g, u)),
                                    rtol=2e-5, atol=2e-5)
@@ -134,8 +140,9 @@ class TestRewriteAndExecute:
         wu = jnp.asarray(rng.standard_normal((128, 256)) * 0.1,
                          jnp.float32)
         f = fusion_cc.fuse_compile(block, x, w, wg, wu)
-        assert sorted(m["pattern"] for m in f.matches) == \
-            ["rmsnorm", "sdpa", "swiglu"]
+        pats = sorted(m["pattern"] for m in f.matches)
+        for need in ("rmsnorm", "sdpa", "swiglu"):
+            assert need in pats, pats
         np.testing.assert_allclose(np.asarray(f(x, w, wg, wu)),
                                    np.asarray(block(x, w, wg, wu)),
                                    rtol=5e-5, atol=5e-5)
@@ -206,3 +213,136 @@ class TestPredictorIntegration:
         ref = layer(paddle.to_tensor(x)).numpy()
         np.testing.assert_allclose(out, np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestPrinterFormatCanary:
+    """VERDICT r3 weak #3: fusion_pass.cc parses the jax printer's
+    one-op-per-line StableHLO text; a printer format change must fail HERE,
+    loudly, instead of silently reducing the C++ pass to a no-op."""
+
+    def _rmsnorm_text(self):
+        def f(x, w):
+            h32 = x.astype(jnp.float32)
+            var = jnp.mean(jnp.square(h32), -1, keepdims=True)
+            return (h32 * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * w
+
+        return jax.jit(f).lower(
+            jnp.zeros((8, 128), jnp.bfloat16),
+            jnp.zeros((128,), jnp.bfloat16)).as_text()
+
+    def test_printer_one_op_per_line_contract(self):
+        import re
+        text = self._rmsnorm_text()
+        op_lines = [l.strip() for l in text.splitlines()
+                    if "stablehlo." in l and "=" in l]
+        assert op_lines, f"no stablehlo op lines in printer output:\n{text}"
+        # every op line is '%ssa = stablehlo.op ...' — the exact shape the
+        # C++ line scanner keys on
+        pat = re.compile(r'^%[A-Za-z0-9_#]+ = "?stablehlo\.')
+        bad = [l for l in op_lines if not pat.match(l)]
+        assert not bad, f"printer format changed; offending lines: {bad[:3]}"
+        # func signature + return forms the splicer relies on
+        assert re.search(r"func\.func public @main", text)
+        assert "return" in text
+
+    def test_matcher_still_fires_on_fresh_lowering(self):
+        if not fusion_cc.available():
+            pytest.skip("no g++ / fusion_pass.so")
+        ms = fusion_cc.analyze_text(self._rmsnorm_text())
+        assert any(m["pattern"] == "rmsnorm" for m in ms), (
+            "the C++ matcher found nothing in a canonical rmsnorm module — "
+            "the jax printer likely changed format", ms)
+
+
+class TestGenericRegionFusion:
+    """CINN generic-fusion parity (VERDICT r3 item 4): arbitrary unnamed
+    same-shape elementwise producer-consumer regions fuse into ONE
+    generated Pallas loop with matching numerics — not a pattern table."""
+
+    def _x(self, seed=0):
+        rng = np.random.RandomState(seed)
+        return jnp.asarray(rng.randn(64, 128), jnp.float32)
+
+    def test_unnamed_chain_matches_and_executes(self):
+        if not fusion_cc.available():
+            pytest.skip("no g++")
+
+        def chain(a, b, c):
+            return (jnp.exp(jnp.tanh(a * b + c) * 0.5)
+                    - jnp.sqrt(jnp.abs(b) + 1.0))
+
+        x = self._x()
+        ms = fusion_cc.analyze_text(jax.jit(chain).lower(x, x, x).as_text())
+        gen = [m for m in ms if m["pattern"] == "generic"]
+        assert gen and len(gen[0]["prog"]) >= 8, ms
+        f = fusion_cc.fuse_compile(chain, x, x, x)
+        assert f.n_fused >= 1
+        np.testing.assert_allclose(np.asarray(f(x, x, x)),
+                                   np.asarray(jax.jit(chain)(x, x, x)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_second_unnamed_shape_min_maximum_mix(self):
+        if not fusion_cc.available():
+            pytest.skip("no g++")
+
+        def chain(a, b):
+            h = jnp.maximum(a, b) * jnp.minimum(a, -b)
+            return jnp.log(jnp.abs(h) + 2.0) / (jnp.tanh(b) + 3.0)
+
+        x, y = self._x(1), self._x(2)
+        f = fusion_cc.fuse_compile(chain, x, y)
+        assert f.n_fused >= 1, f.matches
+        np.testing.assert_allclose(np.asarray(f(x, y)),
+                                   np.asarray(jax.jit(chain)(x, y)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_multiuse_value_stays_external(self):
+        if not fusion_cc.available():
+            pytest.skip("no g++")
+
+        # a diamond: t is used twice, so it must NOT be swallowed into a
+        # single-use region; both sub-regions may fuse independently
+        def chain(a, b, c):
+            t = jnp.tanh(a * b + c)
+            u = t * jax.nn.sigmoid(a)
+            return u + jnp.exp(c) * t
+
+        x = self._x(3)
+        f = fusion_cc.fuse_compile(chain, x, x, x)
+        np.testing.assert_allclose(np.asarray(f(x, x, x)),
+                                   np.asarray(jax.jit(chain)(x, x, x)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_named_patterns_not_eaten(self):
+        if not fusion_cc.available():
+            pytest.skip("no g++")
+
+        # rmsnorm followed by extra elementwise: the named pattern claims
+        # its chain first; generic must not overlap it
+        def f(x, w):
+            h32 = x.astype(jnp.float32)
+            var = jnp.mean(jnp.square(h32), -1, keepdims=True)
+            y = (h32 * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * w
+            return jnp.tanh(y * 2.0) + jnp.exp(-y) * 0.5
+
+        x = jnp.zeros((64, 128), jnp.float32)
+        w = jnp.ones((128,), jnp.float32)
+        ms = fusion_cc.analyze_text(jax.jit(f).lower(x, w).as_text())
+        pats = sorted(m["pattern"] for m in ms)
+        assert "rmsnorm" in pats, pats
+        lines = set()
+        for m in ms:
+            span = set(m["chain_lines"]) | {m["final_line"]}
+            assert not (span & lines), "overlapping matches"
+            lines |= span
+
+    def test_small_region_not_matched(self):
+        if not fusion_cc.available():
+            pytest.skip("no g++")
+
+        def f(a, b):
+            return a * b + 1.0   # 2 ops — below the region threshold
+
+        x = self._x(4)
+        ms = fusion_cc.analyze_text(jax.jit(f).lower(x, x).as_text())
+        assert not [m for m in ms if m["pattern"] == "generic"], ms

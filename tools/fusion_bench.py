@@ -40,6 +40,92 @@ def block(x, w1, wq, wk, wv, wo, w2, wg, wu, wd, B, S, H, D):
     return x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
 
 
+def flagship_decode_rows() -> dict:
+    """VERDICT r3 item 3: measure the C++ StableHLO pass where it matters —
+    the 8B-shard serving path (prefill step + decode step), not synthetic
+    stacks. Records the achieved delta even if ~1.0x (XLA already fuses
+    much of this; the honest number bounds the pass's real contribution)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama3_8b_shard_config)
+    from paddle_tpu.generation import (_decode_params, _cached_step_body,
+                                       _llama_weights, _init_caches)
+    from paddle_tpu.jit import fusion_cc
+
+    if not fusion_cc.available():
+        return {"skipped": "fusion_pass.so unavailable"}
+
+    S0, new = 1024, 128
+    total = S0 + new
+    B = 8
+    cfg = llama3_8b_shard_config(mp=8, pp=4,
+                                 max_position_embeddings=total)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    for prm in model.parameters():
+        prm._data = prm._data.astype(jnp.bfloat16)
+    p = _decode_params(model)
+    w = _llama_weights(p)
+    body = _cached_step_body(p, total)
+    rng = np.random.RandomState(0)
+    ids_pf = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S0)), jnp.int32)
+    ids_dec = ids_pf[:, :1]
+    caches = _init_caches(p, B, total)
+
+    def bench_pair(tag, start, ids, reps):
+        def fn(w, ids, caches):
+            return body(w, ids, caches, start)
+        plain = jax.jit(fn)
+
+        def run_plain():
+            logits, _ = plain(w, ids, caches)
+            return logits
+
+        fused = fusion_cc.fuse_compile(fn, w, ids, caches)
+
+        def run_fused():
+            logits, _ = fused(w, ids, caches)
+            return logits
+
+        def t(run):
+            float(jnp.sum(run()))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                o = run()
+            float(jnp.sum(o))
+            return (time.perf_counter() - t0) / reps * 1e3
+
+        tp = t(run_plain)
+        tf = t(run_fused)
+        d = float(jnp.max(jnp.abs(run_plain().astype(jnp.float32)
+                                  - run_fused().astype(jnp.float32))))
+        return {f"{tag}_plain_ms": round(tp, 3),
+                f"{tag}_fused_ms": round(tf, 3),
+                f"{tag}_speedup": round(tp / tf, 3),
+                f"{tag}_matches": fused.n_fused,
+                f"{tag}_max_abs_diff": d}
+
+    out = dict(config="llama3_8b_shard mp=8 pp=4, B=8, prefill 1024 / "
+                      "decode 1 step")
+    out.update(bench_pair("prefill", 0, ids_pf, reps=5))
+    out.update(bench_pair("decode", S0, ids_dec, reps=20))
+    # derive the conclusion from what THIS run measured — never bake a
+    # narrative that can contradict the numbers beside it
+    psp, dsp = out["prefill_speedup"], out["decode_speedup"]
+    if psp < 1.05 and dsp < 1.05:
+        out["finding"] = (
+            f"pass is not a win on the flagship serving path this run "
+            f"(prefill {psp}x, decode {dsp}x): XLA already fuses these "
+            "regions; the pass pays off on naive user code (stack/gate "
+            "rows). FLAGS_use_fusion_compiler stays opt-in.")
+    else:
+        out["finding"] = (
+            f"pass helped this run (prefill {psp}x, decode {dsp}x); "
+            "re-evaluate the opt-in default if this repeats.")
+    return out
+
+
 def main() -> None:
     B, S, H, D, F, L = 4, 2048, 8, 128, 4096, 4
     HD = H * D
@@ -114,7 +200,38 @@ def main() -> None:
     t_gate_plain = bench1(jax.jit(gate), (gg,))
     t_gate_fused = bench1(jax.jit(fuse(gate)), (gg,))
 
+    # --- generic-region fusion (round-4): an unnamed elementwise chain ---
+    from paddle_tpu.jit import fusion_cc
+
+    def gchain(a, b, c):
+        t = jnp.tanh(a * b + c)
+        u = jnp.exp(t * 0.5) - jnp.sqrt(jnp.abs(b) + 1.0)
+        return jnp.log(jnp.abs(u) + 2.0) / (jax.nn.sigmoid(c) + 3.0)
+
+    Tg2 = 4096
+    ga = jnp.asarray(rng.standard_normal((Tg2, 4096)), jnp.float32)
+    gb = jnp.asarray(rng.standard_normal((Tg2, 4096)), jnp.float32)
+    gc = jnp.asarray(rng.standard_normal((Tg2, 4096)), jnp.float32)
+    generic_row = {"shape": [Tg2, 4096], "skipped": "no fusion_pass.so"}
+    if fusion_cc.available():
+        gf = fusion_cc.fuse_compile(gchain, ga, gb, gc)
+        t_g_plain = bench1(jax.jit(gchain), (ga, gb, gc))
+        t_g_fused = bench1(gf, (ga, gb, gc))
+        generic_row = {
+            "shape": [Tg2, 4096], "n_fused": gf.n_fused,
+            "plain_ms": round(t_g_plain, 3),
+            "fused_ms": round(t_g_fused, 3),
+            "speedup": round(t_g_plain / t_g_fused, 3),
+            "finding": (
+                ("XLA fuses arbitrary elementwise chains natively — the "
+                 "generic region pass exists for CINN parity (arbitrary-"
+                 "region capability) and this row bounds its real TPU "
+                 "contribution honestly.")
+                if t_g_fused >= t_g_plain * 0.95 else
+                "generic region fusion won this run; re-evaluate.")}
+
     out = {"device": str(jax.devices()[0].device_kind),
+           "generic_chain": generic_row,
            "shape": dict(B=B, S=S, H=H, D=D, F=F, layers=L),
            "plain_ms": round(t_plain, 2), "fused_ms": round(t_fused, 2),
            "speedup": round(t_plain / t_fused, 3),
@@ -128,7 +245,8 @@ def main() -> None:
                "shape": dict(T=Tg, E=Eg, C=Cg, k=2),
                "plain_ms": round(t_gate_plain, 3),
                "fused_ms": round(t_gate_fused, 3),
-               "speedup": round(t_gate_plain / t_gate_fused, 3)}}
+               "speedup": round(t_gate_plain / t_gate_fused, 3)},
+           "flagship_decode": flagship_decode_rows()}
     path = os.path.join(os.path.dirname(__file__), "..", "docs",
                         "FUSION_BENCH.json")
     with open(path, "w") as f:

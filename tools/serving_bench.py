@@ -59,18 +59,20 @@ def _log(msg):
 _T0 = time.time()
 
 
-def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16"):
+def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16",
+                 weight_only_int8=False):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.llama import (LlamaForCausalLM,
                                          llama3_8b_shard_config)
-    from paddle_tpu.generation import _decode_params, _make_decode_loop
+    from paddle_tpu.generation import (_llama_decode_params,
+                                       _make_decode_loop)
     import paddle_tpu as paddle
 
     total = S0 + new
     cfg = llama3_8b_shard_config(mp=8, pp=4,
                                  max_position_embeddings=total)
-    _log(f"init model B={B} S0={S0} new={new}")
+    _log(f"init model B={B} S0={S0} new={new} int8={weight_only_int8}")
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.eval()
@@ -78,7 +80,7 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16"):
     if dtype == "bfloat16":
         for prm in model.parameters():
             prm._data = prm._data.astype(jnp.bfloat16)
-    p = _decode_params(model)
+    p = _llama_decode_params(model, weight_only_int8=weight_only_int8)
     w_bytes = _tree_bytes(p)
     KV, D = cfg.num_key_value_heads, cfg.head_dim
     cache_bytes_full = 2 * total * KV * D * 2 * len(p["layers"])  # bf16
@@ -127,7 +129,9 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16"):
     bound_tok_s = B * _bw() / (w_bytes + B * kv_read)
     return dict(
         config="llama3_8b_shard mp=8 pp=4 (8 layers, 4 q-heads/1 kv-head "
-               "d128, ffn 1792, vocab 16032)", dtype=dtype,
+               "d128, ffn 1792, vocab 16032)"
+               + (" [weight-only int8]" if weight_only_int8 else ""),
+        dtype="int8-weights" if weight_only_int8 else dtype,
         batch=B, prefill_len=S0, new_tokens=new,
         weight_bytes=int(w_bytes), kv_cache_bytes_full=int(cache_bytes_full),
         compile_plus_first_s=round(compile_and_first, 2),
@@ -290,8 +294,9 @@ def bench_mla_decode(B=8, S0=512, new=256, dtype="bfloat16"):
 
 
 def bench_paged_kernel(B=8, ctx=4096, page_size=16):
-    """Decode-attention op microbench: paged kernel vs dense masked cache
-    at serving shapes (per-chip shard heads)."""
+    """Decode-attention op microbench: the grouped-DMA in-tree kernel (v2)
+    vs the per-page v1, the bundled kernel, and dense masked-cache
+    attention at serving shapes (per-chip shard heads)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.ops.paged_attention import paged_attention
@@ -321,9 +326,12 @@ def bench_paged_kernel(B=8, ctx=4096, page_size=16):
             return out
         return jax.jit(chained)
 
-    from paddle_tpu.ops.pallas_paged import paged_decode_attention
+    from paddle_tpu.ops.pallas_paged import (paged_decode_attention,
+                                             paged_decode_attention_v2)
     from paddle_tpu.flags import flags_guard
-    paged = chain(lambda q, kp, vp: paged_decode_attention(
+    paged_v2 = chain(lambda q, kp, vp: paged_decode_attention_v2(
+        q, kp, vp, lengths, page_idx))
+    paged_v1 = chain(lambda q, kp, vp: paged_decode_attention(
         q, kp, vp, lengths, page_idx))
 
     def _bundled(q, kp, vp):
@@ -348,13 +356,15 @@ def bench_paged_kernel(B=8, ctx=4096, page_size=16):
     def timeit(fn, *args, reps=4):
         return _shared_timeit(fn, *args, reps=reps) / CHAIN
 
-    t_paged = timeit(paged, q, kp, vp)
+    t_paged = timeit(paged_v2, q, kp, vp)
+    t_v1 = timeit(paged_v1, q, kp, vp)
     t_bundled = timeit(paged_bundled, q, kp, vp)
     t_dense = timeit(dense, q, k_dense, v_dense)
     # per-layer op; a full decode step runs `layers` of these
     return dict(batch=B, context=ctx, page_size=page_size,
                 heads=f"{H}q/{KV}kv d{D}", layers_note=f"x{layers}/step",
                 paged_intree_us=round(t_paged * 1e6, 1),
+                paged_intree_v1_us=round(t_v1 * 1e6, 1),
                 paged_bundled_us=round(t_bundled * 1e6, 1),
                 dense_us=round(t_dense * 1e6, 1),
                 intree_vs_dense=round(t_dense / t_paged, 2),
@@ -375,9 +385,17 @@ def main():
                   # weight reads in the roofline denominator
                   decode_b1=bench_decode(B=1, S0=1024, new=256),
                   decode_b16=bench_decode(B=16, S0=1024, new=256),
+                  # decode-dominated lengths: the prefill-subtraction
+                  # method needs the decode phase to dwarf prefill noise
+                  decode_int8=bench_decode(B=8, S0=256, new=1024,
+                                           weight_only_int8=True),
+                  decode_bf16_ref=bench_decode(B=8, S0=256, new=1024),
                   moe_decode=bench_moe_decode(),
                   mla_decode=bench_mla_decode(),
-                  paged_attention_op=bench_paged_kernel())
+                  paged_attention_op=bench_paged_kernel(),
+                  paged_attention_sweep=[
+                      bench_paged_kernel(ctx=c, page_size=p)
+                      for c in (4096, 8192, 16384) for p in (16, 32)])
     out = os.path.join(os.path.dirname(__file__), "..", "docs",
                        "SERVING_BENCH.json")
     if on_tpu:
