@@ -329,25 +329,41 @@ def _llama_weights(p):
             if k not in ("cfg", "family", "moe_static")}
 
 
+def _mm_w(h, L, key):
+    """Quant-aware matmul against a stored weight: weight-only int8
+    layouts hold (key_q int8, key_s per-channel f32) and dequantize in
+    VMEM right before the matmul (the HBM read is int8 — half the bf16
+    bytes that bound decode); fp layouts hold the key directly. The ONE
+    place both layouts' matmul goes through."""
+    if key + "_q" in L:
+        w8 = L[key + "_q"]
+        return h @ (w8.astype(h.dtype)
+                    * L[key + "_s"].astype(h.dtype)[None, :])
+    return h @ L[key]
+
+
 def _ffn_apply(L, h2, st=None):
-    """Per-layer FFN on [B, S, H]: dense SwiGLU or routed-MoE (dropless
-    per-token top-k — numerics match MoELayer._dropless exactly so the
-    cached path exact-matches a moe_dropless buffer model). ``st`` holds
-    the layer's STATIC routing knobs (top_k, renorm) from _mlp_params."""
+    """Per-layer FFN on [B, S, H]: dense SwiGLU (fp or weight-only int8)
+    or routed-MoE (dropless per-token top-k — numerics match
+    MoELayer._dropless exactly so the cached path exact-matches a
+    moe_dropless buffer model). ``st`` holds the layer's STATIC routing
+    knobs (top_k, renorm) from _mlp_params."""
     if "moe" not in L:
-        gate = h2 @ L["wg"]
-        return (jax.nn.silu(gate) * (h2 @ L["wu"])) @ L["wd"]
+        return _mm_w(jax.nn.silu(_mm_w(h2, L, "wg"))
+                     * _mm_w(h2, L, "wu"), L, "wd")
     mo = L["moe"]
     B, S, H = h2.shape
     T = B * S
     xt = h2.reshape(T, H)
     gates = jax.nn.softmax(
         xt.astype(jnp.float32) @ mo["gate"].astype(jnp.float32), axis=-1)
-    from .incubate.moe import dropless_expert_ffn
-    y, _ = dropless_expert_ffn(xt, gates, mo["wge"], mo["wup"], mo["wdn"],
-                               top_k=st["top_k"],
-                               renormalize=st["renorm"],
-                               activation="swiglu")
+    from .incubate.moe import dense_expert_ffn, dropless_expert_ffn
+    # decode steps (tiny T): every-expert dense compute beats the
+    # sort+grouped-GEMM path (128-row tile padding) and is bitwise-equal
+    ffn = dense_expert_ffn if T <= 32 else dropless_expert_ffn
+    y, _ = ffn(xt, gates, mo["wge"], mo["wup"], mo["wdn"],
+               top_k=st["top_k"], renormalize=st["renorm"],
+               activation="swiglu")
     y = y.reshape(B, S, H).astype(h2.dtype)
     if "shared" in mo:
         sh = mo["shared"]
@@ -370,15 +386,6 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
         var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
         return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * w
 
-    def mm(h, L, key):
-        # weight-only int8: dequant in VMEM right before the matmul — the
-        # HBM read is int8 (half the bf16 bytes that bound decode)
-        if key + "_q" in L:
-            w8 = L[key + "_q"]
-            return h @ (w8.astype(h.dtype)
-                        * L[key + "_s"].astype(h.dtype)[None, :])
-        return h @ L[key]
-
     def step(w, ids, caches, start):
         B, S = ids.shape
         x = w["embed"][ids]
@@ -392,7 +399,8 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
         sts = moe_static or (None,) * len(w["layers"])
         for L, (ck, cv), st in zip(w["layers"], caches, sts):
             h = rms(x, L["ln1"])
-            q, k, v = mm(h, L, "wq"), mm(h, L, "wk"), mm(h, L, "wv")
+            q, k, v = (_mm_w(h, L, "wq"), _mm_w(h, L, "wk"),
+                       _mm_w(h, L, "wv"))
             if "bq" in L:                      # Qwen2 qkv biases
                 q, k, v = q + L["bq"], k + L["bk"], v + L["bv"]
             q = q.reshape(B, S, Hh, D)
@@ -411,17 +419,13 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
                                -1e30)
             aw = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
             o = jnp.einsum("bhst,bthd->bshd", aw, vv).reshape(B, S, Hh * D)
-            x = x + mm(o, L, "wo")
+            x = x + _mm_w(o, L, "wo")
             h2 = rms(x, L["ln2"])
-            if "moe" in L or "wg" in L:
-                x = x + _ffn_apply(L, h2, st)
-            else:   # weight-only int8 dense FFN
-                x = x + mm(jax.nn.silu(mm(h2, L, "wg"))
-                           * mm(h2, L, "wu"), L, "wd")
+            x = x + _ffn_apply(L, h2, st)
         x = rms(x, w["norm"])
         last = x[:, -1]
         if "head_q" in w:
-            logits = mm(last, w, "head")
+            logits = _mm_w(last, w, "head")
         else:
             logits = last @ (w["head"] if w["head"] is not None
                              else w["embed"].T)

@@ -135,6 +135,34 @@ def global_gather(expert_out, combine, expert_axis: Optional[str] = None):
 # layers
 # ---------------------------------------------------------------------------
 
+def dense_expert_ffn(xt, gates, wg, wu, wd, *, top_k: int,
+                     renormalize: bool, activation: str = "swiglu"):
+    """Decode-sized routed FFN: run EVERY expert on every token and
+    weighted-select. At serving token counts (T <= ~32) this beats the
+    sort+grouped-GEMM path, whose per-expert tiles pad to 128 rows — and
+    it is bitwise-identical to it (same per-row matmuls, same combine),
+    so the cached-decode exact-match contract is preserved."""
+    topv, topi = jax.lax.top_k(gates, top_k)
+    gv = topv
+    if renormalize:
+        gv = gv / jnp.maximum(jnp.sum(gv, -1, keepdims=True), 1e-9)
+    up = jnp.einsum("th,ehi->eti", xt, wu)
+    if activation == "swiglu":
+        g = jnp.einsum("th,ehi->eti", xt, wg)
+        act = jax.nn.silu(g) * up
+    else:
+        act = jax.nn.gelu(up)
+    down = jnp.einsum("eti,eih->eth", act, wd)          # [E, T, H]
+    # combine EXACTLY like the grouped path: gather the k selected expert
+    # outputs per token and reduce over k in rank order (a different
+    # summation order would argmax-flip near-tied logits vs the
+    # buffer/grouped path and break the exact-match contract)
+    T = xt.shape[0]
+    sel = down[topi, jnp.arange(T)[:, None]]            # [T, k, H]
+    y = jnp.einsum("tk,tkh->th", gv.astype(sel.dtype), sel)
+    return y, topi
+
+
 def dropless_expert_ffn(xt, gates, wg, wu, wd, *, top_k: int,
                         renormalize: bool, activation: str = "swiglu"):
     """Per-token top-k routed expert FFN, dropless (megablocks pattern:

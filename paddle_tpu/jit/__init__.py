@@ -10,10 +10,15 @@ the AST rewriter and the CPython frame-eval (SOT) machinery. `to_static(fn)`:
    key, the analog of SOT's guard system,
 3. returns compiled XLA executables with donated buffers on later calls.
 
-Graph breaks: code that genuinely can't trace (data-dependent python control
-flow, dynamic-shape ops) raises a clear error naming the eager fallback
-(call the fn un-decorated) — the honest TPU equivalent of SOT's silent
-subgraph fallback, which would hide 10-100x performance cliffs here.
+Control flow: the dy2static AST pass (jit/dy2static.py) rewrites python
+``if``/``while``/``for range()`` into runtime dispatchers that execute
+plain python under concrete predicates and lower through
+``static.nn.cond``/``while_loop`` (lax control flow) under traced ones —
+the reference's IfElse/Loop transformer, TPU-sized. What genuinely can't
+capture (dynamic-shape ops, break/return inside a traced branch) raises a
+clear error naming the eager fallback — the honest TPU equivalent of
+SOT's silent subgraph fallback, which would hide 10-100x performance
+cliffs here.
 """
 
 from __future__ import annotations

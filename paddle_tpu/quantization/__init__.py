@@ -317,6 +317,15 @@ class PTQ:
     def quantize(self, model, inplace: bool = False):
         self._hooks = []
         obs_cls = self.config.activation or AbsmaxObserver
+        probe = obs_cls()   # class OR zero-arg factory (functools.partial
+                            # for configured observers, e.g.
+                            # partial(KLObserver, bins=512))
+        if not hasattr(probe, "observe"):
+            raise TypeError(
+                f"PTQ needs an OBSERVER (has .observe/.scale) for "
+                f"QuantConfig.activation, got {type(probe).__name__}; "
+                "fake-quanters (FakeQuanterWithAbsMax etc.) are QAT "
+                "layers — use them with QAT, not PTQ")
         for name, sub in model.named_sublayers():
             if isinstance(sub, nn.Linear):
                 obs = obs_cls()

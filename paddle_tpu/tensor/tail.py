@@ -416,6 +416,35 @@ gammaln_ = _inplace_of(gammaln)
 gammainc_ = _inplace_of(gammainc)
 gammaincc_ = _inplace_of(gammaincc)
 multigammaln_ = _inplace_of(multigammaln)
+atan2_ = _inplace_of(_math.atan2)
+deg2rad_ = _inplace_of(_math.deg2rad)
+rad2deg_ = _inplace_of(_math.rad2deg)
+nextafter_ = _inplace_of(_math.nextafter)
+sign_ = _inplace_of(_math.sign)
+stanh_ = _inplace_of(_math.stanh)
+bitwise_left_shift_ = _inplace_of(bitwise_left_shift)
+bitwise_right_shift_ = _inplace_of(bitwise_right_shift)
+
+
+def index_copy(x, index, axis, value, name=None):
+    """ref: paddle.index_copy — rows of ``value`` written into ``x`` at
+    ``index`` along ``axis`` (the scatter twin of index_select)."""
+    idx = _arr(index).astype(jnp.int32)
+    ax = int(axis)
+
+    def impl(a, v):
+        mov = jnp.moveaxis(a, ax, 0)
+        vv = jnp.moveaxis(v, ax, 0)
+        out = mov.at[idx].set(vv)
+        return jnp.moveaxis(out, 0, ax)
+    return apply("index_copy", impl, [x, value])
+
+
+def index_copy_(x, index, axis, value, name=None):
+    _guard_inplace(x, "index_copy_")
+    x._data = index_copy(x, index, axis, value)._data
+    return x
+
 
 __all__ += [
     "abs_", "acos_", "asin_", "atan_", "atanh_", "acosh_", "asinh_",
@@ -425,4 +454,7 @@ __all__ += [
     "hypot_", "ldexp_", "gcd_", "lcm_", "cumsum_", "cumprod_", "renorm_",
     "index_add_", "put_along_axis_", "masked_scatter_", "copysign_",
     "gammaln_", "gammainc_", "gammaincc_", "multigammaln_",
+    "atan2_", "deg2rad_", "rad2deg_", "nextafter_", "sign_", "stanh_",
+    "bitwise_left_shift_", "bitwise_right_shift_",
+    "index_copy", "index_copy_",
 ]

@@ -412,3 +412,68 @@ class TestMiscFramework:
         n = int(r.stdout.split("wrote docs/API_CHECKLIST.md: ")[1]
                 .split(" parity")[0])
         assert n >= 500, f"flat parity surface regressed to {n}"
+
+
+class TestRound4Stragglers:
+    """Round-4 additions: the last missing inplace variants + index_copy."""
+
+    def test_new_inplace_variants(self):
+        import numpy as np
+        y = paddle.to_tensor(np.array([2.0, -3.0], np.float32))
+        paddle.sign_(y)
+        np.testing.assert_allclose(y.numpy(), [1.0, -1.0])
+        z = paddle.to_tensor(np.array([180.0], np.float32))
+        paddle.deg2rad_(z)
+        np.testing.assert_allclose(z.numpy(), [np.pi], rtol=1e-6)
+        w = paddle.to_tensor(np.array([np.pi], np.float32))
+        paddle.rad2deg_(w)
+        np.testing.assert_allclose(w.numpy(), [180.0], rtol=1e-6)
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        paddle.atan2_(a, paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+        np.testing.assert_allclose(a.numpy(), [np.arctan2(1, 1),
+                                               np.arctan2(2, 1)], rtol=1e-6)
+        s = paddle.to_tensor(np.array([0.5], np.float32))
+        paddle.stanh_(s)
+        b = paddle.to_tensor(np.array([1, 2], np.int32))
+        paddle.bitwise_left_shift_(b, paddle.to_tensor(
+            np.array([1, 2], np.int32)))
+        np.testing.assert_array_equal(b.numpy(), [2, 8])
+        c = paddle.to_tensor(np.array([8, 8], np.int32))
+        paddle.bitwise_right_shift_(c, paddle.to_tensor(
+            np.array([1, 2], np.int32)))
+        np.testing.assert_array_equal(c.numpy(), [4, 2])
+        n = paddle.to_tensor(np.array([1.0], np.float32))
+        paddle.nextafter_(n, paddle.to_tensor(np.array([2.0], np.float32)))
+        assert float(n.numpy()[0]) > 1.0
+
+    def test_index_copy(self):
+        import numpy as np
+        x = paddle.to_tensor(np.zeros((4, 3), np.float32))
+        v = paddle.to_tensor(np.full((2, 3), 5.0, np.float32))
+        out = paddle.index_copy(x, paddle.to_tensor([0, 2]), 0, v)
+        expect = np.zeros((4, 3), np.float32)
+        expect[[0, 2]] = 5.0
+        np.testing.assert_array_equal(out.numpy(), expect)
+        # axis=1
+        x2 = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        v2 = paddle.to_tensor(np.full((2, 1), 7.0, np.float32))
+        out2 = paddle.index_copy(x2, paddle.to_tensor([3]), 1, v2)
+        assert out2.numpy()[0, 3] == 7.0 and out2.numpy()[0, 0] == 0.0
+        # inplace twin
+        paddle.index_copy_(x, paddle.to_tensor([1]), 0,
+                           paddle.to_tensor(np.full((1, 3), 9.0,
+                                                    np.float32)))
+        assert x.numpy()[1, 0] == 9.0
+
+    def test_index_copy_gradients(self):
+        import numpy as np
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        x.stop_gradient = False
+        v = paddle.to_tensor(np.full((1, 2), 4.0, np.float32))
+        v.stop_gradient = False
+        out = paddle.index_copy(x, paddle.to_tensor([1]), 0, v)
+        out.sum().backward()
+        # overwritten row contributes no grad to x; v gets full grad
+        np.testing.assert_array_equal(x.grad.numpy(),
+                                      [[1, 1], [0, 0], [1, 1]])
+        np.testing.assert_array_equal(v.grad.numpy(), [[1, 1]])

@@ -79,6 +79,33 @@ class TestMoEServing:
         assert (gen.numpy()[0, 1:] == 0).all()
 
 
+class TestDenseVsDroplessFFN:
+    """The decode-sized dense-all-expert path must match the grouped
+    dropless path exactly (the T<=32 switch in generation._ffn_apply
+    relies on it), including at the threshold boundary."""
+
+    @pytest.mark.parametrize("T", [1, 8, 32, 33, 64])
+    def test_equality_across_threshold(self, T):
+        from paddle_tpu.incubate.moe import (dense_expert_ffn,
+                                             dropless_expert_ffn)
+        import jax
+        rng = np.random.RandomState(T)
+        H, I, E, k = 64, 32, 4, 2
+        xt = jnp.asarray(rng.randn(T, H), jnp.float32)
+        gates = jax.nn.softmax(
+            jnp.asarray(rng.randn(T, E), jnp.float32), -1)
+        wg = jnp.asarray(rng.randn(E, H, I) * 0.1, jnp.float32)
+        wu = jnp.asarray(rng.randn(E, H, I) * 0.1, jnp.float32)
+        wd = jnp.asarray(rng.randn(E, I, H) * 0.1, jnp.float32)
+        yd, td = dense_expert_ffn(xt, gates, wg, wu, wd, top_k=k,
+                                  renormalize=True)
+        yg, tg = dropless_expert_ffn(xt, gates, wg, wu, wd, top_k=k,
+                                     renormalize=True)
+        np.testing.assert_array_equal(np.asarray(td), np.asarray(tg))
+        np.testing.assert_allclose(np.asarray(yd), np.asarray(yg),
+                                   rtol=1e-6, atol=1e-6)
+
+
 class TestCapacityModeWarning:
     def test_capacity_model_decode_warns(self):
         paddle.seed(23)
