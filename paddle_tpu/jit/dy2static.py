@@ -21,11 +21,24 @@ type inference is needed because the decision is made on the live value
 (the same trick as convert_ifelse's ``paddle.jit.dy2static.convert_*``
 wrappers, which also dispatch on Variable-ness at run time).
 
+``break``/``continue`` in WHILE bodies are captured via the reference's
+flag rewrite (BreakContinueTransformer): the statement becomes a flag
+assignment, skipped statements are guarded by ``loop_guard``, and the
+loop test gains ``not brk`` — all through the same recursive pass, so a
+break under a tensor-if lowers to lax correctly. A predicate that BECOMES
+traced mid-loop (a break flag turned cond output) hands the remaining
+iterations to the lax lowering.
+
 Scope (documented limitations, each falls back to the untransformed
 statement, which still works for concrete predicates):
-* ``return`` / ``break`` / ``continue`` inside a tensor-dependent branch
-  or loop body are not captured (the reference rewrites these with flag
-  variables; here the statement is left as plain Python),
+* ``return`` inside a tensor-dependent branch or loop body is not
+  captured; ``break``/``continue`` in FOR bodies, or nested inside
+  ``try``/``match`` blocks, are not captured (while bodies are — see
+  above),
+* a loop temp FIRST assigned after a continue-guard needs a pre-loop
+  initial value under trace (clear NameError says so); initialized
+  temps are promoted into the lax carry at runtime, so post-loop reads
+  see the last-iteration value exactly like python,
 * in-place Tensor mutation of closure variables inside a traced branch is
   dropped (branch outputs must flow through the returned loop/branch vars),
 * loops with a traced predicate are forward-only unless
@@ -91,6 +104,55 @@ class Undefined:
 
     def __repr__(self):
         return f"<undefined '{self.name}'>"
+
+
+def loop_not(v):
+    """Boolean NOT that works for python values AND traced Tensors (the
+    break-flag guard in converted loops; `not tensor` would trace-fail)."""
+    if isinstance(v, Tensor):
+        from ..tensor.logic import logical_not
+        return logical_not(v)
+    return not v
+
+
+def loop_and(a, b):
+    """Non-short-circuit AND over python values / traced Tensors (the
+    rewritten loop test `not brk and test`)."""
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        from ..tensor.logic import logical_and
+        return logical_and(a, b)
+    return a and b
+
+
+def loop_test(brk, test_thunk):
+    """The rewritten while test: short-circuits on a CONCRETE break flag —
+    python never re-evaluates the test after ``break``, and the test may
+    only be safe while the loop is live (e.g. an index bound the break
+    protects). A traced flag can't short-circuit (lax evaluates the cond
+    region with the final carry once more); that requires the test itself
+    to be trace-safe, which the traced regime requires anyway."""
+    if isinstance(brk, Tensor):
+        if _is_traced(brk):
+            return loop_and(loop_not(brk), test_thunk())
+        if bool(brk._data):
+            return False
+        return test_thunk()
+    if brk:
+        return False
+    return test_thunk()
+
+
+def loop_guard(*flags):
+    """True when NO break/continue flag is set — the guard condition for
+    statements a python break/continue would have skipped."""
+    acc = flags[0]
+    for f in flags[1:]:
+        if isinstance(acc, Tensor) or isinstance(f, Tensor):
+            from ..tensor.logic import logical_or
+            acc = logical_or(acc, f)
+        else:
+            acc = acc or f
+    return loop_not(acc)
 
 
 def is_undef(v) -> bool:
@@ -169,18 +231,51 @@ def run_while(cond_fn: Callable, body_fn: Callable, cur: tuple,
     first = cond_fn(*cur)
     if _is_traced(first):
         from ..static import control_flow as cf
-        carried, temps = cur[:n_carried], cur[n_carried:]
+        carried, temps = list(cur[:n_carried]), list(cur[n_carried:])
         _check_defined(carried, "while loop")
+        # RUNTIME temp promotion: a temp that HAS a pre-loop value rides
+        # the lax carry, so its post-loop value is the last-iteration one
+        # (python semantics for `acc = acc + tmp` after the loop); only
+        # genuinely uninitialized temps stay closure-side and scrub to
+        # Undefined after the loop
+        promote = [i for i, v in enumerate(temps)
+                   if not isinstance(v, Undefined)]
+        keep = [i for i in range(len(temps)) if i not in promote]
+
+        def remap(args2):
+            c = args2[:n_carried]
+            pr = args2[n_carried:]
+            t = [None] * len(temps)
+            for j, i in enumerate(promote):
+                t[i] = pr[j]
+            for i in keep:
+                t[i] = temps[i]
+            return tuple(c) + tuple(t)
+
+        sel = list(range(n_carried)) + [n_carried + i for i in promote]
         mx = flag("FLAGS_dy2static_max_iter") or None
         out = cf.while_loop(
-            lambda *c: cond_fn(*c, *temps),
-            lambda *c: tuple(body_fn(*c, *temps))[:n_carried],
-            list(carried), max_iter=mx)
-        tail = tuple(Undefined(names[n_carried + j] if names else "<temp>")
-                     for j in range(len(temps)))
-        return tuple(out) + tail
+            lambda *a: cond_fn(*remap(a)),
+            lambda *a: tuple(tuple(body_fn(*remap(a)))[k] for k in sel),
+            carried + [temps[i] for i in promote], max_iter=mx)
+        out = tuple(out)
+        full_t = [None] * len(temps)
+        for j, i in enumerate(promote):
+            full_t[i] = out[n_carried + j]
+        for i in keep:
+            full_t[i] = Undefined(names[n_carried + i] if names
+                                  else "<temp>")
+        return out[:n_carried] + tuple(full_t)
     vals = cur
-    while _truthy(first):
+    while True:
+        if _is_traced(first):
+            # the predicate BECAME traced mid-loop (e.g. a break flag
+            # assigned under a tensor-if turned into a cond output):
+            # the concrete iterations already ran as the prefix — hand
+            # the current state to the lax lowering for the rest
+            return run_while(cond_fn, body_fn, vals, names, n_carried)
+        if not _truthy(first):
+            break
         vals = tuple(body_fn(*vals))
         first = cond_fn(*vals)
     return vals
@@ -404,6 +499,11 @@ class _Disallowed(ast.NodeVisitor):
 
     visit_YieldFrom = visit_Await = visit_Yield
 
+    def visit_If(self, node):
+        if getattr(node, "_pt_scrub", False):
+            return                    # generated Undefined-scrub guard
+        self.generic_visit(node)
+
     def visit_Delete(self, node):
         self.bad = True
 
@@ -472,8 +572,14 @@ def _ld_tuple(names):
 
 
 def _fn_def(name, argnames, body):
+    # ld-wrapped returns: a generated scrub guard may have del'ed a temp
+    # inside this body — the return must yield the Undefined sentinel for
+    # it, not raise UnboundLocalError from synthesized code
     ret = ast.Return(value=ast.Tuple(
-        elts=[_n(a) for a in argnames], ctx=ast.Load()))
+        elts=[ast.Call(func=_jst_attr("ld"),
+                       args=[_lambda0(_n(a)), ast.Constant(a)],
+                       keywords=[])
+              for a in argnames], ctx=ast.Load()))
     return ast.FunctionDef(
         name=name,
         args=ast.arguments(
@@ -498,12 +604,152 @@ def _scrub_guards(names):
     documented 'reads raise' contract."""
     out = []
     for w in names:
-        out.append(ast.If(
+        guard = ast.If(
             test=ast.Call(func=_jst_attr("is_undef"), args=[_n(w)],
                           keywords=[]),
             body=[ast.Delete(targets=[ast.Name(id=w, ctx=ast.Del())])],
-            orelse=[]))
+            orelse=[])
+        # generated construct: its `del` must not disqualify an ENCLOSING
+        # loop/branch from conversion (_Disallowed skips marked nodes)
+        guard._pt_scrub = True
+        out.append(guard)
     return out
+
+
+def _stmt_may_flag(s) -> bool:
+    """Does this statement contain a loop-LEVEL break/continue (not one
+    belonging to a nested loop / function)?"""
+    d = _Disallowed(is_loop_body=True)
+    d.visit(s)
+    return d.bad
+
+
+def _rewrite_break_continue(node: ast.While, uid: int):
+    """The reference BreakContinueTransformer (dy2static/break_continue_
+    transformer.py), TPU-sized: loop-level ``break``/``continue`` become
+    flag assignments; the statements python would have skipped are wrapped
+    in ``if __pt_jst__.loop_guard(flags):`` (which the recursive pass then
+    lowers like any other if); the loop test becomes
+    ``loop_and(loop_not(brk), test)``. Returns (pre_stmts, node, used) —
+    used is False when the body has no loop-level break/continue."""
+    # NOTE: these are USER-scope variables (threaded through the loop as
+    # carried state), so they must not carry the _pt_ prefix that the
+    # written-name analysis filters out
+    brk = f"_loopbrk_{uid}"
+    cont = f"_loopcont_{uid}"
+    used = {"b": False, "c": False}
+
+    class R(ast.NodeTransformer):
+        def __init__(self):
+            self._loop_depth = 0
+
+        def visit_Break(self, n):
+            if self._loop_depth == 0:
+                used["b"] = True
+                return ast.Assign(targets=[_ns(brk)],
+                                  value=ast.Constant(True))
+            return n
+
+        def visit_Continue(self, n):
+            if self._loop_depth == 0:
+                used["c"] = True
+                return ast.Assign(targets=[_ns(cont)],
+                                  value=ast.Constant(True))
+            return n
+
+        def visit_While(self, n):
+            self._loop_depth += 1
+            self.generic_visit(n)
+            self._loop_depth -= 1
+            return n
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            return n                    # nested scopes own their breaks
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    def guard_block(stmts):
+        """Rewrite one statement list: after any statement that may set a
+        flag, the remaining statements run only under the guard."""
+        out = []
+        for i, s in enumerate(stmts):
+            may = _stmt_may_flag(s)
+            if isinstance(s, ast.If):
+                s = ast.If(test=s.test, body=guard_block(s.body),
+                           orelse=guard_block(s.orelse))
+            elif isinstance(s, ast.With):
+                s = ast.With(items=s.items, body=guard_block(s.body))
+            s = R().visit(s)
+            out.append(s)
+            rest = stmts[i + 1:]
+            if may and rest:
+                guard = ast.Call(func=_jst_attr("loop_guard"),
+                                 args=[_n(brk), _n(cont)], keywords=[])
+                out.append(ast.If(test=guard, body=guard_block(rest),
+                                  orelse=[]))
+                return out
+        return out
+
+    # a loop-level break/continue inside a construct guard_block can't
+    # guard (Try/Match) would leave its trailing statements unguarded —
+    # silently wrong on BOTH paths; bail and leave the loop untransformed
+    class _InUnsupported(ast.NodeVisitor):
+        def __init__(self):
+            self.bad = False
+            self._loop = 0
+            self._try = 0
+
+        def visit_Break(self, n):
+            if self._loop == 0 and self._try > 0:
+                self.bad = True
+
+        visit_Continue = visit_Break
+
+        def visit_Try(self, n):
+            self._try += 1
+            self.generic_visit(n)
+            self._try -= 1
+
+        def visit_While(self, n):
+            self._loop += 1
+            self.generic_visit(n)
+            self._loop -= 1
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+        if hasattr(ast, "Match"):
+            visit_Match = visit_Try
+
+    chk = _InUnsupported()
+    for s in node.body:
+        chk.visit(s)
+    if chk.bad:
+        return [], node, False
+
+    new_body = guard_block(list(node.body))
+    if not (used["b"] or used["c"]):
+        return [], node, False
+    # reset the continue flag at the top of every iteration
+    new_body = [ast.Assign(targets=[_ns(cont)],
+                           value=ast.Constant(False))] + new_body
+    # short-circuiting test (see loop_test): `not brk and <test>` with
+    # python semantics on concrete flags
+    new_test = ast.Call(
+        func=_jst_attr("loop_test"),
+        args=[_n(brk), _lambda0(node.test)],
+        keywords=[])
+    new_node = ast.While(test=new_test, body=new_body, orelse=[])
+    pre = [ast.Assign(targets=[_ns(brk)], value=ast.Constant(False)),
+           ast.Assign(targets=[_ns(cont)], value=ast.Constant(False))]
+    return pre, new_node, True
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -546,10 +792,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [tdef, fdef, _unpack(written, call)]
 
     def visit_While(self, node: ast.While):
+        pre = []
+        if not node.orelse and not _has_walrus(node.test):
+            # loop-level break/continue -> flag rewrite (reference
+            # BreakContinueTransformer) BEFORE the recursive pass, so the
+            # generated guard ifs get converted like any other
+            pre, node, _ = _rewrite_break_continue(node, self._uid())
         node = self.generic_visit(node)
         if (node.orelse or _has_walrus(node.test)
                 or not _branch_ok(node.body, is_loop_body=True)):
-            return node
+            return pre + [node] if pre else node
         written = _written_names(node.body)
         carried = sorted(_carried_names(node.test, node.body, written))
         temps = sorted(written - set(carried))
@@ -570,7 +822,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Constant(tuple(ordered)), ast.Constant(len(carried))],
             keywords=[])
         self.applied += 1
-        return [cdef, bdef, _unpack(ordered, call)] + _scrub_guards(temps)
+        return (pre + [cdef, bdef, _unpack(ordered, call)]
+                + _scrub_guards(temps))
 
     def visit_For(self, node: ast.For):
         node = self.generic_visit(node)

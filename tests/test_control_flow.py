@@ -565,3 +565,197 @@ class TestAssert:
         with pytest.raises(AssertionError):
             static.nn.Assert(paddle.to_tensor(False),
                              data=[paddle.to_tensor([1.0])])
+
+
+class TestBreakContinueCapture:
+    """Loop-level break/continue in while bodies: the reference
+    BreakContinueTransformer flag rewrite (round-4)."""
+
+    def test_break_under_tensor_if(self):
+        def f(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                while i < n:
+                    if s > 5.0:
+                        break
+                    s = s + 2.0
+                    i = i + 1
+            return s
+
+        sf = paddle.jit.to_static(f)
+        assert float(sf(paddle.to_tensor(10))) == 6.0 == \
+            float(f(paddle.to_tensor(10)))
+
+    def test_continue_skips_rest_of_iteration(self):
+        def g(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                while i < n:
+                    i = i + 1
+                    if paddle.equal(paddle.mod(i, paddle.to_tensor(2)),
+                                    paddle.to_tensor(0)):
+                        continue
+                    s = s + 1.0
+            return s
+
+        sg = paddle.jit.to_static(g)
+        assert float(sg(paddle.to_tensor(7))) == 4.0 == \
+            float(g(paddle.to_tensor(7)))
+
+    def test_mixed_break_continue(self):
+        def h(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                while i < n:
+                    i = i + 1
+                    if i > 5:
+                        break
+                    if paddle.equal(paddle.mod(i, paddle.to_tensor(2)),
+                                    paddle.to_tensor(1)):
+                        continue
+                    s = s + i.astype("float32")
+            return s, i
+
+        sh = paddle.jit.to_static(h)
+        se, ie = h(paddle.to_tensor(20))
+        st, it = sh(paddle.to_tensor(20))
+        assert float(st) == float(se) == 6.0
+        assert int(it) == int(ie) == 6
+
+    def test_predicate_becomes_traced_mid_loop(self):
+        # `while True` with a break whose flag turns into a cond output:
+        # the concrete prefix runs as python, the rest lowers to lax
+        def k(m):
+            with paddle.no_grad():
+                tot = paddle.to_tensor(0.0)
+                while True:
+                    tot = tot + 1.0
+                    if tot > m:
+                        break
+            return tot
+
+        ck = paddle.jit.to_static(k)
+        assert float(ck(paddle.to_tensor(3.0))) == 4.0
+
+    def test_break_in_nested_loop_stays_inner(self):
+        def f(n):
+            total = paddle.to_tensor(0.0)
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                while i < n:
+                    j = 0
+                    while j < 10:       # python inner loop
+                        j += 1
+                        if j >= 2:
+                            break       # belongs to the INNER loop
+                    total = total + float(j)
+                    i = i + 1
+            return total
+
+        sf = paddle.jit.to_static(f)
+        assert float(sf(paddle.to_tensor(3))) == 6.0 == \
+            float(f(paddle.to_tensor(3)))
+
+
+class TestBreakContinueReviewCases:
+    """Round-4 review repros: Try containment, short-circuit test,
+    nested-temp carry promotion, guard-temp error clarity."""
+
+    def test_break_inside_try_left_untransformed(self):
+        # guard_block can't guard Try internals: the rewrite bails and the
+        # concrete python path stays exactly correct
+        def t1(n=10):
+            i = 0
+            s = paddle.to_tensor(0.0)
+            while i < n:
+                try:
+                    if i > 2:
+                        break
+                    s = s + 1.0
+                except ValueError:
+                    pass
+                i = i + 1
+            return s, i
+
+        st = paddle.jit.to_static(t1)
+        se, ie = t1()
+        ste, sti = st()
+        assert float(ste) == float(se) == 3.0 and int(sti) == int(ie) == 3
+
+    def test_rewritten_test_short_circuits_after_break(self):
+        # python never re-evaluates the test after break; the test here is
+        # only safe while the break's index guard holds
+        def t2():
+            vals = [1.0, 2.0, 3.0]
+            i = 0
+            s = 0.0
+            while vals[i] < 10.0:
+                s += vals[i]
+                i += 1
+                if i >= len(vals):
+                    break
+            return paddle.to_tensor(s)
+
+        assert float(paddle.jit.to_static(t2)()) == 6.0
+
+    def test_initialized_inner_temp_promoted_to_carry(self):
+        # tmp is an inner-loop temp read AFTER the inner loop: because it
+        # has a pre-loop value it rides the lax carry and the post-loop
+        # read sees the last-iteration value, matching python exactly
+        def t3(n):
+            with paddle.no_grad():
+                tmp = paddle.to_tensor(0.0)
+                i = paddle.to_tensor(0)
+                acc = paddle.to_tensor(0.0)
+                while i < n:
+                    j = paddle.to_tensor(0)
+                    while j < 2:
+                        tmp = acc + 1.0
+                        j = j + 1
+                    acc = acc + tmp
+                    i = i + 1
+            return acc
+
+        st = paddle.jit.to_static(t3)
+        assert float(st(paddle.to_tensor(3))) == \
+            float(t3(paddle.to_tensor(3))) == 7.0
+
+    def test_uninitialized_guard_temp_errors_clearly(self):
+        def t4(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                while i < n:
+                    i = i + 1
+                    if paddle.equal(paddle.mod(i, paddle.to_tensor(2)),
+                                    paddle.to_tensor(0)):
+                        continue
+                    delta = i.astype("float32") * 2.0
+                    s = s + delta
+            return s
+
+        with pytest.raises(NameError, match="delta.*assigned before"):
+            paddle.jit.to_static(t4)(paddle.to_tensor(5))
+
+    def test_guard_temp_with_init_runs(self):
+        # the error's suggested fix works: initialize the temp pre-loop
+        def t5(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                delta = paddle.to_tensor(0.0)
+                while i < n:
+                    i = i + 1
+                    if paddle.equal(paddle.mod(i, paddle.to_tensor(2)),
+                                    paddle.to_tensor(0)):
+                        continue
+                    delta = i.astype("float32") * 2.0
+                    s = s + delta
+            return s
+
+        st = paddle.jit.to_static(t5)
+        assert float(st(paddle.to_tensor(5))) == \
+            float(t5(paddle.to_tensor(5))) == 18.0
