@@ -29,12 +29,21 @@ break under a tensor-if lowers to lax correctly. A predicate that BECOMES
 traced mid-loop (a break flag turned cond output) hands the remaining
 iterations to the lax lowering.
 
+Early returns are captured by the reference ReturnTransformer's
+normalization: ``if p: return a`` followed by REST folds into
+``if p: return a else: REST``, every Return becomes an assignment to a
+single return variable, and the tail-position fold carries ONLY that
+variable out of the branches — so tensor-predicated early returns and
+elif-return chains lower to lax.cond. Applies when every path explicitly
+returns and no Return hides in a loop/try.
+
 Scope (documented limitations, each falls back to the untransformed
 statement, which still works for concrete predicates):
-* ``return`` inside a tensor-dependent branch or loop body is not
-  captured; ``break``/``continue`` in FOR bodies, or nested inside
-  ``try``/``match`` blocks, are not captured (while bodies are — see
-  above),
+* ``return`` inside a LOOP body or try-block is not captured (branch
+  returns are — see above); functions with fall-off-the-end paths keep
+  their original form,
+* ``break``/``continue`` in FOR bodies, or nested inside
+  ``try``/``match`` blocks, are not captured (while bodies are),
 * a loop temp FIRST assigned after a continue-guard needs a pre-loop
   initial value under trace (clear NameError says so); initialized
   temps are promoted into the lax carry at runtime, so post-loop reads
@@ -233,13 +242,24 @@ def run_while(cond_fn: Callable, body_fn: Callable, cur: tuple,
         from ..static import control_flow as cf
         carried, temps = list(cur[:n_carried]), list(cur[n_carried:])
         _check_defined(carried, "while loop")
-        # RUNTIME temp promotion: a temp that HAS a pre-loop value rides
-        # the lax carry, so its post-loop value is the last-iteration one
-        # (python semantics for `acc = acc + tmp` after the loop); only
-        # genuinely uninitialized temps stay closure-side and scrub to
+        # RUNTIME temp promotion: a temp that HAS a jax-carryable pre-loop
+        # value rides the lax carry, so its post-loop value is the
+        # last-iteration one (python semantics for `acc = acc + tmp` after
+        # the loop); uninitialized or non-numeric temps (strings, lists —
+        # lax carries reject them) stay closure-side and scrub to
         # Undefined after the loop
-        promote = [i for i, v in enumerate(temps)
-                   if not isinstance(v, Undefined)]
+        def _carryable(v):
+            if isinstance(v, Undefined):
+                return False
+            if isinstance(v, (Tensor, bool, int, float, complex)):
+                return True
+            import numpy as _np
+            return (hasattr(v, "dtype") and hasattr(v, "shape")
+                    and _np.issubdtype(getattr(v, "dtype"), _np.number)
+                    or (hasattr(v, "dtype")
+                        and getattr(v, "dtype") == bool))
+
+        promote = [i for i, v in enumerate(temps) if _carryable(v)]
         keep = [i for i in range(len(temps)) if i not in promote]
 
         def remap(args2):
@@ -572,14 +592,25 @@ def _ld_tuple(names):
 
 
 def _fn_def(name, argnames, body):
-    # ld-wrapped returns: a generated scrub guard may have del'ed a temp
-    # inside this body — the return must yield the Undefined sentinel for
-    # it, not raise UnboundLocalError from synthesized code
+    # ld-wrap ONLY the names a generated scrub guard inside this body can
+    # del (their read would otherwise raise UnboundLocalError from
+    # synthesized code); plain names return bare — the concrete loop path
+    # runs this body every iteration and need not pay N lambdas
+    scrubbed = set()
+    for s in body:
+        for n in ast.walk(s):
+            if getattr(n, "_pt_scrub", False):
+                scrubbed.add(n.body[0].targets[0].id)
+
+    def _ret_elt(a):
+        if a in scrubbed:
+            return ast.Call(func=_jst_attr("ld"),
+                            args=[_lambda0(_n(a)), ast.Constant(a)],
+                            keywords=[])
+        return _n(a)
+
     ret = ast.Return(value=ast.Tuple(
-        elts=[ast.Call(func=_jst_attr("ld"),
-                       args=[_lambda0(_n(a)), ast.Constant(a)],
-                       keywords=[])
-              for a in argnames], ctx=ast.Load()))
+        elts=[_ret_elt(a) for a in argnames], ctx=ast.Load()))
     return ast.FunctionDef(
         name=name,
         args=ast.arguments(
@@ -779,6 +810,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return node
         written = sorted(_written_names(node.body) |
                          _written_names(node.orelse))
+        live_out = getattr(node, "_pt_live_out", None)
+        if live_out is not None:
+            written = sorted(set(written) & live_out)
         k = self._uid()
         tname, fname = f"_pt_true_{k}", f"_pt_false_{k}"
         tdef = _fn_def(tname, written, node.body)
@@ -861,6 +895,164 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 # convert()
 # ---------------------------------------------------------------------------
 
+def _always_returns(stmts) -> bool:
+    """Every path through this statement list ends in an explicit Return
+    (or raise)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise)):
+        return True
+    if isinstance(last, ast.If):
+        return (_always_returns(last.body) and last.orelse
+                and _always_returns(last.orelse))
+    return False
+
+
+def _return_in_unsupported(stmts) -> bool:
+    """Is any function-level Return nested in a loop/try (the v1
+    return-capture can't fold those)?"""
+    class V(ast.NodeVisitor):
+        bad = False
+
+        def __init__(self):
+            self._depth = 0
+
+        def visit_Return(self, n):
+            if self._depth > 0:
+                self.bad = True
+
+        def _enter(self, n):
+            self._depth += 1
+            self.generic_visit(n)
+            self._depth -= 1
+
+        visit_While = visit_For = visit_Try = _enter
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.bad
+
+
+def _returns_are_leaf_only(stmts, tail=True) -> bool:
+    """After folding, EVERY Return must sit in a terminal leaf position:
+    last statement of its block, with every enclosing construct an If that
+    is itself the last statement of its block, up to the function body. A
+    Return anywhere else (inside With/Try, or mid-body) would become an
+    assignment that silently falls through — refuse the rewrite."""
+    for i, s in enumerate(stmts):
+        last = i == len(stmts) - 1
+        if isinstance(s, ast.Return):
+            if not (tail and last):
+                return False
+        elif isinstance(s, ast.If):
+            if not _returns_are_leaf_only(s.body, tail and last):
+                return False
+            if not _returns_are_leaf_only(s.orelse, tail and last):
+                return False
+        else:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Return):
+                    return False
+    return True
+
+
+def _fold_early_returns(stmts):
+    """Normalize early returns (the reference ReturnTransformer's core
+    move): ``if p: return a`` followed by REST becomes ``if p: return a
+    else: REST`` — after which every Return sits on an else-paired leaf
+    and the ordinary if-capture handles a tensor-valued ``p``."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            body = _fold_early_returns(s.body)
+            orelse = _fold_early_returns(s.orelse)
+            rest = stmts[i + 1:]
+            if (_always_returns(body) and not orelse and rest):
+                folded = ast.If(test=s.test, body=body,
+                                orelse=_fold_early_returns(rest))
+                folded._pt_folded = True
+                return out + [folded]
+            if (orelse and _always_returns(orelse)
+                    and not _always_returns(body) and rest):
+                # mirrored: else-branch returns, fall-through continues
+                folded = ast.If(
+                    test=s.test,
+                    body=body + _fold_early_returns(rest),
+                    orelse=orelse)
+                folded._pt_folded = True
+                return out + [folded]
+            s = ast.If(test=s.test, body=body, orelse=orelse)
+        out.append(s)
+    return out
+
+
+class _ReturnToAssign(ast.NodeTransformer):
+    """Replace function-level Return nodes with ``_retv_N = value`` (the
+    epilogue returns it). Runs AFTER folding, so every Return is a leaf."""
+
+    def __init__(self, retv: str):
+        self.retv = retv
+
+    def visit_Return(self, node):
+        val = node.value if node.value is not None else ast.Constant(None)
+        return ast.Assign(targets=[_ns(self.retv)], value=val)
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_While(self, node):
+        return node        # bailed earlier if returns live in loops
+
+    visit_For = visit_While
+
+
+def _rewrite_returns(body, uid: int):
+    """Capture early returns: fold trailing code into else-branches so
+    every Return is an else-paired leaf, convert Returns to assignments of
+    ``_retv_N``, and append the single real return. Applies only when
+    every path explicitly returns and no Return hides in a loop/try —
+    otherwise the body is returned unchanged (concrete predicates keep
+    working via the plain python path)."""
+    n_returns = sum(isinstance(n, ast.Return)
+                    for s in body for n in ast.walk(s))
+    trailing_only = (n_returns == 1 and isinstance(body[-1], ast.Return))
+    if n_returns == 0 or trailing_only:
+        return body
+    if _return_in_unsupported(body):
+        return body
+    folded = _fold_early_returns(body)
+    if not _always_returns(folded):
+        return body            # fall-off-the-end path: leave untouched
+    if not _returns_are_leaf_only(folded):
+        # a Return the fold could not move to a terminal position (With/
+        # nested-in-non-returning-branch): converting it would silently
+        # fall through — leave the function untouched
+        return body
+    retv = f"_retval_{uid}"
+    tr = _ReturnToAssign(retv)
+    new = [tr.visit(s) for s in folded]
+    # a folded if sits in TAIL position: the only name live after it is
+    # the return variable — mark it so the if-capture does not thread the
+    # tail's branch-local temps as outputs (they'd need both-branch
+    # assignment for no reason)
+    for s in new:
+        for n in ast.walk(s):
+            if getattr(n, "_pt_folded", False):
+                n._pt_live_out = {retv}
+    return new + [ast.Return(value=_n(retv))]
+
+
 def _has_nonlocal_or_global(tree) -> bool:
     return any(isinstance(n, (ast.Nonlocal, ast.Global))
                for n in ast.walk(tree))
@@ -900,6 +1092,9 @@ def convert(fn: Callable) -> Callable:
 
     tr = _ControlFlowTransformer()
     fndef = tr.visit(fndef)
+    # early-return capture first: fold trailing code into else-branches so
+    # tensor-predicated `if p: return a` converts like any other if
+    fndef.body = _rewrite_returns(fndef.body, tr._uid())
     # visit_FunctionDef skips the top-level def itself; walk its body
     new_body = []
     for s in fndef.body:

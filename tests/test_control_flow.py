@@ -759,3 +759,112 @@ class TestBreakContinueReviewCases:
         st = paddle.jit.to_static(t5)
         assert float(st(paddle.to_tensor(5))) == \
             float(t5(paddle.to_tensor(5))) == 18.0
+
+
+class TestReturnCapture:
+    """Early-return capture (reference ReturnTransformer): folding
+    trailing code into else-branches so tensor-predicated returns lower
+    to lax.cond (round-4)."""
+
+    def test_early_return_tensor_pred(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x * -1.0
+
+        sf = paddle.jit.to_static(f)
+        assert float(sf(paddle.to_tensor([3.0])).sum()) == 6.0
+        assert float(sf(paddle.to_tensor([-3.0])).sum()) == 3.0
+
+    def test_elif_chain_all_return(self):
+        def g(x):
+            if x.sum() > 10.0:
+                return x * 100.0
+            elif x.sum() > 0:
+                return x * 10.0
+            else:
+                return x
+
+        sg = paddle.jit.to_static(g)
+        assert float(sg(paddle.to_tensor([20.0])).sum()) == 2000.0
+        assert float(sg(paddle.to_tensor([1.0])).sum()) == 10.0
+        assert float(sg(paddle.to_tensor([-1.0])).sum()) == -1.0
+
+    def test_tail_temps_stay_branch_local(self):
+        # z is only live inside the folded tail: it must NOT become a
+        # cond output needing both-branch assignment
+        def h(x):
+            y = x + 1.0
+            if y.sum() > 5.0:
+                return y * 2.0
+            z = y * 3.0
+            return z + 1.0
+
+        sh = paddle.jit.to_static(h)
+        for v in (10.0, 1.0):
+            assert float(sh(paddle.to_tensor([v])).sum()) == \
+                float(h(paddle.to_tensor([v])).sum())
+
+    def test_fall_off_end_untouched(self):
+        def k(x, flag=False):
+            if flag:
+                return x * 2.0
+
+        assert paddle.jit.to_static(k)(paddle.to_tensor([1.0])) is None
+
+    def test_return_in_loop_untouched(self):
+        # v1 scope: returns inside loops stay python (concrete path ok)
+        def m(n=4):
+            s = paddle.to_tensor(0.0)
+            for i in range(n):
+                s = s + 1.0
+                if i == 2:
+                    return s
+            return s
+
+        assert float(paddle.jit.to_static(m)()) == 3.0 == float(m())
+
+
+class TestReturnCaptureReviewCases:
+    """Round-4 review repros for the return capture + temp promotion."""
+
+    def test_return_inside_with_bails(self):
+        # the fold can't move a Return out of a With: the rewrite must
+        # bail entirely (silent fall-through would be wrong)
+        def g(x, flag=True):
+            with paddle.no_grad():
+                if flag:
+                    return x * 2.0
+            return x
+
+        c = dy2static.convert(g)
+        assert float(c(paddle.to_tensor([5.0])).sum()) == 10.0
+
+    def test_fold_inside_non_folding_parent_bails(self):
+        def f(x, big=False):
+            y = paddle.to_tensor(0.0)
+            if x is not None:
+                if big:
+                    return paddle.to_tensor(-1.0)
+                y = paddle.to_tensor(1.0)
+            z = y + 2.0
+            return z
+
+        c = dy2static.convert(f)
+        assert float(c(paddle.to_tensor([1.0])).sum()) == 3.0
+        assert float(c(paddle.to_tensor([1.0]), big=True).sum()) == -1.0
+
+    def test_string_temp_not_promoted_into_carry(self):
+        def h(n):
+            with paddle.no_grad():
+                msg = ""
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                while i < n:
+                    msg = "iter"
+                    s = s + 1.0
+                    i = i + 1
+            return s
+
+        sh = paddle.jit.to_static(h)
+        assert float(sh(paddle.to_tensor(4))) == 4.0
