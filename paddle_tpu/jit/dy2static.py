@@ -895,17 +895,23 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 # convert()
 # ---------------------------------------------------------------------------
 
-def _always_returns(stmts) -> bool:
+def _always_returns(stmts, allow_raise: bool = True) -> bool:
     """Every path through this statement list ends in an explicit Return
-    (or raise)."""
+    (or raise). ``with`` blocks are transparent for RETURN (no context
+    manager can suppress one) but NOT for raise — ``__exit__`` may
+    swallow the exception and fall through (contextlib.suppress)."""
     if not stmts:
         return False
     last = stmts[-1]
-    if isinstance(last, (ast.Return, ast.Raise)):
+    if isinstance(last, ast.Return):
         return True
+    if isinstance(last, ast.Raise):
+        return allow_raise
     if isinstance(last, ast.If):
-        return (_always_returns(last.body) and last.orelse
-                and _always_returns(last.orelse))
+        return (_always_returns(last.body, allow_raise) and last.orelse
+                and _always_returns(last.orelse, allow_raise))
+    if isinstance(last, ast.With):
+        return _always_returns(last.body, allow_raise=False)
     return False
 
 
@@ -957,6 +963,10 @@ def _returns_are_leaf_only(stmts, tail=True) -> bool:
                 return False
             if not _returns_are_leaf_only(s.orelse, tail and last):
                 return False
+        elif isinstance(s, ast.With):
+            # transparent for control flow; terminal only in tail position
+            if not _returns_are_leaf_only(s.body, tail and last):
+                return False
         else:
             for n in ast.walk(s):
                 if isinstance(n, ast.Return):
@@ -990,6 +1000,9 @@ def _fold_early_returns(stmts):
                 folded._pt_folded = True
                 return out + [folded]
             s = ast.If(test=s.test, body=body, orelse=orelse)
+        elif isinstance(s, ast.With):
+            s = ast.With(items=s.items,
+                         body=_fold_early_returns(s.body))
         out.append(s)
     return out
 

@@ -868,3 +868,23 @@ class TestReturnCaptureReviewCases:
 
         sh = paddle.jit.to_static(h)
         assert float(sh(paddle.to_tensor(4))) == 4.0
+
+
+class TestSuppressedRaiseUnderWith:
+    def test_raise_in_suppress_with_not_counted_terminal(self):
+        # contextlib.suppress can swallow the raise and fall through: the
+        # fold must NOT treat the With body's Raise as terminal
+        import contextlib
+
+        def f(x, p=True, q=True):
+            if p:
+                with contextlib.suppress(ValueError):
+                    raise ValueError()
+            if q:
+                return x
+            return x + 1.0
+
+        c = dy2static.convert(f)
+        # original: raise suppressed, falls through, returns x
+        assert float(c(paddle.to_tensor([10.0])).sum()) == 10.0
+        assert float(c(paddle.to_tensor([10.0]), q=False).sum()) == 11.0
