@@ -42,8 +42,10 @@ statement, which still works for concrete predicates):
 * ``return`` inside a LOOP body or try-block is not captured (branch
   returns are — see above); functions with fall-off-the-end paths keep
   their original form,
-* ``break``/``continue`` in FOR bodies, or nested inside
-  ``try``/``match`` blocks, are not captured (while bodies are),
+* ``break``/``continue`` nested inside ``try``/``match`` blocks are not
+  captured (while and for-range bodies are — for-range desugars to the
+  canonical while, counter advanced before the body so continue keeps
+  python semantics),
 * a loop temp FIRST assigned after a continue-guard needs a pre-loop
   initial value under trace (clear NameError says so); initialized
   temps are promoted into the lax carry at runtime, so post-loop reads
@@ -162,6 +164,28 @@ def loop_guard(*flags):
         else:
             acc = acc or f
     return loop_not(acc)
+
+
+def range_cond(i, stop, step):
+    """The while-test of a desugared for-range: direction follows the
+    (static) step sign; works for python and traced values. Keeps
+    range()'s own argument validation (zero step, non-integer bounds)."""
+    import numpy as _np
+    if isinstance(step, Tensor):
+        raise ValueError(
+            "dy2static for-range: step must be a python int when the "
+            "bounds are tensors (XLA needs the loop direction statically)")
+    if not isinstance(step, (int, _np.integer)):
+        raise TypeError(f"'{type(step).__name__}' object cannot be "
+                        "interpreted as an integer")
+    if step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    for v in (i, stop):
+        if not isinstance(v, Tensor) and not isinstance(
+                v, (int, _np.integer)):
+            raise TypeError(f"'{type(v).__name__}' object cannot be "
+                            "interpreted as an integer")
+    return (i < stop) if step > 0 else (i > stop)
 
 
 def is_undef(v) -> bool:
@@ -859,7 +883,60 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return (pre + [cdef, bdef, _unpack(ordered, call)]
                 + _scrub_guards(temps))
 
+    def _desugar_for_range_with_break(self, node: ast.For):
+        """for <name> in range(...) whose body has loop-level break/
+        continue: desugar to the canonical while so the flag rewrite and
+        all while machinery apply. Concrete-path python semantics are
+        exact (target rebound from the counter each iteration, unbound on
+        zero-trip). Under a TRACED predicate the usual promotion rule
+        applies: a post-loop read of the target needs a pre-loop initial
+        value (clear NameError says so), like any other loop temp."""
+        k = self._uid()
+        cnt, stop, step = f"_fori_{k}", f"_fstop_{k}", f"_fstep_{k}"
+        args = list(node.iter.args)
+        if len(args) == 1:
+            start_e, stop_e, step_e = ast.Constant(0), args[0], \
+                ast.Constant(1)
+        elif len(args) == 2:
+            start_e, stop_e = args
+            step_e = ast.Constant(1)
+        else:
+            start_e, stop_e, step_e = args[:3]
+        pre = [ast.Assign(targets=[_ns(cnt)], value=start_e),
+               ast.Assign(targets=[_ns(stop)], value=stop_e),
+               ast.Assign(targets=[_ns(step)], value=step_e)]
+        test = ast.Call(func=_jst_attr("range_cond"),
+                        args=[_n(cnt), _n(stop), _n(step)], keywords=[])
+        # increment BEFORE the body: a converted `continue` must still
+        # advance the counter (python's for advances the iterator first)
+        body = ([ast.Assign(targets=[ast.Name(id=node.target.id,
+                                              ctx=ast.Store())],
+                            value=_n(cnt)),
+                 ast.Assign(targets=[_ns(cnt)],
+                            value=ast.BinOp(left=_n(cnt), op=ast.Add(),
+                                            right=_n(step)))]
+                + list(node.body))
+        return pre + [ast.While(test=test, body=body, orelse=[])]
+
     def visit_For(self, node: ast.For):
+        if (not node.orelse and not _has_walrus(node.iter)
+                and isinstance(node.target, ast.Name)
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and len(node.iter.args) in (1, 2, 3)
+                and not any(isinstance(a, ast.Starred)
+                            for a in node.iter.args)
+                and any(_stmt_may_flag(s) for s in node.body)
+                and not _return_in_unsupported([node])):
+            # loop-level break/continue -> desugar to while and recurse
+            stmts = self._desugar_for_range_with_break(node)
+            out = []
+            for s in stmts:
+                r = self.visit(s)
+                out.extend(r if isinstance(r, list) else [r])
+            return out
         node = self.generic_visit(node)
         if (node.orelse or _has_walrus(node.iter)
                 or not isinstance(node.target, ast.Name)

@@ -888,3 +888,135 @@ class TestSuppressedRaiseUnderWith:
         # original: raise suppressed, falls through, returns x
         assert float(c(paddle.to_tensor([10.0])).sum()) == 10.0
         assert float(c(paddle.to_tensor([10.0]), q=False).sum()) == 11.0
+
+
+class TestForRangeBreakContinue:
+    """for-range bodies with break/continue: desugared to the canonical
+    while so the flag rewrite + lax lowering apply (round-4)."""
+
+    def test_for_break_tensor_pred(self):
+        def f(n):
+            with paddle.no_grad():
+                s = paddle.to_tensor(0.0)
+                for i in range(n):
+                    if s > 4.0:
+                        break
+                    s = s + 2.0
+            return s
+
+        sf = paddle.jit.to_static(f)
+        assert float(sf(paddle.to_tensor(10))) == 6.0
+        # concrete path identical
+        assert float(f(10)) == 6.0
+        assert float(sf(paddle.to_tensor(1))) == 2.0
+
+    def test_for_continue_advances_counter(self):
+        def g(n):
+            with paddle.no_grad():
+                s = paddle.to_tensor(0.0)
+                for i in range(n):
+                    if paddle.equal(paddle.mod(paddle.to_tensor(i)
+                                               if isinstance(i, int)
+                                               else i,
+                                               paddle.to_tensor(2)),
+                                    paddle.to_tensor(0)):
+                        continue
+                    s = s + 1.0
+            return s
+
+        sg = paddle.jit.to_static(g)
+        # odd i in [0, 7): 1,3,5 -> 3
+        assert float(sg(paddle.to_tensor(7))) == 3.0 == float(g(7))
+
+    def test_for_break_concrete_bound(self):
+        def h():
+            s = paddle.to_tensor(0.0)
+            for i in range(100):
+                s = s + 1.0
+                if i >= 4:
+                    break
+            return s
+
+        assert float(paddle.jit.to_static(h)()) == 5.0 == float(h())
+
+    def test_target_last_value_after_break(self):
+        def k(n=10):
+            last = -1
+            for i in range(n):
+                last = i
+                if i >= 3:
+                    break
+            return paddle.to_tensor(float(last))
+
+        assert float(paddle.jit.to_static(k)()) == 3.0 == float(k())
+
+
+class TestForRangeDesugarEdgeCases:
+    """Round-4 review: desugar gate robustness."""
+
+    def test_starred_range_args_left_alone(self):
+        def f(bounds=(0, 5)):
+            s = paddle.to_tensor(0.0)
+            for i in range(*bounds):
+                s = s + 1.0
+                if i >= 2:
+                    break
+            return s
+
+        c = dy2static.convert(f)
+        assert float(c()) == 3.0 == float(f())
+
+    def test_zero_step_raises_like_range(self):
+        def f(n=5):
+            s = paddle.to_tensor(0.0)
+            step = 0
+            for i in range(10, 0, step):
+                s = s + 1.0
+                if s > 3.0:
+                    break
+            return s
+
+        c = dy2static.convert(f)
+        with pytest.raises(ValueError, match="must not be zero"):
+            c()
+
+    def test_float_bound_raises_like_range(self):
+        def f():
+            s = paddle.to_tensor(0.0)
+            stop = 2.5
+            for i in range(stop):
+                s = s + 1.0
+                if s > 1.0:
+                    break
+            return s
+
+        c = dy2static.convert(f)
+        with pytest.raises(TypeError, match="interpreted as an integer"):
+            c()
+
+    def test_del_body_not_desugared(self):
+        def f():
+            cache = {0: "a", 1: "b"}
+            s = paddle.to_tensor(0.0)
+            for i in range(2):
+                del cache[i]
+                s = s + 1.0
+            return s, cache
+
+        c = dy2static.convert(f)
+        s, cache = c()
+        assert float(s) == 2.0 and cache == {}
+
+    def test_nested_def_with_return_in_body_still_converts(self):
+        def f(n=4):
+            s = paddle.to_tensor(0.0)
+            for i in range(n):
+                def pick(v):
+                    return v + 1
+                s = s + float(pick(i))
+                if i >= 2:
+                    break
+            return s
+
+        c = dy2static.convert(f)
+        assert float(c()) == 6.0 == float(f())

@@ -57,9 +57,13 @@ def _gen_program(rng: random.Random, idx: int) -> str:
                 f"{depth_ind}else:",
                 f"{depth_ind}    s = s + 0.25"]
 
-    # a while loop with a bounded counter, random body, maybe break/continue
-    lines.append(f"{ind}while i < n:")
-    lines.append(f"{ind}    i = i + 1")
+    # a bounded loop (while or for-range), random body, maybe break/continue
+    if rng.random() < 0.35:
+        lines.append(f"{ind}for _k in range({rng.randrange(4, 9)}):")
+        lines.append(f"{ind}    i = i + 1")
+    else:
+        lines.append(f"{ind}while i < n:")
+        lines.append(f"{ind}    i = i + 1")
     if rng.random() < 0.4:
         lines.append(f"{ind}    if {tensor_pred()}:")
         lines.append(f"{ind}        {'break' if rng.random() < 0.5 else 'continue'}")
