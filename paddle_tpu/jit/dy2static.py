@@ -816,13 +816,34 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.counter += 1
         return self.counter
 
-    # do not transform statements inside nested function scopes: they run
-    # with their own locals and convert() can be applied to them separately
-    def visit_FunctionDef(self, node):
+    # NESTED defs get the full conversion too (the reference converts
+    # called functions via convert_call): their scopes are independent, so
+    # the same per-function pipeline — return capture then statement
+    # transforms — runs on each body. Generated _pt_* helpers are left
+    # alone (nested only — a USER function may carry any name). Lambdas
+    # and async defs stay untouched. NOTE: nonlocal/global anywhere bails
+    # the whole conversion in convert() — a nested `nonlocal` writes the
+    # enclosing frame's cell, which the branch-fn parameter threading
+    # cannot observe, so partial conversion would silently diverge; the
+    # check here is a second fence for direct visitation.
+    def visit_FunctionDef(self, node, top: bool = False):
+        if not top and node.name.startswith(("_pt_", "__pt_")):
+            return node
+        if _has_nonlocal_or_global(node):
+            return node
+        node.body = _rewrite_returns(node.body, self._uid())
+        new_body = []
+        for s in node.body:
+            r = self.visit(s)      # dispatches nested/async defs correctly
+            new_body.extend(r if isinstance(r, list) else [r])
+        node.body = new_body
         return node
 
-    visit_AsyncFunctionDef = visit_FunctionDef
-    visit_Lambda = visit_FunctionDef
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
 
     def visit_If(self, node: ast.If):
         node = self.generic_visit(node)
@@ -972,6 +993,22 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 # convert()
 # ---------------------------------------------------------------------------
 
+def _walk_same_scope(node):
+    """ast.walk that does NOT descend into nested function/lambda scopes
+    (their returns/names belong to them, not the function under rewrite).
+    The scope nodes themselves are yielded; their interiors never are —
+    including when ``node`` itself is one (callers pass STATEMENTS; a def
+    statement owns its returns)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
 def _always_returns(stmts, allow_raise: bool = True) -> bool:
     """Every path through this statement list ends in an explicit Return
     (or raise). ``with`` blocks are transparent for RETURN (no context
@@ -1045,7 +1082,7 @@ def _returns_are_leaf_only(stmts, tail=True) -> bool:
             if not _returns_are_leaf_only(s.body, tail and last):
                 return False
         else:
-            for n in ast.walk(s):
+            for n in _walk_same_scope(s):
                 if isinstance(n, ast.Return):
                     return False
     return True
@@ -1115,7 +1152,7 @@ def _rewrite_returns(body, uid: int):
     otherwise the body is returned unchanged (concrete predicates keep
     working via the plain python path)."""
     n_returns = sum(isinstance(n, ast.Return)
-                    for s in body for n in ast.walk(s))
+                    for s in body for n in _walk_same_scope(s))
     trailing_only = (n_returns == 1 and isinstance(body[-1], ast.Return))
     if n_returns == 0 or trailing_only:
         return body
@@ -1181,16 +1218,10 @@ def convert(fn: Callable) -> Callable:
         return fn
 
     tr = _ControlFlowTransformer()
-    fndef = tr.visit(fndef)
-    # early-return capture first: fold trailing code into else-branches so
-    # tensor-predicated `if p: return a` converts like any other if
-    fndef.body = _rewrite_returns(fndef.body, tr._uid())
-    # visit_FunctionDef skips the top-level def itself; walk its body
-    new_body = []
-    for s in fndef.body:
-        r = tr.visit(s) if not isinstance(s, ast.FunctionDef) else s
-        new_body.extend(r if isinstance(r, list) else [r])
-    fndef.body = new_body
+    # visit_FunctionDef runs the whole per-function pipeline (early-return
+    # capture, then the statement transforms) and recurses into nested
+    # defs, which the reference converts via convert_call
+    fndef = tr.visit_FunctionDef(fndef, top=True)
     if tr.applied == 0:
         return fn
     fndef.decorator_list = []

@@ -1020,3 +1020,102 @@ class TestForRangeDesugarEdgeCases:
 
         c = dy2static.convert(f)
         assert float(c()) == 6.0 == float(f())
+
+
+class TestNestedFunctionConversion:
+    """Nested defs get the full conversion too (reference convert_call)."""
+
+    def test_inner_def_tensor_if_converts(self):
+        def outer(x):
+            def head(v):
+                if v.sum() > 0:
+                    return v * 2.0
+                return v * -1.0
+
+            a = head(x)
+            b = head(-x)
+            return a + b
+
+        so = paddle.jit.to_static(outer)
+        got = float(so(paddle.to_tensor([3.0])).sum())
+        want = float(outer(paddle.to_tensor([3.0])).sum())
+        assert got == want == 9.0
+
+    def test_inner_def_while_break(self):
+        def outer(n):
+            def count(lim):
+                with paddle.no_grad():
+                    i = paddle.to_tensor(0)
+                    while True:
+                        i = i + 1
+                        if i >= lim:
+                            break
+                return i
+
+            return count(n) + count(n + 1)
+
+        so = paddle.jit.to_static(outer)
+        assert int(so(paddle.to_tensor(3))) == \
+            int(outer(paddle.to_tensor(3))) == 7
+
+    def test_nonlocal_inner_def_untouched(self):
+        def outer(x):
+            state = [0.0]
+
+            def bump():
+                state[0] += 1.0
+
+            bump()
+            bump()
+            if x.sum() > 0:
+                return paddle.to_tensor(state[0]) + x.sum()
+            return paddle.to_tensor(state[0])
+
+        so = paddle.jit.to_static(outer)
+        assert float(so(paddle.to_tensor([1.0]))) == \
+            float(outer(paddle.to_tensor([1.0]))) == 3.0
+
+
+class TestNestedDefReviewCases:
+    def test_outer_return_capture_despite_inner_returns(self):
+        # a nested def's returns must not disable the OUTER fold
+        def outer(x):
+            def head(v):
+                return v + 1.0
+
+            if x.sum() > 0:
+                return head(x) * 2.0
+            return head(x) * -1.0
+
+        so = paddle.jit.to_static(outer)
+        assert float(so(paddle.to_tensor([3.0])).sum()) == 8.0
+        assert float(so(paddle.to_tensor([-3.0])).sum()) == 2.0
+
+    def test_pt_prefixed_user_function_converts(self):
+        def _pt_step(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x * -1.0
+
+        c = dy2static.convert(_pt_step)
+        assert c is not _pt_step
+        assert float(paddle.jit.to_static(_pt_step)(
+            paddle.to_tensor([2.0])).sum()) == 4.0
+
+    def test_true_nonlocal_inner_def_bails_whole_function(self):
+        # a nested nonlocal writes the enclosing frame's cell, which the
+        # branch-fn threading cannot observe: conversion must bail
+        def outer(x):
+            n = 0
+
+            def bump():
+                nonlocal n
+                n += 1
+
+            bump()
+            if float(x.sum()) > 0:
+                bump()
+            return paddle.to_tensor(float(n)) + x.sum()
+
+        assert dy2static.convert(outer) is outer
+        assert float(outer(paddle.to_tensor([1.0]))) == 3.0
