@@ -106,7 +106,10 @@ def main() -> None:
 
     tokens = batch * seq * steps
     tok_per_sec = tokens / dt
-    fpt = flops_per_token(mc)  # 6N fwd+bwd weight FLOPs per token
+    # 6N fwd+bwd weight FLOPs/token — the conservative model-FLOPs MFU
+    # denominator (no attention term; flops_per_token_hw adds it, and
+    # docs/FLAGSHIP.md reports both conventions)
+    fpt = flops_per_token(mc)
     mfu = tok_per_sec * fpt / _peak_flops()
     print(json.dumps({
         "metric": "llama3_8b_shard_pretrain_tokens_per_sec_per_chip"

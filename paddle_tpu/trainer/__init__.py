@@ -2,4 +2,5 @@
 accumulation, bf16, hybrid-parallel composition, MFU logging; SURVEY §2.4)."""
 
 from .pretrain import (PretrainConfig, build_llama_pretrain_step,  # noqa: F401
-                       make_hybrid_mesh_for, flops_per_token)
+                       make_hybrid_mesh_for, flops_per_token,
+                       flops_per_token_hw)

@@ -37,7 +37,7 @@ from ..optimizer.functional import FunctionalAdamW
 from ..jit import _StateSwap, bind_state, extract_state
 
 __all__ = ["PretrainConfig", "build_llama_pretrain_step",
-           "make_hybrid_mesh_for", "flops_per_token"]
+           "make_hybrid_mesh_for", "flops_per_token", "flops_per_token_hw"]
 
 
 class PretrainConfig:
@@ -114,17 +114,41 @@ def make_hybrid_mesh_for(cfg: PretrainConfig, devices=None) -> Mesh:
                              sep_degree=cfg.sep, devices=devices)
 
 
+def _n_params(c: LlamaConfig) -> float:
+    return (c.vocab_size * c.hidden_size * (1 if c.tie_word_embeddings else 2)
+            + c.num_hidden_layers * (
+                c.hidden_size * c.head_dim
+                * (c.num_attention_heads + 2 * c.num_key_value_heads)
+                + c.num_attention_heads * c.head_dim * c.hidden_size
+                + 3 * c.hidden_size * c.intermediate_size
+                + 2 * c.hidden_size)
+            + c.hidden_size)
+
+
 def flops_per_token(c: LlamaConfig) -> float:
-    """6*N FLOPs/token (weights) + attention term; the MFU denominator."""
-    n_params = (c.vocab_size * c.hidden_size * (1 if c.tie_word_embeddings else 2)
-                + c.num_hidden_layers * (
-                    c.hidden_size * c.head_dim
-                    * (c.num_attention_heads + 2 * c.num_key_value_heads)
-                    + c.num_attention_heads * c.head_dim * c.hidden_size
-                    + 3 * c.hidden_size * c.intermediate_size
-                    + 2 * c.hidden_size)
-                + c.hidden_size)
-    return 6.0 * n_params
+    """6*N FLOPs/token — weight FLOPs only, NO attention term.
+
+    This is the *model*-FLOPs MFU denominator (the conservative convention:
+    attention score/value FLOPs the hardware actually performs are not
+    credited, so MFU reported against this is a lower bound). For the
+    hardware-FLOPs variant that adds the 12*L*h*s attention term, use
+    `flops_per_token_hw`; both are reported in docs/FLAGSHIP.md.
+    """
+    return 6.0 * _n_params(c)
+
+
+def flops_per_token_hw(c: LlamaConfig, seq_len: int) -> float:
+    """6*N + attention FLOPs/token: the hardware-FLOPs MFU denominator.
+
+    Attention adds 2 matmuls (QK^T and PV) per head per layer, each
+    s*head_dim MACs = 2*s*head_dim FLOPs per token in the forward pass ->
+    4*s*head_dim*n_heads*L forward FLOPs/token; the backward costs 2x the
+    forward, so fwd+bwd = 3x -> 12 * L * n_heads * head_dim * seq_len per
+    token (causal masking halves the realized work, but the dense
+    convention is standard for MFU).
+    """
+    attn = 12.0 * c.num_hidden_layers * c.num_attention_heads * c.head_dim * seq_len
+    return 6.0 * _n_params(c) + attn
 
 
 def _param_spec_tree(state: Dict[str, jnp.ndarray], model) -> Dict[str, P]:

@@ -10,10 +10,20 @@ each, and (c) flags the names we expose that upstream does not (so the
 count is honest in both directions).
 
 Run:  python tools/api_checklist.py          (writes docs/API_CHECKLIST.md)
+      python tools/api_checklist.py --diff /root/reference
+                                             (reference-contact protocol:
+                                              the session the mount has
+                                              content, machine-diff the real
+                                              upstream flat namespace against
+                                              ours, re-verify the ABSENT
+                                              hand-curation, and write
+                                              docs/REF_DIFF.md)
 """
 
 from __future__ import annotations
 
+import ast
+import os
 import sys
 import types
 from collections import defaultdict
@@ -59,6 +69,124 @@ MODULE_ROLES = {
     "trainer": "pretrain step builder (upstream: PaddleNLP Trainer)",
     "flags": "FLAGS registry (upstream paddle.base.core flags)",
 }
+
+
+def _our_flat_names():
+    import paddle_tpu as p
+    return sorted(n for n in dir(p) if not n.startswith("_")
+                  and not isinstance(getattr(p, n), types.ModuleType))
+
+
+def _ref_flat_names(ref_root: str):
+    """Extract the upstream flat-name universe WITHOUT importing paddle
+    (the reference is CUDA/torch-built and unimportable here): AST-parse
+    python/paddle/__init__.py for __all__ plus every top-level
+    `from X import a, b` / `import m` binding, the same set `dir(paddle)`
+    would show sans underscore names. Returns (flat_names, module_names,
+    init_path); module bindings (`from . import nn`, `import paddle.X`)
+    are bucketed separately so they diff against OUR modules, not our
+    flat functions."""
+    init = os.path.join(ref_root, "python", "paddle", "__init__.py")
+    if not os.path.isfile(init):
+        return None, None, init
+    tree = ast.parse(open(init, encoding="utf-8").read())
+    names, mod_names, all_names = set(), set(), None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if t.id == "__all__":
+                        try:
+                            all_names = set(ast.literal_eval(node.value))
+                        except ValueError:
+                            pass
+                    elif not t.id.startswith("_"):
+                        names.add(t.id)
+        elif isinstance(node, ast.ImportFrom):
+            # `from . import nn` (module is None) binds submodules;
+            # `from .tensor.math import add` binds objects
+            is_mod = node.module is None and node.level >= 1
+            for a in node.names:
+                bound = a.asname or a.name
+                if bound != "*" and not bound.startswith("_"):
+                    (mod_names if is_mod else names).add(bound)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    bound = a.asname
+                elif a.name.startswith("paddle."):
+                    # `import paddle.X` inside paddle/__init__ registers X
+                    # as an attribute of the package — the surface name
+                    # dir(paddle) shows is X, not `paddle`
+                    bound = a.name.split(".")[1]
+                else:
+                    bound = a.name.split(".")[0]
+                if not bound.startswith("_") and bound != "paddle":
+                    mod_names.add(bound)
+    if all_names:
+        # __all__ is the authoritative public surface when present;
+        # names already seen as module bindings stay in the module bucket
+        names |= {n for n in all_names
+                  if not n.startswith("_") and n not in mod_names}
+    return names, mod_names, init
+
+
+def diff_against_reference(ref_root: str) -> int:
+    """Reference-contact protocol (VERDICT r4 item 8): the day the mount
+    stops being empty, this produces the real missing-name list in minutes
+    and converts the self-audit into a machine audit."""
+    import paddle_tpu as p
+    ref_names, ref_mods, init = _ref_flat_names(ref_root)
+    if ref_names is None:
+        print(f"reference mount has no {init} — still empty/absent; "
+              f"nothing to diff (this is the expected state while the "
+              f"mount is empty; re-run the session it appears)")
+        return 1
+    ours = set(_our_flat_names())
+    our_mods = {n for n in dir(p) if not n.startswith("_")
+                and isinstance(getattr(p, n), types.ModuleType)}
+    ref_universe = ref_names | ref_mods
+    # already-triaged names (the ABSENT table) are excluded from the
+    # actionable missing list and verified separately below
+    missing = sorted(ref_names - ours - our_mods - set(ABSENT))
+    missing_mods = sorted(ref_mods - our_mods - ours - set(ABSENT))
+    extra = sorted(ours - ref_universe)         # we have, upstream doesn't
+    absent_confirmed = sorted(n for n in ABSENT if n in ref_universe)
+    absent_stale = sorted(n for n in ABSENT if n not in ref_universe)
+    out = []
+    w = out.append
+    w("# REF_DIFF — machine diff vs the real reference flat namespace")
+    w("")
+    w(f"Source: `{init}` ({len(ref_names)} public names + "
+      f"{len(ref_mods)} module bindings).")
+    w("")
+    w(f"**Missing here ({len(missing)})** — upstream-flat names this build "
+      f"does not expose, ABSENT table already subtracted (triage each: "
+      f"implement, alias, or move to the ABSENT table with a mapping):")
+    w("")
+    w(" ".join(f"`{n}`" for n in missing) or "(none)")
+    w("")
+    w(f"**Missing submodules ({len(missing_mods)})** — upstream module "
+      f"bindings with no namesake package here:")
+    w("")
+    w(" ".join(f"`{n}`" for n in missing_mods) or "(none)")
+    w("")
+    w(f"**Extra here ({len(extra)})** — candidates for the EXTENSIONS "
+      f"table:")
+    w("")
+    w(" ".join(f"`{n}`" for n in extra) or "(none)")
+    w("")
+    w(f"**ABSENT hand-curation check:** {len(absent_confirmed)} confirmed "
+      f"upstream-present (correctly listed), {len(absent_stale)} stale "
+      f"(listed as known-absent but not in the real surface — remove): "
+      + (", ".join(f"`{n}`" for n in absent_stale) or "none stale"))
+    w("")
+    with open("/root/repo/docs/REF_DIFF.md", "w") as f:
+        f.write("\n".join(out))
+    print(f"wrote docs/REF_DIFF.md: {len(missing)} missing, {len(extra)} "
+          f"extra, ABSENT check {len(absent_confirmed)} ok/"
+          f"{len(absent_stale)} stale")
+    return 0
 
 
 def main() -> None:
@@ -151,4 +279,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--diff":
+        if len(sys.argv) < 3:
+            print("usage: python tools/api_checklist.py --diff "
+                  "<reference-root>", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(diff_against_reference(sys.argv[2]))
     main()
