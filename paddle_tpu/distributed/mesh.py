@@ -19,7 +19,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ProcessMesh", "HybridTopology", "get_mesh", "set_mesh",
-           "mesh_context", "build_hybrid_mesh", "AXIS_ORDER"]
+           "mesh_context", "build_hybrid_mesh", "AXIS_ORDER",
+           "global_device_put"]
 
 # outermost → innermost (DCN-most → ICI-most)
 AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
@@ -50,6 +51,24 @@ class mesh_context:
         global _current_mesh
         _current_mesh = self._prev
         return False
+
+
+def global_device_put(arr, sharding: NamedSharding):
+    """device_put that also works when `sharding` spans devices of OTHER
+    processes (multi-host; ref: the fleet path where every rank holds the
+    full host value and NCCL broadcast/scatter distributes it — SURVEY
+    §3.5, §5.8). Single-process this IS jax.device_put; multi-process,
+    each process supplies its addressable shards from the (identical)
+    host value via make_array_from_callback. Caller contract: `arr` holds
+    the same values on every process (seeded init / seeded data), which
+    is the same contract the reference's per-rank parameter init has."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    # one host copy up front so each shard extraction below is a
+    # zero-copy numpy view, not an eager device gather per shard
+    host = np.asarray(arr)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
 
 
 class ProcessMesh:

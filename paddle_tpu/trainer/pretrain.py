@@ -26,7 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..amp import decorate_tree
 from ..core.tensor import Tensor
-from ..distributed.mesh import build_hybrid_mesh, mesh_context
+from ..distributed.mesh import (build_hybrid_mesh, global_device_put,
+                                mesh_context)
 from ..distributed.pipeline import (PP_AXIS, spmd_pipeline,
                                     spmd_pipeline_interleaved,
                                     stack_layer_params,
@@ -250,7 +251,7 @@ def build_llama_pretrain_step(cfg: PretrainConfig, mesh: Mesh):
         for k, v in tree.items():
             arr = v.astype(dtype) if dtype is not None and \
                 jnp.issubdtype(v.dtype, jnp.floating) else v
-            out[k] = jax.device_put(arr, NamedSharding(mesh, specs_tree[k]))
+            out[k] = global_device_put(arr, NamedSharding(mesh, specs_tree[k]))
         return out
 
     master = {g: place(params[g], specs[g]) for g in params}

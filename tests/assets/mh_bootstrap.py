@@ -1,0 +1,22 @@
+"""Shared bootstrap for the launched multi-host workers: this process
+simulates ONE host with 4 virtual CPU devices. MUST be imported before
+jax (env flags bind at backend init); finishes with the rendezvous ->
+jax.distributed bridge up and the global device view asserted."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=4"
+    " --xla_cpu_collective_call_terminate_timeout_seconds=900"
+    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300")
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.distributed as dist  # noqa: E402
+
+dist.init_parallel_env()
+
+assert jax.process_count() == int(os.environ["PADDLE_TRAINERS_NUM"]), \
+    (jax.process_count(), os.environ["PADDLE_TRAINERS_NUM"])
+assert jax.device_count() == 4 * jax.process_count()

@@ -1,29 +1,15 @@
 """Worker for the executed multi-host test (SURVEY §3.1 / §5.8 DCN half):
 launched by python -m paddle_tpu.distributed.launch on 2 simulated hosts;
-each process owns 4 virtual CPU devices, init_parallel_env bridges the
-TCPStore rendezvous into jax.distributed.initialize, and a psum runs
-across all 8 global devices."""
+each process owns 4 virtual CPU devices, init_parallel_env (via
+mh_bootstrap) bridges the TCPStore rendezvous into
+jax.distributed.initialize, and a psum runs across all 8 global devices."""
 import os
+import sys
 
-# this process simulates ONE host with 4 local devices; keep the
-# collective-timeout flags the suite uses, drop the 8-device forcing
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    "--xla_force_host_platform_device_count=4"
-    " --xla_cpu_collective_call_terminate_timeout_seconds=900"
-    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import mh_bootstrap  # noqa: F401  (env + jax.distributed init, pre-jax)
 
 import jax
-jax.config.update("jax_platforms", "cpu")
-
-import paddle_tpu.distributed as dist
-
-dist.init_parallel_env()
-
-assert jax.process_count() == int(os.environ["PADDLE_TRAINERS_NUM"]), \
-    (jax.process_count(), os.environ["PADDLE_TRAINERS_NUM"])
-assert jax.device_count() == 4 * jax.process_count()
-
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
