@@ -42,12 +42,12 @@ def main() -> None:
                                              flops_per_token)
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    # tuned recipe: at the flagship shape (equal-length causal seq 8192,
-    # 4 heads d128) the bundled flash kernel measures ~2% faster on the
-    # full train step than the in-tree default (docs/FLASH_BENCH.json has
-    # the kernel-level A/B; both within 5%) — the pretrain recipe picks
-    # the faster one, the in-tree kernel stays the default elsewhere and
-    # is the only option for configs the bundled kernel refuses
+    # tuned recipe: on the full train step the bundled flash kernel is
+    # ~0.8% faster on mean with the band CROSSING 1 (same-run interleaved
+    # x3: bundled/intree step-time 0.977-1.004, docs/FLASH_RECIPE_AB.json)
+    # — i.e. within noise; the recipe keeps the variant that never lost a
+    # round, the in-tree kernel stays the default elsewhere and is the
+    # only option for configs the bundled kernel refuses
     from paddle_tpu.flags import set_flags
     set_flags({"FLAGS_flash_impl": "bundled"})
     # Headline: the per-chip shard of an mp=8 x pp=4 partitioned

@@ -412,13 +412,29 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
             cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
             new_caches.append((ck, cv))
             rep = Hh // KV
-            kk = jnp.repeat(ck, rep, axis=2) if rep > 1 else ck
-            vv = jnp.repeat(cv, rep, axis=2) if rep > 1 else cv
-            scores = jnp.einsum("bshd,bthd->bhst", q, kk) * (D ** -0.5)
-            scores = jnp.where(vis[None, None], scores.astype(jnp.float32),
-                               -1e30)
-            aw = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
-            o = jnp.einsum("bhst,bthd->bshd", aw, vv).reshape(B, S, Hh * D)
+            if rep > 1:
+                # GQA WITHOUT materializing jnp.repeat of the cache: the
+                # repeat wrote+read rep x the KV bytes per step — at the
+                # MoE serving shape (16q/4kv, 8 layers) that was ~0.8 GB
+                # of pure overhead against 1.5 GB of weights, the bulk of
+                # the missing moe_decode roofline (VERDICT r4 item 2).
+                # Group q as [B,S,KV,rep,D] and batch the dot over the kv
+                # head so each cache byte is read exactly once.
+                qg = q.reshape(B, S, KV, rep, D)
+                scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ck) \
+                    * (D ** -0.5)
+                scores = jnp.where(vis[None, None, None],
+                                   scores.astype(jnp.float32), -1e30)
+                aw = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+                o = jnp.einsum("bgrst,btgd->bsgrd", aw, cv).reshape(
+                    B, S, Hh * D)
+            else:
+                scores = jnp.einsum("bshd,bthd->bhst", q, ck) * (D ** -0.5)
+                scores = jnp.where(vis[None, None],
+                                   scores.astype(jnp.float32), -1e30)
+                aw = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+                o = jnp.einsum("bhst,bthd->bshd", aw, cv).reshape(
+                    B, S, Hh * D)
             x = x + _mm_w(o, L, "wo")
             h2 = rms(x, L["ln2"])
             x = x + _ffn_apply(L, h2, st)

@@ -16,7 +16,7 @@ import numpy as np
 import os as _os
 import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
-from bench_util import timeit as _timeit  # noqa: E402
+from bench_util import ab_rounds, band, ratio_band  # noqa: E402
 
 
 def main():
@@ -52,46 +52,47 @@ def main():
 
         intree_fwd = jax.jit(lambda q, k, v: flash_sdpa(
             q, k, v, causal=causal))
-        t_intree = _timeit(intree_fwd, q, k, v)
 
-        t_bundled = None
+        def loss_intree(q, k, v):
+            return jnp.sum(flash_sdpa(q, k, v, causal=causal)
+                           .astype(jnp.float32) ** 2)
+        g_intree = jax.jit(jax.grad(loss_intree, (0, 1, 2)))
+
+        kernels = {"intree_fwd": (intree_fwd, (q, k, v)),
+                   "intree_fwdbwd": (g_intree, (q, k, v))}
         if Sq == Sk or not causal:
             qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
             bundled_fwd = jax.jit(lambda qh, kh, vh: bundled(
                 qh, kh, vh, causal=causal, sm_scale=D ** -0.5,
                 block_sizes=_flash_block_sizes(Sq, Sk)))
-            t_bundled = _timeit(bundled_fwd, qh, kh, vh)
 
-        # fwd+bwd
-        def loss_intree(q, k, v):
-            return jnp.sum(flash_sdpa(q, k, v, causal=causal)
-                           .astype(jnp.float32) ** 2)
-        g_intree = jax.jit(jax.grad(loss_intree, (0, 1, 2)))
-        t_intree_bwd = _timeit(g_intree, q, k, v)
-        t_bundled_bwd = None
-        if Sq == Sk or not causal:
             def loss_bundled(qh, kh, vh):
                 return jnp.sum(bundled(
                     qh, kh, vh, causal=causal, sm_scale=D ** -0.5,
                     block_sizes=_flash_block_sizes(Sq, Sk))
                     .astype(jnp.float32) ** 2)
             g_bundled = jax.jit(jax.grad(loss_bundled, (0, 1, 2)))
-            qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-            t_bundled_bwd = _timeit(g_bundled, qh, kh, vh)
+            kernels["bundled_fwd"] = (bundled_fwd, (qh, kh, vh))
+            kernels["bundled_fwdbwd"] = (g_bundled, (qh, kh, vh))
+
+        # same-run interleaved rounds (VERDICT r4 item 3): intree and
+        # bundled alternate within each round; every ratio carries the
+        # per-round band so <5% claims are checkable against the noise
+        runs = ab_rounds(kernels, rounds=3, reps=10)
 
         row = dict(shape=name, B=B, Sq=Sq, Sk=Sk, H=H, D=D, causal=causal,
-                   intree_fwd_us=round(t_intree * 1e6, 1),
-                   bundled_fwd_us=(None if t_bundled is None
-                                   else round(t_bundled * 1e6, 1)),
-                   intree_fwdbwd_us=round(t_intree_bwd * 1e6, 1),
-                   bundled_fwdbwd_us=(None if t_bundled_bwd is None
-                                      else round(t_bundled_bwd * 1e6, 1)))
-        if t_bundled:
-            row["fwd_ratio_intree_over_bundled"] = round(
-                t_intree / t_bundled, 3)
-        if t_bundled_bwd:
-            row["fwdbwd_ratio_intree_over_bundled"] = round(
-                t_intree_bwd / t_bundled_bwd, 3)
+                   rounds=3,
+                   intree_fwd=band(runs["intree_fwd"]),
+                   intree_fwdbwd=band(runs["intree_fwdbwd"]),
+                   bundled_fwd=(band(runs["bundled_fwd"])
+                                if "bundled_fwd" in runs else None),
+                   bundled_fwdbwd=(band(runs["bundled_fwdbwd"])
+                                   if "bundled_fwdbwd" in runs else None))
+        if "bundled_fwd" in runs:
+            row["fwd_ratio_intree_over_bundled"] = ratio_band(
+                runs["intree_fwd"], runs["bundled_fwd"])
+            row["fwdbwd_ratio_intree_over_bundled"] = ratio_band(
+                runs["intree_fwdbwd"], runs["bundled_fwdbwd"])
         rows.append(row)
         print(json.dumps(row), flush=True)
 
