@@ -162,6 +162,18 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self._data.shape[0]
 
+    def __iter__(self):
+        """Iterate rows of the leading axis (paddle/torch parity). Without
+        this, python's legacy __getitem__ iteration never terminates:
+        XLA's gather clamps out-of-range indices, so t[i] past the end
+        silently returns the last row instead of raising IndexError. The
+        leading dim is static even under trace, so this also makes plain
+        `for row in t` unroll correctly inside jit."""
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        for i in range(self._data.shape[0]):
+            yield self[i]
+
     def __hash__(self):
         return id(self)
 

@@ -1102,9 +1102,13 @@ class TestNestedDefReviewCases:
         assert float(paddle.jit.to_static(_pt_step)(
             paddle.to_tensor([2.0])).sum()) == 4.0
 
-    def test_true_nonlocal_inner_def_bails_whole_function(self):
-        # a nested nonlocal writes the enclosing frame's cell, which the
-        # branch-fn threading cannot observe: conversion must bail
+    def test_true_nonlocal_contained_per_site(self):
+        # r5: nonlocal no longer bails the whole function — it is
+        # contained per-site. Here the if threads NO names (the branch
+        # only calls bump()), so conversion is sound: the branch fn is a
+        # closure over the live frame and the cell mutation stays
+        # visible. Statements that WOULD thread `n` fall back
+        # individually (tests/test_for_iter.py::TestNonlocalContainment).
         def outer(x):
             n = 0
 
@@ -1117,5 +1121,7 @@ class TestNestedDefReviewCases:
                 bump()
             return paddle.to_tensor(float(n)) + x.sum()
 
-        assert dy2static.convert(outer) is outer
+        co = dy2static.convert(outer)
+        assert float(co(paddle.to_tensor([1.0]))) == 3.0
+        assert float(co(paddle.to_tensor([-1.0]))) == 0.0
         assert float(outer(paddle.to_tensor([1.0]))) == 3.0

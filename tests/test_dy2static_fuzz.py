@@ -57,14 +57,46 @@ def _gen_program(rng: random.Random, idx: int) -> str:
                 f"{depth_ind}else:",
                 f"{depth_ind}    s = s + 0.25"]
 
-    # a bounded loop (while or for-range), random body, maybe break/continue
-    if rng.random() < 0.35:
+    # a bounded loop (while, for-range, or for-over-iterable: tensor /
+    # enumerate / zip — VERDICT r4 item 4), random body; break/continue
+    # only in the while/for-range forms (for-iter bodies with break fall
+    # back by design)
+    loop_kind = rng.random()
+    for_iter = False
+    if loop_kind < 0.12:
+        k1, k2 = rng.randrange(3, 7), rng.randrange(3, 7)
+        lines.append(f"{ind}_t = paddle.arange({k1}).astype('float32')"
+                     " + n.astype('float32')")  # input-derived => traced
+        lines.append(f"{ind}_u = paddle.arange({k2}).astype('float32') * 2.0"
+                     " + n.astype('float32')")
+        lines.append(f"{ind}for _a, _b in zip(_t, _u):")
+        lines.append(f"{ind}    s = s + _a * 0.5 + _b * 0.25")
+        lines.append(f"{ind}    i = i + 1")
+        for_iter = True
+    elif loop_kind < 0.24:
+        k1 = rng.randrange(3, 7)
+        lines.append(f"{ind}_t = paddle.arange({k1}).astype('float32')"
+                     " + n.astype('float32')")  # input-derived => traced
+        start = rng.randrange(0, 3)
+        lines.append(f"{ind}for _j, _row in enumerate(_t, {start}):")
+        lines.append(f"{ind}    s = s + _row + _j")
+        lines.append(f"{ind}    i = i + 1")
+        for_iter = True
+    elif loop_kind < 0.36:
+        k1 = rng.randrange(3, 7)
+        lines.append(f"{ind}_t = paddle.arange({k1}).astype('float32')"
+                     " + n.astype('float32')")  # input-derived => traced
+        lines.append(f"{ind}for _row in _t:")
+        lines.append(f"{ind}    s = s + _row")
+        lines.append(f"{ind}    i = i + 1")
+        for_iter = True
+    elif loop_kind < 0.6:
         lines.append(f"{ind}for _k in range({rng.randrange(4, 9)}):")
         lines.append(f"{ind}    i = i + 1")
     else:
         lines.append(f"{ind}while i < n:")
         lines.append(f"{ind}    i = i + 1")
-    if rng.random() < 0.4:
+    if not for_iter and rng.random() < 0.4:
         lines.append(f"{ind}    if {tensor_pred()}:")
         lines.append(f"{ind}        {'break' if rng.random() < 0.5 else 'continue'}")
     for _ in range(rng.randrange(1, 3)):
@@ -79,7 +111,7 @@ def _gen_program(rng: random.Random, idx: int) -> str:
     return "\n".join(lines) + "\n"
 
 
-N_PROGRAMS = 40
+N_PROGRAMS = 64
 _DOCUMENTED = ("must be assigned before", "assigned in only one branch",
                "max_iter")
 
