@@ -51,4 +51,22 @@ def maybe_initialize() -> bool:
     return True
 
 
+def _honor_jax_platforms_env() -> None:
+    """Pin jax's platform choice to the JAX_PLATFORMS env var at import
+    time. On images with a preinstalled PJRT plugin (axon TPU) the plugin
+    outranks the env var, so ``JAX_PLATFORMS=cpu python -m
+    paddle_tpu...`` would silently land on the TPU; mirroring the env
+    into jax.config BEFORE the backend initialises makes the env contract
+    hold for every entry point (run_pretrain, launch workers, tools)."""
+    plats = os.environ.get("JAX_PLATFORMS")
+    if not plats:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", plats)
+    except Exception:  # pragma: no cover - never block package import
+        pass
+
+
+_honor_jax_platforms_env()
 maybe_initialize()

@@ -974,7 +974,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             if isinstance(n, (ast.Nonlocal, ast.Global))
             for name in n.names}
         a = node.args
-        self._assigned = (_written_names(node.body)
+        # UNION with the enclosing scope's assignments: a name this scope
+        # does not assign resolves lexically, so an outer shadow of
+        # range/enumerate/zip must also disable the structural treatment
+        # inside nested defs
+        self._assigned = (outer_assigned
+                          | _written_names(node.body)
                           | {x.arg for x in a.args + a.posonlyargs
                              + a.kwonlyargs}
                           | ({a.vararg.arg} if a.vararg else set())
@@ -1100,9 +1105,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 + list(node.body))
         return pre + [ast.While(test=test, body=body, orelse=[])]
 
-    def visit_For(self, node: ast.For):
-        if (not node.orelse and not _has_walrus(node.iter)
-                and isinstance(node.target, ast.Name)
+    def _is_builtin_range_for(self, node: ast.For) -> bool:
+        """``for <Name> in range(1..3 plain args)`` with `range` not
+        locally shadowed — the ONE predicate both the desugar path and
+        the plain run_for_range path must agree on."""
+        return (isinstance(node.target, ast.Name)
                 and isinstance(node.iter, ast.Call)
                 and isinstance(node.iter.func, ast.Name)
                 and node.iter.func.id == "range"
@@ -1110,7 +1117,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 and not node.iter.keywords
                 and len(node.iter.args) in (1, 2, 3)
                 and not any(isinstance(a, ast.Starred)
-                            for a in node.iter.args)
+                            for a in node.iter.args))
+
+    def visit_For(self, node: ast.For):
+        if (not node.orelse and not _has_walrus(node.iter)
+                and self._is_builtin_range_for(node)
                 and any(_stmt_may_flag(s) for s in node.body)
                 and not _return_in_unsupported([node])):
             # loop-level break/continue -> desugar to while and recurse
@@ -1124,15 +1135,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if (node.orelse or _has_walrus(node.iter)
                 or not _branch_ok(node.body, is_loop_body=True)):
             return node
-        if (isinstance(node.target, ast.Name)
-                and isinstance(node.iter, ast.Call)
-                and isinstance(node.iter.func, ast.Name)
-                and node.iter.func.id == "range"
-                and "range" not in self._assigned
-                and not node.iter.keywords
-                and len(node.iter.args) in (1, 2, 3)
-                and not any(isinstance(a, ast.Starred)
-                            for a in node.iter.args)):
+        if self._is_builtin_range_for(node):
             idx = node.target.id
             written = _written_names(node.body) - {idx}
             carried = sorted(_carried_names(None, node.body, written,

@@ -1,0 +1,117 @@
+"""One-command pretrain CLI (VERDICT r4 item 5; ref: PaddleNLP
+llm/run_pretrain.py). End-to-end on the 8-device CPU mesh: text corpus ->
+in-tree BPE -> DistributedBatchSampler -> dp2 x mp2 x zero2 hybrid step ->
+MFU/tok-s jsonl logging -> sharded checkpoint; then SIGKILL mid-run and
+verify auto-resume reproduces the uninterrupted run's losses exactly."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(tmp_path, out_name, max_steps=10):
+    corpus = tmp_path / "corpus.txt"
+    if not corpus.exists():
+        import random
+        rng = random.Random(0)
+        words = ["the", "quick", "brown", "fox", "jumps", "over", "lazy",
+                 "dog", "tensor", "mesh", "shard", "chip", "scale", "train"]
+        corpus.write_text(" ".join(rng.choice(words)
+                                   for _ in range(20000)))
+    cfg = {
+        "model": {"preset": "tiny", "num_hidden_layers": 2},
+        "data": {"corpus": str(corpus), "vocab_size": 280},
+        "seq_len": 64, "global_batch": 8, "max_steps": max_steps,
+        "parallel": {"dp": 2, "mp": 2, "sharding": 2},
+        "save_interval": 4, "log_interval": 10, "remat": "none",
+        "output_dir": str(tmp_path / out_name),
+    }
+    p = tmp_path / f"{out_name}.json"
+    p.write_text(json.dumps(cfg))
+    return p, cfg
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _cmd(cfg_path):
+    return [sys.executable, "-m", "paddle_tpu.trainer.run_pretrain",
+            "--config", str(cfg_path)]
+
+
+def _losses(out_dir):
+    path = os.path.join(out_dir, "losses.jsonl")
+    res = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            res[rec["step"]] = rec["loss"]   # resume re-logs: latest wins
+    return res
+
+
+class TestRunPretrainCLI:
+    def test_end_to_end_and_kill_resume_loss_continuity(self, tmp_path):
+        env = _env()
+        # uninterrupted reference
+        cfg_ref, ref_cfg = _cfg(tmp_path, "ref")
+        r = subprocess.run(_cmd(cfg_ref), env=env, cwd=REPO,
+                           capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        ref = _losses(ref_cfg["output_dir"])
+        assert len(ref) == 10 and all(v == v for v in ref.values())
+        # BPE vocab was trained and cached; MFU/tok-s logged
+        assert os.path.exists(os.path.join(ref_cfg["output_dir"],
+                                           "bpe_tokenizer.json"))
+        first = json.loads(open(os.path.join(
+            ref_cfg["output_dir"], "losses.jsonl")).readline())
+        assert "tokens_per_s" in first and "mfu_6N_est" in first
+
+        # killed run: SIGKILL once past step 5 (checkpoint exists at 4).
+        # max_steps is far larger than the kill point so the run cannot
+        # finish before the monitor catches it even on a fast/loaded host
+        cfg_k, k_cfg = _cfg(tmp_path, "killed", max_steps=60)
+        p = subprocess.Popen(_cmd(cfg_k), env=env, cwd=REPO,
+                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        log = os.path.join(k_cfg["output_dir"], "losses.jsonl")
+        deadline = time.time() + 360
+        killed = False
+        while time.time() < deadline:
+            if p.poll() is not None:
+                break
+            if os.path.exists(log):
+                lines = open(log).read().strip().splitlines()
+                if lines and json.loads(lines[-1])["step"] >= 5:
+                    p.send_signal(signal.SIGKILL)
+                    killed = True
+                    break
+            time.sleep(0.25)
+        p.wait()
+        assert killed, "run finished before the kill window"
+        assert os.path.exists(os.path.join(k_cfg["output_dir"], "latest"))
+
+        # resume with the SAME command: must continue from the checkpoint
+        r2 = subprocess.run(_cmd(cfg_k), env=env, cwd=REPO,
+                            capture_output=True, text=True, timeout=420)
+        assert r2.returncode == 0, (r2.stdout, r2.stderr)
+        assert "resumed from ckpt_step" in r2.stdout, r2.stdout
+        got = _losses(k_cfg["output_dir"])
+        # loss continuity: the killed+resumed lineage reproduces the
+        # uninterrupted run's losses at every comparable step (state +
+        # data order restored exactly), and the resume actually ran on
+        # to completion
+        for s in range(1, 11):
+            assert got[s] == pytest.approx(ref[s], abs=5e-4), \
+                (s, got[s], ref[s])
+        assert max(got) == 60 and "done at step 60" in r2.stdout
