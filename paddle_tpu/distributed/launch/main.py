@@ -34,6 +34,12 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_restarts", type=int, default=0)
     p.add_argument("--rdzv_timeout", type=float, default=120.0)
     p.add_argument("--poll_interval", type=float, default=0.2)
+    p.add_argument("--elastic_join", action="store_true",
+                   help="join a RUNNING elastic job (--nnodes MIN:MAX) "
+                        "by claiming a free membership slot; the leader "
+                        "relaunches the pod with this node included")
+    p.add_argument("--elastic_ttl", type=float, default=10.0,
+                   help="membership heartbeat TTL seconds")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p

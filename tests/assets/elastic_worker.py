@@ -1,0 +1,22 @@
+"""Worker for the IN-LAUNCHER elastic scale-up test: writes a marker for
+its (generation, rank, world) then — while the world is still below 3
+nodes — runs until the controller's elastic relaunch SIGTERMs it. At a
+3-node world it exits 0 so the whole job completes. No jax import: the
+test exercises the launcher's membership/generation machinery, not the
+compute path (the train path is covered by TestMultiHostTrain)."""
+import os
+import sys
+import time
+
+gen = os.environ.get("PADDLE_ELASTIC_GEN", "0")
+rank = os.environ["PADDLE_TRAINER_ID"]
+n = os.environ["PADDLE_TRAINERS_NUM"]
+out = os.environ["MH_OUT"]
+with open(os.path.join(out, f"g{gen}.{rank}of{n}"), "w") as f:
+    f.write("ok")
+print(f"elastic worker g{gen} rank {rank}/{n}", flush=True)
+if int(n) >= 3:
+    sys.exit(0)
+for _ in range(1200):   # ~5 min ceiling; the relaunch kills us first
+    time.sleep(0.25)
+sys.exit(0)
