@@ -106,6 +106,14 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
                 # metadata dtype restores the logical type on load
                 data = data.astype(np.float32)
             np.save(os.path.join(path, fname), data)
+        # EVERY rank records its own shard map: a multi-process save has
+        # shards only THIS process can see, so a single coordinator meta
+        # would silently omit every other rank's files and a later load
+        # would zero-fill their regions. load_state_dict unions the
+        # per-rank metas. The legacy single metadata.json stays for
+        # single-process checkpoints (and old artifacts).
+        with open(os.path.join(path, f"{_META}.r{rank}"), "w") as f:
+            json.dump(meta, f)
         if rank == coordinator_rank:
             with open(os.path.join(path, _META), "w") as f:
                 json.dump(meta, f)
@@ -143,13 +151,39 @@ def _read_overlap(saved_shards, path, t_offs, t_exts, dtype):
     return out
 
 
+def _load_meta(path: str) -> dict:
+    """Union the per-rank shard maps when present (multi-process saves);
+    fall back to the legacy single metadata.json."""
+    import glob
+    per_rank = sorted(glob.glob(os.path.join(path, f"{_META}.r*")))
+    if not per_rank:
+        with open(os.path.join(path, _META)) as f:
+            return json.load(f)
+    meta = None
+    for p in per_rank:
+        with open(p) as f:
+            m = json.load(f)
+        if meta is None:
+            meta = m
+            continue
+        for key, entry in m["tensors"].items():
+            tgt = meta["tensors"].setdefault(
+                key, {**entry, "shards": []})
+            seen = {tuple(s["offsets"]) + tuple(s["shape"])
+                    for s in tgt["shards"]}
+            for s in entry["shards"]:
+                if tuple(s["offsets"]) + tuple(s["shape"]) not in seen:
+                    tgt["shards"].append(s)
+    return meta
+
+
 def load_state_dict(state_dict: Dict[str, object], path: str,
                     process_group=None, coordinator_rank: int = 0) -> None:
     """In-place load (paddle signature): each tensor in ``state_dict`` is
     filled from the checkpoint, resharded to ITS OWN current sharding —
-    regardless of the topology that wrote the checkpoint."""
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
+    regardless of the topology that wrote the checkpoint (including a
+    different PROCESS topology: per-rank shard maps are unioned)."""
+    meta = _load_meta(path)
 
     for key, v in state_dict.items():
         if key not in meta["tensors"]:

@@ -118,21 +118,30 @@ def _token_stream(data_cfg: dict, vocab_size_needed: int, out_dir: str,
         if hasattr(arr, "files"):
             arr = arr[arr.files[0]]
         return np.asarray(arr, np.int32).reshape(-1), vocab_size_needed
-    # text corpus -> BPE
+    # text corpus -> BPE; only the COORDINATOR trains/writes the cached
+    # vocab (atomic tmp+rename), other ranks wait for it — concurrent
+    # writers would race on the shared file
+    import jax
     from ..text import BPETokenizer, train_bpe
     vs = int(data_cfg.get("vocab_size", 512))
     cache = os.path.join(out_dir, "bpe_tokenizer.json")
     text = open(corpus, encoding="utf-8").read()
-    if os.path.exists(cache):
-        spec = json.load(open(cache))
-        tok = BPETokenizer(spec["vocab"],
-                           [tuple(m) for m in spec["merges"]])
-    else:
-        vocab, merges = train_bpe([text], vocab_size=vs)
-        tok = BPETokenizer(vocab, merges)
-        os.makedirs(out_dir, exist_ok=True)
-        with open(cache, "w") as f:
-            json.dump({"vocab": vocab, "merges": list(merges)}, f)
+    if not os.path.exists(cache):
+        if jax.process_index() == 0:
+            vocab, merges = train_bpe([text], vocab_size=vs)
+            os.makedirs(out_dir, exist_ok=True)
+            with open(cache + ".tmp", "w") as f:
+                json.dump({"vocab": vocab, "merges": list(merges)}, f)
+            os.replace(cache + ".tmp", cache)
+        else:
+            deadline = time.time() + 300
+            while not os.path.exists(cache):
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        "waiting for the coordinator's bpe_tokenizer.json")
+                time.sleep(0.2)
+    spec = json.load(open(cache))
+    tok = BPETokenizer(spec["vocab"], [tuple(m) for m in spec["merges"]])
     ids = np.asarray(tok.encode(text), np.int32)
     return ids, max(vs, int(ids.max()) + 1)
 
@@ -219,6 +228,11 @@ def run(cfg: dict) -> int:
     out_dir = cfg["output_dir"]
     os.makedirs(out_dir, exist_ok=True)
     paddle.seed(cfg["seed"])
+    # multi-process (launcher-driven) runs: every process executes the
+    # same SPMD program over the GLOBAL mesh; only the coordinator writes
+    # the shared log/pointer files (checkpoint shards are per-process by
+    # design — distributed.checkpoint tags files by rank)
+    is_coord = jax.process_index() == 0
 
     mc = _build_model_config(cfg["model"], cfg["seq_len"])
     tokens, data_vocab = _token_stream(cfg["data"], mc.vocab_size, out_dir,
@@ -300,16 +314,32 @@ def run(cfg: dict) -> int:
         name = f"ckpt_step{step}"
         dck.save_state_dict(_flatten_state(state),
                             os.path.join(out_dir, name))
-        with open(latest + ".tmp", "w") as f:
-            f.write(name)
-        os.replace(latest + ".tmp", latest)   # atomic pointer flip
-        print(f"[run_pretrain] saved {name}", flush=True)
+        if jax.process_count() > 1:
+            # every rank's shard files must be ON DISK before the
+            # coordinator commits the pointer — a kill between one rank's
+            # save and another's would otherwise publish a checkpoint
+            # with missing shards
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt_{step}")
+        if is_coord:
+            with open(latest + ".tmp", "w") as f:
+                f.write(name)
+            os.replace(latest + ".tmp", latest)   # atomic pointer flip
+            print(f"[run_pretrain] saved {name}", flush=True)
 
     stop = {"sig": False}
-    signal.signal(signal.SIGTERM, lambda *_: stop.update(sig=True))
+    # single-process: SIGTERM -> emergency checkpoint at the step
+    # boundary. Multi-process: a signal may reach only SOME ranks; a
+    # partial emergency save would hang in the pointer-flip barrier (the
+    # unsignaled peers never join), so those runs exit WITHOUT an extra
+    # save and recovery rides the periodic checkpoints + auto-resume —
+    # the preemption-aware story of SURVEY §5.3 (the launcher's teardown
+    # SIGTERMs every child anyway).
+    if jax.process_count() == 1:
+        signal.signal(signal.SIGTERM, lambda *_: stop.update(sig=True))
 
     log_path = os.path.join(out_dir, "losses.jsonl")
-    logf = open(log_path, "a")
+    logf = open(log_path, "a") if is_coord else None
     tokens_per_step = cfg["global_batch"] * cfg["seq_len"]
     peak = _peak_flops()
 
@@ -344,10 +374,11 @@ def run(cfg: dict) -> int:
         rec = {"step": step + 1, "loss": round(loss, 6),
                "tokens_per_s": round(tok_s, 1),
                "mfu_6N_est": round(tok_s * fpt / peak, 4)}
-        logf.write(json.dumps(rec) + "\n")
-        logf.flush()
-        if (step + 1) % cfg["log_interval"] == 0:
-            print(f"[run_pretrain] {json.dumps(rec)}", flush=True)
+        if logf is not None:
+            logf.write(json.dumps(rec) + "\n")
+            logf.flush()
+            if (step + 1) % cfg["log_interval"] == 0:
+                print(f"[run_pretrain] {json.dumps(rec)}", flush=True)
         # save_interval <= 0 disables ALL checkpoints (tuner trials)
         if cfg["save_interval"] > 0 and (
                 (step + 1) % cfg["save_interval"] == 0

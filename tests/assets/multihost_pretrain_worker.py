@@ -1,0 +1,14 @@
+"""Worker for the launcher-driven MULTI-PROCESS run_pretrain test: the
+literal reference workflow (distributed launch -> run_pretrain.py) on 2
+simulated hosts x 4 CPU devices. mh_bootstrap joins the jax pod before
+any backend init; run_pretrain then sees the GLOBAL 8-device mesh and
+its sharded-checkpoint writer tags shards per process."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import mh_bootstrap  # noqa: F401  (env + jax.distributed init, pre-jax)
+
+from paddle_tpu.trainer.run_pretrain import main  # noqa: E402
+
+sys.exit(main(["--config", os.environ["MH_CFG"]]))

@@ -128,6 +128,77 @@ class TestMultiHostTrain:
                 got, baseline)
 
 
+class TestMultiHostRunPretrain:
+    """r5: the reference's NAMED workflow end to end across processes —
+    `paddle_tpu.distributed.launch` -> run_pretrain CLI on 2 OS processes
+    x 4 devices, dp2 x mp2 x zero2 over the global 8-device mesh, with
+    loss parity vs the identical single-process CLI run."""
+
+    def test_launcher_driven_cli_loss_parity(self, tmp_path):
+        import json
+
+        def write_cfg(out_name, max_steps=6, cfg_name=None):
+            cfg = {"model": {"preset": "tiny", "num_hidden_layers": 2},
+                   "data": {"corpus": None},
+                   "seq_len": 64, "global_batch": 8, "max_steps": max_steps,
+                   "parallel": {"dp": 2, "mp": 2, "sharding": 2},
+                   "save_interval": 3, "log_interval": 6, "remat": "none",
+                   "output_dir": str(tmp_path / out_name)}
+            p = tmp_path / f"{cfg_name or out_name}.json"
+            p.write_text(json.dumps(cfg))
+            return str(p), cfg
+
+        # single-process reference run of the SAME config
+        ref_cfg_path, ref_cfg = write_cfg("ref")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.trainer.run_pretrain",
+             "--config", ref_cfg_path],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        ref = [json.loads(x)["loss"] for x in open(
+            os.path.join(ref_cfg["output_dir"], "losses.jsonl"))]
+
+        # 2-process launcher-driven run of the SAME config, in TWO stages:
+        # stage A stops at step 3 (checkpoint), stage B auto-RESUMES the
+        # multi-process sharded checkpoint and runs to 6 — so the
+        # cross-process save -> union-meta load path is what produces
+        # steps 4-6, and any dropped rank's shards would show up as a
+        # loss divergence immediately
+        stage_a, mh_cfg = write_cfg("mh", max_steps=3, cfg_name="mh_a")
+        stage_b, _ = write_cfg("mh", max_steps=6, cfg_name="mh_b")
+        out_dir = str(tmp_path / "out")
+        os.makedirs(out_dir)
+        for stage_path in (stage_a, stage_b):
+            master = f"127.0.0.1:{_free_port()}"
+            procs = [
+                _launch_node(rk, 2, master,
+                             os.path.join(ASSETS,
+                                          "multihost_pretrain_worker.py"),
+                             str(tmp_path), out_dir,
+                             extra_env={"MH_CFG": stage_path})
+                for rk in range(2)]
+            outs, logs = _wait_and_assert_ok(procs, tmp_path, timeout=420)
+        assert any("resumed from ckpt_step3" in lg for lg in logs), logs
+        got = {}
+        for x in open(os.path.join(mh_cfg["output_dir"], "losses.jsonl")):
+            rec = json.loads(x)
+            got[rec["step"]] = rec["loss"]
+        assert sorted(got) == [1, 2, 3, 4, 5, 6], (got, outs, logs)
+        assert np.allclose([got[s] for s in range(1, 7)], ref,
+                           rtol=1e-5, atol=1e-5), (got, ref)
+        # the sharded checkpoint has shards AND shard maps from BOTH
+        # processes
+        ck = os.path.join(mh_cfg["output_dir"], "ckpt_step6")
+        files = os.listdir(ck)
+        assert any(".r0." in f for f in files) \
+            and any(".r1." in f for f in files), files
+        assert "metadata.json.r0" in files and "metadata.json.r1" in files
+
+
 class TestElasticScaleUpAndHold:
     """r5 (VERDICT r4 weak #7): real elastic semantics — a JOIN claims a
     free heartbeat slot and triggers a scale-up relaunch that includes the
