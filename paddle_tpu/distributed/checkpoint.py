@@ -109,12 +109,17 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
         # EVERY rank records its own shard map: a multi-process save has
         # shards only THIS process can see, so a single coordinator meta
         # would silently omit every other rank's files and a later load
-        # would zero-fill their regions. load_state_dict unions the
-        # per-rank metas. The legacy single metadata.json stays for
-        # single-process checkpoints (and old artifacts).
-        with open(os.path.join(path, f"{_META}.r{rank}"), "w") as f:
-            json.dump(meta, f)
-        if rank == coordinator_rank:
+        # would zero-fill their regions. load_state_dict unions exactly
+        # world_size per-rank metas (as recorded by rank 0's meta), so a
+        # stale meta.r{k} from an earlier larger-topology save into the
+        # same directory is ignored. The legacy single metadata.json is
+        # written ONLY single-process — multi-process it would list just
+        # this rank's shards, a silent-corruption trap for any consumer
+        # reading it directly.
+        if jax.process_count() > 1:
+            with open(os.path.join(path, f"{_META}.r{rank}"), "w") as f:
+                json.dump(meta, f)
+        else:
             with open(os.path.join(path, _META), "w") as f:
                 json.dump(meta, f)
 
@@ -153,22 +158,21 @@ def _read_overlap(saved_shards, path, t_offs, t_exts, dtype):
 
 def _load_meta(path: str) -> dict:
     """Union the per-rank shard maps when present (multi-process saves);
-    fall back to the legacy single metadata.json."""
-    import glob
-    per_rank = sorted(glob.glob(os.path.join(path, f"{_META}.r*")))
-    if not per_rank:
+    fall back to the legacy single metadata.json. Rank 0's meta records
+    the save's world_size, and exactly ranks [0, world_size) are unioned
+    — a stale meta.r{k} left behind by an earlier LARGER-topology save
+    into the same directory must not leak old shard data into the load."""
+    r0 = os.path.join(path, f"{_META}.r0")
+    if not os.path.exists(r0):
         with open(os.path.join(path, _META)) as f:
             return json.load(f)
-    meta = None
-    for p in per_rank:
-        with open(p) as f:
+    with open(r0) as f:
+        meta = json.load(f)
+    for rank in range(1, int(meta.get("world_size", 1))):
+        with open(os.path.join(path, f"{_META}.r{rank}")) as f:
             m = json.load(f)
-        if meta is None:
-            meta = m
-            continue
         for key, entry in m["tensors"].items():
-            tgt = meta["tensors"].setdefault(
-                key, {**entry, "shards": []})
+            tgt = meta["tensors"].setdefault(key, {**entry, "shards": []})
             seen = {tuple(s["offsets"]) + tuple(s["shape"])
                     for s in tgt["shards"]}
             for s in entry["shards"]:

@@ -385,8 +385,10 @@ def run(cfg: dict) -> int:
                 or (step + 1) == cfg["max_steps"] or stop["sig"]):
             save(step + 1)
         if stop["sig"]:
-            print("[run_pretrain] SIGTERM: emergency checkpoint done",
-                  flush=True)
+            print("[run_pretrain] SIGTERM: emergency checkpoint done"
+                  if cfg["save_interval"] > 0 else
+                  "[run_pretrain] SIGTERM: exiting (checkpoints disabled "
+                  "by save_interval<=0 — nothing saved)", flush=True)
             return 0
     print(f"[run_pretrain] done at step {cfg['max_steps']}", flush=True)
     return 0

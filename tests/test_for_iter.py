@@ -75,6 +75,24 @@ class TestForIterConcrete:
         assert float(sf(paddle.to_tensor([0.0])).sum()) == 20.0
 
 
+class TestForIterConcreteNested:
+    def test_enumerate_of_zip_of_tensors(self):
+        # enumerate(zip(t, u)): the zip OBJECT is the enumerate component
+        # (not a Tensor), so the concrete path iterates it — which under
+        # trace unrolls through Tensor.__iter__ over the static leading
+        # axis. Exact python semantics either way.
+        def f(a, b):
+            acc = paddle.to_tensor(0.0)
+            for i, (u, v) in enumerate(zip(a, b)):
+                acc = acc + u * v + i
+            return acc
+
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        b = paddle.to_tensor(np.array([10.0, 20.0], np.float32))
+        sf = paddle.jit.to_static(f)
+        assert float(sf(a, b)) == float(f(a, b)) == 51.0
+
+
 class TestForIterTensor:
     def test_tensor_iteration_parity(self):
         def f(t, x):
