@@ -47,6 +47,12 @@ the min length, python semantics; mixed tensor+python zips raise a clear
 TypeError under trace). ``enumerate``/``zip`` are treated structurally
 only when not shadowed by a local binding.
 
+``while``/``for`` ELSE clauses are captured (r5): without a loop-level
+break the else body simply follows the loop; with one, an ``_elseok``
+flag cleared on every loop-level break guards the else, so a TRACED
+break predicate turns the else into a lax.cond. Exact python semantics
+on both paths, all loop forms (while / for-range / for-iterable).
+
 ``nonlocal``/``global`` are contained PER-SITE (r5): names written
 through a cell or the module dict anywhere in the function make only the
 statements that would THREAD those names fall back (threading by value
@@ -930,6 +936,81 @@ def _rewrite_break_continue(node: ast.While, uid: int):
     return pre, new_node, True
 
 
+def _has_loop_level_break(stmts) -> bool:
+    class V(ast.NodeVisitor):
+        found = False
+
+        def __init__(self):
+            self._depth = 0
+
+        def visit_Break(self, n):
+            if self._depth == 0:
+                self.found = True
+
+        def visit_While(self, n):
+            # the body belongs to the INNER loop, but a break in the
+            # else clause targets the ENCLOSING loop (python scoping)
+            self._depth += 1
+            for s in n.body:
+                self.visit(s)
+            self._depth -= 1
+            for s in n.orelse:
+                self.visit(s)
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _rewrite_breaks_clear_flag(stmts, flag: str):
+    """Prefix every loop-LEVEL break with ``<flag> = False`` (nested
+    loops own their body breaks — but a break in a nested loop's ELSE
+    clause targets the enclosing loop, python scoping)."""
+    class B(ast.NodeTransformer):
+        def __init__(self):
+            self._depth = 0
+
+        def _block(self, stmts_):
+            out = []
+            for s in stmts_:
+                r = self.visit(s)
+                out.extend(r if isinstance(r, list) else [r])
+            return out
+
+        def visit_Break(self, n):
+            if self._depth == 0:
+                return [ast.Assign(targets=[_ns(flag)],
+                                   value=ast.Constant(False)),
+                        ast.Break()]
+            return n
+
+        def visit_While(self, n):
+            self._depth += 1
+            n.body = self._block(n.body)
+            self._depth -= 1
+            n.orelse = self._block(n.orelse)
+            return n
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            return n
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    return B()._block(stmts)
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
@@ -1034,15 +1115,45 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.applied += 1
         return [tdef, fdef, _unpack(written, call)]
 
+    def _desugar_loop_orelse(self, node):
+        """``while``/``for`` with an ``else`` clause (r5, reference
+        LoopTransformer parity): python runs the else body iff the loop
+        exits through its condition/iterator rather than a break. Without
+        a loop-level break the else body simply follows the loop; with
+        one, an ``_elseok`` flag is cleared on every loop-level break and
+        guards the else — the pieces then convert like any other loop +
+        if (the flag becomes carried state, so a TRACED break flag makes
+        the else a lax.cond). Exact python semantics either way."""
+        core = (ast.While(test=node.test, body=list(node.body), orelse=[])
+                if isinstance(node, ast.While)
+                else ast.For(target=node.target, iter=node.iter,
+                             body=list(node.body), orelse=[]))
+        if not _has_loop_level_break(node.body):
+            return [core] + list(node.orelse)
+        flag = f"_elseok_{self._uid()}"
+        core.body = _rewrite_breaks_clear_flag(core.body, flag)
+        return [ast.Assign(targets=[_ns(flag)], value=ast.Constant(True)),
+                core,
+                ast.If(test=_n(flag), body=list(node.orelse), orelse=[])]
+
+    def _visit_desugared(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
     def visit_While(self, node: ast.While):
+        if node.orelse:
+            return self._visit_desugared(self._desugar_loop_orelse(node))
         pre = []
-        if not node.orelse and not _has_walrus(node.test):
+        if not _has_walrus(node.test):
             # loop-level break/continue -> flag rewrite (reference
             # BreakContinueTransformer) BEFORE the recursive pass, so the
             # generated guard ifs get converted like any other
             pre, node, _ = _rewrite_break_continue(node, self._uid())
         node = self.generic_visit(node)
-        if (node.orelse or _has_walrus(node.test)
+        if (_has_walrus(node.test)
                 or not _branch_ok(node.body, is_loop_body=True)):
             return pre + [node] if pre else node
         written = _written_names(node.body)
@@ -1120,7 +1231,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                             for a in node.iter.args))
 
     def visit_For(self, node: ast.For):
-        if (not node.orelse and not _has_walrus(node.iter)
+        if node.orelse:
+            return self._visit_desugared(self._desugar_loop_orelse(node))
+        if (not _has_walrus(node.iter)
                 and self._is_builtin_range_for(node)
                 and any(_stmt_may_flag(s) for s in node.body)
                 and not _return_in_unsupported([node])):
@@ -1132,7 +1245,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 out.extend(r if isinstance(r, list) else [r])
             return out
         node = self.generic_visit(node)
-        if (node.orelse or _has_walrus(node.iter)
+        if (_has_walrus(node.iter)
                 or not _branch_ok(node.body, is_loop_body=True)):
             return node
         if self._is_builtin_range_for(node):

@@ -890,6 +890,139 @@ class TestSuppressedRaiseUnderWith:
         assert float(c(paddle.to_tensor([10.0]), q=False).sum()) == 11.0
 
 
+class TestLoopElse:
+    """r5: while/for ... else capture (LoopTransformer parity). Without a
+    loop-level break the else body follows the loop; with one, an
+    _elseok flag guards it — under a traced break predicate the guard
+    lowers to lax.cond with the flag as carried state."""
+
+    def test_while_else_no_break(self):
+        def f(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                while i < n:
+                    i = i + 1
+                    s = s + 2.0
+                else:
+                    s = s + 100.0
+            return s
+
+        sf = paddle.jit.to_static(f)
+        n = paddle.to_tensor(3)
+        assert float(sf(n)) == float(f(n)) == 106.0
+
+    def test_while_else_skipped_on_tensor_break(self):
+        def f(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                while i < n:
+                    i = i + 1
+                    s = s + 1.0
+                    if s > 2.0:      # tensor predicate -> traced break
+                        break
+                else:
+                    s = s + 100.0
+            return s
+
+        sf = paddle.jit.to_static(f)
+        # breaks at s=3 -> else skipped
+        n = paddle.to_tensor(10)
+        assert float(sf(n)) == float(f(n)) == 3.0
+        # loop exhausts at s=2 -> else runs
+        n2 = paddle.to_tensor(2)
+        assert float(sf(n2)) == float(f(n2)) == 102.0
+
+    def test_for_range_else_with_break(self):
+        def f(x):
+            total = x * 0.0
+            for i in range(5):
+                total = total + 1.0
+                if total.sum() > 2.5:    # tensor predicate -> traced break
+                    break
+            else:
+                total = total + 100.0
+            return total
+
+        sf = paddle.jit.to_static(f)
+        a = paddle.to_tensor([0.0])
+        assert float(sf(a).sum()) == float(f(a).sum()) == 3.0
+
+    def test_for_iter_else(self):
+        def f(t):
+            acc = paddle.to_tensor(0.0)
+            for row in t:
+                acc = acc + row.sum()
+            else:
+                acc = acc + 100.0
+            return acc
+
+        t = paddle.to_tensor(np.ones((3, 2), np.float32))
+        sf = paddle.jit.to_static(f)
+        assert float(sf(t)) == float(f(t)) == 106.0
+
+    def test_break_in_inner_loop_else_targets_outer(self):
+        # review r5: a break inside an INNER loop's else clause belongs
+        # to the OUTER loop (python scoping) — the outer else must be
+        # guarded by it
+        def f(x):
+            s = x * 0.0
+            i = 0
+            while i < 3:
+                i = i + 1
+                s = s + 1.0
+                for j in range(2):
+                    s = s + 0.0
+                else:
+                    break
+            else:
+                s = s + 100.0
+            return s
+
+        sf = paddle.jit.to_static(f)
+        a = paddle.to_tensor([0.0])
+        assert float(sf(a).sum()) == float(f(a).sum()) == 1.0
+
+    def test_bare_loop_level_break_with_else(self):
+        # review r5: a break as a DIRECT body statement must not produce
+        # a nested-list AST (silent conversion fallback)
+        def f(n):
+            with paddle.no_grad():
+                i = paddle.to_tensor(0)
+                s = paddle.to_tensor(0.0)
+                while i < n:
+                    i = i + 1
+                    s = s + 1.0
+                    break
+                else:
+                    s = s + 100.0
+            return s
+
+        sf = paddle.jit.to_static(f)
+        n = paddle.to_tensor(5)
+        assert float(sf(n)) == float(f(n)) == 1.0
+        z = paddle.to_tensor(0)
+        assert float(sf(z)) == float(f(z)) == 100.0
+
+    def test_for_list_else_break_concrete(self):
+        def f(x):
+            acc = x * 0.0
+            k = 0
+            for v in [1.0, 2.0, 3.0]:
+                acc = acc + v
+                k = k + 1
+                if k > 2:    # python predicate: concrete even under trace
+                    break
+            else:
+                acc = acc + 100.0
+            return acc
+
+        sf = paddle.jit.to_static(f)
+        a = paddle.to_tensor([0.0])
+        assert float(sf(a).sum()) == float(f(a).sum()) == 6.0
+
+
 class TestForRangeBreakContinue:
     """for-range bodies with break/continue: desugared to the canonical
     while so the flag rewrite + lax lowering apply (round-4)."""

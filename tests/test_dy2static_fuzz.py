@@ -101,6 +101,10 @@ def _gen_program(rng: random.Random, idx: int) -> str:
         lines.append(f"{ind}        {'break' if rng.random() < 0.5 else 'continue'}")
     for _ in range(rng.randrange(1, 3)):
         lines.extend(body_stmt(ind + "    "))
+    # optional loop-else clause (r5 capture: runs unless a break fired)
+    if rng.random() < 0.3:
+        lines.append(f"{ind}else:")
+        lines.append(f"{ind}    s = s + 50.0")
     # optional early-return epilogue
     if rng.random() < 0.4:
         lines.append(f"{ind}if s.sum() > {rng.randrange(2, 10)}.0:")
