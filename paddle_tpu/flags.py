@@ -152,6 +152,12 @@ define_flag("FLAGS_paged_impl", "intree",
             "gather composite)",
             validator=lambda v: v in ("intree", "intree_v1", "bundled",
                                       "reference"))
+define_flag("FLAGS_mla_decode_impl", "auto",
+            "MLA absorbed-latent decode attention: 'auto' (fused "
+            "single-cache-read kernel ops/pallas_mla.py when the latent "
+            "rank is lane-aligned, einsum otherwise), 'fused' (pin the "
+            "kernel), or 'xla' (pin the two-einsum composite)",
+            validator=lambda v: v in ("auto", "fused", "xla"))
 define_flag("FLAGS_gmm_impl", "auto",
             "grouped-GEMM (MoE expert compute): 'auto' (fastest-first: "
             "ragged_dot -> in-tree ops/pallas_gmm.py -> bundled "

@@ -512,6 +512,12 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
     r = cfg.kv_lora_rank
     eps = cfg.rms_norm_eps
     from .models.llama import apply_rope
+    # the impl flag is pinned at BODY-CONSTRUCTION time: jax.jit traces
+    # lazily at first call, and _DECODE_LOOP_CACHE keys on the flag as
+    # read when the loop is built — a trace-time read could cache the
+    # other impl's program under this key (review r5)
+    from .flags import flag
+    impl = flag("FLAGS_mla_decode_impl")
 
     def rms(h, w):
         var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
@@ -526,6 +532,11 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
         q_pos = start + jnp.arange(S)
         vis = pos_k[None, :] <= q_pos[:, None]            # [S, max_len]
         scale = 1.0 / float(np.sqrt(dn + dr))
+        use_fused = False
+        if S == 1 and impl != "xla":
+            from .ops import pallas_mla
+            use_fused = (impl == "fused"
+                         or pallas_mla.mla_kernel_eligible(nh, r, dr))
         new_caches = []
         sts = moe_static or (None,) * len(w["layers"])
         for L, (c_lat, c_pe), st in zip(w["layers"], caches, sts):
@@ -550,12 +561,22 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
             w_k, w_v = wkb[..., :dn], wkb[..., dn:]
             # absorb W_k onto the query: score = q_eff . latent + q_pe . k_pe
             q_eff = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_k)
-            scores = (jnp.einsum("bsnr,btr->bnst", q_eff, c_lat)
-                      + jnp.einsum("bsnd,btd->bnst", q_pe, c_pe)) * scale
-            scores = jnp.where(vis[None, None], scores.astype(jnp.float32),
-                               -1e30)
-            aw = jax.nn.softmax(scores, axis=-1).astype(c_lat.dtype)
-            o_lat = jnp.einsum("bnst,btr->bsnr", aw, c_lat)
+            if use_fused:
+                # single-read fused decode: each latent-cache byte feeds
+                # the score AND the output from one VMEM tile (the XLA
+                # einsum pair below reads c_lat twice across the softmax
+                # barrier — the measured 0.09 roofline residual)
+                lens = jnp.full((B,), start + 1, jnp.int32)
+                o_lat = pallas_mla.mla_decode_attention(
+                    q_eff[:, 0], q_pe[:, 0], c_lat, c_pe, lens,
+                    scale=scale)[:, None]
+            else:
+                scores = (jnp.einsum("bsnr,btr->bnst", q_eff, c_lat)
+                          + jnp.einsum("bsnd,btd->bnst", q_pe, c_pe)) * scale
+                scores = jnp.where(vis[None, None],
+                                   scores.astype(jnp.float32), -1e30)
+                aw = jax.nn.softmax(scores, axis=-1).astype(c_lat.dtype)
+                o_lat = jnp.einsum("bnst,btr->bsnr", aw, c_lat)
             o = jnp.einsum("bsnr,rnv->bsnv", o_lat, w_v)
             x = x + o.reshape(B, S, nh * dv) @ L["wo"]
             h2 = rms(x, L["ln2"])
@@ -730,8 +751,13 @@ def _make_decode_loop(p, S0: int, max_new_tokens: int,
                getattr(cfg, "qk_nope_head_dim", 0),
                getattr(cfg, "qk_rope_head_dim", 0),
                getattr(cfg, "v_head_dim", 0))
+    from .flags import flag
     prog_key = (cfg_key, S0, max_new_tokens, decode_strategy, top_k,
-                top_p, temperature, eos_token_id, pad_token_id)
+                top_p, temperature, eos_token_id, pad_token_id,
+                # trace-time flags that shape the step body: a flipped
+                # impl flag must MISS, not return the other impl's
+                # compiled program (gmm routes the MoE prefill experts)
+                flag("FLAGS_mla_decode_impl"), flag("FLAGS_gmm_impl"))
     jitted = _DECODE_LOOP_CACHE.get(prog_key)
     if jitted is None:
         if len(_DECODE_LOOP_CACHE) >= 32:

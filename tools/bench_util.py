@@ -21,10 +21,13 @@ def fetch(out):
     np.asarray(leaf[(0,) * leaf.ndim])
 
 
-def timeit(fn, *args, reps: int = 20) -> float:
-    """Seconds per call, steady-state (one warmup/compile call first)."""
-    out = fn(*args)
-    fetch(out)
+def timeit(fn, *args, reps: int = 20, warmup: bool = True) -> float:
+    """Seconds per call, steady-state (one warmup/compile call first;
+    pass warmup=False for an already-compiled+warm fn whose single call
+    dominates wall-clock, e.g. whole decode loops at reps=1)."""
+    if warmup:
+        out = fn(*args)
+        fetch(out)
     t0 = time.time()
     for _ in range(reps):
         out = fn(*args)
@@ -32,7 +35,8 @@ def timeit(fn, *args, reps: int = 20) -> float:
     return (time.time() - t0) / reps
 
 
-def ab_rounds(kernels, rounds: int = 3, reps: int = 20):
+def ab_rounds(kernels, rounds: int = 3, reps: int = 20,
+              warmup: bool = True):
     """Same-run interleaved A/B: each round times every kernel once, so
     all contenders see the same tunnel/chip conditions drift. `kernels`
     is {name: (fn, args_tuple)}. Returns {name: [t_round0, ...]} seconds.
@@ -42,7 +46,8 @@ def ab_rounds(kernels, rounds: int = 3, reps: int = 20):
     runs = {name: [] for name in kernels}
     for _ in range(rounds):
         for name, (fn, args) in kernels.items():
-            runs[name].append(timeit(fn, *args, reps=reps))
+            runs[name].append(timeit(fn, *args, reps=reps,
+                                     warmup=warmup))
     return runs
 
 
