@@ -381,6 +381,12 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
                  cfg.head_dim)
     eps = cfg.rms_norm_eps
     from .models.llama import apply_rope
+    from .flags import flag, flags_guard
+    # prefill routes through sdpa, whose kernel choice reads
+    # FLAGS_flash_impl at trace time — pin it at construction so the
+    # program matches _DECODE_LOOP_CACHE's key (same lazy-trace hazard
+    # as the mla impl flag, review r5)
+    flash_impl = flag("FLAGS_flash_impl")
 
     def rms(h, w):
         var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
@@ -412,7 +418,20 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
             cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
             new_caches.append((ck, cv))
             rep = Hh // KV
-            if rep > 1:
+            if S > 1 and isinstance(start, int) and start == 0:
+                # prefill-from-zero: the cache holds nothing but this
+                # window, so attend causally over the fresh k/v through
+                # the flash route — the dense path below materializes
+                # [*, S, max_len] f32 scores, which both OOMs long
+                # contexts and wastes the (max_len - S) masked columns
+                # (same routing as the buffer-model forward)
+                from .ops.flash_attention import sdpa
+                kr = jnp.repeat(k, rep, 2) if rep > 1 else k
+                vr = jnp.repeat(v, rep, 2) if rep > 1 else v
+                with flags_guard(flash_impl=flash_impl):
+                    o = sdpa(q, kr, vr,
+                             causal=True).reshape(B, S, Hh * D)
+            elif rep > 1:
                 # GQA WITHOUT materializing jnp.repeat of the cache: the
                 # repeat wrote+read rep x the KV bytes per step — at the
                 # MoE serving shape (16q/4kv, 8 layers) that was ~0.8 GB
@@ -455,6 +474,8 @@ def _gpt_cached_step_body(cfg, max_len: int):
     bias, fused qkv, GELU MLP; MHA cache (KV heads == q heads)."""
     nh, hd = cfg.num_attention_heads, cfg.head_dim
     eps = cfg.layer_norm_eps
+    from .flags import flag, flags_guard
+    flash_impl = flag("FLAGS_flash_impl")   # see _llama_cached_step_body
 
     def ln(h, wt, b):
         h32 = h.astype(jnp.float32)
@@ -481,11 +502,18 @@ def _gpt_cached_step_body(cfg, max_len: int):
             ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
             new_caches.append((ck, cv))
-            scores = jnp.einsum("bshd,bthd->bhst", q, ck) * (hd ** -0.5)
-            scores = jnp.where(vis[None, None],
-                               scores.astype(jnp.float32), -1e30)
-            aw = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-            o = jnp.einsum("bhst,bthd->bshd", aw, cv).reshape(B, S, -1)
+            if S > 1 and isinstance(start, int) and start == 0:
+                # flash prefill — see _llama_cached_step_body
+                from .ops.flash_attention import sdpa
+                with flags_guard(flash_impl=flash_impl):
+                    o = sdpa(q, k, v, causal=True).reshape(B, S, -1)
+            else:
+                scores = jnp.einsum("bshd,bthd->bhst", q, ck) \
+                    * (hd ** -0.5)
+                scores = jnp.where(vis[None, None],
+                                   scores.astype(jnp.float32), -1e30)
+                aw = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+                o = jnp.einsum("bhst,bthd->bshd", aw, cv).reshape(B, S, -1)
             x = x + (o @ L["wo"] + L["bo"])
             h2 = ln(x, L["ln2w"], L["ln2b"])
             x = x + (jax.nn.gelu(h2 @ L["wi"] + L["bi"],
@@ -516,8 +544,9 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
     # lazily at first call, and _DECODE_LOOP_CACHE keys on the flag as
     # read when the loop is built — a trace-time read could cache the
     # other impl's program under this key (review r5)
-    from .flags import flag
+    from .flags import flag, flags_guard
     impl = flag("FLAGS_mla_decode_impl")
+    flash_impl = flag("FLAGS_flash_impl")   # see _llama_cached_step_body
 
     def rms(h, w):
         var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
@@ -557,6 +586,27 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
             c_pe = jax.lax.dynamic_update_slice(c_pe, k_pe, (0, start, 0))
             new_caches.append((c_lat, c_pe))
 
+            if S > 1 and isinstance(start, int) and start == 0:
+                # prefill-from-zero in the NON-absorbed form (k/v heads
+                # materialized once — reassociation of the same math) so
+                # the flash route applies; the absorbed dense path below
+                # materializes [B,nh,S,max_len] f32 scores, which OOMs
+                # long-context prefill (matches models/deepseek.py
+                # forward, incl. the padded-head route for dv != dn+dr)
+                from .ops.flash_attention import sdpa_padded_heads
+                kv = (lat @ L["wkvb"]).reshape(B, S, nh, dn + dv)
+                k_h = jnp.concatenate(
+                    [kv[..., :dn],
+                     jnp.broadcast_to(k_pe[:, :, None, :], (B, S, nh, dr))],
+                    -1)
+                q_h = jnp.concatenate([q_nope, q_pe], -1)
+                with flags_guard(flash_impl=flash_impl):
+                    o_v = sdpa_padded_heads(q_h, k_h, kv[..., dn:],
+                                            causal=True, scale=scale)
+                x = x + o_v.reshape(B, S, nh * dv) @ L["wo"]
+                h2 = rms(x, L["ln2"])
+                x = x + _ffn_apply(L, h2, st)
+                continue
             wkb = L["wkvb"].reshape(r, nh, dn + dv)
             w_k, w_v = wkb[..., :dn], wkb[..., dn:]
             # absorb W_k onto the query: score = q_eff . latent + q_pe . k_pe
@@ -621,10 +671,21 @@ def _init_caches(p, B: int, total: int):
 
 def _make_cached_step(p, max_len: int):
     """Jitted cached step: one compile per distinct step width (prefill
-    S0, decode 1). Weights ride as jit arguments (see _llama_weights)."""
+    S0, decode 1). Weights ride as jit arguments (see _llama_weights).
+    A multi-token call at start=0 pins start STATICALLY so the body can
+    take the flash prefill route (O(S) memory) instead of the dense
+    [S, max_len] score path; decode keeps start traced (no retrace per
+    position)."""
     w = _llama_weights(p)
-    jitted = jax.jit(_cached_step_body(p, max_len))
-    return lambda ids, caches, start: jitted(w, ids, caches, start)
+    body = _cached_step_body(p, max_len)
+    jit_dec = jax.jit(body)
+    jit_pre = jax.jit(lambda w, ids, caches: body(w, ids, caches, 0))
+
+    def call(ids, caches, start):
+        if ids.shape[1] > 1 and isinstance(start, int) and start == 0:
+            return jit_pre(w, ids, caches)
+        return jit_dec(w, ids, caches, start)
+    return call
 
 
 def generate_cached(model, input_ids, max_new_tokens: int = 20,
@@ -757,7 +818,8 @@ def _make_decode_loop(p, S0: int, max_new_tokens: int,
                 # trace-time flags that shape the step body: a flipped
                 # impl flag must MISS, not return the other impl's
                 # compiled program (gmm routes the MoE prefill experts)
-                flag("FLAGS_mla_decode_impl"), flag("FLAGS_gmm_impl"))
+                flag("FLAGS_mla_decode_impl"), flag("FLAGS_gmm_impl"),
+                flag("FLAGS_flash_impl"))
     jitted = _DECODE_LOOP_CACHE.get(prog_key)
     if jitted is None:
         if len(_DECODE_LOOP_CACHE) >= 32:

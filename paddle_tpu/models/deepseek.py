@@ -138,9 +138,19 @@ class MLAttention(nn.Layer):
             qh = jnp.concatenate([q_nope, q_pe], -1)
             kh = jnp.concatenate([k_nope, k_pe], -1)
 
-            if dv == dn + dr and c.use_flash_attention and mask is None:
-                from ..ops.flash_attention import sdpa
-                o = sdpa(qh, kh, v, causal=True)
+            if c.use_flash_attention and mask is None:
+                if dv == dn + dr:
+                    from ..ops.flash_attention import sdpa
+                    o = sdpa(qh, kh, v, causal=True)
+                else:
+                    # real DeepSeek geometry (dv != dn+dr, e.g. 128 vs
+                    # 192): zero-pad heads to the lane so the O(S) flash
+                    # route applies — the dense path below OOMs
+                    # long-context prefill on [B,nh,S,S] f32 scores
+                    from ..ops.flash_attention import sdpa_padded_heads
+                    o = sdpa_padded_heads(
+                        qh, kh, v, causal=True,
+                        scale=float(dn + dr) ** -0.5)
             else:
                 scale = 1.0 / float(jnp.sqrt(jnp.float32(dn + dr)))
                 scores = jnp.einsum("bsnd,btnd->bnst", qh, kh) * scale

@@ -189,6 +189,29 @@ def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
                           dropout_p=dropout_p, scale=scale)
 
 
+def sdpa_padded_heads(q, k, v, *, causal: bool = True,
+                      scale: Optional[float] = None):
+    """SDPA for MLA-geometry heads where the q/k head dim differs from
+    the v head dim (DeepSeek: dn+dr=192 vs dv=128) and neither is
+    lane-aligned for the flash gate. Zero-pads q/k AND v to the next
+    128-multiple — exactly score- and output-preserving (padded q/k dims
+    contribute 0 to every logit; padded v dims emit 0s that are sliced
+    off) — so the O(S) flash kernel applies instead of the O(S^2) f32
+    score composite that OOMs long-context prefill. The scale MUST be
+    the caller's true 1/sqrt(d_qk); the default uses q's unpadded dim."""
+    D, Dv = q.shape[-1], v.shape[-1]
+    if scale is None:
+        scale = D ** -0.5
+    Dp = -(-max(D, Dv) // 128) * 128
+    if D != Dp:
+        pad = [(0, 0)] * (q.ndim - 1) + [(0, Dp - D)]
+        q, k = jnp.pad(q, pad), jnp.pad(k, pad)
+    if Dv != Dp:
+        v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(0, Dp - Dv)])
+    out = sdpa(q, k, v, causal=causal, scale=scale)
+    return out[..., :Dv]
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, name=None):
     """paddle.nn.functional.flash_attention.flash_attention parity wrapper."""

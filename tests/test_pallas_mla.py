@@ -135,6 +135,12 @@ class TestDecodeIntegration:
                               1.0, None, 0)
         assert len(_DECODE_LOOP_CACHE) == 2, \
             "flag flip must be a program-cache MISS"
+        # the flash-impl flag shapes the prefill program the same way
+        with flags_guard(mla_decode_impl="xla", flash_impl="composite"):
+            _make_decode_loop(p, 4, 2, "greedy_search", None, None,
+                              1.0, None, 0)
+        assert len(_DECODE_LOOP_CACHE) == 3, \
+            "flash-impl flip must be a program-cache MISS"
 
     def test_compiled_fused_matches_xla_tokens(self, model):
         from paddle_tpu.generation import generate_compiled
