@@ -150,14 +150,6 @@ def _llama_decode_params(model, weight_only_int8: bool = False):
         raise NotImplementedError(
             "use_cache generation supports the unfused Llama layout; the "
             "fused qkv/ffn packs are pretrain perf knobs")
-    def q8(d, key):
-        if not weight_only_int8:
-            return
-        from .ops.quant import weight_quantize
-        qw, sc = weight_quantize(d.pop(key))
-        d[key + "_q"] = qw
-        d[key + "_s"] = sc.astype(jnp.float32)
-
     layers = []
     for lyr in inner.layers:
         a, m = lyr.self_attn, lyr.mlp
@@ -173,7 +165,7 @@ def _llama_decode_params(model, weight_only_int8: bool = False):
             d["bk"] = a.k_proj.bias._data
             d["bv"] = a.v_proj.bias._data
         for k in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
-            q8(d, k)
+            _q8(d, k, weight_only_int8)
         layers.append(d)
     head = model.lm_head.weight._data if model.lm_head is not None else None
     p = dict(cfg=cfg, family="llama",
@@ -181,7 +173,7 @@ def _llama_decode_params(model, weight_only_int8: bool = False):
              layers=layers, norm=inner.norm.weight._data, head=head,
              cos=inner.rope_cos._data, sin=inner.rope_sin._data)
     if weight_only_int8 and head is not None:
-        q8(p, "head")
+        _q8(p, "head")
         p["head"] = None
     return p
 
@@ -207,12 +199,35 @@ def _gpt_decode_params(model):
                 normb=gpt.ln_f.bias._data, head=head)
 
 
-def _mlp_params(lyr):
+def _q8(d, key, enabled: bool = True):
+    """Quantize d[key] in place to (int8, per-out-channel f32 scale) —
+    the weight-only deploy transform shared by every decode family. 3-D
+    expert stacks [E, K, N] quantize per expert (vmapped absmax) with
+    scales [E, N]; None entries and disabled calls are no-ops."""
+    if not enabled or d.get(key) is None:
+        return
+    from .ops.quant import weight_quantize
+    w = d.pop(key)
+    if w.ndim == 3:
+        qw, sc = jax.vmap(weight_quantize)(w)
+    else:
+        qw, sc = weight_quantize(w)
+    d[key + "_q"] = qw
+    d[key + "_s"] = sc.astype(jnp.float32)
+
+
+def _mlp_params(lyr, weight_only_int8: bool = False):
     """Per-layer FFN weights: (weight dict, static routing knobs or None).
     Dense SwiGLU (llama layout) or routed MoE (dropless per-token routing —
     serving never drops tokens; the capacity factor is a training
     regularizer, ref fused MoE serving kernels). Static knobs must stay out
-    of the weight tree: it rides through jit as arguments."""
+    of the weight tree: it rides through jit as arguments.
+
+    ``weight_only_int8`` quantizes the dense ffn, the per-expert stacks
+    (per-expert out-channel scales) and the shared expert; the ROUTER
+    gate stays fp — it is tiny and routing decisions are
+    precision-sensitive (a flipped top-k is a different program, not a
+    rounding error)."""
     m = lyr.mlp
     from .incubate.moe import MoELayer
     if isinstance(m, MoELayer):
@@ -228,22 +243,31 @@ def _mlp_params(lyr):
                 "diverge from generate() near capacity overflow. Exactness "
                 "vs the buffer path holds for moe_dropless=True models.",
                 stacklevel=3)
-        d = dict(moe=dict(
-            gate=m.gate_weight._data,
-            wge=m.w_gate._data if m.w_gate is not None else None,
-            wup=m.w_up._data, wdn=m.w_down._data))
+        mo = dict(gate=m.gate_weight._data,
+                  wge=m.w_gate._data if m.w_gate is not None else None,
+                  wup=m.w_up._data, wdn=m.w_down._data)
+        for k in ("wge", "wup", "wdn"):
+            _q8(mo, k, weight_only_int8)
         if m.shared_up is not None:
-            d["moe"]["shared"] = dict(sg=m.shared_gate.weight._data,
-                                      su=m.shared_up.weight._data,
-                                      sd=m.shared_down.weight._data)
-        return d, dict(top_k=m.top_k, renorm=m.renormalize)
-    return dict(wg=m.gate_proj.weight._data, wu=m.up_proj.weight._data,
-                wd=m.down_proj.weight._data), None
+            sh = dict(sg=m.shared_gate.weight._data,
+                      su=m.shared_up.weight._data,
+                      sd=m.shared_down.weight._data)
+            for k in ("sg", "su", "sd"):
+                _q8(sh, k, weight_only_int8)
+            mo["shared"] = sh
+        return dict(moe=mo), dict(top_k=m.top_k, renorm=m.renormalize)
+    d = dict(wg=m.gate_proj.weight._data, wu=m.up_proj.weight._data,
+             wd=m.down_proj.weight._data)
+    for k in ("wg", "wu", "wd"):
+        _q8(d, k, weight_only_int8)
+    return d, None
 
 
-def _moe_decode_params(model):
+def _moe_decode_params(model, weight_only_int8: bool = False):
     """MoEForCausalLM (Qwen2-MoE/DeepSeekMoE pattern): llama attention
-    backbone, per-layer dense-or-routed FFN."""
+    backbone, per-layer dense-or-routed FFN. ``weight_only_int8`` halves
+    the HBM weight reads (the expert stacks are the bulk of them) — see
+    _llama_decode_params."""
     inner = model.model
     cfg = model.config
     layers = []
@@ -255,19 +279,25 @@ def _moe_decode_params(model):
             wq=a.q_proj.weight._data, wk=a.k_proj.weight._data,
             wv=a.v_proj.weight._data, wo=a.o_proj.weight._data,
             ln2=lyr.post_attention_layernorm.weight._data)
-        mlp_w, mlp_st = _mlp_params(lyr)
+        for k in ("wq", "wk", "wv", "wo"):
+            _q8(d, k, weight_only_int8)
+        mlp_w, mlp_st = _mlp_params(lyr, weight_only_int8)
         d.update(mlp_w)
         layers.append(d)
         moe_static.append(mlp_st)
     head = model.lm_head.weight._data if model.lm_head is not None else None
-    return dict(cfg=cfg, family="moe",
-                embed=inner.embed_tokens.weight._data,
-                layers=layers, norm=inner.norm.weight._data, head=head,
-                cos=inner.rope_cos._data, sin=inner.rope_sin._data,
-                moe_static=tuple(moe_static))
+    p = dict(cfg=cfg, family="moe",
+             embed=inner.embed_tokens.weight._data,
+             layers=layers, norm=inner.norm.weight._data, head=head,
+             cos=inner.rope_cos._data, sin=inner.rope_sin._data,
+             moe_static=tuple(moe_static))
+    if weight_only_int8 and head is not None:
+        _q8(p, "head")
+        p["head"] = None
+    return p
 
 
-def _mla_decode_params(model):
+def _mla_decode_params(model, weight_only_int8: bool = False):
     """DeepSeekV2ForCausalLM: multi-head latent attention with the
     ABSORBED decode formulation — the KV cache stores only the normalized
     latent [r] + shared rope key [dr] per token, and kv_b is folded into
@@ -292,31 +322,43 @@ def _mla_decode_params(model):
             d["wqb"] = a.q_b_proj.weight._data
         else:
             d["wq"] = a.q_proj.weight._data
-        mlp_w, mlp_st = _mlp_params(lyr)
+        for k in ("wkva", "wkvb", "wo", "wqa", "wqb", "wq"):
+            if k in d:
+                _q8(d, k, weight_only_int8)
+        mlp_w, mlp_st = _mlp_params(lyr, weight_only_int8)
         d.update(mlp_w)
         layers.append(d)
         moe_static.append(mlp_st)
     head = model.lm_head.weight._data if model.lm_head is not None else None
-    return dict(cfg=cfg, family="mla",
-                embed=inner.embed_tokens.weight._data,
-                layers=layers, norm=inner.norm.weight._data, head=head,
-                cos=inner.rope_cos._data, sin=inner.rope_sin._data,
-                moe_static=tuple(moe_static))
+    p = dict(cfg=cfg, family="mla",
+             embed=inner.embed_tokens.weight._data,
+             layers=layers, norm=inner.norm.weight._data, head=head,
+             cos=inner.rope_cos._data, sin=inner.rope_sin._data,
+             moe_static=tuple(moe_static))
+    if weight_only_int8 and head is not None:
+        _q8(p, "head")
+        p["head"] = None
+    return p
 
 
-def _decode_params(model):
+def _decode_params(model, weight_only_int8: bool = False):
     """Family dispatch for the cached/compiled decode paths."""
     if getattr(model, "gpt", None) is not None:
+        if weight_only_int8:
+            raise NotImplementedError(
+                "weight_only_int8 decode covers the llama/MoE/MLA "
+                "families; the GPT family is fp (its fused-qkv + bias "
+                "layout is not wired through the quant matmul helper)")
         return _gpt_decode_params(model)
     inner = getattr(model, "model", None)
     if inner is not None:
         from .models.deepseek import DeepSeekV2Model
         from .models.moe_llm import MoEModel
         if isinstance(inner, DeepSeekV2Model):
-            return _mla_decode_params(model)
+            return _mla_decode_params(model, weight_only_int8)
         if isinstance(inner, MoEModel):
-            return _moe_decode_params(model)
-    return _llama_decode_params(model)
+            return _moe_decode_params(model, weight_only_int8)
+    return _llama_decode_params(model, weight_only_int8)
 
 
 def _llama_weights(p):
@@ -329,17 +371,28 @@ def _llama_weights(p):
             if k not in ("cfg", "family", "moe_static")}
 
 
+def _dq(d, key, dtype):
+    """Read an optionally-quantized weight entry WHOLE (for consumers
+    that reshape/slice it, e.g. the MLA kv_b or 3-D expert stacks, where
+    _mm_w's fused matmul shape doesn't apply): int8 layouts dequantize
+    in VMEM — the HBM read stays int8 and XLA fuses the scale multiply
+    into the consuming einsum. 3-D stacks carry per-(expert, out-channel)
+    scales [E, N]."""
+    if key + "_q" in d:
+        q, s = d[key + "_q"], d[key + "_s"].astype(dtype)
+        if q.ndim == 3:
+            return q.astype(dtype) * s[:, None, :]
+        return q.astype(dtype) * s
+    return d[key]
+
+
 def _mm_w(h, L, key):
     """Quant-aware matmul against a stored weight: weight-only int8
     layouts hold (key_q int8, key_s per-channel f32) and dequantize in
     VMEM right before the matmul (the HBM read is int8 — half the bf16
     bytes that bound decode); fp layouts hold the key directly. The ONE
     place both layouts' matmul goes through."""
-    if key + "_q" in L:
-        w8 = L[key + "_q"]
-        return h @ (w8.astype(h.dtype)
-                    * L[key + "_s"].astype(h.dtype)[None, :])
-    return h @ L[key]
+    return h @ _dq(L, key, h.dtype)
 
 
 def _ffn_apply(L, h2, st=None):
@@ -361,14 +414,16 @@ def _ffn_apply(L, h2, st=None):
     # decode steps (tiny T): every-expert dense compute beats the
     # sort+grouped-GEMM path (128-row tile padding) and is bitwise-equal
     ffn = dense_expert_ffn if T <= 32 else dropless_expert_ffn
-    y, _ = ffn(xt, gates, mo["wge"], mo["wup"], mo["wdn"],
+    dt = h2.dtype
+    y, _ = ffn(xt, gates, _dq(mo, "wge", dt),
+               _dq(mo, "wup", dt), _dq(mo, "wdn", dt),
                top_k=st["top_k"], renormalize=st["renorm"],
                activation="swiglu")
     y = y.reshape(B, S, H).astype(h2.dtype)
     if "shared" in mo:
         sh = mo["shared"]
-        s = jax.nn.silu(h2 @ sh["sg"]) * (h2 @ sh["su"])
-        y = y + s @ sh["sd"]
+        s = jax.nn.silu(h2 @ _dq(sh, "sg", dt)) * (h2 @ _dq(sh, "su", dt))
+        y = y + s @ _dq(sh, "sd", dt)
     return y
 
 
@@ -570,15 +625,15 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
         sts = moe_static or (None,) * len(w["layers"])
         for L, (c_lat, c_pe), st in zip(w["layers"], caches, sts):
             h = rms(x, L["ln1"])
-            if "wqa" in L:
-                q = rms(h @ L["wqa"], L["gq"]) @ L["wqb"]
+            if "wqa" in L or "wqa_q" in L:
+                q = _mm_w(rms(_mm_w(h, L, "wqa"), L["gq"]), L, "wqb")
             else:
-                q = h @ L["wq"]
+                q = _mm_w(h, L, "wq")
             q = q.reshape(B, S, nh, dn + dr)
             q_nope, q_pe = q[..., :dn], q[..., dn:]
             q_pe = apply_rope(q_pe, cos, sin)
 
-            kv_a = h @ L["wkva"]                          # [B, S, r+dr]
+            kv_a = _mm_w(h, L, "wkva")                    # [B, S, r+dr]
             lat = rms(kv_a[..., :r], L["gkv"])            # normalized latent
             k_pe = apply_rope(kv_a[..., r:][:, :, None, :], cos, sin)[:, :, 0]
 
@@ -594,7 +649,8 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
                 # long-context prefill (matches models/deepseek.py
                 # forward, incl. the padded-head route for dv != dn+dr)
                 from .ops.flash_attention import sdpa_padded_heads
-                kv = (lat @ L["wkvb"]).reshape(B, S, nh, dn + dv)
+                kv = (lat @ _dq(L, "wkvb", x.dtype)).reshape(
+                    B, S, nh, dn + dv)
                 k_h = jnp.concatenate(
                     [kv[..., :dn],
                      jnp.broadcast_to(k_pe[:, :, None, :], (B, S, nh, dr))],
@@ -603,11 +659,11 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
                 with flags_guard(flash_impl=flash_impl):
                     o_v = sdpa_padded_heads(q_h, k_h, kv[..., dn:],
                                             causal=True, scale=scale)
-                x = x + o_v.reshape(B, S, nh * dv) @ L["wo"]
+                x = x + _mm_w(o_v.reshape(B, S, nh * dv), L, "wo")
                 h2 = rms(x, L["ln2"])
                 x = x + _ffn_apply(L, h2, st)
                 continue
-            wkb = L["wkvb"].reshape(r, nh, dn + dv)
+            wkb = _dq(L, "wkvb", x.dtype).reshape(r, nh, dn + dv)
             w_k, w_v = wkb[..., :dn], wkb[..., dn:]
             # absorb W_k onto the query: score = q_eff . latent + q_pe . k_pe
             q_eff = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_k)
@@ -628,13 +684,16 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
                 aw = jax.nn.softmax(scores, axis=-1).astype(c_lat.dtype)
                 o_lat = jnp.einsum("bnst,btr->bsnr", aw, c_lat)
             o = jnp.einsum("bsnr,rnv->bsnv", o_lat, w_v)
-            x = x + o.reshape(B, S, nh * dv) @ L["wo"]
+            x = x + _mm_w(o.reshape(B, S, nh * dv), L, "wo")
             h2 = rms(x, L["ln2"])
             x = x + _ffn_apply(L, h2, st)
         x = rms(x, w["norm"])
         last = x[:, -1]
-        logits = last @ (w["head"] if w["head"] is not None
-                         else w["embed"].T)
+        if "head_q" in w:
+            logits = _mm_w(last, w, "head")
+        else:
+            logits = last @ (w["head"] if w["head"] is not None
+                             else w["embed"].T)
         return logits, new_caches
 
     return step
@@ -692,7 +751,8 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
                     decode_strategy: str = "sampling",
                     top_k: Optional[int] = None, top_p: Optional[float] = None,
                     temperature: float = 1.0,
-                    eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+                    eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+                    weight_only_int8: bool = False):
     """KV-cache generation for LlamaForCausalLM-family models: prefill once
     over the prompt, then O(1) work per new token (the compiled-decode
     analog of the reference's masked_multihead_attention loop).
@@ -708,7 +768,7 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
     if decode_strategy not in ("greedy_search", "sampling"):
         raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
                          "'greedy_search' or 'sampling'")
-    p = _decode_params(model)
+    p = _decode_params(model, weight_only_int8)
     cfg = p["cfg"]
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
@@ -843,7 +903,8 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
                       top_k: Optional[int] = None,
                       top_p: Optional[float] = None, temperature: float = 1.0,
                       eos_token_id: Optional[int] = None,
-                      pad_token_id: int = 0):
+                      pad_token_id: int = 0,
+                      weight_only_int8: bool = False):
     """KV-cache generation with the whole decode loop compiled (see
     _make_decode_loop). Same contract (and defaults) as
     generate_cached; sampling draws from the framework RNG stream once
@@ -851,7 +912,7 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
     if decode_strategy not in ("greedy_search", "sampling"):
         raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
                          "'greedy_search' or 'sampling'")
-    p = _decode_params(model)
+    p = _decode_params(model, weight_only_int8)
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)

@@ -142,9 +142,11 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16",
         roofline_fraction=round(decode_tok_s / bound_tok_s, 3))
 
 
-def bench_moe_decode(B=8, S0=512, new=256, dtype="bfloat16"):
+def bench_moe_decode(B=8, S0=512, new=256, dtype="bfloat16",
+                     weight_only_int8=False):
     """MoE-LM shard decode (VERDICT r3 item 6): routed experts inside the
-    scanned decode step via the grouped-GEMM dropless path."""
+    scanned decode step via the grouped-GEMM dropless path. int8 halves
+    the expert-stack HBM reads that dominate the weight traffic (r5)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.moe_llm import MoEForCausalLM, MoEConfig
@@ -161,14 +163,14 @@ def bench_moe_decode(B=8, S0=512, new=256, dtype="bfloat16"):
                     moe_intermediate_size=1408,
                     shared_expert_intermediate_size=1408,
                     moe_dropless=True, first_k_dense_replace=1)
-    _log(f"init MoE model B={B} S0={S0} new={new}")
+    _log(f"init MoE model B={B} S0={S0} new={new} int8={weight_only_int8}")
     paddle.seed(0)
     model = MoEForCausalLM(cfg)
     model.eval()
     if dtype == "bfloat16":
         for prm in model.parameters():
             prm._data = prm._data.astype(jnp.bfloat16)
-    p = _decode_params(model)
+    p = _decode_params(model, weight_only_int8=weight_only_int8)
     w_bytes = _tree_bytes(p)
     KV, D = cfg.num_key_value_heads, cfg.head_dim
     rng = np.random.RandomState(0)
@@ -208,8 +210,10 @@ def bench_moe_decode(B=8, S0=512, new=256, dtype="bfloat16"):
     bound_tok_s = B * _bw() / (w_bytes + B * kv_read)
     return dict(
         config="moe_shard 8L h2048 E8 top2 mi1408 shared1408 (dropless "
-               "grouped-GEMM routing in the scanned decode step)",
-        dtype=dtype, batch=B, prefill_len=S0, new_tokens=new,
+               + ("[weight-only int8] " if weight_only_int8 else "")
+               + "grouped-GEMM routing in the scanned decode step)",
+        dtype="int8-weights" if weight_only_int8 else dtype,
+        batch=B, prefill_len=S0, new_tokens=new,
         weight_bytes=int(w_bytes),
         compile_plus_first_s=round(compile_and_first, 2),
         decode_tokens_per_s_per_chip=round(decode_tok_s, 1),
@@ -218,7 +222,7 @@ def bench_moe_decode(B=8, S0=512, new=256, dtype="bfloat16"):
         roofline_fraction=round(decode_tok_s / bound_tok_s, 3))
 
 
-def _mla_bench_model(total, dtype="bfloat16"):
+def _mla_bench_model(total, dtype="bfloat16", weight_only_int8=False):
     """The ONE mla_shard bench config (both the headline decode bench and
     the context sweep must measure the same model — only the cache
     capacity differs)."""
@@ -241,10 +245,11 @@ def _mla_bench_model(total, dtype="bfloat16"):
     if dtype == "bfloat16":
         for prm in model.parameters():
             prm._data = prm._data.astype(jnp.bfloat16)
-    return cfg, _decode_params(model)
+    return cfg, _decode_params(model, weight_only_int8=weight_only_int8)
 
 
-def bench_mla_decode(B=8, S0=512, new=256, dtype="bfloat16"):
+def bench_mla_decode(B=8, S0=512, new=256, dtype="bfloat16",
+                     weight_only_int8=False):
     """DeepSeek-V2 MLA shard decode: absorbed latent-KV cache (r+dr per
     token) through the scanned decode loop."""
     import jax
@@ -252,8 +257,8 @@ def bench_mla_decode(B=8, S0=512, new=256, dtype="bfloat16"):
     from paddle_tpu.generation import _make_decode_loop
 
     total = S0 + new
-    _log(f"init MLA model B={B} S0={S0} new={new}")
-    cfg, p = _mla_bench_model(total, dtype)
+    _log(f"init MLA model B={B} S0={S0} new={new} int8={weight_only_int8}")
+    cfg, p = _mla_bench_model(total, dtype, weight_only_int8)
     w_bytes = _tree_bytes(p)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S0)), jnp.int32)
@@ -305,8 +310,11 @@ def bench_mla_decode(B=8, S0=512, new=256, dtype="bfloat16"):
     bound_tok_s = B * _bw() / (w_bytes + B * kv_read)
     return dict(
         config="mla_shard 8L h2048 16h q768/kv512 nope128 rope64 v128 "
-               "E8 top2 (absorbed latent-KV decode)",
-        dtype=dtype, batch=B, prefill_len=S0, new_tokens=new,
+               + ("E8 top2 [weight-only int8] (absorbed latent-KV decode)"
+                  if weight_only_int8
+                  else "E8 top2 (absorbed latent-KV decode)"),
+        dtype="int8-weights" if weight_only_int8 else dtype,
+        batch=B, prefill_len=S0, new_tokens=new,
         weight_bytes=int(w_bytes),
         latent_cache_bytes_per_token_layer=(cfg.kv_lora_rank
                                             + cfg.qk_rope_head_dim) * 2,
@@ -523,7 +531,9 @@ def main():
                                            weight_only_int8=True),
                   decode_bf16_ref=bench_decode(B=8, S0=256, new=1024),
                   moe_decode=bench_moe_decode(),
+                  moe_decode_int8=bench_moe_decode(weight_only_int8=True),
                   mla_decode=bench_mla_decode(),
+                  mla_decode_int8=bench_mla_decode(weight_only_int8=True),
                   mla_context_sweep=bench_mla_context_sweep(),
                   # the old single-shot paged_attention_op row is gone:
                   # it duplicated sweep[0] and its pre-q-scaling-fix
