@@ -128,7 +128,8 @@ def generate(model, input_ids, max_new_tokens: int = 20,
 # PaddleNLP use_cache generation over the masked/block decode attention
 # kernels — paddle/phi/kernels/fusion/gpu/masked_multihead_attention)
 # ---------------------------------------------------------------------------
-def _llama_decode_params(model, weight_only_int8: bool = False):
+def _llama_decode_params(model, weight_only_int8: bool = False,
+                         weight_only_quant=None):
     """Extract the cached-decode weight tree from a Llama-family CausalLM
     (LlamaForCausalLM, Qwen2ForCausalLM — same GQA backbone; Qwen2 adds
     q/k/v biases, carried as optional leaves).
@@ -136,7 +137,11 @@ def _llama_decode_params(model, weight_only_int8: bool = False):
     ``weight_only_int8``: store every 2-D matmul weight as (int8 values,
     per-output-channel f32 scale) — ops/quant.weight_quantize — halving
     the HBM weight reads that bound decode; the body dequantizes in VMEM
-    (ref: paddle/nn/quant weight-only deploy path)."""
+    (ref: paddle/nn/quant weight-only deploy path).
+    ``weight_only_quant``: 'int8' (same as the bool) or 'int4' (packed
+    nibbles, quarter the weight reads; decode contracts even/odd rows so
+    the unpack fuses — see _int4_halves)."""
+    algo, enabled = _woq_algo(weight_only_int8, weight_only_quant)
     cfg = model.config
     inner = getattr(model, "llama", None)
     if inner is None:
@@ -165,15 +170,15 @@ def _llama_decode_params(model, weight_only_int8: bool = False):
             d["bk"] = a.k_proj.bias._data
             d["bv"] = a.v_proj.bias._data
         for k in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
-            _q8(d, k, weight_only_int8)
+            _q8(d, k, enabled, algo)
         layers.append(d)
     head = model.lm_head.weight._data if model.lm_head is not None else None
     p = dict(cfg=cfg, family="llama",
              embed=inner.embed_tokens.weight._data,
              layers=layers, norm=inner.norm.weight._data, head=head,
              cos=inner.rope_cos._data, sin=inner.rope_sin._data)
-    if weight_only_int8 and head is not None:
-        _q8(p, "head")
+    if enabled and head is not None:
+        _q8(p, "head", True, algo)
         p["head"] = None
     return p
 
@@ -199,20 +204,42 @@ def _gpt_decode_params(model):
                 normb=gpt.ln_f.bias._data, head=head)
 
 
-def _q8(d, key, enabled: bool = True):
-    """Quantize d[key] in place to (int8, per-out-channel f32 scale) —
-    the weight-only deploy transform shared by every decode family. 3-D
-    expert stacks [E, K, N] quantize per expert (vmapped absmax) with
-    scales [E, N]; None entries and disabled calls are no-ops."""
+def _woq_algo(weight_only_int8, weight_only_quant):
+    """Normalize the two public quant knobs to (algo, enabled)."""
+    if weight_only_quant not in (None, "int8", "int4"):
+        raise ValueError(
+            f"weight_only_quant {weight_only_quant!r}: expected "
+            "'int8' or 'int4'")
+    if weight_only_quant:
+        if weight_only_int8 and weight_only_quant != "int8":
+            raise ValueError(
+                "conflicting quant knobs: weight_only_int8=True with "
+                f"weight_only_quant={weight_only_quant!r} — drop the "
+                "bool or make them agree")
+        return "weight_only_" + weight_only_quant, True
+    return "weight_only_int8", bool(weight_only_int8)
+
+
+def _q8(d, key, enabled: bool = True, algo: str = "weight_only_int8"):
+    """Quantize d[key] in place to (int8 or packed-int4 values,
+    per-out-channel f32 scale) — the weight-only deploy transform shared
+    by every decode family. int8 stores key_q [K, N]; int4 stores key_q4
+    [K/2, N] (two nibbles per byte — consumers split the contraction
+    into even/odd rows so the unpack stays an elementwise chain XLA
+    fuses into the dot operand loads, never a materialized bf16 weight).
+    3-D expert stacks [E, K, N] quantize per expert (vmapped absmax)
+    with scales [E, N]; None entries and disabled calls are no-ops."""
     if not enabled or d.get(key) is None:
         return
     from .ops.quant import weight_quantize
+    import functools
     w = d.pop(key)
+    qfn = functools.partial(weight_quantize, algo=algo)
     if w.ndim == 3:
-        qw, sc = jax.vmap(weight_quantize)(w)
+        qw, sc = jax.vmap(qfn)(w)
     else:
-        qw, sc = weight_quantize(w)
-    d[key + "_q"] = qw
+        qw, sc = qfn(w)
+    d[key + ("_q4" if algo == "weight_only_int4" else "_q")] = qw
     d[key + "_s"] = sc.astype(jnp.float32)
 
 
@@ -341,10 +368,21 @@ def _mla_decode_params(model, weight_only_int8: bool = False):
     return p
 
 
-def _decode_params(model, weight_only_int8: bool = False):
-    """Family dispatch for the cached/compiled decode paths."""
+def _decode_params(model, weight_only_int8: bool = False,
+                   weight_only_quant=None):
+    """Family dispatch for the cached/compiled decode paths. int4 covers
+    the llama family only — the MoE expert stacks and MLA kv_b are
+    consumed whole by einsums whose contraction the int4 split would
+    have to thread through every call site (int8 already halves them)."""
+    algo, enabled = _woq_algo(weight_only_int8, weight_only_quant)
+    if enabled and algo == "weight_only_int4" and (
+            getattr(model, "gpt", None) is not None
+            or getattr(model, "model", None) is not None):
+        raise NotImplementedError(
+            "weight_only_quant='int4' covers the llama family; MoE/MLA/"
+            "GPT run 'int8'")
     if getattr(model, "gpt", None) is not None:
-        if weight_only_int8:
+        if enabled:
             raise NotImplementedError(
                 "weight_only_int8 decode covers the llama/MoE/MLA "
                 "families; the GPT family is fp (its fused-qkv + bias "
@@ -355,10 +393,11 @@ def _decode_params(model, weight_only_int8: bool = False):
         from .models.deepseek import DeepSeekV2Model
         from .models.moe_llm import MoEModel
         if isinstance(inner, DeepSeekV2Model):
-            return _mla_decode_params(model, weight_only_int8)
+            return _mla_decode_params(model, enabled)
         if isinstance(inner, MoEModel):
-            return _moe_decode_params(model, weight_only_int8)
-    return _llama_decode_params(model, weight_only_int8)
+            return _moe_decode_params(model, enabled)
+    return _llama_decode_params(model, weight_only_int8,
+                                weight_only_quant)
 
 
 def _llama_weights(p):
@@ -377,7 +416,13 @@ def _dq(d, key, dtype):
     _mm_w's fused matmul shape doesn't apply): int8 layouts dequantize
     in VMEM — the HBM read stays int8 and XLA fuses the scale multiply
     into the consuming einsum. 3-D stacks carry per-(expert, out-channel)
-    scales [E, N]."""
+    scales [E, N]. int4 (_q4) entries are NOT readable whole — their
+    bandwidth win requires the even/odd contraction split (_mm_w)."""
+    if key + "_q4" in d:
+        raise NotImplementedError(
+            f"{key}: packed-int4 weights only flow through the matmul "
+            "helper (_mm_w); whole-tensor consumers (MLA kv_b, expert "
+            "stacks) are int8-only")
     if key + "_q" in d:
         q, s = d[key + "_q"], d[key + "_s"].astype(dtype)
         if q.ndim == 3:
@@ -386,12 +431,37 @@ def _dq(d, key, dtype):
     return d[key]
 
 
+def _int4_halves(q4, s):
+    """Sign-extended nibble planes of a packed int4 weight, scaled:
+    (lo, hi) each [K/2, N] — h @ W == h[..., 0::2] @ lo + h[..., 1::2]
+    @ hi. Pure elementwise on the packed bytes, so XLA fuses the unpack
+    into the dot operand loads (the same fusion that makes int8
+    weight-only decode win); nothing bf16-sized ever hits HBM."""
+    from .ops.quant import int4_planes
+    lo, hi = int4_planes(q4)
+    return lo.astype(s.dtype) * s, hi.astype(s.dtype) * s
+
+
 def _mm_w(h, L, key):
     """Quant-aware matmul against a stored weight: weight-only int8
     layouts hold (key_q int8, key_s per-channel f32) and dequantize in
     VMEM right before the matmul (the HBM read is int8 — half the bf16
     bytes that bound decode); fp layouts hold the key directly. The ONE
-    place both layouts' matmul goes through."""
+    place both layouts' matmul goes through. Packed-int4 layouts
+    (key_q4) contract even/odd input rows against the nibble planes so
+    the unpack fuses into the dot operand loads (_int4_halves)."""
+    if key + "_q4" in L:
+        q4, sc = L[key + "_q4"], L[key + "_s"]
+        if q4.shape[1] % 128 == 0:
+            # in-kernel unpack: packed int4 is the only weight HBM
+            # traffic (XLA cannot fuse the shift chain into the MXU
+            # feed, so the split below materializes bf16 planes and
+            # runs at bf16 speed — measured r5)
+            from .ops.quant import weight_only_linear
+            return weight_only_linear(h, q4, sc,
+                                      algo="weight_only_int4")
+        lo, hi = _int4_halves(q4, sc.astype(h.dtype))
+        return h[..., 0::2] @ lo + h[..., 1::2] @ hi
     return h @ _dq(L, key, h.dtype)
 
 
@@ -514,7 +584,7 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
             x = x + _ffn_apply(L, h2, st)
         x = rms(x, w["norm"])
         last = x[:, -1]
-        if "head_q" in w:
+        if "head_q" in w or "head_q4" in w:
             logits = _mm_w(last, w, "head")
         else:
             logits = last @ (w["head"] if w["head"] is not None
@@ -689,7 +759,7 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
             x = x + _ffn_apply(L, h2, st)
         x = rms(x, w["norm"])
         last = x[:, -1]
-        if "head_q" in w:
+        if "head_q" in w or "head_q4" in w:
             logits = _mm_w(last, w, "head")
         else:
             logits = last @ (w["head"] if w["head"] is not None
@@ -752,7 +822,8 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
                     top_k: Optional[int] = None, top_p: Optional[float] = None,
                     temperature: float = 1.0,
                     eos_token_id: Optional[int] = None, pad_token_id: int = 0,
-                    weight_only_int8: bool = False):
+                    weight_only_int8: bool = False,
+                    weight_only_quant=None):
     """KV-cache generation for LlamaForCausalLM-family models: prefill once
     over the prompt, then O(1) work per new token (the compiled-decode
     analog of the reference's masked_multihead_attention loop).
@@ -768,7 +839,7 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
     if decode_strategy not in ("greedy_search", "sampling"):
         raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
                          "'greedy_search' or 'sampling'")
-    p = _decode_params(model, weight_only_int8)
+    p = _decode_params(model, weight_only_int8, weight_only_quant)
     cfg = p["cfg"]
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
@@ -904,7 +975,8 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
                       top_p: Optional[float] = None, temperature: float = 1.0,
                       eos_token_id: Optional[int] = None,
                       pad_token_id: int = 0,
-                      weight_only_int8: bool = False):
+                      weight_only_int8: bool = False,
+                      weight_only_quant=None):
     """KV-cache generation with the whole decode loop compiled (see
     _make_decode_loop). Same contract (and defaults) as
     generate_cached; sampling draws from the framework RNG stream once
@@ -912,7 +984,7 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
     if decode_strategy not in ("greedy_search", "sampling"):
         raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
                          "'greedy_search' or 'sampling'")
-    p = _decode_params(model, weight_only_int8)
+    p = _decode_params(model, weight_only_int8, weight_only_quant)
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
