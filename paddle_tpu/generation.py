@@ -379,8 +379,8 @@ def _decode_params(model, weight_only_int8: bool = False,
             getattr(model, "gpt", None) is not None
             or getattr(model, "model", None) is not None):
         raise NotImplementedError(
-            "weight_only_quant='int4' covers the llama family; MoE/MLA/"
-            "GPT run 'int8'")
+            "weight_only_quant='int4' covers the llama family; MoE/MLA "
+            "run 'int8', the GPT family is fp-only")
     if getattr(model, "gpt", None) is not None:
         if enabled:
             raise NotImplementedError(

@@ -186,7 +186,14 @@ def weight_only_linear(x, qweight, scale, bias=None,
     K = shape[-1]
     x2 = x.reshape(-1, K)
     if algo == "weight_only_int4":
-        out = _wol_int4(x2, qweight, scale)
+        if qweight.shape[1] % 128 == 0:
+            out = _wol_int4(x2, qweight, scale)
+        else:
+            # non-lane-aligned N (e.g. the vocab-16032 head): the Mosaic
+            # block would be illegal on a real chip — dequantize-then-
+            # matmul keeps these shapes working as before
+            w = weight_dequantize(qweight, scale, algo).astype(x.dtype)
+            out = x2 @ w
     else:
         out = _wol_int8(x2, qweight, scale)
     if bias is not None:

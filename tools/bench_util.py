@@ -13,7 +13,13 @@ import numpy as np
 
 
 def fetch(out):
-    """Force device completion by fetching one element to the host."""
+    """Force device completion by fetching one element to the host.
+
+    CAVEAT: the one-element slice is itself a device computation whose
+    executable REMOTE-COMPILES on first use per shape (~0.7-0.8 s on the
+    tunnel) — warm paths must call fetch() once per output shape before
+    any warmup=False timing, or round 0 of the first kernel is charged a
+    compile (observed as a phantom 2x spike on exactly one contender)."""
     leaf = out
     while isinstance(leaf, (tuple, list, dict)):
         leaf = next(iter(leaf.values())) if isinstance(leaf, dict) \
