@@ -1180,8 +1180,9 @@ class _CachedBeamState:
     """KV-cache model state for beam search: caches gathered by the beam
     permutation every step (the reference's cache reorder on beam_idx)."""
 
-    def __init__(self, model, ids, nb, max_new_tokens):
-        p = _decode_params(model)
+    def __init__(self, model, ids, nb, max_new_tokens,
+                 weight_only_int8=False, weight_only_quant=None):
+        p = _decode_params(model, weight_only_int8, weight_only_quant)
         self.p = p
         cfg = p["cfg"]
         B, S0 = ids.shape
@@ -1234,13 +1235,16 @@ def beam_search_cached(model, input_ids, max_new_tokens: int = 20,
                        repetition_penalty: float = 1.0,
                        eos_token_id: Optional[int] = None,
                        pad_token_id: int = 0,
-                       num_return_sequences: int = 1):
+                       num_return_sequences: int = 1,
+                       weight_only_int8: bool = False,
+                       weight_only_quant=None):
     """KV-cache beam search for the Llama family (cache rows gathered by
     the beam permutation each step); same contract as beam_search."""
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
-    state = _CachedBeamState(model, ids, num_beams, max_new_tokens)
+    state = _CachedBeamState(model, ids, num_beams, max_new_tokens,
+                             weight_only_int8, weight_only_quant)
     with ag.no_grad():
         return _beam_engine(state.logits_at, state, ids, max_new_tokens,
                             num_beams, num_beam_groups, diversity_rate,

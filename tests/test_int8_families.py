@@ -226,3 +226,20 @@ class TestLlamaInt4:
             generate_cached(m, ids, max_new_tokens=2,
                             decode_strategy="greedy_search",
                             weight_only_quant="int4")
+
+
+class TestBeamSearchQuant:
+    def test_beam_search_cached_int8_runs(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        from paddle_tpu.generation import beam_search_cached
+        paddle.seed(37)
+        m = LlamaForCausalLM(llama_tiny_config(max_position_embeddings=32))
+        m.eval()
+        rng = np.random.RandomState(6)
+        ids = paddle.to_tensor(
+            rng.randint(1, m.config.vocab_size, (1, 4)).astype("int32"))
+        toks, sc = beam_search_cached(m, ids, max_new_tokens=4,
+                                      num_beams=2,
+                                      weight_only_int8=True)
+        assert toks.numpy().shape[-1] == 4
