@@ -59,29 +59,40 @@ def _log(msg):
 _T0 = time.time()
 
 
+def _llama_bench_model(total, dtype="bfloat16", weight_only_int8=False,
+                       weight_only_quant=None):
+    """The ONE llama bench config (decode rows and the long-prefill row
+    must measure the same 8B mp=8 x pp=4 shard — only cache capacity and
+    quant mode differ)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama3_8b_shard_config)
+    from paddle_tpu.generation import _llama_decode_params
+    import paddle_tpu as paddle
+    cfg = llama3_8b_shard_config(mp=8, pp=4,
+                                 max_position_embeddings=total)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if dtype == "bfloat16":
+        for prm in model.parameters():
+            prm._data = prm._data.astype(jnp.bfloat16)
+    return cfg, _llama_decode_params(
+        model, weight_only_int8=weight_only_int8,
+        weight_only_quant=weight_only_quant)
+
+
 def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16",
                  weight_only_int8=False, weight_only_quant=None):
     import jax
     import jax.numpy as jnp
-    from paddle_tpu.models.llama import (LlamaForCausalLM,
-                                         llama3_8b_shard_config)
-    from paddle_tpu.generation import (_llama_decode_params,
-                                       _make_decode_loop)
-    import paddle_tpu as paddle
+    from paddle_tpu.generation import _make_decode_loop
 
     total = S0 + new
-    cfg = llama3_8b_shard_config(mp=8, pp=4,
-                                 max_position_embeddings=total)
     _log(f"init model B={B} S0={S0} new={new} int8={weight_only_int8}")
-    paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
-    model.eval()
+    cfg, p = _llama_bench_model(total, dtype, weight_only_int8,
+                                weight_only_quant)
     _log("model built")
-    if dtype == "bfloat16":
-        for prm in model.parameters():
-            prm._data = prm._data.astype(jnp.bfloat16)
-    p = _llama_decode_params(model, weight_only_int8=weight_only_int8,
-                             weight_only_quant=weight_only_quant)
     w_bytes = _tree_bytes(p)
     KV, D = cfg.num_key_value_heads, cfg.head_dim
     cache_bytes_full = 2 * total * KV * D * 2 * len(p["layers"])  # bf16
@@ -542,6 +553,57 @@ def _sweep_note(sweep):
             "the same rounds.")
 
 
+def bench_prefill_long(family="llama", S0=8192, B=4, dtype="bfloat16"):
+    """Long-context PREFILL throughput — the r5 flash-prefill record.
+    Before r5 every cached body materialized [*, S, max_len] f32 scores
+    at prefill: a 12k-token B=8 MLA prefill OOM'd the 16 GB chip and the
+    masked (max_len - S) columns were wasted even when it fit. The
+    prefill-from-zero flash route makes these shapes runnable; this row
+    records the achieved prefill tok/s at 8k context (new=1 decode loop
+    isolates prefill + one step, matching the subtraction method the
+    decode rows use)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.generation import _make_decode_loop
+    from bench_util import fetch
+    import paddle_tpu as paddle
+
+    total = S0 + 16
+    if family == "llama":
+        _log(f"prefill_long llama: init S0={S0} B={B}")
+        cfg, p = _llama_bench_model(total, dtype)
+    else:
+        _log(f"prefill_long mla: init S0={S0} B={B}")
+        cfg, p = _mla_bench_model(total, dtype)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S0)), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    run = _make_decode_loop(p, S0, 1, "greedy_search", None, None,
+                            1.0, None, 0)
+    t0 = time.time()
+    toks, _ = run(ids, key)
+    np.asarray(toks)
+    compile_and_first = time.time() - t0
+    fetch(run(ids, key)[0])          # warm incl. the fetch-slice program
+    reps = 3
+    ts = []
+    for _ in range(reps):
+        t0 = time.time()
+        fetch(run(ids, key)[0])
+        ts.append(time.time() - t0)
+    from bench_util import band
+    mean = sum(ts) / len(ts)    # mean over reps, matching bench_decode's
+                                # identically-named field
+    return dict(
+        family=family, batch=B, prefill_len=S0, dtype=dtype,
+        compile_plus_first_s=round(compile_and_first, 2),
+        prefill_tokens_per_s=round(B * S0 / mean),
+        loop_band=band(ts),
+        note="runnable at all only since the r5 flash prefill (the "
+             "dense [S, max_len] f32 score path OOMs these shapes); "
+             "includes one decode step")
+
+
 def _paged_sweep_row():
     # the old single-shot paged_attention_op row is gone: it duplicated
     # sweep[0] and its pre-q-scaling-fix "bundled" number contradicted
@@ -571,6 +633,8 @@ ROWS = {
     "mla_decode": lambda: bench_mla_decode(),
     "mla_decode_int8": lambda: bench_mla_decode(weight_only_int8=True),
     "mla_context_sweep": lambda: bench_mla_context_sweep(),
+    "prefill_8k_llama": lambda: bench_prefill_long("llama"),
+    "prefill_8k_mla": lambda: bench_prefill_long("mla"),
     "_paged": _paged_sweep_row,
 }
 
