@@ -116,3 +116,42 @@ class TestCachedPrefillRoute:
                                  decode_strategy="greedy_search")
         assert len(calls) == cfg.num_hidden_layers
         np.testing.assert_array_equal(got.numpy(), ref.numpy())
+
+
+class TestDenseFallbackParity:
+    """S>1 with a TRACED start keeps the dense [S, max_len] path (the
+    flash branch requires the statically-pinned start=0 program). The
+    two programs must agree — the fallback is what chunked or
+    library-internal callers hit."""
+
+    def test_traced_start_matches_static_prefill(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        from paddle_tpu.generation import (_llama_decode_params,
+                                           _cached_step_body,
+                                           _llama_weights, _init_caches)
+        import jax
+        paddle.seed(47)
+        m = LlamaForCausalLM(llama_tiny_config(max_position_embeddings=16))
+        m.eval()
+        p = _llama_decode_params(m)
+        body = _cached_step_body(p, 12)
+        w = _llama_weights(p)
+        rng = np.random.RandomState(8)
+        ids = jnp.asarray(rng.randint(1, m.config.vocab_size, (2, 8)),
+                          jnp.int32)
+        # static start=0 -> flash branch
+        flash_logits, flash_caches = jax.jit(
+            lambda w, ids, c: body(w, ids, c, 0))(
+                w, ids, _init_caches(p, 2, 12))
+        # traced start -> dense branch (start abstracted by jit)
+        dense_logits, dense_caches = jax.jit(body)(
+            w, ids, _init_caches(p, 2, 12), 0)
+        np.testing.assert_allclose(np.asarray(flash_logits, np.float32),
+                                   np.asarray(dense_logits, np.float32),
+                                   rtol=2e-5, atol=2e-5)
+        for (fk, fv), (dk, dv) in zip(flash_caches, dense_caches):
+            np.testing.assert_allclose(np.asarray(fk), np.asarray(dk),
+                                       rtol=2e-5, atol=2e-5)
+            np.testing.assert_allclose(np.asarray(fv), np.asarray(dv),
+                                       rtol=2e-5, atol=2e-5)
