@@ -54,7 +54,8 @@ for _sub in ("nn", "optimizer", "amp", "io", "jit", "distribution",
              "sparse", "fft", "signal", "geometric", "audio",
              "quantization", "profiler", "vision", "hapi", "incubate",
              "native", "generation", "static", "utils", "text", "trainer",
-             "regularizer", "sysconfig", "version", "onnx", "hub"):
+             "regularizer", "sysconfig", "version", "onnx", "hub",
+             "observability"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError:

@@ -22,9 +22,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import autograd
+from .. import observability as _obs
 from ..flags import flag
 
 __all__ = ["OpDef", "register_op", "get_op", "apply", "all_ops"]
+
+# per-op dispatch counters (ISSUE 1): the label is the op name so cache-hit
+# rates and hot-op tables fall out of one metric family
+_DISPATCH = _obs.registry().counter(
+    "pt_ops_dispatch_total", "eager op dispatches through apply()",
+    labels=("op",))
+_GRAD_RECORDED = _obs.registry().counter(
+    "pt_ops_grad_recorded_total",
+    "dispatches that recorded a GradNode (tape-active, diff inputs)")
 
 
 class OpDef:
@@ -125,6 +135,9 @@ def apply(name: str, fn: Callable, inputs: Sequence[Any], **kwargs):
     """
     from .tensor import Tensor
 
+    if _obs.enabled():
+        _DISPATCH.labels(op=name).inc()
+
     arrs = []
     tlist = []
     for t in inputs:
@@ -146,6 +159,7 @@ def apply(name: str, fn: Callable, inputs: Sequence[Any], **kwargs):
         for t, a in zip(tlist, arrs))
 
     if needs_grad:
+        _GRAD_RECORDED.inc()
         out, vjp_fn = jax.vjp(fn, *arrs)
         multi = isinstance(out, (tuple, list))
         outs = tuple(out) if multi else (out,)

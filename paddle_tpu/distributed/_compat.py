@@ -1,0 +1,61 @@
+"""shard_map version compatibility.
+
+jax >= 0.9 exposes ``jax.shard_map(..., check_vma=, axis_names=)``; older
+releases (this image ships 0.4.37) have
+``jax.experimental.shard_map.shard_map(..., check_rep=, auto=)``. One
+wrapper so every distributed module runs on both: ``check_vma`` maps to
+``check_rep`` and ``axis_names`` (the manual axes) maps to its complement
+``auto`` on the legacy signature.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map
+    _LEGACY = False
+except ImportError:  # jax < 0.9
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _LEGACY = True
+
+__all__ = ["shard_map", "pvary", "vma_of"]
+
+
+def pvary(x, axis):
+    """jax.lax.pvary where the VMA system exists (jax >= 0.7); identity on
+    legacy jax, whose shard_map runs with replication checking off so no
+    varying/invariant distinction is tracked. Callers that own pvary's
+    transpose (pipeline._pvary_safe) still psum partial cotangents across
+    the axis, which is the correct reduction on both versions."""
+    fn = getattr(jax.lax, "pvary", None)
+    return x if fn is None else fn(x, axis)
+
+
+def vma_of(x):
+    """The varying-manual-axes set of a traced value (empty set on legacy
+    jax, which has neither jax.typeof nor aval.vma)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", frozenset())
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None, axis_names=None):
+    kw = {}
+    if _LEGACY:
+        # the legacy rep-checker predates VMA and rejects the custom-vjp
+        # pvary idioms the pipeline paths use — run it unchecked; the 0.9
+        # path keeps check_vma (load-bearing there, see _pp_shard_map)
+        kw["check_rep"] = False
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kw["auto"] = auto
+    else:
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)

@@ -13,15 +13,61 @@ In-place semantics preserved: `all_reduce(t)` rewrites t's buffer.
 from __future__ import annotations
 
 import functools
+import time
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ._compat import shard_map
 
+from .. import observability as _obs
 from ..core.tensor import Tensor
 from .mesh import get_mesh
+
+# per-collective visibility (ISSUE 1): calls, input-payload bytes, and
+# host wall-time per call. Latency includes XLA dispatch only — PJRT runs
+# collectives async, so device time shows up here only when the call
+# itself materializes results (the eager in-place rewrite paths do).
+_COLL_CALLS = _obs.registry().counter(
+    "pt_collective_calls_total", "collective API calls",
+    labels=("collective",))
+_COLL_BYTES = _obs.registry().counter(
+    "pt_collective_bytes_total", "input payload bytes per collective",
+    labels=("collective",))
+_COLL_LAT = _obs.registry().histogram(
+    "pt_collective_seconds", "collective call wall time",
+    labels=("collective",))
+
+
+def _payload_bytes(args) -> int:
+    n = 0
+    for a in args:
+        if isinstance(a, Tensor):
+            n += int(a._data.size) * jnp.dtype(a._data.dtype).itemsize
+        elif isinstance(a, (list, tuple)):
+            n += _payload_bytes(a)
+    return n
+
+
+def _instrumented(fn):
+    """Wrap a collective: count calls/bytes and time the call. Disabled
+    metrics cost one attribute check."""
+    name = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _obs.enabled():
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _COLL_CALLS.labels(collective=name).inc()
+            _COLL_BYTES.labels(collective=name).inc(_payload_bytes(args))
+            _COLL_LAT.labels(collective=name).observe(
+                time.perf_counter() - t0)
+    return wrapper
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce_scatter",
            "broadcast", "scatter", "reduce", "alltoall", "send", "recv",
@@ -92,6 +138,7 @@ def _collective(mesh: Mesh, axis: str, fn, x):
     return out
 
 
+@_instrumented
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
                sync_op: bool = True) -> Tensor:
     axis = _axis_of(group)
@@ -112,6 +159,7 @@ def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
     return tensor
 
 
+@_instrumented
 def all_gather(tensor_list: Optional[List], tensor: Tensor = None, group=None,
                sync_op: bool = True):
     """paddle signature: all_gather(out_list, in_tensor). With a 1-axis mesh
@@ -141,6 +189,7 @@ def all_gather(tensor_list: Optional[List], tensor: Tensor = None, group=None,
     return Tensor(out)
 
 
+@_instrumented
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True) -> Tensor:
     axis = _axis_of(group)
@@ -166,6 +215,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     return tensor
 
 
+@_instrumented
 def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True) -> Tensor:
     """Within a mesh axis all replicas already hold identical values under
     SPMD; broadcast selects the src rank's value for all."""
@@ -193,6 +243,7 @@ def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
                       group, sync_op)
 
 
+@_instrumented
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     axis = _axis_of(group)
     mesh = _active_mesh(axis)
@@ -234,6 +285,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
         "on TPU — use distributed.pipeline")
 
 
+@_instrumented
 def barrier(group=None):
     """Fence all outstanding device work (SPMD: program order is the sync)."""
     for a in jax.live_arrays():

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import pvary as _pvary
+
 __all__ = ["spmd_pipeline", "stack_layer_params", "PP_AXIS"]
 
 PP_AXIS = "pp"
@@ -38,9 +40,10 @@ def _pp_shard_map(f, mesh, in_specs, out_specs):
     'auto' so GSPMD keeps tensor/data parallelism inside each stage body."""
     # check_vma=True is load-bearing: jax 0.9's eager partial-manual path
     # (_unmatch) mis-builds an all-axes dst spec when check_vma=False
-    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs,
-                         axis_names=frozenset({PP_AXIS}), check_vma=True)
+    from ._compat import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs,
+                     axis_names=frozenset({PP_AXIS}), check_vma=True)
 
 
 @jax.custom_vjp
@@ -52,11 +55,11 @@ def _pvary_safe(x):
     compute (and the carried activations) genuinely bf16 on every
     backend — this replaces the old whole-region _cpu_f32_upcast for
     the compiled pipeline paths."""
-    return jax.lax.pvary(x, PP_AXIS)
+    return _pvary(x, PP_AXIS)
 
 
 def _pvary_safe_fwd(x):
-    return jax.lax.pvary(x, PP_AXIS), None
+    return _pvary(x, PP_AXIS), None
 
 
 def _pvary_safe_bwd(_, g):
@@ -254,8 +257,8 @@ def spmd_pipeline_interleaved(stage_fn, stacked_params: Dict[str, Any],
         mb_shape = mbs.shape[1:]
         zero = jnp.zeros(mb_shape, mbs.dtype)
         state = _pvary_safe(zero)
-        h0 = jax.lax.pvary(jnp.zeros((), jnp.int32), PP_AXIS)
-        m0 = jax.lax.pvary(jnp.zeros((), jnp.int32), PP_AXIS)
+        h0 = _pvary(jnp.zeros((), jnp.int32), PP_AXIS)
+        m0 = _pvary(jnp.zeros((), jnp.int32), PP_AXIS)
         out_buf = _pvary_safe(jnp.zeros((M,) + mb_shape, mbs.dtype))
 
         def tick(carry, t):

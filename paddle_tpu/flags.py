@@ -165,6 +165,11 @@ define_flag("FLAGS_gmm_impl", "auto",
             "'einsum'",
             validator=lambda v: v in ("auto", "xla", "intree", "bundled",
                                       "einsum"))
+define_flag("FLAGS_metrics", True,
+            "record observability metrics (paddle_tpu.observability): "
+            "counters/gauges/histograms from ops dispatch, jit caches, "
+            "trainer, serving and collectives. Off = every instrumented "
+            "site degrades to one attribute test (near-zero overhead)")
 define_flag("FLAGS_eager_op_cache_size", 4096,
             "max entries in the per-op jitted computation cache")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity (higher = chattier)")

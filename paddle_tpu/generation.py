@@ -22,11 +22,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import observability as _obs
 from .core.tensor import Tensor
 from .core import autograd as ag
 from .framework.random import next_key
 
 __all__ = ["generate"]
+
+# serving metrics (ISSUE 1): prefill vs decode token throughput, request
+# batch sizes, and decode-loop program-cache hit rate. Durations are host
+# wall-clock around the dispatching section; PJRT dispatch is async, so a
+# section's time includes device wait only where the code forces a fetch
+# (documented in docs/OBSERVABILITY.md).
+_SRV_REQS = _obs.registry().counter(
+    "pt_serving_requests_total", "generate-family calls", labels=("path",))
+_SRV_PREFILL_TOK = _obs.registry().counter(
+    "pt_serving_prefill_tokens_total", "prompt tokens prefilled")
+_SRV_DECODE_TOK = _obs.registry().counter(
+    "pt_serving_decode_tokens_total", "tokens produced by decode steps")
+_SRV_PREFILL_S = _obs.registry().histogram(
+    "pt_serving_prefill_seconds", "prefill section wall time",
+    labels=("path",))
+_SRV_DECODE_S = _obs.registry().histogram(
+    "pt_serving_decode_seconds", "decode section wall time",
+    labels=("path",))
+_SRV_BATCH = _obs.registry().histogram(
+    "pt_serving_batch_size", "request batch size",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+_JIT_CACHE = _obs.registry().counter(
+    "pt_jit_cache_events_total", "compiled-program cache lookups",
+    labels=("cache", "event"))
 
 
 def _logits_fn(model, ids_arr):
@@ -852,8 +877,19 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
     step = _make_cached_step(p, total)
     finished = jnp.zeros((B,), bool)
     out_tokens, out_scores = [], []
+    mx = _obs.enabled()
+    if mx:
+        _SRV_REQS.labels(path="cached").inc()
+        _SRV_BATCH.observe(B)
+        _SRV_PREFILL_TOK.inc(B * S0)
+    import time as _time
     with ag.no_grad():
+        t0 = _time.perf_counter() if mx else 0.0
         logits, caches = step(ids, caches, 0)          # prefill
+        if mx:
+            _SRV_PREFILL_S.labels(path="cached").observe(
+                _time.perf_counter() - t0)
+            t0 = _time.perf_counter()
         pos = S0
         while pos < total:
             tok = _sample_token(logits, decode_strategy, top_k, top_p,
@@ -871,6 +907,10 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
                 break
             logits, caches = step(tok[:, None], caches, pos)
             pos += 1
+    if mx:
+        _SRV_DECODE_S.labels(path="cached").observe(
+            _time.perf_counter() - t0)
+        _SRV_DECODE_TOK.inc(B * len(out_tokens))
     gen = jnp.stack(out_tokens, 1)
     sc = jnp.stack(out_scores, 1)
     if gen.shape[1] < max_new_tokens:
@@ -952,9 +992,16 @@ def _make_decode_loop(p, S0: int, max_new_tokens: int,
                 flag("FLAGS_mla_decode_impl"), flag("FLAGS_gmm_impl"),
                 flag("FLAGS_flash_impl"))
     jitted = _DECODE_LOOP_CACHE.get(prog_key)
+    if _obs.enabled():
+        _JIT_CACHE.labels(cache="decode_loop",
+                          event="hit" if jitted is not None
+                          else "miss").inc()
     if jitted is None:
         if len(_DECODE_LOOP_CACHE) >= 32:
             _DECODE_LOOP_CACHE.pop(next(iter(_DECODE_LOOP_CACHE)))
+            if _obs.enabled():
+                _JIT_CACHE.labels(cache="decode_loop",
+                                  event="evict").inc()
         jitted = jax.jit(run)
         _DECODE_LOOP_CACHE[prog_key] = jitted
     weights = _llama_weights(p)
@@ -995,8 +1042,21 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
     run = _make_decode_loop(p, S0, max_new_tokens, decode_strategy,
                             top_k, top_p, temperature, eos_token_id,
                             pad_token_id)
+    mx = _obs.enabled()
+    if mx:
+        _SRV_REQS.labels(path="compiled").inc()
+        _SRV_BATCH.observe(B)
+        _SRV_PREFILL_TOK.inc(B * S0)
+    import time as _time
+    t0 = _time.perf_counter() if mx else 0.0
     with ag.no_grad():
         gen, sc = run(ids, next_key())
+    if mx:
+        # one XLA program fuses prefill + decode; the whole call is
+        # charged to the decode section
+        _SRV_DECODE_S.labels(path="compiled").observe(
+            _time.perf_counter() - t0)
+        _SRV_DECODE_TOK.inc(B * max_new_tokens)
     return Tensor(gen), Tensor(sc)
 
 

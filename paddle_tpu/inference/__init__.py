@@ -18,13 +18,30 @@ Artifact format (written by `paddle_tpu.jit.save(layer, path, input_spec)`):
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
+
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+# serving-engine metrics (ISSUE 1): queue wait is the staging-to-execution
+# gap — the time between the FIRST copy_from_cpu of a request's inputs and
+# the run() that consumes them (the paddle_infer feed/run protocol)
+_Q_WAIT = _obs.registry().histogram(
+    "pt_serving_queue_wait_seconds",
+    "staging (copy_from_cpu) to run() latency per request")
+_RUN_S = _obs.registry().histogram(
+    "pt_serving_run_seconds", "Predictor.run wall time")
+_RUN_BATCH = _obs.registry().histogram(
+    "pt_serving_run_batch_size", "leading input dim per Predictor.run",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+_RUN_TOTAL = _obs.registry().counter(
+    "pt_serving_run_total", "Predictor.run calls")
 
 
 class Config:
@@ -86,6 +103,7 @@ class PredictorTensor:
         self.name = name
         self._spec = spec
         self._value: Optional[jnp.ndarray] = None
+        self._staged_ts: Optional[float] = None
 
     def reshape(self, shape: Sequence[int]):
         self._spec = jax.ShapeDtypeStruct(tuple(shape), self._spec.dtype)
@@ -99,6 +117,8 @@ class PredictorTensor:
         if arr.dtype != self._spec.dtype:
             arr = arr.astype(self._spec.dtype)
         self._value = arr
+        if _obs.enabled():
+            self._staged_ts = time.perf_counter()
 
     def copy_to_cpu(self) -> np.ndarray:
         if self._value is None:
@@ -186,7 +206,21 @@ class Predictor:
             if v is None:
                 raise RuntimeError(f"input {n!r} not set")
             args.append(v)
+        mx = _obs.enabled()
+        if mx:
+            _RUN_TOTAL.inc()
+            staged = [self._inputs[n]._staged_ts for n in self._input_names]
+            staged = [s for s in staged if s is not None]
+            t_run = time.perf_counter()
+            if staged:
+                _Q_WAIT.observe(t_run - min(staged))
+                for n in self._input_names:
+                    self._inputs[n]._staged_ts = None
+            if args and getattr(args[0], "ndim", 0):
+                _RUN_BATCH.observe(int(args[0].shape[0]))
         outs = self._call(*args)
+        if mx:
+            _RUN_S.observe(time.perf_counter() - t_run)
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
         flat = jax.tree_util.tree_leaves(outs)

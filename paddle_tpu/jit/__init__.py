@@ -29,8 +29,19 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
+
+# jit-cache visibility (ISSUE 1): traces happen once per new signature
+# (jax.jit cache miss = a compile), calls happen every invocation; the
+# hit rate is (calls - traces) / calls
+_JIT_TRACE = _obs.registry().counter(
+    "pt_jit_trace_total", "to_static retraces (jit-cache misses)",
+    labels=("kind",))
+_JIT_CALL = _obs.registry().counter(
+    "pt_jit_call_total", "to_static compiled-wrapper invocations",
+    labels=("kind",))
 
 __all__ = ["to_static", "jit", "functional_call", "extract_state",
            "bind_state", "save", "load", "TracedLayer", "TranslatedLayer",
@@ -195,6 +206,9 @@ class StaticFunction:
         pure_dyn = pure
 
         def pure(mode_sig, *rest):
+            # this wrapper only runs while jax.jit TRACES (a cache miss),
+            # so the increment counts compiles, not steady-state calls
+            _JIT_TRACE.labels(kind="to_static").inc()
             from ..flags import get_flags
             if get_flags("FLAGS_use_fusion_compiler")[
                     "FLAGS_use_fusion_compiler"]:
@@ -208,6 +222,8 @@ class StaticFunction:
                      for l in lay.sublayers(include_self=True))
 
     def __call__(self, *args, **kwargs):
+        if _obs.enabled():
+            _JIT_CALL.labels(kind="to_static").inc()
         if self._compiled is None:
             self._build()
         from ..framework.random import default_generator

@@ -331,9 +331,12 @@ def fuse_compile(fn, *example_args):
     with mlir.make_ir_context():
         module = ir.Module.parse(new_text)   # MLIR verifier gate
         opts = xc.CompileOptions()
-        devs = xc.DeviceList(tuple(backend.local_devices()[:1]))
-        exe = compiler.backend_compile_and_load(
-            backend, module, devs, opts, [])
+        if hasattr(compiler, "backend_compile_and_load"):
+            devs = xc.DeviceList(tuple(backend.local_devices()[:1]))
+            exe = compiler.backend_compile_and_load(
+                backend, module, devs, opts, [])
+        else:  # older jax: no explicit executable-device list
+            exe = compiler.backend_compile(backend, module, opts, [])
 
     n_out = len(out_leaves)
 

@@ -21,7 +21,21 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
+
 __all__ = ["sdpa_reference", "flash_attention", "sdpa_path"]
+
+# per-kernel dispatch counters (ISSUE 1). Inside a jit trace each site
+# counts once per compile, eagerly once per call — either way the label
+# answers "which implementation did this config actually route to".
+_KERNEL = _obs.registry().counter(
+    "pt_kernel_launch_total",
+    "fused-kernel dispatches by implementation route", labels=("kernel",))
+
+
+def _count_kernel(kernel: str) -> None:
+    if _obs.enabled():
+        _KERNEL.labels(kernel=kernel).inc()
 
 
 def sdpa_reference(q, k, v, mask=None, causal: bool = False,
@@ -160,10 +174,12 @@ def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
     path = sdpa_path(q, k, mask=mask, causal=causal, dropout_p=dropout_p)
     if path == "flash":
         if _flash_impl() == "intree":
+            _count_kernel("flash_intree")
             from .pallas_flash import flash_sdpa
             return flash_sdpa(q, k, v, causal=causal, scale=scale,
                               block_q=_largest_dividing_block(Sq),
                               block_k=_largest_dividing_block(Sk))
+        _count_kernel("flash_bundled")
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _pallas_flash)
         qh = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
@@ -173,6 +189,7 @@ def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
                             block_sizes=_flash_block_sizes(Sq, Sk))
         return jnp.swapaxes(out, 1, 2)
     if path == "flash_segmented":
+        _count_kernel("flash_segmented")
         pad = _as_key_padding(mask, B, Sq, Sk)
         seg_kv = pad.astype(jnp.int32)
         # every QUERY row keeps segment 1: a key mask excludes keys for
@@ -181,6 +198,7 @@ def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
         seg_q = jnp.ones((B, Sq), jnp.int32)
         return sdpa_segmented(q, k, v, seg_q, kv_segment_ids=seg_kv,
                               causal=causal, scale=scale)
+    _count_kernel("sdpa_composite")
     if mask is not None:
         pad = _as_key_padding(mask, B, Sq, Sk)
         if pad is not None:  # normalize [B,Sk] forms for broadcasting

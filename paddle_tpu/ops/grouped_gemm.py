@@ -17,6 +17,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .flash_attention import _count_kernel
+
 __all__ = ["grouped_gemm", "sort_by_group", "unsort_by_group"]
 
 
@@ -36,6 +38,7 @@ def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
     G = rhs.shape[0]
     gs32 = group_sizes.astype(jnp.int32)
     if impl == "xla":
+        _count_kernel("gmm_xla")
         return jax.lax.ragged_dot(lhs, rhs, gs32)
     if impl == "intree":
         from .pallas_gmm import gmm, gmm_kernel_eligible
@@ -45,9 +48,11 @@ def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
                 f"FLAGS_gmm_impl='intree' pinned but shape M={lhs.shape[0]} "
                 f"K={lhs.shape[1]} N={rhs.shape[2]} is not kernel-eligible "
                 "(N and K must be 128-multiples)")
+        _count_kernel("gmm_intree")
         return gmm(lhs, rhs, gs32)
     if impl == "bundled":
         from jax.experimental.pallas.ops.tpu.megablox import gmm as mb_gmm
+        _count_kernel("gmm_bundled")
         return mb_gmm(lhs, rhs, gs32)
     if impl == "auto" and prefer_ragged:
         # NOTE: the try/excepts below only catch TRACE-time rejections
@@ -56,14 +61,18 @@ def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
         # gated on static predicates first — kernel eligibility and a VMEM
         # block-footprint bound — and the excepts are just a second fence.
         try:
-            return jax.lax.ragged_dot(lhs, rhs, gs32)
+            out = jax.lax.ragged_dot(lhs, rhs, gs32)
+            _count_kernel("gmm_xla")
+            return out
         except Exception:  # pragma: no cover - backend-specific gaps
             pass
         from .pallas_gmm import gmm, gmm_kernel_eligible
         if (gmm_kernel_eligible(lhs.shape[0], lhs.shape[1], rhs.shape[2])
                 and _gmm_vmem_ok(lhs.shape[1], rhs.shape[2], lhs.dtype)):
             try:
-                return gmm(lhs, rhs, gs32)
+                out = gmm(lhs, rhs, gs32)
+                _count_kernel("gmm_intree")
+                return out
             except Exception:  # pragma: no cover - trace-time only
                 pass
         if (jax.default_backend() == "tpu"
@@ -72,10 +81,13 @@ def grouped_gemm(lhs, rhs, group_sizes, *, prefer_ragged: bool = True):
                 # megablox gmm: the bundled Pallas TPU grouped-GEMM kernel
                 from jax.experimental.pallas.ops.tpu.megablox import gmm \
                     as mb_gmm
-                return mb_gmm(lhs, rhs, gs32)
+                out = mb_gmm(lhs, rhs, gs32)
+                _count_kernel("gmm_bundled")
+                return out
             except Exception:  # pragma: no cover - kernel constraints
                 pass
     # fallback: one-hot group membership -> batched einsum (static shapes)
+    _count_kernel("gmm_einsum")
     M = lhs.shape[0]
     ends = jnp.cumsum(group_sizes)
     starts = ends - group_sizes

@@ -19,7 +19,29 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
+from .flash_attention import _count_kernel
+
 __all__ = ["paged_attention", "paged_attention_reference", "append_to_cache"]
+
+# serving KV-cache visibility: fraction of allocated page capacity that
+# holds live tokens, sampled at each EAGER paged-attention call (traced
+# calls have abstract lengths and are skipped)
+_KV_UTIL = _obs.registry().gauge(
+    "pt_serving_kv_page_utilization",
+    "mean(lengths) / (pages_per_seq * page_size) at the last eager call")
+
+
+def _sample_kv_utilization(lengths, page_indices, page_size: int) -> None:
+    if not _obs.enabled() or isinstance(lengths, jax.core.Tracer):
+        return
+    try:
+        import numpy as np
+        cap = page_indices.shape[1] * page_size
+        if cap:
+            _KV_UTIL.set(float(np.asarray(lengths).mean()) / cap)
+    except Exception:
+        pass  # metrics must never break the serving path
 
 
 def paged_attention_reference(q, k_pages, v_pages, lengths, page_indices,
@@ -71,10 +93,12 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices,
     impl = flag("FLAGS_paged_impl")
     H, D = q.shape[1], q.shape[2]
     KV, page_size = k_pages.shape[0], k_pages.shape[2]
+    _sample_kv_utilization(lengths, page_indices, page_size)
     if impl == "intree":
         from .pallas_paged import (paged_decode_attention_v2,
                                    paged_kernel_eligible)
         if paged_kernel_eligible(H, KV, D, page_size):
+            _count_kernel("paged_intree")
             return paged_decode_attention_v2(q, k_pages, v_pages,
                                              lengths, page_indices, scale)
     elif impl == "intree_v1":
@@ -82,6 +106,7 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices,
         from .pallas_paged import (paged_decode_attention,
                                    paged_kernel_eligible)
         if paged_kernel_eligible(H, KV, D, page_size):
+            _count_kernel("paged_intree_v1")
             return paged_decode_attention(q, k_pages, v_pages,
                                           lengths, page_indices, scale)
     elif impl == "bundled" and jax.default_backend() == "tpu":
@@ -99,11 +124,14 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices,
             ppcb = min(default_pages_per_group(nj, page_size), nj)
             while nj % ppcb:
                 ppcb //= 2
-            return _kernel(sq, k_pages, v_pages, lengths.astype(jnp.int32),
-                           page_indices.astype(jnp.int32),
-                           pages_per_compute_block=max(ppcb, 1))
+            out = _kernel(sq, k_pages, v_pages, lengths.astype(jnp.int32),
+                          page_indices.astype(jnp.int32),
+                          pages_per_compute_block=max(ppcb, 1))
+            _count_kernel("paged_bundled")
+            return out
         except Exception:
             pass
+    _count_kernel("paged_reference")
     return paged_attention_reference(q, k_pages, v_pages, lengths,
                                      page_indices, scale)
 

@@ -37,6 +37,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 __all__ = ["flash_sdpa", "flash_kernel_eligible"]
 
 _NEG = -1e30
@@ -44,7 +48,7 @@ _NEG = -1e30
 # B/H/outer-block grid dims are independent; only the innermost dim
 # carries the online-softmax / accumulator state. Marking them parallel
 # lets Mosaic split them across TensorCores (megacore parts)
-_CPARAMS = pltpu.CompilerParams(
+_CPARAMS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 

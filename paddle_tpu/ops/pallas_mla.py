@@ -144,7 +144,9 @@ def mla_decode_attention(q_eff, q_pe, c_lat, c_pe, lengths, *,
                 pltpu.VMEM((nh, 1), jnp.float32),
             ]),
         out_shape=jax.ShapeDtypeStruct((B, nh, r), c_lat.dtype),
-        compiler_params=pltpu.CompilerParams(
+        # jax renamed TPUCompilerParams -> CompilerParams; accept both
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(lens, q_eff, q_pe, c_lat, c_pe)

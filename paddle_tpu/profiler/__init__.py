@@ -56,12 +56,30 @@ def make_scheduler(*, closed: int = 0, ready: int = 0, record: int = 1,
     return schedule
 
 
+def _sanitize_worker_name(name: str) -> str:
+    """Worker names come from user config (hostnames, rank strings): strip
+    path separators and anything else unsafe for a filename."""
+    import re
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", name)
+    safe = safe.lstrip("._")  # no hidden/relative-looking names
+    return safe or f"worker_{os.getpid()}"
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
-    """on_trace_ready factory writing chrome-trace JSON (host events)."""
+    """on_trace_ready factory writing chrome-trace JSON (host events).
+
+    The worker name is sanitized for filesystem safety, parent directories
+    are created, and an existing trace file is never overwritten — a
+    deterministic numeric suffix (`name.1`, `name.2`, …) is appended
+    instead, so repeated exports from scheduler cycles all survive."""
     def handler(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)
-        name = worker_name or f"worker_{os.getpid()}"
+        name = _sanitize_worker_name(worker_name or f"worker_{os.getpid()}")
         path = os.path.join(dir_name, f"{name}.pt.trace.json")
+        n = 0
+        while os.path.exists(path):
+            n += 1
+            path = os.path.join(dir_name, f"{name}.{n}.pt.trace.json")
         prof_export(path, pid=os.getpid())
         prof.last_export_path = path
     return handler
@@ -157,10 +175,20 @@ class Profiler:
                 time_unit="ms", views=None):
         """Aggregate host events into a per-name table (printed + returned)."""
         import json
-        tmp = f"/tmp/_pt_prof_{os.getpid()}.json"
-        prof_export(tmp, pid=os.getpid())
-        with open(tmp) as f:
-            events = json.load(f)["traceEvents"]
+        import tempfile
+        # round-trip through a private temp file that is always unlinked
+        # (the old fixed /tmp/_pt_prof_<pid>.json leaked one file per pid)
+        fd, tmp = tempfile.mkstemp(prefix="_pt_prof_", suffix=".json")
+        try:
+            os.close(fd)
+            prof_export(tmp, pid=os.getpid())
+            with open(tmp) as f:
+                events = json.load(f)["traceEvents"]
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         agg = defaultdict(lambda: [0, 0.0])
         for e in events:
             agg[e["name"]][0] += 1

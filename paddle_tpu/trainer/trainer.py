@@ -21,7 +21,36 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from .. import observability as _obs
+
 __all__ = ["TrainingArguments", "Trainer"]
+
+# trainer metrics (ISSUE 1): host wall-time breakdown of the optimizer
+# step, throughput gauges, and a grad-norm histogram. Section times are
+# host-side; the device sync happens where the loop already fetches the
+# loss, so data/forward/backward/optimizer partition the step's wall time.
+_T_DATA = _obs.registry().histogram(
+    "pt_train_data_seconds", "dataloader next() wall time")
+_T_FWD = _obs.registry().histogram(
+    "pt_train_forward_seconds", "loss computation wall time")
+_T_BWD = _obs.registry().histogram(
+    "pt_train_backward_seconds", "backward (tape walk) wall time")
+_T_OPT = _obs.registry().histogram(
+    "pt_train_optimizer_seconds",
+    "optimizer.step + clear_grad + lr step wall time")
+_G_TOKPS = _obs.registry().gauge(
+    "pt_train_tokens_per_second", "training token throughput (running)")
+_G_SAMPPS = _obs.registry().gauge(
+    "pt_train_samples_per_second", "training sample throughput (running)")
+_G_MFU = _obs.registry().gauge(
+    "pt_train_mfu", "model flops utilization (needs flops_per_sample and "
+    "hardware_peak_flops in TrainingArguments)")
+_H_GNORM = _obs.registry().histogram(
+    "pt_train_grad_norm", "global grad norm per optimizer step",
+    buckets=(1e-3, 1e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+             100.0, 1e3, 1e4))
+_C_STEPS = _obs.registry().counter(
+    "pt_train_steps_total", "optimizer steps taken")
 
 
 @dataclasses.dataclass
@@ -46,6 +75,8 @@ class TrainingArguments:
     lr_scheduler_type: str = "linear"   # linear | cosine | constant
     # informational for MFU logging:
     flops_per_sample: float = 0.0
+    # peak chip flops for the MFU gauge (0 = gauge not set):
+    hardware_peak_flops: float = 0.0
 
     def __init__(self, **kwargs):
         for f in dataclasses.fields(self):
@@ -140,14 +171,55 @@ class Trainer:
 
     def training_step(self, batch) -> float:
         paddle = self.paddle
+        mx = _obs.enabled()
+        t0 = time.perf_counter() if mx else 0.0
         if self.args.bf16:
             with paddle.amp.auto_cast(dtype="bfloat16"):
                 loss = self.compute_loss(self.model, batch)
         else:
             loss = self.compute_loss(self.model, batch)
+        if mx:
+            t1 = time.perf_counter()
+            _T_FWD.observe(t1 - t0)
         scaled = loss / self.args.gradient_accumulation_steps
         scaled.backward()
+        if mx:
+            _T_BWD.observe(time.perf_counter() - t1)
         return float(loss.numpy())
+
+    def _grad_global_norm(self) -> Optional[float]:
+        """Host-side global grad norm over model parameters (metrics only —
+        the optimizer's own clip path is untouched)."""
+        try:
+            import jax.numpy as jnp
+            sq = 0.0
+            seen = False
+            for p in self.model.parameters():
+                g = getattr(p, "_grad", None)
+                if g is None:
+                    continue
+                a = g._data if hasattr(g, "_data") else g
+                sq = sq + jnp.sum(jnp.square(a.astype(jnp.float32)))
+                seen = True
+            return float(jnp.sqrt(sq)) if seen else None
+        except Exception:
+            return None
+
+    def _count_tokens(self, batch) -> int:
+        """Tokens in a micro-batch for the throughput gauge: the size of
+        an `input_ids`-like field when present, else the batch size."""
+        try:
+            if isinstance(batch, dict):
+                for k in ("input_ids", "ids", "tokens"):
+                    if k in batch and hasattr(batch[k], "shape"):
+                        return int(np.prod(batch[k].shape))
+            elif isinstance(batch, (list, tuple)) and batch \
+                    and hasattr(batch[0], "shape") \
+                    and getattr(batch[0], "ndim", 0) >= 2:
+                return int(np.prod(batch[0].shape[:2]))
+        except Exception:
+            pass
+        return self.args.per_device_train_batch_size
 
     def train(self, resume_from_checkpoint: Optional[str] = None):
         args = self.args
@@ -186,11 +258,25 @@ class Trainer:
                   steps_per_epoch):
         args = self.args
         samples = 0
+        tokens = 0
         while not done:
-            for batch in loader:
+            # manual iteration (not `for batch in loader`) so the metrics
+            # layer can see dataloader latency as its own step section
+            it = iter(loader)
+            while True:
+                mx = _obs.enabled()
+                td = time.perf_counter() if mx else 0.0
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                if mx:
+                    _T_DATA.observe(time.perf_counter() - td)
                 if skip > 0:
                     skip -= 1
                     continue
+                if mx:
+                    tokens += self._count_tokens(batch)
                 losses.append(self.training_step(batch))
                 samples += args.per_device_train_batch_size
                 self.state["micro_batches"] += 1
@@ -198,10 +284,18 @@ class Trainer:
                 if accum < args.gradient_accumulation_steps:
                     continue
                 accum = 0
+                if mx:
+                    gn = self._grad_global_norm()
+                    if gn is not None:
+                        _H_GNORM.observe(gn)
+                    to = time.perf_counter()
                 self.optimizer.step()
                 self.optimizer.clear_grad()
                 if self.lr_scheduler is not None:
                     self.lr_scheduler.step()
+                if mx:
+                    _T_OPT.observe(time.perf_counter() - to)
+                    _C_STEPS.inc()
                 self.state["global_step"] += 1
                 gs = self.state["global_step"]
                 self.state["epoch"] = gs / max(
@@ -218,6 +312,13 @@ class Trainer:
                         entry["tflops"] = (samples * args.flops_per_sample
                                            / dt / 1e12)
                     self.state["log_history"].append(entry)
+                    if mx:
+                        _G_SAMPPS.set(entry["samples_per_sec"])
+                        _G_TOKPS.set(tokens / max(dt, 1e-9))
+                        if args.flops_per_sample and args.hardware_peak_flops:
+                            _G_MFU.set(samples * args.flops_per_sample
+                                       / max(dt, 1e-9)
+                                       / args.hardware_peak_flops)
                 if self._preempted:
                     # log the marker BEFORE serializing so the emergency
                     # checkpoint's trainer_state.json records the preemption

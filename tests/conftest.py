@@ -16,7 +16,26 @@ if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
 # 8 virtual devices share one physical core: a lagging device thread can
 # miss XLA-CPU's default 40s collective rendezvous kill on a busy host
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+
+
+def _xla_knows(flag_name: str) -> bool:
+    """True when the installed jaxlib's XLA recognizes `flag_name`. Older
+    XLA builds hard-abort the process on any unknown flag in XLA_FLAGS
+    (parse_flags_from_env), so probe the binary before opting in."""
+    try:
+        import mmap
+        import jaxlib
+        so = os.path.join(os.path.dirname(jaxlib.__file__),
+                          "xla_extension.so")
+        with open(so, "rb") as f:
+            with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as m:
+                return m.find(flag_name.encode()) != -1
+    except Exception:
+        return False
+
+
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags and \
+        _xla_knows("xla_cpu_collective_call_terminate_timeout_seconds"):
     flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=900"
               " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300")
 os.environ["XLA_FLAGS"] = flags

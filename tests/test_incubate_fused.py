@@ -106,7 +106,11 @@ def test_fused_mha_qkv_weight_gets_grad():
                              stop_gradient=False)
     out = F.fused_multi_head_attention(x, qkv_w, lin_w, dropout_rate=0.0,
                                        attn_dropout_rate=0.0)
-    out.sum().backward()
+    # quadratic loss: a plain sum() of the post-LN output is invariant to
+    # the input up to the epsilon residue (each normalized row sums to ~0),
+    # so its true gradient is numerical noise that some XLA builds round
+    # to exactly 0. sum(out^2) depends on the input through LN robustly.
+    (out * out).sum().backward()
     assert qkv_w.grad is not None and float(
         paddle.abs(qkv_w.grad).sum()) > 0
     assert lin_w.grad is not None
@@ -143,6 +147,7 @@ def test_fused_mha_cache_receives_grad():
     out, _ = F.fused_multi_head_attention(
         x, qkv_w, lin_w, cache_kv=cache, dropout_rate=0.0,
         attn_dropout_rate=0.0)
-    out.sum().backward()
+    # quadratic loss — see test_fused_mha_qkv_weight_gets_grad
+    (out * out).sum().backward()
     assert cache.grad is not None and float(
         paddle.abs(cache.grad).sum()) > 0

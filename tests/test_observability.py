@@ -1,0 +1,365 @@
+"""Observability layer (ISSUE 1): metric semantics, exporter round-trips,
+span/step-log correlation, the disabled-path overhead gate, and the
+acceptance check that >=4 subsystems actually report into the default
+registry (ops dispatch, collectives, trainer, serving)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import (Registry, StepLogger, parse_prometheus,
+                                      sample_values, span, to_prometheus)
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    """Every test here assumes metrics are recording; restore on exit."""
+    prev = obs.enabled()
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# metric semantics
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_counter(self):
+        r = Registry()
+        c = r.counter("c_total", "help text")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge(self):
+        r = Registry()
+        g = r.gauge("g", "a gauge")
+        g.set(4.0)
+        g.inc()
+        g.dec(2.0)
+        assert g.value == 3.0
+
+    def test_histogram_buckets(self):
+        r = Registry()
+        h = r.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count == 5
+        assert h.sum == pytest.approx(56.05)
+        flat = sample_values(r)
+        # cumulative exposition: le=0.1 -> 1, le=1 -> 3, le=10 -> 4, +Inf -> 5
+        assert flat['h_seconds_bucket{le="0.1"}'] == 1
+        assert flat['h_seconds_bucket{le="1"}'] == 3
+        assert flat['h_seconds_bucket{le="10"}'] == 4
+        assert flat['h_seconds_bucket{le="+Inf"}'] == 5
+
+    def test_histogram_timer(self):
+        r = Registry()
+        h = r.histogram("t_seconds")
+        with h.time():
+            time.sleep(0.002)
+        assert h.count == 1
+        assert h.sum >= 0.002
+
+    def test_labels_vend_children(self):
+        r = Registry()
+        c = r.counter("ops_total", labels=("op",))
+        c.labels(op="add").inc()
+        c.labels(op="add").inc()
+        c.labels(op="mul").inc()
+        assert c.labels(op="add").value == 2
+        assert c.labels(op="mul").value == 1
+        with pytest.raises(ValueError):
+            c.labels(notalabel="x")
+
+    def test_get_or_create_and_mismatch(self):
+        r = Registry()
+        a = r.counter("same", "h")
+        assert r.counter("same") is a
+        with pytest.raises(ValueError):
+            r.gauge("same")
+        with pytest.raises(ValueError):
+            r.counter("same", labels=("x",))
+
+    def test_disabled_mutations_are_dropped(self):
+        r = Registry()
+        c = r.counter("off_total")
+        h = r.histogram("off_seconds")
+        obs.set_enabled(False)
+        c.inc()
+        h.observe(1.0)
+        obs.set_enabled(True)
+        assert c.value == 0 and h.count == 0
+
+    def test_thread_safety(self):
+        r = Registry()
+        c = r.counter("mt_total", labels=("t",))
+        u = r.counter("mt_unlabeled_total")
+
+        def work(i):
+            for _ in range(1000):
+                c.labels(t=str(i % 2)).inc()
+                u.inc()
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert u.value == 4000
+        assert (c.labels(t="0").value + c.labels(t="1").value) == 4000
+
+    def test_reset(self):
+        r = Registry()
+        c = r.counter("r_total", labels=("k",))
+        c.labels(k="a").inc(5)
+        r.reset()
+        assert c.labels(k="a").value == 0
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def _populated_registry():
+    r = Registry()
+    r.counter("req_total", 'requests with "quotes" and \\ and\nnewline',
+              labels=("path", "code")).labels(path="/v1", code="200").inc(7)
+    g = r.gauge("temp", "a gauge")
+    g.set(36.6)
+    h = r.histogram("lat_seconds", "latency", labels=("route",),
+                    buckets=(0.01, 0.1, 1.0))
+    h.labels(route="a").observe(0.005)
+    h.labels(route="a").observe(0.5)
+    h.labels(route="b").observe(99.0)
+    return r
+
+
+class TestExporters:
+    def test_prometheus_round_trip(self):
+        r = _populated_registry()
+        text = to_prometheus(r)
+        assert "# TYPE req_total counter" in text
+        assert "# TYPE lat_seconds histogram" in text
+        assert parse_prometheus(text) == sample_values(r)
+
+    def test_json_snapshot_round_trip(self):
+        r = _populated_registry()
+        snap = r.snapshot()
+        # survives actual JSON serialization, not just dict equality
+        snap2 = json.loads(json.dumps(snap))
+        rebuilt = Registry.from_snapshot(snap2)
+        assert rebuilt.snapshot() == snap
+        assert sample_values(rebuilt) == sample_values(r)
+
+    def test_prometheus_escaping(self):
+        r = Registry()
+        r.counter("e_total", labels=("v",)).labels(v='a"b\\c\nd').inc()
+        flat = parse_prometheus(to_prometheus(r))
+        assert flat == sample_values(r)
+
+
+# ---------------------------------------------------------------------------
+# spans + step log (chrome-trace correlation)
+# ---------------------------------------------------------------------------
+
+class TestStepLog:
+    def test_span_ids_join_trace_and_jsonl(self, tmp_path):
+        from paddle_tpu import native
+        native.prof_clear()
+        native.prof_enable(True)
+        log_path = str(tmp_path / "steps.jsonl")
+        with StepLogger(log_path) as sl:
+            with span("train_step") as sp:
+                sum(range(100))
+            sl.log(step=1, span_id=sp.span_id, loss=0.5)
+        native.prof_enable(False)
+        trace = str(tmp_path / "trace.json")
+        native.prof_export(trace)
+        events = json.load(open(trace))["traceEvents"]
+        names = [e["name"] for e in events]
+        assert f"train_step[span={sp.span_id}]" in names
+        rows = [json.loads(l) for l in open(log_path)]
+        assert rows[0]["step"] == 1
+        assert rows[0]["span_id"] == sp.span_id
+        assert rows[0]["loss"] == 0.5
+        assert isinstance(rows[0]["metrics"], dict)
+        native.prof_clear()
+
+    def test_step_log_snapshots_metrics(self, tmp_path):
+        r = Registry()
+        c = r.counter("steps_total")
+        p = str(tmp_path / "s.jsonl")
+        with StepLogger(p, reg=r) as sl:
+            c.inc()
+            sl.log(step=1)
+            c.inc()
+            sl.log(step=2)
+        rows = [json.loads(l) for l in open(p)]
+        assert rows[0]["metrics"]["steps_total"] == 1
+        assert rows[1]["metrics"]["steps_total"] == 2
+
+
+# ---------------------------------------------------------------------------
+# subsystem population (acceptance: >=4 subsystems report in)
+# ---------------------------------------------------------------------------
+
+class TestSubsystems:
+    def test_dispatch_and_collectives_and_serving_and_trainer(self, tmp_path):
+        reg = obs.registry()
+
+        # 1. ops dispatch: one eager add
+        before = sample_values(reg).get('pt_ops_dispatch_total{op="add"}', 0)
+        t = paddle.to_tensor(np.ones((2, 2), np.float32))
+        _ = t + t
+        flat = sample_values(reg)
+        assert flat['pt_ops_dispatch_total{op="add"}'] == before + 1
+
+        # 2. collectives: all_reduce (meshless degrades to identity but the
+        #    call-level instrumentation still fires)
+        from paddle_tpu.distributed import collective
+        b4_calls = flat.get(
+            'pt_collective_calls_total{collective="all_reduce"}', 0)
+        collective.all_reduce(paddle.to_tensor(np.ones(4, np.float32)))
+        flat = sample_values(reg)
+        assert flat['pt_collective_calls_total{collective="all_reduce"}'] \
+            == b4_calls + 1
+        assert flat['pt_collective_bytes_total{collective="all_reduce"}'] > 0
+        assert flat['pt_collective_seconds_count'
+                    '{collective="all_reduce"}'] >= 1
+
+        # 3. serving: paged decode attention samples KV-page utilization and
+        #    counts the routed kernel
+        from paddle_tpu.ops.paged_attention import paged_attention
+        q = np.random.RandomState(0).randn(2, 2, 8).astype(np.float32)
+        kp = np.random.RandomState(1).randn(1, 4, 4, 8).astype(np.float32)
+        vp = np.random.RandomState(2).randn(1, 4, 4, 8).astype(np.float32)
+        lens = np.array([3, 6], np.int32)
+        tab = np.array([[0, 1], [2, 3]], np.int32)
+        import jax.numpy as jnp
+        paged_attention(jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                        jnp.asarray(lens), jnp.asarray(tab))
+        flat = sample_values(reg)
+        util = flat["pt_serving_kv_page_utilization"]
+        assert util == pytest.approx(4.5 / 8.0)
+        assert sum(v for k, v in flat.items()
+                   if k.startswith("pt_kernel_launch_total")) >= 1
+
+        # 4. trainer: a 2-step run populates the step breakdown + gauges
+        from paddle_tpu import nn
+        from paddle_tpu.io import Dataset
+        from paddle_tpu.trainer.trainer import Trainer, TrainingArguments
+
+        class DS(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                x = np.full((4,), i, np.float32)
+                return x, x.sum(keepdims=True)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 1)
+
+            def forward(self, x, y=None):
+                out = self.fc(x)
+                if y is not None:
+                    return ((out - y) ** 2).mean()
+                return out
+
+        b4_steps = flat.get("pt_train_steps_total", 0)
+        tr = Trainer(model=Net(),
+                     args=TrainingArguments(
+                         output_dir=str(tmp_path), max_steps=2,
+                         per_device_train_batch_size=4, logging_steps=1,
+                         flops_per_sample=1e6, hardware_peak_flops=1e12),
+                     train_dataset=DS())
+        tr.train()
+        flat = sample_values(reg)
+        assert flat["pt_train_steps_total"] == b4_steps + 2
+        assert flat["pt_train_forward_seconds_count"] >= 2
+        assert flat["pt_train_backward_seconds_count"] >= 2
+        assert flat["pt_train_optimizer_seconds_count"] >= 2
+        assert flat["pt_train_data_seconds_count"] >= 2
+        assert flat["pt_train_grad_norm_count"] >= 2
+        assert flat["pt_train_samples_per_second"] > 0
+        assert flat["pt_train_tokens_per_second"] > 0
+        assert flat["pt_train_mfu"] > 0
+
+        # the four subsystems are all visible in one Prometheus scrape
+        text = to_prometheus(reg)
+        for family in ("pt_ops_dispatch_total", "pt_collective_calls_total",
+                       "pt_serving_kv_page_utilization",
+                       "pt_train_steps_total"):
+            assert f"# TYPE {family}" in text
+
+    def test_jit_cache_hit_miss_counters(self):
+        reg = obs.registry()
+        from paddle_tpu import jit
+
+        @jit.to_static
+        def f(x):
+            return x * 2 + 1
+
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        f(x)
+        f(x)
+        f(x)
+        flat = sample_values(reg)
+        calls = flat['pt_jit_call_total{kind="to_static"}']
+        traces = flat['pt_jit_trace_total{kind="to_static"}']
+        assert calls >= 3
+        # same shape/dtype -> exactly one trace for the three calls
+        assert traces >= 1
+        assert calls - traces >= 2  # cache hits
+
+
+# ---------------------------------------------------------------------------
+# overhead gate: disabled metrics must not tax the hot loop
+# ---------------------------------------------------------------------------
+
+class TestOverhead:
+    def test_disabled_overhead_under_5pct(self):
+        r = Registry()
+        c = r.counter("ov_total")
+        h = r.histogram("ov_seconds")
+        a = np.random.RandomState(0).randn(160, 160).astype(np.float32)
+        n = 600
+
+        def plain():
+            t0 = time.perf_counter()
+            for _ in range(n):
+                a.dot(a)
+            return time.perf_counter() - t0
+
+        def instrumented():
+            t0 = time.perf_counter()
+            for _ in range(n):
+                a.dot(a)
+                c.inc()
+                h.observe(1.0)
+            return time.perf_counter() - t0
+
+        obs.set_enabled(False)
+        try:
+            # warm both paths, then interleave rounds and compare the best
+            # observation of each (min filters scheduler noise)
+            plain()
+            instrumented()
+            tp, ti = [], []
+            for _ in range(7):
+                tp.append(plain())
+                ti.append(instrumented())
+        finally:
+            obs.set_enabled(True)
+        assert c.value == 0  # the flag really gated recording
+        assert min(ti) < min(tp) * 1.05, (
+            f"disabled-metrics loop {min(ti):.4f}s vs plain {min(tp):.4f}s "
+            f"(+{(min(ti) / min(tp) - 1) * 100:.1f}%)")

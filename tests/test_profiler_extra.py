@@ -1,0 +1,126 @@
+"""Profiler edge cases (ISSUE 1 satellites): scheduler state machine
+boundaries, load_profiler_result input formats, summary() temp-file
+hygiene, and export_chrome_tracing filesystem safety."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.profiler import (Profiler, export_chrome_tracing,
+                                 load_profiler_result, make_scheduler)
+from paddle_tpu.profiler import _ProfilerState as S
+
+
+class TestMakeScheduler:
+    def test_skip_first_then_repeat_exhaustion(self):
+        # period = 1+1+2 = 4; skip 3; repeat twice then closed forever
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                               skip_first=3)
+        assert [sched(i) for i in range(3)] == [S.CLOSED] * 3
+        cycle = [S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN]
+        assert [sched(3 + i) for i in range(4)] == cycle
+        assert [sched(7 + i) for i in range(4)] == cycle
+        # repeat budget spent: stays closed no matter how far we step
+        assert all(sched(11 + i) == S.CLOSED for i in range(12))
+
+    def test_single_step_period(self):
+        # closed=0, ready=0, record=1: every step is the last of its
+        # cycle, so the scheduler must return-and-export every step
+        sched = make_scheduler(record=1)
+        assert [sched(i) for i in range(4)] == [S.RECORD_AND_RETURN] * 4
+
+    def test_single_step_period_with_repeat(self):
+        sched = make_scheduler(record=1, repeat=3)
+        assert [sched(i) for i in range(3)] == [S.RECORD_AND_RETURN] * 3
+        assert sched(3) == S.CLOSED
+
+    def test_skip_first_only_delays(self):
+        sched = make_scheduler(closed=1, record=1, skip_first=2)
+        assert [sched(i) for i in range(4)] == [
+            S.CLOSED, S.CLOSED, S.CLOSED, S.RECORD_AND_RETURN]
+
+
+class TestLoadProfilerResult:
+    def test_trace_events_object(self, tmp_path):
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps({"traceEvents": [
+            {"name": "op", "ph": "X", "ts": 0, "dur": 5}]}))
+        evs = load_profiler_result(str(p))
+        assert len(evs) == 1 and evs[0]["name"] == "op"
+
+    def test_legacy_bare_array(self, tmp_path):
+        p = tmp_path / "bare.json"
+        p.write_text(json.dumps([{"name": "a", "ph": "X"},
+                                 {"name": "b", "ph": "X"}]))
+        evs = load_profiler_result(str(p))
+        assert [e["name"] for e in evs] == ["a", "b"]
+
+    def test_object_without_trace_events(self, tmp_path):
+        p = tmp_path / "empty.json"
+        p.write_text("{}")
+        assert load_profiler_result(str(p)) == []
+
+
+class TestSummaryHygiene:
+    def test_summary_leaves_no_temp_files(self):
+        native.prof_clear()
+        native.prof_enable(True)
+        with native.RecordEvent("sum_op"):
+            sum(range(100))
+        native.prof_enable(False)
+        before = set(glob.glob("/tmp/_pt_prof_*"))
+        table = Profiler().summary()
+        after = set(glob.glob("/tmp/_pt_prof_*"))
+        assert after == before, "summary() leaked a temp file"
+        assert "sum_op" in table
+        assert table["sum_op"]["calls"] == 1
+        native.prof_clear()
+
+
+class TestExportChromeTracing:
+    def _record_one(self, name="exported_op"):
+        native.prof_clear()
+        native.prof_enable(True)
+        with native.RecordEvent(name):
+            pass
+        native.prof_enable(False)
+
+    def test_worker_name_sanitized(self, tmp_path):
+        self._record_one()
+        handler = export_chrome_tracing(
+            str(tmp_path), worker_name="../evil/host:8471 rank#0")
+        prof = Profiler()
+        handler(prof)
+        # nothing escaped the export dir; separators/spaces were replaced
+        assert os.path.dirname(prof.last_export_path) == str(tmp_path)
+        base = os.path.basename(prof.last_export_path)
+        assert base == "evil_host_8471_rank_0.pt.trace.json"
+        assert not (tmp_path.parent / "evil").exists()
+        native.prof_clear()
+
+    def test_collision_gets_deterministic_suffix(self, tmp_path):
+        prof = Profiler()
+        handler = export_chrome_tracing(str(tmp_path), worker_name="w")
+        paths = []
+        for _ in range(3):
+            self._record_one()
+            handler(prof)
+            paths.append(os.path.basename(prof.last_export_path))
+        assert paths == ["w.pt.trace.json", "w.1.pt.trace.json",
+                        "w.2.pt.trace.json"]
+        # each export is a readable trace
+        for p in paths:
+            assert load_profiler_result(str(tmp_path / p))
+        native.prof_clear()
+
+    def test_creates_directory(self, tmp_path):
+        self._record_one()
+        d = tmp_path / "a" / "b"
+        handler = export_chrome_tracing(str(d), worker_name="w")
+        prof = Profiler()
+        handler(prof)
+        assert os.path.exists(prof.last_export_path)
+        native.prof_clear()

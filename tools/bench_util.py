@@ -76,3 +76,25 @@ def ratio_band(num_runs, den_runs):
     mean = sum(ratios) / len(ratios)
     return {"mean": round(mean, 2), "min": round(min(ratios), 2),
             "max": round(max(ratios), 2)}
+
+
+def write_metrics_snapshot(path: str, extra: dict | None = None) -> dict:
+    """Dump the paddle_tpu.observability registry next to the bench rows.
+
+    A bench row says how fast a run was; the metrics snapshot says what the
+    run actually did (which kernel routes fired, jit cache hit/miss, bytes
+    through collectives) — together they make a bench reproducible. Returns
+    the snapshot dict; writes JSON to `path` (parent dirs created)."""
+    import json
+    import os
+
+    from paddle_tpu import observability as obs
+
+    snap = {"metrics": obs.registry().snapshot()}
+    if extra:
+        snap.update(extra)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=2, sort_keys=True)
+    return snap
