@@ -20,22 +20,26 @@ TPU-native rework (tensorstore/Orbax pattern, self-contained):
 
 from __future__ import annotations
 
+import io as _io
 import json
 import os
 import re
 import threading
-from typing import Dict, Optional
+import warnings
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import resilience as _res
 from ..core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict", "wait_async_saves"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_saves",
+           "verify_checkpoint"]
 
 _META = "metadata.json"
-_pending: list = []
+_pending: list = []  # (thread, error_box) pairs
 
 
 def _safe(key: str) -> str:
@@ -59,6 +63,18 @@ def _arr_of(v):
     return v
 
 
+def _npy_bytes(data: np.ndarray) -> bytes:
+    """Serialized .npy payload for a shard — one buffer so the checksum
+    covers exactly what lands on disk."""
+    if data.dtype == jnp.bfloat16:
+        # .npy has no native bf16; store lossless as f32, the metadata
+        # dtype restores the logical type on load
+        data = data.astype(np.float32)
+    buf = _io.BytesIO()
+    np.save(buf, data)
+    return buf.getvalue()
+
+
 def save_state_dict(state_dict: Dict[str, object], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False) -> None:
@@ -68,7 +84,7 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
     rank = jax.process_index()
     meta = {"tensors": {}, "world_size": jax.process_count()}
 
-    jobs = []  # (filename, numpy array) pairs, written now or async
+    jobs = []  # (filename, serialized .npy bytes), written now or async
     for key, v in state_dict.items():
         arr = _arr_of(v)
         if arr is None:
@@ -82,10 +98,11 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
         shards = getattr(arr, "addressable_shards", None)
         if not shards:
             fname = f"{_safe(key)}.r{rank}.s0.npy"
+            raw = _npy_bytes(np.asarray(arr))
             entry["shards"].append(
                 {"offsets": [0] * arr.ndim, "shape": list(arr.shape),
-                 "file": fname})
-            jobs.append((fname, np.asarray(arr)))
+                 "file": fname, "crc32": _res.crc32_bytes(raw)})
+            jobs.append((fname, raw))
         else:
             for i, sh in enumerate(shards):
                 offs, exts = _index_to_offsets(sh.index, arr.shape)
@@ -94,18 +111,24 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
                     continue
                 seen.add(domkey)
                 fname = f"{_safe(key)}.r{rank}.s{i}.npy"
+                raw = _npy_bytes(np.asarray(sh.data))
                 entry["shards"].append(
-                    {"offsets": offs, "shape": exts, "file": fname})
-                jobs.append((fname, np.asarray(sh.data)))
+                    {"offsets": offs, "shape": exts, "file": fname,
+                     "crc32": _res.crc32_bytes(raw)})
+                jobs.append((fname, raw))
         meta["tensors"][key] = entry
 
     def write_all():
-        for fname, data in jobs:
-            if data.dtype == jnp.bfloat16:
-                # .npy has no native bf16; store lossless as f32, the
-                # metadata dtype restores the logical type on load
-                data = data.astype(np.float32)
-            np.save(os.path.join(path, fname), data)
+        # per-shard atomic write (temp + os.replace) under the bounded
+        # retry budget; the injection hook exercises exactly this path
+        for fname, raw in jobs:
+            def _attempt(fname=fname, raw=raw):
+                rule = _res.inject("ckpt_write_fail", file=fname)
+                if rule is not None:
+                    raise _res.InjectedFault(
+                        f"ckpt_write_fail injected for shard {fname}", rule)
+                _res.atomic_write(os.path.join(path, fname), raw)
+            _res.retry_io(_attempt, what=f"shard write {fname}")
         # EVERY rank records its own shard map: a multi-process save has
         # shards only THIS process can see, so a single coordinator meta
         # would silently omit every other rank's files and a later load
@@ -116,24 +139,40 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
         # written ONLY single-process — multi-process it would list just
         # this rank's shards, a silent-corruption trap for any consumer
         # reading it directly.
-        if jax.process_count() > 1:
-            with open(os.path.join(path, f"{_META}.r{rank}"), "w") as f:
-                json.dump(meta, f)
-        else:
-            with open(os.path.join(path, _META), "w") as f:
-                json.dump(meta, f)
+        meta_name = f"{_META}.r{rank}" if jax.process_count() > 1 else _META
+        _res.retry_io(
+            lambda: _res.atomic_write(os.path.join(path, meta_name),
+                                      json.dumps(meta).encode()),
+            what=f"metadata write {meta_name}")
 
     if async_save:
-        t = threading.Thread(target=write_all, daemon=True)
+        # errors on the background thread surface at wait_async_saves();
+        # a daemon thread swallowing a failed write would report a save
+        # that never durably happened
+        box: list = []
+
+        def run():
+            try:
+                write_all()
+            except BaseException as e:  # noqa: BLE001 — re-raised at wait
+                box.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
         t.start()
-        _pending.append(t)
+        _pending.append((t, box))
     else:
         write_all()
 
 
 def wait_async_saves() -> None:
+    """Join outstanding async saves; re-raises the first write error."""
+    errors: list = []
     while _pending:
-        _pending.pop().join()
+        t, box = _pending.pop()
+        t.join()
+        errors.extend(box)
+    if errors:
+        raise errors[0]
 
 
 def _read_overlap(saved_shards, path, t_offs, t_exts, dtype):
@@ -181,13 +220,71 @@ def _load_meta(path: str) -> dict:
     return meta
 
 
+def _verify_shard_files(meta: dict, path: str, keys) -> None:
+    """Integrity pre-pass: every shard file a load will touch is checked
+    against its recorded crc32 BEFORE any tensor is assigned, so a
+    corrupt checkpoint never leaves the target state_dict half-filled.
+    Legacy metas without crc32 fields verify vacuously."""
+    checked: Dict[str, bool] = {}
+    for key in keys:
+        entry = meta["tensors"].get(key)
+        if entry is None:
+            continue
+        for s in entry["shards"]:
+            fname = s["file"]
+            if fname in checked:
+                continue
+            checked[fname] = True
+            full = os.path.join(path, fname)
+            if not os.path.exists(full):
+                raise _res.CheckpointCorrupt(
+                    f"{path}: shard file {fname} (tensor {key!r}) missing")
+            want = s.get("crc32")
+            if want is None:
+                continue
+            injected = _res.inject("ckpt_read_corrupt",
+                                   file=fname) is not None
+            if injected or _res.crc32_file(full) != int(want):
+                raise _res.CheckpointCorrupt(
+                    f"{path}: shard {fname} (tensor {key!r}) checksum "
+                    f"mismatch" + (" (injected)" if injected else ""))
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True when every shard recorded in the checkpoint's metadata exists
+    and matches its crc32 (vacuous for legacy checksum-less metas)."""
+    try:
+        meta = _load_meta(path)
+        _verify_shard_files(meta, path, list(meta["tensors"]))
+        return True
+    except (_res.CheckpointCorrupt, OSError, KeyError, ValueError):
+        return False
+
+
 def load_state_dict(state_dict: Dict[str, object], path: str,
-                    process_group=None, coordinator_rank: int = 0) -> None:
+                    process_group=None, coordinator_rank: int = 0,
+                    fallback_paths: Sequence[str] = ()) -> None:
     """In-place load (paddle signature): each tensor in ``state_dict`` is
     filled from the checkpoint, resharded to ITS OWN current sharding —
     regardless of the topology that wrote the checkpoint (including a
-    different PROCESS topology: per-rank shard maps are unioned)."""
-    meta = _load_meta(path)
+    different PROCESS topology: per-rank shard maps are unioned).
+
+    ``fallback_paths``: previous known-good checkpoints to fall back to
+    (in order) when this one has a corrupt/missing shard; each fallback
+    taken bumps ``resilience.ckpt_fallbacks``."""
+    try:
+        meta = _load_meta(path)
+        _verify_shard_files(meta, path, list(state_dict))
+    except (_res.CheckpointCorrupt, OSError) as e:
+        if not fallback_paths:
+            raise
+        _res._count_fallback()
+        warnings.warn(
+            f"checkpoint {path} failed integrity verification ({e}); "
+            f"falling back to {fallback_paths[0]}", RuntimeWarning)
+        return load_state_dict(state_dict, fallback_paths[0],
+                               process_group, coordinator_rank,
+                               fallback_paths=fallback_paths[1:])
 
     for key, v in state_dict.items():
         if key not in meta["tensors"]:

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ._compat import shard_map
 
 from .. import observability as _obs
+from .. import resilience as _res
 from ..core.tensor import Tensor
 from .mesh import get_mesh
 
@@ -50,6 +51,25 @@ def _payload_bytes(args) -> int:
     return n
 
 
+def _maybe_fault(name: str) -> None:
+    """Fault-injection hook shared by every collective entry point:
+    collective_delay@collective=<name>[:ms=N] sleeps before dispatch,
+    collective_error@collective=<name> raises InjectedFault. `collective`
+    may also be `all` to target every collective."""
+    plan = _res.active_plan()
+    if plan is None:
+        return
+    for site in (name, "all"):      # delays first: a delayed call can
+        rule = _res.inject("collective_delay", collective=site)
+        if rule is not None:        # ALSO error below, like real flakes
+            time.sleep(float(rule.opts.get("ms", 50.0)) / 1e3)
+    for site in (name, "all"):
+        rule = _res.inject("collective_error", collective=site)
+        if rule is not None:
+            raise _res.InjectedFault(
+                f"collective_error injected in {name}", rule)
+
+
 def _instrumented(fn):
     """Wrap a collective: count calls/bytes and time the call. Disabled
     metrics cost one attribute check."""
@@ -57,6 +77,7 @@ def _instrumented(fn):
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        _maybe_fault(name)
         if not _obs.enabled():
             return fn(*args, **kwargs)
         t0 = time.perf_counter()

@@ -172,6 +172,20 @@ define_flag("FLAGS_metrics", True,
             "site degrades to one attribute test (near-zero overhead)")
 define_flag("FLAGS_eager_op_cache_size", 4096,
             "max entries in the per-op jitted computation cache")
+define_flag("FLAGS_fault_spec", "",
+            "deterministic fault-injection plan (paddle_tpu.resilience): "
+            "semicolon-separated clauses 'kind@site[:opt=val...]' plus an "
+            "optional 'seed=N'. Kinds: nan_loss/inf_loss/spike_loss, "
+            "nan_grad/inf_grad, ckpt_write_fail/ckpt_read_corrupt, "
+            "loader_raise, collective_delay/collective_error, preempt. "
+            "Empty = no faults (zero overhead). See docs/RESILIENCE.md")
+define_flag("FLAGS_ckpt_retries", 3,
+            "bounded retry budget for checkpoint write failures "
+            "(framework.io.save / distributed.checkpoint.save_state_dict)",
+            validator=lambda v: v >= 0)
+define_flag("FLAGS_ckpt_retry_backoff", 0.05,
+            "base seconds for exponential backoff between checkpoint "
+            "write retries", validator=lambda v: v >= 0)
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity (higher = chattier)")
 define_flag("FLAGS_allocator_strategy", "pjrt",
             "memory allocator strategy; TPU memory is owned by PJRT")

@@ -8,18 +8,21 @@ in paddle_tpu.distributed.checkpoint (orbax/tensorstore-backed).
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
-from typing import Any
+from typing import Any, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+from .. import resilience as _res
 from ..core.tensor import Tensor
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "verify"]
 
 _PROTOCOL = 4
+_META_SUFFIX = ".meta.json"
 
 
 class _TensorPayload:
@@ -63,17 +66,62 @@ def _unpack(obj: Any) -> Any:
     return obj
 
 
-def save(obj: Any, path: str, protocol: int = _PROTOCOL) -> None:
+def save(obj: Any, path: str, protocol: int = _PROTOCOL,
+         retries: Optional[int] = None,
+         backoff: Optional[float] = None) -> None:
+    """Atomic, integrity-tracked save: the pickle is written via
+    temp-file + os.replace (a crash mid-save never truncates an existing
+    checkpoint), its crc32 is recorded in a ``<path>.meta.json`` sidecar
+    that load() verifies, and write failures are retried with bounded
+    backoff (FLAGS_ckpt_retries / FLAGS_ckpt_retry_backoff)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    payload = pickle.dumps(_pack(obj), protocol=protocol)
+    meta = json.dumps({"crc32": _res.crc32_bytes(payload),
+                       "bytes": len(payload)}).encode()
+
+    def _attempt():
+        rule = _res.inject("ckpt_write_fail", path=os.path.basename(path))
+        if rule is not None:
+            raise _res.InjectedFault(
+                f"ckpt_write_fail injected for {path}", rule)
+        _res.atomic_write(path, payload)
+        _res.atomic_write(path + _META_SUFFIX, meta)
+
+    _res.retry_io(_attempt, what=f"save({path})", retries=retries,
+                  backoff=backoff)
 
 
-def load(path: str, return_numpy: bool = False) -> Any:
+def verify(path: str) -> bool:
+    """True when `path` matches its integrity sidecar (or has no sidecar
+    — legacy checkpoints verify vacuously); False on mismatch."""
+    meta_path = path + _META_SUFFIX
+    if not os.path.exists(meta_path):
+        return os.path.exists(path)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        return _res.crc32_file(path) == int(meta["crc32"])
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def load(path: str, return_numpy: bool = False,
+         verify_integrity: bool = True) -> Any:
     with open(path, "rb") as f:
-        obj = pickle.load(f)
+        data = f.read()
+    meta_path = path + _META_SUFFIX
+    if verify_integrity and os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        injected = _res.inject("ckpt_read_corrupt",
+                               path=os.path.basename(path)) is not None
+        if injected or _res.crc32_bytes(data) != int(meta["crc32"]):
+            raise _res.CheckpointCorrupt(
+                f"{path}: checksum mismatch vs {meta_path}"
+                + (" (injected)" if injected else ""))
+    obj = pickle.loads(data)
     out = _unpack(obj)
     if return_numpy:
         def to_np(o):

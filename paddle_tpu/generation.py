@@ -23,11 +23,40 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import observability as _obs
+from . import resilience as _res
 from .core.tensor import Tensor
 from .core import autograd as ag
 from .framework.random import next_key
 
 __all__ = ["generate"]
+
+
+def _finalize_tokens(out_tokens, out_scores, B, max_new_tokens,
+                     pad_token_id):
+    """Stack + right-pad the per-step token/score lists to the full
+    [B, max_new_tokens] width (early eos or deadline expiry leaves the
+    lists short; an expiry before the first token leaves them empty)."""
+    if out_tokens:
+        gen = jnp.stack(out_tokens, 1)
+        sc = jnp.stack(out_scores, 1)
+    else:
+        gen = jnp.zeros((B, 0), jnp.int32)
+        sc = jnp.zeros((B, 0), jnp.float32)
+    if gen.shape[1] < max_new_tokens:
+        padw = max_new_tokens - gen.shape[1]
+        gen = jnp.concatenate(
+            [gen, jnp.full((B, padw), pad_token_id, jnp.int32)], 1)
+        sc = jnp.concatenate([sc, jnp.zeros((B, padw), sc.dtype)], 1)
+    return Tensor(gen), Tensor(sc)
+
+
+def _timeout_result(kind, dl, completed, partial):
+    """Typed deadline-expiry return (resilience.TimeoutResult): counts
+    the miss and carries whatever tokens were produced in time."""
+    _res.deadline_miss()
+    return _res.TimeoutResult(kind=kind, budget_s=dl.budget_s,
+                              elapsed_s=dl.elapsed_s,
+                              completed=completed, partial=partial)
 
 # serving metrics (ISSUE 1): prefill vs decode token throughput, request
 # batch sizes, and decode-loop program-cache hit rate. Durations are host
@@ -96,11 +125,17 @@ def _filter_logits(logits, top_k, top_p, temperature):
 def generate(model, input_ids, max_new_tokens: int = 20,
              decode_strategy: str = "sampling", top_k: Optional[int] = None,
              top_p: Optional[float] = None, temperature: float = 1.0,
-             eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+             deadline_s: Optional[float] = None):
     """ref: PaddleNLP model.generate(...). Returns (generated_ids, scores):
     generated_ids [B, max_new_tokens] holds ONLY the new tokens (prompt
     excluded, PaddleNLP convention), padded with pad_token_id after eos;
     scores [B, max_new_tokens] are the chosen tokens' log-probs.
+
+    ``deadline_s`` bounds the request wall-clock: the decode loop stops
+    at the first step past the budget and the call returns a falsy
+    resilience.TimeoutResult whose .partial carries the (padded) tokens
+    produced in time — a typed outcome, never an unbounded hang.
     """
     if decode_strategy not in ("greedy_search", "sampling"):
         raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
@@ -115,12 +150,17 @@ def generate(model, input_ids, max_new_tokens: int = 20,
     finished = jnp.zeros((B,), bool)
     out_tokens = []
     out_scores = []
+    dl = _res.Deadline(deadline_s) if deadline_s else None
+    timed_out = False
     was_training = getattr(model, "training", False)
     if hasattr(model, "eval"):
         model.eval()
     try:
         with ag.no_grad():
             for t in range(S0 - 1, total - 1):
+                if dl is not None and dl.expired():
+                    timed_out = True
+                    break
                 logits = _logits_fn(model, buf)[:, t]
                 tok = _sample_token(logits, decode_strategy, top_k, top_p,
                                     temperature)
@@ -138,14 +178,11 @@ def generate(model, input_ids, max_new_tokens: int = 20,
     finally:
         if was_training and hasattr(model, "train"):
             model.train()
-    gen = jnp.stack(out_tokens, 1)
-    sc = jnp.stack(out_scores, 1)
-    if gen.shape[1] < max_new_tokens:  # early eos: pad to the full width
-        padw = max_new_tokens - gen.shape[1]
-        gen = jnp.concatenate(
-            [gen, jnp.full((B, padw), pad_token_id, jnp.int32)], 1)
-        sc = jnp.concatenate([sc, jnp.zeros((B, padw), sc.dtype)], 1)
-    return Tensor(gen), Tensor(sc)
+    partial = _finalize_tokens(out_tokens, out_scores, B, max_new_tokens,
+                               pad_token_id)
+    if timed_out:
+        return _timeout_result("generate", dl, len(out_tokens), partial)
+    return partial
 
 
 # ---------------------------------------------------------------------------
@@ -848,10 +885,12 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
                     temperature: float = 1.0,
                     eos_token_id: Optional[int] = None, pad_token_id: int = 0,
                     weight_only_int8: bool = False,
-                    weight_only_quant=None):
+                    weight_only_quant=None,
+                    deadline_s: Optional[float] = None):
     """KV-cache generation for LlamaForCausalLM-family models: prefill once
     over the prompt, then O(1) work per new token (the compiled-decode
     analog of the reference's masked_multihead_attention loop).
+    ``deadline_s``: per-request wall-clock budget — see generate().
 
     Numerics note: matches the buffer path exactly under f32 matmul
     precision; under the TPU bf16 default the two paths may argmax-flip
@@ -877,6 +916,8 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
     step = _make_cached_step(p, total)
     finished = jnp.zeros((B,), bool)
     out_tokens, out_scores = [], []
+    dl = _res.Deadline(deadline_s) if deadline_s else None
+    timed_out = False
     mx = _obs.enabled()
     if mx:
         _SRV_REQS.labels(path="cached").inc()
@@ -905,20 +946,21 @@ def generate_cached(model, input_ids, max_new_tokens: int = 20,
             if pos == total - 1 or (eos_token_id is not None
                                     and bool(jnp.all(finished))):
                 break
+            if dl is not None and dl.expired():
+                timed_out = True
+                break
             logits, caches = step(tok[:, None], caches, pos)
             pos += 1
     if mx:
         _SRV_DECODE_S.labels(path="cached").observe(
             _time.perf_counter() - t0)
         _SRV_DECODE_TOK.inc(B * len(out_tokens))
-    gen = jnp.stack(out_tokens, 1)
-    sc = jnp.stack(out_scores, 1)
-    if gen.shape[1] < max_new_tokens:
-        padw = max_new_tokens - gen.shape[1]
-        gen = jnp.concatenate(
-            [gen, jnp.full((B, padw), pad_token_id, jnp.int32)], 1)
-        sc = jnp.concatenate([sc, jnp.zeros((B, padw), sc.dtype)], 1)
-    return Tensor(gen), Tensor(sc)
+    partial = _finalize_tokens(out_tokens, out_scores, B, max_new_tokens,
+                               pad_token_id)
+    if timed_out:
+        return _timeout_result("generate_cached", dl, len(out_tokens),
+                               partial)
+    return partial
 
 
 def _make_decode_loop(p, S0: int, max_new_tokens: int,
@@ -1023,11 +1065,18 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
                       eos_token_id: Optional[int] = None,
                       pad_token_id: int = 0,
                       weight_only_int8: bool = False,
-                      weight_only_quant=None):
+                      weight_only_quant=None,
+                      deadline_s: Optional[float] = None):
     """KV-cache generation with the whole decode loop compiled (see
     _make_decode_loop). Same contract (and defaults) as
     generate_cached; sampling draws from the framework RNG stream once
-    per call (the per-step keys are split on-device)."""
+    per call (the per-step keys are split on-device).
+
+    ``deadline_s``: the scan-fused loop is one atomic XLA program, so
+    the deadline is enforced at the dispatch boundaries — an expired
+    budget before launch short-circuits to a TimeoutResult (partial
+    None), and a launch that finishes past the budget returns a
+    TimeoutResult whose .partial holds the full output."""
     if decode_strategy not in ("greedy_search", "sampling"):
         raise ValueError(f"decode_strategy {decode_strategy!r}: expected "
                          "'greedy_search' or 'sampling'")
@@ -1039,6 +1088,9 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
     if S0 + max_new_tokens > p["cfg"].max_position_embeddings:
         raise ValueError(f"{S0 + max_new_tokens} tokens exceed "
                          "max_position_embeddings")
+    dl = _res.Deadline(deadline_s) if deadline_s else None
+    if dl is not None and dl.expired():
+        return _timeout_result("generate_compiled", dl, 0, None)
     run = _make_decode_loop(p, S0, max_new_tokens, decode_strategy,
                             top_k, top_p, temperature, eos_token_id,
                             pad_token_id)
@@ -1057,7 +1109,11 @@ def generate_compiled(model, input_ids, max_new_tokens: int = 20,
         _SRV_DECODE_S.labels(path="compiled").observe(
             _time.perf_counter() - t0)
         _SRV_DECODE_TOK.inc(B * max_new_tokens)
-    return Tensor(gen), Tensor(sc)
+    out = (Tensor(gen), Tensor(sc))
+    if dl is not None and dl.expired():
+        return _timeout_result("generate_compiled", dl, max_new_tokens,
+                               out)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
+from .. import resilience as _res
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
 
@@ -58,6 +59,20 @@ class Config:
         self._device_id = 0
         self._enable_memory_optim = True
         self._switches: Dict[str, bool] = {}
+        self._deadline_s: Optional[float] = None
+        self._admission: Optional[tuple] = None
+
+    def set_deadline(self, seconds: Optional[float]):
+        """Per-request wall-clock budget for Predictor.run: an expired
+        budget yields a typed resilience.TimeoutResult (never a hang)."""
+        self._deadline_s = float(seconds) if seconds else None
+
+    def set_admission(self, max_inflight: int, queue_timeout_s: float = 0.0):
+        """Queue-admission backpressure: at most max_inflight run() calls
+        execute concurrently (shared across clone()s); a request that
+        cannot get a slot within queue_timeout_s raises
+        resilience.Overloaded instead of queueing unboundedly."""
+        self._admission = (int(max_inflight), float(queue_timeout_s))
 
     def set_prog_file(self, path: str):
         self._model_prefix = path
@@ -135,6 +150,9 @@ class Predictor:
     """paddle_infer.Predictor parity over a jax.export artifact."""
 
     def __init__(self, config: Config):
+        self._config = config
+        self._gate = _res.AdmissionGate(*config._admission) \
+            if config._admission else None
         prefix = config.prog_file()
         if prefix is None:
             raise ValueError("Config needs the jit.save artifact prefix")
@@ -190,9 +208,32 @@ class Predictor:
     def get_output_handle(self, name: str) -> PredictorTensor:
         return self._outputs[name]
 
-    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None,
+            deadline_s: Optional[float] = None):
         """Execute. Either feed via get_input_handle().copy_from_cpu()
-        then run(), or pass arrays positionally (newer paddle_infer.run)."""
+        then run(), or pass arrays positionally (newer paddle_infer.run).
+
+        Degradation contract (ISSUE 2): with a deadline (per-call
+        ``deadline_s`` or Config.set_deadline) an over-budget request
+        returns a falsy resilience.TimeoutResult instead of hanging —
+        the executable dispatch is atomic, so the budget is enforced at
+        the dispatch boundaries; with Config.set_admission, a request
+        that cannot get an execution slot raises resilience.Overloaded."""
+        budget = deadline_s if deadline_s is not None \
+            else self._config._deadline_s
+        dl = _res.Deadline(budget) if budget else None
+        if self._gate is None:
+            return self._run_inner(inputs, dl)
+        with self._gate.admit():
+            return self._run_inner(inputs, dl)
+
+    def _run_inner(self, inputs, dl):
+        if dl is not None and dl.expired():
+            # spent the whole budget queueing — don't dispatch at all
+            _res.deadline_miss()
+            return _res.TimeoutResult(kind="predictor",
+                                      budget_s=dl.budget_s,
+                                      elapsed_s=dl.elapsed_s)
         if inputs is not None:
             if len(inputs) != len(self._input_names):
                 raise ValueError(
@@ -226,11 +267,21 @@ class Predictor:
         flat = jax.tree_util.tree_leaves(outs)
         for n, o in zip(self._output_names, flat):
             self._outputs[n]._value = o
-        return [np.asarray(o) for o in flat] if inputs is not None else None
+        result = [np.asarray(o) for o in flat] if inputs is not None else None
+        if dl is not None and dl.expired():
+            # the dispatch finished but blew the budget: typed miss with
+            # the full outputs attached (handles are populated either way)
+            _res.deadline_miss()
+            return _res.TimeoutResult(kind="predictor",
+                                      budget_s=dl.budget_s,
+                                      elapsed_s=dl.elapsed_s,
+                                      completed=len(flat), partial=result)
+        return result
 
     def clone(self) -> "Predictor":
         """Independent predictor over the same compiled program (the
-        paddle_infer pattern for per-thread serving): shares the executable,
+        paddle_infer pattern for per-thread serving): shares the executable
+        AND the admission gate (concurrency is a process-wide budget),
         gets fresh input AND output handles."""
         new = object.__new__(Predictor)
         new.__dict__ = dict(self.__dict__)

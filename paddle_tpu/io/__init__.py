@@ -20,6 +20,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..framework.random import default_generator
+from .. import resilience as _res
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
@@ -325,10 +326,14 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False, worker_mode: str = "thread",
-                 mp_context: str = "fork"):
+                 mp_context: str = "fork", max_batch_retries: int = 0):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        # >0 tolerates transient __getitem__/collate failures: a failed
+        # batch is re-fetched up to this many times before the error
+        # propagates (resilience.loader_retries counts each retry)
+        self.max_batch_retries = max(int(max_batch_retries), 0)
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
@@ -375,7 +380,19 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def _fetch(self, indices):
+        rule = _res.inject("loader_raise")
+        if rule is not None:
+            raise _res.InjectedFault("loader_raise injected", rule)
         return self.collate_fn([self.dataset[i] for i in indices])
+
+    def _fetch_retrying(self, indices):
+        for attempt in range(self.max_batch_retries + 1):
+            try:
+                return self._fetch(indices)
+            except Exception:
+                if attempt >= self.max_batch_retries:
+                    raise
+                _res._count_loader_retry()
 
     def __iter__(self):
         if self.is_iterable:
@@ -383,7 +400,7 @@ class DataLoader:
             return
         if self.num_workers <= 0:
             for indices in self.batch_sampler:
-                yield self._fetch(indices)
+                yield self._fetch_retrying(indices)
             return
         if self.worker_mode == "process":
             yield from self._iter_processes()
@@ -399,7 +416,7 @@ class DataLoader:
                 _worker_info.info = _WorkerInfo(0, self.num_workers,
                                                self.dataset)
                 for indices in self.batch_sampler:
-                    q.put(self._fetch(indices))
+                    q.put(self._fetch_retrying(indices))
             except BaseException as e:  # propagate to consumer
                 q.put(e)
             finally:
@@ -502,7 +519,19 @@ class DataLoader:
                     if dispatched == total:
                         for _ in range(W):
                             task_q.put(None)
-                item = _shm_decode(item)
+                if isinstance(item, _BatchError):
+                    # the worker failed this batch but stayed alive;
+                    # re-fetch inline in the parent when a retry budget
+                    # exists, else surface the worker's error
+                    if self.max_batch_retries <= 0:
+                        raise RuntimeError(
+                            f"DataLoader worker failed batch {nxt}: "
+                            f"{item.err}")
+                    _res._count_loader_retry()
+                    samples = [self.dataset[i] for i in batches[nxt]]
+                    item = (user_collate or numpy_collate_fn)(samples)
+                else:
+                    item = _shm_decode(item)
                 yield item if user_collate is not None \
                     else _tensorize_tree(item)
                 nxt += 1
@@ -646,6 +675,16 @@ def _has_tensor_leaf(x):
     return False
 
 
+class _BatchError:
+    """Picklable marker a process worker ships in place of a batch it
+    failed to produce — the worker itself stays alive for later tasks."""
+
+    __slots__ = ("err",)
+
+    def __init__(self, err: str):
+        self.err = err
+
+
 def _process_worker(dataset, user_collate, task_q, worker_id,
                     num_workers, base_seed, init_fn, out_q,
                     use_shared_memory=True):
@@ -669,21 +708,29 @@ def _process_worker(dataset, user_collate, task_q, worker_id,
             if task is None:
                 break
             bid, indices = task
-            samples = [dataset[i] for i in indices]
-            for s in samples:
-                if _has_tensor_leaf(s):
-                    # converting an inherited device array in a forked
-                    # child touches the (fork-unsafe) runtime — fail
-                    # loudly instead of deadlocking
-                    raise RuntimeError(
-                        "process workers require host (numpy/python) "
-                        "samples; this dataset returned a device "
-                        "Tensor — convert to numpy in __getitem__ or "
-                        "use worker_mode='thread'")
-            batch = collate(samples)
-            if use_shared_memory:
-                import os as _os
-                batch = _shm_encode(batch, name=f"ppio{_os.getpid()}_{bid}")
+            try:
+                rule = _res.inject("loader_raise", worker=worker_id)
+                if rule is not None:
+                    raise _res.InjectedFault("loader_raise injected", rule)
+                samples = [dataset[i] for i in indices]
+                for s in samples:
+                    if _has_tensor_leaf(s):
+                        # converting an inherited device array in a
+                        # forked child touches the (fork-unsafe)
+                        # runtime — fail loudly instead of deadlocking
+                        raise RuntimeError(
+                            "process workers require host (numpy/"
+                            "python) samples; this dataset returned a "
+                            "device Tensor — convert to numpy in "
+                            "__getitem__ or use worker_mode='thread'")
+                batch = collate(samples)
+                if use_shared_memory:
+                    import os as _os
+                    batch = _shm_encode(batch,
+                                        name=f"ppio{_os.getpid()}_{bid}")
+            except Exception as e:  # per-task: ship a marker, stay alive
+                out_q.put((bid, _BatchError(repr(e))))
+                continue
             out_q.put((bid, batch))
     except BaseException as e:  # noqa: BLE001 — shipped to the parent
         err = e

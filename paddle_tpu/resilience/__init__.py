@@ -1,0 +1,503 @@
+"""paddle_tpu.resilience — fault injection and recovery substrate.
+
+Long pretrain jobs on preemptible TPU slices treat worker loss, corrupt
+checkpoint shards and numeric blowups as routine (ISSUE 2). This module
+provides the shared machinery every recovery path builds on:
+
+  - a deterministic, seedable fault-injection framework (``FaultPlan``)
+    driven by ``FLAGS_fault_spec`` so chaos tests and CLI runs exercise
+    the exact same failure schedule (same seed -> same schedule);
+  - atomic file I/O (temp-file + ``os.replace``) and bounded
+    retry-with-backoff for checkpoint writes;
+  - per-request ``Deadline`` budgets and an ``AdmissionGate`` for
+    queue-admission backpressure in serving, with typed
+    ``TimeoutResult`` / ``Overloaded`` outcomes instead of hangs;
+  - the ``resilience.*`` counters every fault/recovery event reports
+    into (paddle_tpu.observability), so a metrics snapshot shows what
+    was injected and what was absorbed.
+
+Fault spec grammar (full reference: docs/RESILIENCE.md)::
+
+    spec    := clause (';' clause)*
+    clause  := 'seed=' INT | kind '@' site (':' opt)*
+    site    := key '=' value        # step=3, n=1, p=0.25, collective=all_reduce
+    opt     := key '=' value        # times=2, ms=50, scale=100
+
+Each injection point is a *candidate event*; ``n=K`` matches the K-th
+candidate of that kind, context keys (``step=``, ``batch=``,
+``collective=``) match what the call site reports, and ``p=`` draws from
+a per-kind RNG stream seeded by the plan seed (deterministic given call
+order). Every rule fires at most ``times`` times (default 1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from .. import observability as _obs
+
+__all__ = [
+    "FaultRule", "FaultPlan", "parse_fault_spec", "active_plan", "inject",
+    "set_fault_spec", "clear_fault_spec",
+    "InjectedFault", "CheckpointCorrupt", "DeadlineExceeded", "Overloaded",
+    "Deadline", "TimeoutResult", "AdmissionGate",
+    "atomic_write", "retry_io", "crc32_bytes", "crc32_file",
+    "list_checkpoints", "metrics",
+]
+
+# ---------------------------------------------------------------------------
+# metrics (ISSUE 2 names these exactly; dots are fine for the JSON
+# snapshot consumers — bench_util.write_resilience_report keys off the
+# "resilience." prefix)
+# ---------------------------------------------------------------------------
+_M_FAULTS = _obs.registry().counter(
+    "resilience.faults_injected", "faults fired by the active FaultPlan",
+    labels=("kind",))
+_M_SKIPPED = _obs.registry().counter(
+    "resilience.steps_skipped", "optimizer steps skipped by trainer guards")
+_M_ROLLBACKS = _obs.registry().counter(
+    "resilience.rollbacks", "rollbacks to last-good trainer state")
+_M_CKPT_RETRIES = _obs.registry().counter(
+    "resilience.ckpt_retries", "checkpoint write attempts retried")
+_M_CKPT_FALLBACKS = _obs.registry().counter(
+    "resilience.ckpt_fallbacks",
+    "loads redirected to a previous known-good checkpoint")
+_M_DEADLINE = _obs.registry().counter(
+    "resilience.deadline_misses", "serving requests past their deadline")
+_M_REJECTS = _obs.registry().counter(
+    "resilience.admission_rejects",
+    "serving requests refused by queue-admission backpressure")
+_M_LOADER_RETRIES = _obs.registry().counter(
+    "resilience.loader_retries", "dataloader batches retried after a "
+    "worker raise")
+_M_EMERGENCY = _obs.registry().counter(
+    "resilience.emergency_checkpoints",
+    "emergency checkpoints written on preemption")
+
+
+def metrics() -> Dict[str, Any]:
+    """The resilience.* slice of the registry snapshot."""
+    return {k: v for k, v in _obs.registry().snapshot().items()
+            if k.startswith("resilience.")}
+
+
+# ---------------------------------------------------------------------------
+# typed failure outcomes
+# ---------------------------------------------------------------------------
+class InjectedFault(RuntimeError):
+    """Raised (or used as a cause) at sites where the active FaultPlan
+    fired a raising fault."""
+
+    def __init__(self, msg: str, rule: Optional["FaultRule"] = None):
+        super().__init__(msg)
+        self.rule = rule
+
+
+class CheckpointCorrupt(IOError):
+    """Checkpoint payload failed its checksum / integrity verification."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """A request ran past its deadline where no partial result makes
+    sense (gate acquisition paths return TimeoutResult instead)."""
+
+
+class Overloaded(RuntimeError):
+    """Queue admission refused the request (backpressure, not failure):
+    retry later or shed load upstream."""
+
+
+@dataclasses.dataclass
+class TimeoutResult:
+    """Typed deadline-expiry outcome. Falsy on purpose: callers that
+    treat the return as success-ish data can gate on truthiness, while
+    `isinstance(r, TimeoutResult)` keeps the explicit protocol."""
+
+    kind: str                 # "generate" | "predictor" | ...
+    budget_s: float
+    elapsed_s: float
+    completed: int = 0        # units of work done (decode steps, ...)
+    partial: Any = None       # partial outputs when they exist
+
+    def __bool__(self) -> bool:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# fault spec parsing
+# ---------------------------------------------------------------------------
+_RAISING_KINDS = frozenset({
+    "nan_loss", "inf_loss", "spike_loss", "nan_grad", "inf_grad",
+    "ckpt_write_fail", "ckpt_read_corrupt", "loader_raise",
+    "collective_delay", "collective_error", "preempt",
+})
+
+
+def _parse_val(raw: str) -> Any:
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw
+
+
+class FaultRule:
+    """One clause of a fault spec: kind + site match + options."""
+
+    __slots__ = ("kind", "when", "p", "times", "opts", "fired")
+
+    def __init__(self, kind: str, when: Mapping[str, Any],
+                 p: Optional[float], times: int, opts: Mapping[str, Any]):
+        self.kind = kind
+        self.when = dict(when)
+        self.p = p
+        self.times = times
+        self.opts = dict(opts)
+        self.fired = 0
+
+    def __repr__(self):
+        site = f"p={self.p}" if self.p is not None else \
+            ",".join(f"{k}={v}" for k, v in self.when.items())
+        return (f"FaultRule({self.kind}@{site}, times={self.times}, "
+                f"fired={self.fired}, opts={self.opts})")
+
+
+class FaultPlan:
+    """A parsed, stateful fault schedule. `should_fire` is called once
+    per candidate event; probabilistic rules draw from a per-kind RNG
+    stream seeded by the plan seed, so two plans parsed from the same
+    spec fire identically over the same event sequence."""
+
+    def __init__(self, rules: Sequence[FaultRule], seed: int = 0,
+                 spec: str = ""):
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self.spec = spec
+        self._seen: Dict[str, int] = {}
+        self._rng: Dict[str, np.random.RandomState] = {}
+        self._lock = threading.Lock()
+
+    def _rng_for(self, kind: str) -> np.random.RandomState:
+        rng = self._rng.get(kind)
+        if rng is None:
+            rng = np.random.RandomState(
+                (self.seed ^ zlib.crc32(kind.encode())) & 0x7FFFFFFF)
+            self._rng[kind] = rng
+        return rng
+
+    def should_fire(self, kind: str, **ctx: Any) -> Optional[FaultRule]:
+        """Register one candidate event of `kind`; return the first rule
+        that fires (and record the fire), else None."""
+        with self._lock:
+            n = self._seen.get(kind, 0) + 1
+            self._seen[kind] = n
+            hit: Optional[FaultRule] = None
+            for r in self.rules:
+                if r.kind != kind:
+                    continue
+                if r.p is not None:
+                    # draw unconditionally so the stream stays aligned
+                    # with the candidate sequence even after exhaustion
+                    draw = float(self._rng_for(kind).random_sample())
+                    if hit is None and r.fired < r.times and draw < r.p:
+                        hit = r
+                    continue
+                if hit is not None or r.fired >= r.times:
+                    continue
+                matched = True
+                for k, v in r.when.items():
+                    have = n if k == "n" else ctx.get(k)
+                    if have != v and str(have) != str(v):
+                        matched = False
+                        break
+                if matched:
+                    hit = r
+            if hit is not None:
+                hit.fired += 1
+                _M_FAULTS.labels(kind=kind).inc()
+            return hit
+
+    def reset(self) -> None:
+        """Forget fire counts, candidate counters and RNG streams (the
+        schedule replays identically afterwards)."""
+        with self._lock:
+            for r in self.rules:
+                r.fired = 0
+            self._seen.clear()
+            self._rng.clear()
+
+
+def parse_fault_spec(spec: str) -> FaultPlan:
+    """Parse ``FLAGS_fault_spec`` grammar into a FaultPlan. Raises
+    ValueError on malformed clauses or unknown fault kinds."""
+    rules: List[FaultRule] = []
+    seed = 0
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if clause.startswith("seed="):
+            seed = int(clause[len("seed="):])
+            continue
+        if "@" not in clause:
+            raise ValueError(
+                f"fault clause {clause!r}: expected 'kind@site[:opt=..]' "
+                f"(or 'seed=N')")
+        kind, _, rest = clause.partition("@")
+        kind = kind.strip()
+        if kind not in _RAISING_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; known kinds: "
+                             f"{sorted(_RAISING_KINDS)}")
+        parts = rest.split(":")
+        when: Dict[str, Any] = {}
+        p: Optional[float] = None
+        times = 1
+        opts: Dict[str, Any] = {}
+        for i, part in enumerate(parts):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"fault clause {clause!r}: bad "
+                                 f"'{part}' (expected key=value)")
+            k, _, v = part.partition("=")
+            k, val = k.strip(), _parse_val(v.strip())
+            if k == "p":
+                p = float(val)
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(f"fault clause {clause!r}: p must be "
+                                     f"in [0, 1]")
+            elif k == "times":
+                times = int(val)
+            elif i == 0:
+                when[k] = val
+            else:
+                opts[k] = val
+        if p is None and not when:
+            raise ValueError(f"fault clause {clause!r}: needs a site "
+                             f"(key=value or p=prob)")
+        rules.append(FaultRule(kind, when, p, times, opts))
+    return FaultPlan(rules, seed=seed, spec=spec)
+
+
+# the plan is cached on the spec string: re-reading the flag each call
+# keeps env/CLI/set_flags control, while an unchanged spec keeps its
+# stateful counters (times=1 means once per process, not once per call)
+_FAULT_FLAG = _flags._registry["FLAGS_fault_spec"]
+_plan_lock = threading.Lock()
+_plan_cache: Tuple[str, Optional[FaultPlan]] = ("", None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The FaultPlan for the current FLAGS_fault_spec ('' -> None).
+    The empty-spec fast path is one attribute read + one compare."""
+    global _plan_cache
+    spec = _FAULT_FLAG.value
+    if not spec:
+        if _plan_cache[0]:
+            with _plan_lock:
+                _plan_cache = ("", None)
+        return None
+    if _plan_cache[0] != spec:
+        with _plan_lock:
+            if _plan_cache[0] != spec:
+                _plan_cache = (spec, parse_fault_spec(spec))
+    return _plan_cache[1]
+
+
+def set_fault_spec(spec: str) -> Optional[FaultPlan]:
+    """Set FLAGS_fault_spec and force a FRESH plan (counters reset) even
+    when the spec string is unchanged — the test-facing entry point."""
+    global _plan_cache
+    _flags.set_flags({"FLAGS_fault_spec": spec})
+    with _plan_lock:
+        _plan_cache = (spec, parse_fault_spec(spec) if spec else None)
+    return _plan_cache[1]
+
+
+def clear_fault_spec() -> None:
+    set_fault_spec("")
+
+
+def inject(kind: str, **ctx: Any) -> Optional[FaultRule]:
+    """The hook call sites use: no-op (None) without an active plan."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    return plan.should_fire(kind, **ctx)
+
+
+# ---------------------------------------------------------------------------
+# atomic I/O + bounded retry
+# ---------------------------------------------------------------------------
+def crc32_bytes(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Write bytes via temp-file + fsync + os.replace in the target's
+    directory: a crash mid-write never truncates an existing file."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}."
+                          f"{threading.get_ident()}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def retry_io(fn, what: str = "checkpoint write",
+             retries: Optional[int] = None,
+             backoff: Optional[float] = None):
+    """Run `fn` with a bounded retry-with-backoff budget. OSError and
+    InjectedFault are retryable; each retry bumps resilience.ckpt_retries.
+    The final failure re-raises the last error."""
+    if retries is None:
+        retries = _flags.flag("FLAGS_ckpt_retries")
+    if backoff is None:
+        backoff = _flags.flag("FLAGS_ckpt_retry_backoff")
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except (OSError, InjectedFault) as e:
+            if attempt >= retries:
+                raise
+            _M_CKPT_RETRIES.inc()
+            if backoff:
+                time.sleep(backoff * (2 ** attempt))
+            attempt += 1
+
+
+def list_checkpoints(output_dir: str) -> List[Tuple[int, str]]:
+    """(step, path) for every checkpoint-<step> dir under output_dir,
+    ascending by step — the fallback scan order source."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(output_dir)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("checkpoint-"):
+            suffix = name[len("checkpoint-"):]
+            if suffix.isdigit():
+                full = os.path.join(output_dir, name)
+                if os.path.isdir(full):
+                    out.append((int(suffix), full))
+    out.sort()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# deadlines + admission backpressure (serving degradation)
+# ---------------------------------------------------------------------------
+class Deadline:
+    """A wall-clock budget. Cheap to poll between decode steps."""
+
+    __slots__ = ("budget_s", "_t0")
+
+    def __init__(self, budget_s: float):
+        self.budget_s = float(budget_s)
+        self._t0 = time.monotonic()
+
+    @property
+    def elapsed_s(self) -> float:
+        return time.monotonic() - self._t0
+
+    def remaining_s(self) -> float:
+        return self.budget_s - self.elapsed_s
+
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+
+def deadline_miss() -> None:
+    _M_DEADLINE.inc()
+
+
+class AdmissionGate:
+    """Queue-admission backpressure: at most `max_inflight` requests
+    execute; a further request waits up to `queue_timeout_s` for a slot
+    and is then refused with the typed `Overloaded` error (never an
+    unbounded hang)."""
+
+    def __init__(self, max_inflight: int = 1, queue_timeout_s: float = 0.0):
+        if max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        self.max_inflight = int(max_inflight)
+        self.queue_timeout_s = float(queue_timeout_s)
+        self._sem = threading.BoundedSemaphore(self.max_inflight)
+
+    def try_acquire(self, timeout_s: Optional[float] = None) -> bool:
+        t = self.queue_timeout_s if timeout_s is None else float(timeout_s)
+        if t > 0:
+            return self._sem.acquire(timeout=t)
+        return self._sem.acquire(blocking=False)
+
+    def release(self) -> None:
+        self._sem.release()
+
+    @contextlib.contextmanager
+    def admit(self, timeout_s: Optional[float] = None):
+        if not self.try_acquire(timeout_s):
+            _M_REJECTS.inc()
+            raise Overloaded(
+                f"admission gate full ({self.max_inflight} inflight); "
+                f"queue wait exceeded "
+                f"{self.queue_timeout_s if timeout_s is None else timeout_s:.3f}s")
+        try:
+            yield self
+        finally:
+            self.release()
+
+
+# internal counters the wired subsystems report through (keeps the
+# metric objects private to this module)
+def _count_skip() -> None:
+    _M_SKIPPED.inc()
+
+
+def _count_rollback() -> None:
+    _M_ROLLBACKS.inc()
+
+
+def _count_fallback() -> None:
+    _M_CKPT_FALLBACKS.inc()
+
+
+def _count_loader_retry() -> None:
+    _M_LOADER_RETRIES.inc()
+
+
+def _count_emergency() -> None:
+    _M_EMERGENCY.inc()

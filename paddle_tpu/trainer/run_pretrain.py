@@ -402,12 +402,19 @@ def main(argv=None) -> int:
                     help="JSON config path ('-' reads stdin)")
     ap.add_argument("--max-steps", type=int, default=None)
     ap.add_argument("--output-dir", default=None)
+    ap.add_argument("--fault-spec", default=None,
+                    help="deterministic fault-injection plan for chaos "
+                         "runs (docs/RESILIENCE.md grammar), e.g. "
+                         "'seed=3;nan_grad@step=100;preempt@step=500'")
     args = ap.parse_args(argv)
     cfg = _load_config(args.config)
     if args.max_steps is not None:
         cfg["max_steps"] = args.max_steps
     if args.output_dir is not None:
         cfg["output_dir"] = args.output_dir
+    if args.fault_spec is not None:
+        from .. import resilience as _res
+        _res.set_fault_spec(args.fault_spec)
     return run(cfg)
 
 

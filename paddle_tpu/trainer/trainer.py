@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .. import observability as _obs
+from .. import resilience as _res
 
 __all__ = ["TrainingArguments", "Trainer"]
 
@@ -77,6 +78,23 @@ class TrainingArguments:
     flops_per_sample: float = 0.0
     # peak chip flops for the MFU gauge (0 = gauge not set):
     hardware_peak_flops: float = 0.0
+    # -- resilience guards (ISSUE 2) --
+    # what to do when a step's loss/grad-norm is NaN/Inf (or a loss
+    # spike fires): "none" (apply anyway, pre-ISSUE-2 behavior),
+    # "skip" (drop the grads, don't count the step), or "rollback"
+    # (restore the last-good model+optimizer snapshot, then continue)
+    bad_step_policy: str = "none"
+    # consecutive bad steps tolerated before the guard gives up (a
+    # persistent NaN source must fail loudly, not loop forever)
+    max_bad_steps: int = 20
+    # loss-spike guard: bad when loss > loss_spike_factor * EWMA(loss)
+    # after warmup (0 = spike detection off)
+    loss_spike_factor: float = 0.0
+    loss_ewma_alpha: float = 0.1
+    # how often (applied steps) the rollback policy snapshots last-good
+    # state; snapshots are references to immutable device arrays, so
+    # the cost is bookkeeping, not a copy
+    snapshot_steps: int = 10
 
     def __init__(self, **kwargs):
         for f in dataclasses.fields(self):
@@ -101,7 +119,18 @@ class Trainer:
         self.optimizer, self.lr_scheduler = optimizers
         self.state: Dict[str, Any] = {"global_step": 0, "epoch": 0.0,
                                       "micro_batches": 0,
+                                      "skipped_steps": 0, "rollbacks": 0,
                                       "log_history": []}
+        if self.args.bad_step_policy not in ("none", "skip", "rollback"):
+            raise ValueError(
+                f"bad_step_policy {self.args.bad_step_policy!r}: expected "
+                f"'none', 'skip' or 'rollback'")
+        # resilience guard state (ISSUE 2)
+        self._loss_ewma: Optional[float] = None
+        self._ewma_warm = 0
+        self._bad_streak = 0
+        self._last_good: Optional[Dict[str, Any]] = None
+        self._preempted = False
         paddle.seed(self.args.seed)
 
     # -- construction helpers ------------------------------------------------
@@ -178,6 +207,7 @@ class Trainer:
                 loss = self.compute_loss(self.model, batch)
         else:
             loss = self.compute_loss(self.model, batch)
+        loss = self._maybe_corrupt_loss(loss)
         if mx:
             t1 = time.perf_counter()
             _T_FWD.observe(t1 - t0)
@@ -230,6 +260,8 @@ class Trainer:
         if resume_from_checkpoint:
             self._load_checkpoint(resume_from_checkpoint)
         self.model.train()
+        if args.bad_step_policy == "rollback":
+            self._capture_good_state()
 
         accum = 0
         losses: List[float] = []
@@ -284,6 +316,14 @@ class Trainer:
                 if accum < args.gradient_accumulation_steps:
                     continue
                 accum = 0
+                self._maybe_corrupt_grads(self.state["global_step"] + 1)
+                step_loss = float(np.mean(
+                    losses[-args.gradient_accumulation_steps:]))
+                reason = self._guard_verdict(step_loss)
+                if reason is not None:
+                    self._handle_bad_step(reason, step_loss)
+                    continue
+                self._bad_streak = 0
                 if mx:
                     gn = self._grad_global_norm()
                     if gn is not None:
@@ -298,6 +338,13 @@ class Trainer:
                     _C_STEPS.inc()
                 self.state["global_step"] += 1
                 gs = self.state["global_step"]
+                if args.bad_step_policy == "rollback" and (
+                        self._last_good is None
+                        or gs % max(1, args.snapshot_steps) == 0):
+                    self._capture_good_state()
+                if not self._preempted and \
+                        _res.inject("preempt", step=gs) is not None:
+                    self._preempted = True
                 self.state["epoch"] = gs / max(
                     1, steps_per_epoch // max(
                         1, args.gradient_accumulation_steps))
@@ -326,6 +373,7 @@ class Trainer:
                         {"step": gs,
                          "preempted_checkpoint": self._ckpt_dir()})
                     self.save_checkpoint()
+                    _res._count_emergency()
                     return True
                 if args.save_steps and gs % args.save_steps == 0:
                     self.save_checkpoint()
@@ -336,6 +384,112 @@ class Trainer:
                 if gs >= target:
                     return True
         return done
+
+    # -- resilience guards (ISSUE 2) ----------------------------------------
+    def _maybe_corrupt_loss(self, loss):
+        """Fault-injection hook: nan_loss / inf_loss / spike_loss rules
+        rewrite the loss BEFORE backward, so the blowup propagates into
+        grads exactly as a real numeric failure would."""
+        if _res.active_plan() is None:
+            return loss
+        step = self.state["global_step"] + 1
+        for kind in ("nan_loss", "inf_loss", "spike_loss"):
+            rule = _res.inject(kind, step=step)
+            if rule is None:
+                continue
+            if kind == "spike_loss":
+                loss = loss * float(rule.opts.get("scale", 1e3))
+            else:
+                bad = float("nan") if kind == "nan_loss" else float("inf")
+                loss = loss * 0.0 + bad
+        return loss
+
+    def _maybe_corrupt_grads(self, step: int) -> None:
+        """Fault-injection hook: nan_grad / inf_grad poison one
+        parameter's accumulated gradient at the optimizer-step boundary."""
+        if _res.active_plan() is None:
+            return
+        for kind, bad in (("nan_grad", float("nan")),
+                          ("inf_grad", float("inf"))):
+            if _res.inject(kind, step=step) is None:
+                continue
+            import jax.numpy as jnp
+            for p in self.model.parameters():
+                g = getattr(p, "_grad", None)
+                if g is None:
+                    continue
+                if hasattr(g, "_data"):
+                    g._data = jnp.full_like(g._data, bad)
+                else:
+                    p._grad = jnp.full_like(g, bad)
+                break
+
+    def _guard_verdict(self, step_loss: float) -> Optional[str]:
+        """None when the accumulated step is healthy; else the reason it
+        must not be applied. Also advances the loss EWMA on good steps."""
+        args = self.args
+        if args.bad_step_policy == "none":
+            return None
+        if not math.isfinite(step_loss):
+            return "non_finite_loss"
+        gn = self._grad_global_norm()
+        if gn is not None and not math.isfinite(gn):
+            return "non_finite_grad"
+        if args.loss_spike_factor > 0 and self._loss_ewma is not None \
+                and self._ewma_warm >= 5 \
+                and step_loss > args.loss_spike_factor * self._loss_ewma:
+            return "loss_spike"
+        if args.loss_spike_factor > 0:
+            a = args.loss_ewma_alpha
+            self._loss_ewma = step_loss if self._loss_ewma is None \
+                else (1.0 - a) * self._loss_ewma + a * step_loss
+            self._ewma_warm += 1
+        return None
+
+    def _handle_bad_step(self, reason: str, step_loss: float) -> None:
+        """Apply the configured bad-step policy: drop this step's grads,
+        then either just skip or restore the last-good snapshot."""
+        args = self.args
+        self._bad_streak += 1
+        if self._bad_streak > args.max_bad_steps:
+            raise RuntimeError(
+                f"{self._bad_streak} consecutive bad optimizer steps "
+                f"(last: {reason}) exceeded max_bad_steps="
+                f"{args.max_bad_steps} — the numeric failure is "
+                f"persistent, not transient")
+        self.optimizer.clear_grad()
+        entry = {"step": self.state["global_step"], "bad_step": reason,
+                 "loss": step_loss, "policy": args.bad_step_policy}
+        if args.bad_step_policy == "rollback" and self._last_good is not None:
+            self._rollback_to_good_state()
+            self.state["rollbacks"] += 1
+            entry["restored_step"] = self._last_good["step"]
+            _res._count_rollback()
+        else:
+            self.state["skipped_steps"] += 1
+            _res._count_skip()
+        self.state["log_history"].append(entry)
+
+    def _capture_good_state(self) -> None:
+        """Snapshot model + optimizer state. jax arrays are immutable and
+        updates REBIND buffers, so holding references is a free, correct
+        point-in-time snapshot (no host copy)."""
+        self._last_good = {
+            "model": {k: v._data
+                      for k, v in self.model.state_dict().items()},
+            "opt": self.optimizer.state_dict(),
+            "lr_epoch": getattr(self.lr_scheduler, "last_epoch", None),
+            "step": self.state["global_step"],
+        }
+
+    def _rollback_to_good_state(self) -> None:
+        sd = self.model.state_dict()
+        for k, arr in self._last_good["model"].items():
+            sd[k]._data = arr
+        self.optimizer.set_state_dict(self._last_good["opt"])
+        if self.lr_scheduler is not None \
+                and self._last_good["lr_epoch"] is not None:
+            self.lr_scheduler.last_epoch = self._last_good["lr_epoch"]
 
     @contextlib.contextmanager
     def _sigterm_guard(self):
@@ -421,11 +575,38 @@ class Trainer:
                      "lr_last_epoch": getattr(self.lr_scheduler,
                                               "last_epoch", 0)},
                     os.path.join(d, "rng_sched.pd"))
-        with open(os.path.join(d, "trainer_state.json"), "w") as f:
-            json.dump({k: v for k, v in self.state.items()}, f)
+        _res.atomic_write(
+            os.path.join(d, "trainer_state.json"),
+            json.dumps({k: v for k, v in self.state.items()}).encode())
         return d
 
     def _load_checkpoint(self, path: str):
+        if not os.path.isdir(path):
+            avail = _res.list_checkpoints(self.args.output_dir)
+            hint = (" Available checkpoints under "
+                    f"{self.args.output_dir!r}: "
+                    + ", ".join(f"checkpoint-{s}" for s, _ in avail)
+                    if avail else
+                    f" No checkpoint-N directories exist under "
+                    f"{self.args.output_dir!r}.")
+            raise FileNotFoundError(
+                f"resume_from_checkpoint={path!r} is not a directory."
+                + hint)
+        try:
+            self._load_checkpoint_files(path)
+        except (_res.CheckpointCorrupt, OSError) as e:
+            older = [p for s, p in _res.list_checkpoints(self.args.output_dir)
+                     if os.path.abspath(p) != os.path.abspath(path)]
+            if not older:
+                raise
+            prev = older[-1]
+            import warnings
+            warnings.warn(f"checkpoint {path} is unreadable ({e}); "
+                          f"falling back to {prev}")
+            _res._count_fallback()
+            self._load_checkpoint_files(prev)
+
+    def _load_checkpoint_files(self, path: str):
         paddle = self.paddle
         self.model.set_state_dict(
             paddle.load(os.path.join(path, "model_state.pdparams")))

@@ -76,3 +76,100 @@ def _restore_global_mesh():
     set_mesh(prev)
     fleet._fleet_state.clear()
     fleet._fleet_state.update(prev_fleet)
+
+
+# ---------------------------------------------------------------------------
+# XLA/jax capability probes: legacy installs (jax 0.4.x) cannot run two
+# feature sets the pipeline/multihost tests depend on. Probe the actual
+# capability (never the version string) and skip the known dependents with
+# an explicit reason instead of letting them fail.
+# ---------------------------------------------------------------------------
+_probe_cache: dict = {}
+
+
+def _partial_auto_axis_index_ok() -> bool:
+    """True when a PARTIAL-AUTO shard_map body may call lax.axis_index:
+    on legacy jax the combination lowers to a PartitionId instruction
+    XLA's SPMD partitioner rejects (UNIMPLEMENTED) — the exact pattern
+    the pipeline-parallel schedules use for stage identity."""
+    if "partial_auto" not in _probe_cache:
+        try:
+            import numpy as np
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, PartitionSpec as P
+            from paddle_tpu.distributed._compat import shard_map
+
+            devs = jax.devices()
+            if len(devs) < 4:
+                # can't build a non-trivial auto axis — don't skip
+                _probe_cache["partial_auto"] = True
+                return True
+            # the auto axis must be >1 wide: with a trivial auto axis
+            # the partitioner never emits the ambiguous PartitionId
+            mesh = Mesh(np.array(devs[:4]).reshape(2, 2),
+                        ("_pa", "_pb"))
+
+            def body(x):
+                return x + jax.lax.axis_index("_pa").astype(jnp.float32)
+
+            f = shard_map(body, mesh=mesh, in_specs=P("_pa"),
+                          out_specs=P("_pa"), axis_names={"_pa"})
+            jax.jit(f)(jnp.zeros((2,), jnp.float32)).block_until_ready()
+            _probe_cache["partial_auto"] = True
+        except Exception:
+            _probe_cache["partial_auto"] = False
+    return _probe_cache["partial_auto"]
+
+
+def _multihost_workers_ok() -> bool:
+    """The multihost tests launch subprocess workers that call
+    jax.distributed.is_initialized — absent on legacy jax."""
+    if "multihost" not in _probe_cache:
+        _probe_cache["multihost"] = hasattr(jax.distributed,
+                                            "is_initialized")
+    return _probe_cache["multihost"]
+
+
+# (file basename, test-name prefixes) — prefixes cover parametrized ids
+_PARTIAL_AUTO_DEPENDENTS = {
+    "test_pipeline.py": ("test_gpipe_matches_sequential",
+                         "test_vpp_matches_sequential",
+                         "test_vpp_grad_flows"),
+    "test_pipeline_bf16.py": ("test_bf16_pipeline_matches_f32",),
+    "test_pp_exec.py": ("test_pretrain_step_1f1b_matches_compiled",
+                        "test_pretrain_step_zbh1_runs",
+                        "test_pretrain_step_vpp_timetable_matches_compiled",
+                        "test_pretrain_step_1f1b_composes_with_sep_axis"),
+}
+_MULTIHOST_DEPENDENTS = {
+    "test_multihost.py": ("test_two_process_launch_psum_across_8_devices",
+                          "test_two_process_hybrid_train_loss_parity",
+                          "test_launcher_driven_cli_loss_parity"),
+}
+
+
+def _match(item, table) -> bool:
+    prefixes = table.get(item.fspath.basename)
+    return bool(prefixes) and item.name.startswith(prefixes)
+
+
+def pytest_collection_modifyitems(config, items):
+    pa_mark = mh_mark = None
+    for item in items:
+        if _match(item, _PARTIAL_AUTO_DEPENDENTS):
+            if _partial_auto_axis_index_ok():
+                continue
+            if pa_mark is None:
+                pa_mark = pytest.mark.skip(reason=(
+                    "legacy jax: partial-auto shard_map + axis_index "
+                    "lowers to PartitionId, unimplemented in this XLA's "
+                    "SPMD partitioner (capability probe)"))
+            item.add_marker(pa_mark)
+        elif _match(item, _MULTIHOST_DEPENDENTS):
+            if _multihost_workers_ok():
+                continue
+            if mh_mark is None:
+                mh_mark = pytest.mark.skip(reason=(
+                    "legacy jax: jax.distributed.is_initialized missing "
+                    "— subprocess workers cannot join (capability probe)"))
+            item.add_marker(mh_mark)

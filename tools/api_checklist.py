@@ -68,6 +68,9 @@ MODULE_ROLES = {
     "ops": "Pallas/XLA kernel library (upstream phi kernels)",
     "trainer": "pretrain step builder (upstream: PaddleNLP Trainer)",
     "flags": "FLAGS registry (upstream paddle.base.core flags)",
+    "resilience": "fault injection + checkpoint integrity + recovery "
+                  "policies (docs/RESILIENCE.md; upstream: fleet "
+                  "elastic/checkpoint hooks)",
 }
 
 

@@ -98,3 +98,36 @@ def write_metrics_snapshot(path: str, extra: dict | None = None) -> dict:
     with open(path, "w") as f:
         json.dump(snap, f, indent=2, sort_keys=True)
     return snap
+
+
+def write_resilience_report(path: str, extra: dict | None = None) -> dict:
+    """Dump the resilience.* metric slice plus the active fault plan after
+    a chaos run (docs/RESILIENCE.md): which faults fired, how many steps
+    were skipped/rolled back, checkpoint retries/fallbacks, deadline
+    misses. The totals line makes 'did every injected fault get handled'
+    a one-field check. Returns the report dict; writes JSON to `path`."""
+    import json
+    import os
+
+    from paddle_tpu import resilience as res
+
+    snap = res.metrics()
+    plan = res.active_plan()
+    totals = {}
+    for name, m in snap.items():
+        totals[name] = sum(s["value"] for s in m["series"])
+    report = {
+        "fault_spec": plan.spec if plan is not None else "",
+        "rules_fired": [
+            {"kind": r.kind, "when": dict(r.when), "fired": r.fired}
+            for r in plan.rules] if plan is not None else [],
+        "totals": totals,
+        "metrics": snap,
+    }
+    if extra:
+        report.update(extra)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    return report
