@@ -17,6 +17,8 @@ from .auto_parallel import (Partial, Replicate, Shard, dtensor_from_fn,  # noqa:
 from .collective import (ReduceOp, all_gather, all_reduce, alltoall,  # noqa: F401
                          barrier, broadcast, get_group, new_group, reduce,
                          reduce_scatter, stream, wait)
+from . import watchdog  # noqa: F401
+from .watchdog import CollectiveTimeout  # noqa: F401
 from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
                               RowParallelLinear, VocabParallelEmbedding,
                               annotate_sequence_parallel)

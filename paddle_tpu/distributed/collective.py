@@ -13,6 +13,7 @@ In-place semantics preserved: `all_reduce(t)` rewrites t's buffer.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from typing import List, Optional
 
@@ -24,6 +25,7 @@ from ._compat import shard_map
 from .. import observability as _obs
 from .. import resilience as _res
 from ..core.tensor import Tensor
+from . import watchdog as _wd
 from .mesh import get_mesh
 
 # per-collective visibility (ISSUE 1): calls, input-payload bytes, and
@@ -51,11 +53,27 @@ def _payload_bytes(args) -> int:
     return n
 
 
+def _describe(args, shapes=None, dtypes=None):
+    """Tensor shapes/dtypes of a call's inputs, for the flight record."""
+    if shapes is None:
+        shapes, dtypes = [], []
+    for a in args:
+        if isinstance(a, Tensor):
+            shapes.append(list(a._data.shape))
+            dtypes.append(str(a._data.dtype))
+        elif isinstance(a, (list, tuple)):
+            _describe(a, shapes, dtypes)
+    return shapes, dtypes
+
+
 def _maybe_fault(name: str) -> None:
     """Fault-injection hook shared by every collective entry point:
     collective_delay@collective=<name>[:ms=N] sleeps before dispatch,
-    collective_error@collective=<name> raises InjectedFault. `collective`
-    may also be `all` to target every collective."""
+    collective_hang@collective=<name>[:ms=N] simulates a dead-peer hang
+    (bounded at ms, default 30 s; the watchdog is expected to cancel it
+    first and raise CollectiveTimeout), collective_error@collective=<name>
+    raises InjectedFault. `collective` may also be `all` to target every
+    collective."""
     plan = _res.active_plan()
     if plan is None:
         return
@@ -64,6 +82,10 @@ def _maybe_fault(name: str) -> None:
         if rule is not None:        # ALSO error below, like real flakes
             time.sleep(float(rule.opts.get("ms", 50.0)) / 1e3)
     for site in (name, "all"):
+        rule = _res.inject("collective_hang", collective=site)
+        if rule is not None:
+            _wd.simulate_hang(name, float(rule.opts.get("ms", 30000.0)) / 1e3)
+    for site in (name, "all"):
         rule = _res.inject("collective_error", collective=site)
         if rule is not None:
             raise _res.InjectedFault(
@@ -71,23 +93,44 @@ def _maybe_fault(name: str) -> None:
 
 
 def _instrumented(fn):
-    """Wrap a collective: count calls/bytes and time the call. Disabled
-    metrics cost one attribute check."""
+    """Wrap a collective: count calls/bytes, time the call, and log it to
+    the watchdog flight recorder. Disabled metrics / disabled watchdog
+    each cost one attribute check."""
     name = fn.__name__
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        _maybe_fault(name)
-        if not _obs.enabled():
-            return fn(*args, **kwargs)
-        t0 = time.perf_counter()
+        rec = None
+        if _wd.enabled():
+            shapes, dtypes = _describe(args)
+            try:
+                axis = _axis_of(kwargs.get("group"))
+            except TypeError:
+                axis = None
+            rec = _wd.start_record(name, shapes, dtypes,
+                                   _payload_bytes(args), axis)
         try:
-            return fn(*args, **kwargs)
-        finally:
-            _COLL_CALLS.labels(collective=name).inc()
-            _COLL_BYTES.labels(collective=name).inc(_payload_bytes(args))
-            _COLL_LAT.labels(collective=name).observe(
-                time.perf_counter() - t0)
+            _maybe_fault(name)
+            if not _obs.enabled():
+                out = fn(*args, **kwargs)
+            else:
+                t0 = time.perf_counter()
+                try:
+                    out = fn(*args, **kwargs)
+                finally:
+                    _COLL_CALLS.labels(collective=name).inc()
+                    _COLL_BYTES.labels(collective=name).inc(
+                        _payload_bytes(args))
+                    _COLL_LAT.labels(collective=name).observe(
+                        time.perf_counter() - t0)
+        except _wd.CollectiveTimeout:
+            _wd.end_record(rec, "timeout")
+            raise
+        except BaseException:
+            _wd.end_record(rec, "error")
+            raise
+        _wd.end_record(rec, "ok")
+        return out
     return wrapper
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce_scatter",
@@ -308,9 +351,43 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 @_instrumented
 def barrier(group=None):
-    """Fence all outstanding device work (SPMD: program order is the sync)."""
-    for a in jax.live_arrays():
-        a.block_until_ready()
+    """Fence all outstanding device work (SPMD: program order is the sync).
+
+    With `FLAGS_collective_timeout` > 0 the fence runs in a helper thread
+    and a dead peer raises a diagnostic `CollectiveTimeout` (flight dump +
+    lagging rank) instead of hanging the pod forever on
+    `block_until_ready`."""
+    tmo = _wd.timeout_s()
+    if tmo <= 0:
+        for a in jax.live_arrays():
+            a.block_until_ready()
+        return
+    err: List[BaseException] = []
+
+    def _fence():
+        try:
+            for a in jax.live_arrays():
+                a.block_until_ready()
+        except BaseException as e:       # surfaced in the caller below
+            err.append(e)
+
+    t = threading.Thread(target=_fence, daemon=True, name="pt-barrier-fence")
+    t0 = time.monotonic()
+    t.start()
+    while True:
+        t.join(timeout=0.005)
+        if not t.is_alive():
+            break
+        rec = _wd.current_record()
+        if rec is not None and rec.cancelled:
+            raise _wd.timeout_error(rec, "barrier", rec.elapsed_s)
+        if time.monotonic() - t0 > tmo:
+            elapsed = time.monotonic() - t0
+            if rec is not None:
+                _wd.handle_timeout(rec)
+            raise _wd.timeout_error(rec, "barrier", elapsed)
+    if err:
+        raise err[0]
 
 
 def wait(tensor, group=None, use_calc_stream=True):

@@ -113,6 +113,9 @@ class CollectiveController:
             "COORDINATOR_ADDRESS": self.jax_coordinator,
             "JAX_PROCESS_ID": str(rank),
             "JAX_NUM_PROCESSES": str(self.world_size),
+            # where the watchdog drops flightdump.<rank>.json on a
+            # collective timeout (collected by _write_flight_report)
+            "PADDLE_LOG_DIR": os.path.abspath(self.args.log_dir),
         })
         if self.args.devices:
             env["TPU_VISIBLE_DEVICES"] = self.args.devices
@@ -159,6 +162,11 @@ class CollectiveController:
             rank = self.node_rank * self.nproc + lr
             log_path = os.path.join(self.args.log_dir, f"workerlog.{rank}")
             logf = open(log_path, "ab", buffering=0)
+            # attempt marker: workerlog.N is opened append-mode across
+            # restarts/generations, so post-mortems need to know which
+            # attempt produced which lines
+            logf.write(f"=== restart {self._restarts} / gen {self.gen} "
+                       f"===\n".encode())
             cmd = [sys.executable, "-u", self.args.training_script,
                    *self.args.training_script_args]
             p = subprocess.Popen(cmd, env=self._rank_env(lr), stdout=logf,
@@ -265,6 +273,7 @@ class CollectiveController:
                         restarted = True
                         break
                     self._kill_all()
+                    self._write_flight_report(rc)
                     return rc
             if restarted:
                 continue
@@ -284,6 +293,37 @@ class CollectiveController:
                 if act == "respawned":
                     continue
             time.sleep(self.args.poll_interval)
+
+    def _write_flight_report(self, rc: int) -> Optional[str]:
+        """Post-mortem merge (ISSUE 3): on terminal child failure, collect
+        any per-rank flightdump.<rank>.json the watchdog wrote into the log
+        dir and merge them into one flight_report.json naming the lagging
+        rank and the first divergent op. Best-effort: a job that died for
+        non-collective reasons has no dumps and writes no report."""
+        import glob as _glob
+        import json as _json
+        dumps = []
+        for p in sorted(_glob.glob(
+                os.path.join(self.args.log_dir, "flightdump.*.json"))):
+            try:
+                with open(p) as f:
+                    dumps.append(_json.load(f))
+            except (OSError, ValueError):
+                continue
+        if not dumps:
+            return None
+        from .. import watchdog as _wd
+        report = _wd.merge_dumps(dumps)
+        report["exit_code"] = rc
+        report["restarts"] = self._restarts
+        report["gen"] = self.gen
+        out = os.path.join(self.args.log_dir, "flight_report.json")
+        try:
+            with open(out, "w") as f:
+                _json.dump(report, f, indent=2)
+        except OSError:
+            return None
+        return out
 
     def _elastic_setup(self):
         """Create the membership manager; founders register their own
@@ -376,7 +416,7 @@ class ElasticManager:
         a slot whose claim counter moved past our token."""
         self._token = self.store.add(f"claim/{self.node_rank}", 1)
 
-    def heartbeat(self) -> None:
+    def heartbeat(self, payload: Optional[str] = None) -> None:
         if self._token is not None:
             cur = self.store.get(f"claim/{self.node_rank}")
             if cur is not None and int(cur) != self._token:
@@ -384,7 +424,13 @@ class ElasticManager:
                     f"elastic slot {self.node_rank} was reclaimed by a "
                     f"newer owner (claim {int(cur)} > ours {self._token}): "
                     "this node paused past the TTL and must exit")
-        self.store.set(f"heartbeat/{self.node_rank}", str(time.time()))
+        # liveness ts first; anything after '|' is an opaque payload
+        # channel (alive_nodes splits it off) — the collective watchdog
+        # publishes per-rank flight progress through it
+        val = str(time.time())
+        if payload:
+            val = f"{val}|{payload}"
+        self.store.set(f"heartbeat/{self.node_rank}", val)
 
     def alive_nodes(self, nnodes: int) -> List[int]:
         now = time.time()

@@ -177,8 +177,25 @@ define_flag("FLAGS_fault_spec", "",
             "semicolon-separated clauses 'kind@site[:opt=val...]' plus an "
             "optional 'seed=N'. Kinds: nan_loss/inf_loss/spike_loss, "
             "nan_grad/inf_grad, ckpt_write_fail/ckpt_read_corrupt, "
-            "loader_raise, collective_delay/collective_error, preempt. "
+            "loader_raise, collective_delay/collective_hang/"
+            "collective_error, preempt. "
             "Empty = no faults (zero overhead). See docs/RESILIENCE.md")
+define_flag("FLAGS_collective_timeout", 0.0,
+            "seconds before an in-flight collective is declared hung by "
+            "the watchdog (distributed.watchdog): the flight-recorder ring "
+            "is dumped to the worker log dir and a diagnostic "
+            "CollectiveTimeout is raised (trainer routes it to an "
+            "emergency checkpoint). 0 = watchdog off; instrumented call "
+            "sites degrade to one attribute test",
+            validator=lambda v: v >= 0)
+define_flag("FLAGS_flight_record_size", 256,
+            "capacity of the collective flight-recorder ring buffer "
+            "(last-N collective calls kept for post-mortem dumps)",
+            validator=lambda v: v >= 1)
+define_flag("FLAGS_watchdog_interval", 0.0,
+            "watchdog monitor poll interval in seconds; 0 = auto "
+            "(FLAGS_collective_timeout/4, clamped to [0.01, 0.25])",
+            validator=lambda v: v >= 0)
 define_flag("FLAGS_ckpt_retries", 3,
             "bounded retry budget for checkpoint write failures "
             "(framework.io.save / distributed.checkpoint.save_state_dict)",

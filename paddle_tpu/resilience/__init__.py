@@ -138,7 +138,7 @@ class TimeoutResult:
 _RAISING_KINDS = frozenset({
     "nan_loss", "inf_loss", "spike_loss", "nan_grad", "inf_grad",
     "ckpt_write_fail", "ckpt_read_corrupt", "loader_raise",
-    "collective_delay", "collective_error", "preempt",
+    "collective_delay", "collective_hang", "collective_error", "preempt",
 })
 
 

@@ -279,9 +279,24 @@ class Trainer:
         # epoch so the data stream continues where it stopped (ref:
         # Trainer's consumed_samples / sampler-state resume)
         skip = self.state["micro_batches"] % max(1, steps_per_epoch)
-        with self._sigterm_guard():
-            done = self._run_loop(loader, target, done, skip, accum, losses,
-                                  t0, steps_per_epoch)
+        try:
+            with self._sigterm_guard():
+                done = self._run_loop(loader, target, done, skip, accum,
+                                      losses, t0, steps_per_epoch)
+        except Exception as e:
+            from ..distributed.watchdog import CollectiveTimeout
+            if not isinstance(e, CollectiveTimeout):
+                raise
+            # a hung collective is unrecoverable in-flight (ISSUE 3): save
+            # an emergency checkpoint so the relaunch resumes instead of
+            # losing the run, then fail fast with the diagnosis attached
+            self.state["log_history"].append(
+                {"step": self.state["global_step"],
+                 "collective_timeout": str(e),
+                 "emergency_checkpoint": self._ckpt_dir()})
+            self.save_checkpoint()
+            _res._count_emergency()
+            raise
         if not self._preempted:
             self.save_checkpoint()
         return self.state

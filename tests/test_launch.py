@@ -84,6 +84,150 @@ def test_elastic_manager_membership():
         s.close()
 
 
+def test_heartbeat_payload_channel_tolerated():
+    """The '|'-suffix payload channel (used by the collective watchdog to
+    publish flight progress) must not break liveness parsing."""
+    from paddle_tpu.native import TCPStore
+    from paddle_tpu.distributed.launch import ElasticManager
+    s = TCPStore(is_master=True, world_size=1)
+    try:
+        m = ElasticManager(s, node_rank=0, ttl=5.0)
+        m.heartbeat(payload="rank=0,seq=7,op=all_reduce")
+        assert m.alive_nodes(1) == [0]
+        raw = s.get("heartbeat/0").decode()
+        assert raw.split("|", 1)[1] == "rank=0,seq=7,op=all_reduce"
+    finally:
+        s.close()
+
+
+def test_claim_slot_rechecks_racing_joiner():
+    """Two joiners race for the same stale slot: the loser's post-add
+    re-check sees the winner's fresh heartbeat and must move on to the
+    next slot instead of double-claiming."""
+    import time as _time
+    from paddle_tpu.native import TCPStore
+    from paddle_tpu.distributed.launch import ElasticManager
+
+    class RacingStore:
+        """Store wrapper that simulates a rival joiner winning slot 0
+        between our claim-counter add and the heartbeat re-check."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self._raced = False
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def add(self, key, amount):
+            token = self._inner.add(key, amount)
+            if key == "claim/0" and not self._raced:
+                self._raced = True
+                self._inner.set("heartbeat/0", str(_time.time()))
+            return token
+
+    s = TCPStore(is_master=True, world_size=1)
+    try:
+        m = ElasticManager(RacingStore(s), node_rank=99, ttl=5.0,
+                           min_nodes=1, max_nodes=3)
+        slot = m.claim_slot()
+        assert slot == 1                    # slot 0 lost to the rival
+        assert m.node_rank == 1
+        assert m.alive_nodes(2) == [0, 1]   # both heartbeating now
+        m.heartbeat()                       # our token is current: no raise
+    finally:
+        s.close()
+
+
+def test_heartbeat_slot_theft_fence():
+    """A node that paused past the TTL and lost its slot to a newer
+    claimant must see the moved claim counter and exit, not keep
+    heartbeating a slot it no longer owns (split-brain fence)."""
+    import pytest
+    from paddle_tpu.native import TCPStore
+    from paddle_tpu.distributed.launch import ElasticManager
+    s = TCPStore(is_master=True, world_size=1)
+    try:
+        m = ElasticManager(s, node_rank=0, ttl=5.0, min_nodes=1,
+                           max_nodes=2)
+        m.register_slot()
+        m.heartbeat()                       # own token: fine
+        s.add("claim/0", 1)                 # a newer owner claims the slot
+        with pytest.raises(RuntimeError, match="reclaimed"):
+            m.heartbeat()
+    finally:
+        s.close()
+
+
+def test_restart_banner_marks_each_attempt(tmp_path):
+    """Satellite bugfix: workerlog.N is opened append-mode across
+    restarts, so every (re)spawn writes a '=== restart N / gen G ==='
+    marker separating the attempts."""
+    sentinel = tmp_path / "came_before"
+    script = _write_script(tmp_path, f"""
+        import os, sys
+        s = {str(sentinel)!r}
+        if not os.path.exists(s):
+            open(s, "w").write("x")
+            sys.exit(1)
+        print("attempt two ok")
+    """)
+    rc = launch(["--nproc_per_node", "1", "--max_restarts", "1",
+                 "--log_dir", str(tmp_path / "log"), script])
+    assert rc == 0
+    log = (tmp_path / "log" / "workerlog.0").read_text()
+    assert "=== restart 0 / gen 0 ===" in log
+    assert "=== restart 1 / gen 0 ===" in log
+    # the failing first attempt's lines sit under the first banner
+    assert log.index("=== restart 0") < log.index("=== restart 1") \
+        < log.index("attempt two ok")
+
+
+def test_flight_report_merged_on_terminal_failure(tmp_path):
+    """On terminal child failure the controller collects per-rank
+    flightdump.*.json from the log dir into one flight_report.json naming
+    the lagging rank (ISSUE 3 post-mortem merge)."""
+    import json
+    script = _write_script(tmp_path, """
+        import json, os, sys
+        # stand in for the watchdog: write this rank's flight dump, then
+        # die the way a hung collective does after CollectiveTimeout
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        seqs = {0: 2, 1: 1}[rank]
+        recs = [{"seq": i + 1, "op": "all_reduce", "shapes": [[4]],
+                 "dtypes": ["float32"], "bytes": 16, "axis": "dp",
+                 "start": 0.0, "end": 0.1, "duration_s": 0.1,
+                 "status": "ok"} for i in range(seqs)]
+        dump = {"version": 1, "rank": rank, "last_completed_seq": seqs,
+                "records": recs}
+        path = os.path.join(os.environ["PADDLE_LOG_DIR"],
+                            f"flightdump.{rank}.json")
+        with open(path, "w") as f:
+            json.dump(dump, f)
+        # wait for the peer's dump so the controller can't reap one rank
+        # before the other has written (both must appear in the report)
+        import time
+        peer = os.path.join(os.environ["PADDLE_LOG_DIR"],
+                            f"flightdump.{1 - rank}.json")
+        for _ in range(200):
+            if os.path.exists(peer):
+                break
+            time.sleep(0.05)
+        sys.exit(7)
+    """)
+    rc = launch(["--nproc_per_node", "2", "--max_restarts", "0",
+                 "--log_dir", str(tmp_path / "log"), script])
+    assert rc == 7
+    report = json.load(open(tmp_path / "log" / "flight_report.json"))
+    assert report["world"] == 2
+    assert report["exit_code"] == 7
+    assert report["lagging_rank"] == 1
+    assert report["last_completed_seq"] == {"0": 2, "1": 1} or \
+        report["last_completed_seq"] == {0: 2, 1: 1}
+    fd = report["first_divergence"]
+    assert fd["seq"] == 2 and fd["reason"] == "missing_rank"
+
+
 def test_fault_injection_sigkill_worker_recovers(tmp_path):
     """Kill-a-worker fault injection (SURVEY §5.3): rank 1 SIGKILLs itself
     mid-run on the first attempt; the watch loop must tear the pod down and

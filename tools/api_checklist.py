@@ -71,6 +71,11 @@ MODULE_ROLES = {
     "resilience": "fault injection + checkpoint integrity + recovery "
                   "policies (docs/RESILIENCE.md; upstream: fleet "
                   "elastic/checkpoint hooks)",
+    "distributed": "upstream namesake package + `distributed.watchdog` "
+                   "(collective flight recorder, hang watchdog, "
+                   "cross-rank desync diagnosis — docs/RESILIENCE.md; "
+                   "upstream: ProcessGroupNCCL watchdog/async error "
+                   "handling)",
 }
 
 

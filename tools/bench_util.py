@@ -131,3 +131,33 @@ def write_resilience_report(path: str, extra: dict | None = None) -> dict:
     with open(path, "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)
     return report
+
+
+def write_watchdog_report(path: str, extra: dict | None = None) -> dict:
+    """Dump the watchdog.* metric slice plus the live flight-recorder ring
+    after a run (docs/RESILIENCE.md): collectives recorded, timeouts per
+    op, dumps written, last-completed seq, and the in-memory ring itself —
+    the hang post-mortem in one file even when no on-disk flightdump was
+    triggered. Returns the report dict; writes JSON to `path`."""
+    import json
+    import os
+
+    from paddle_tpu.distributed import watchdog as wd
+
+    snap = wd.metrics()
+    totals = {}
+    for name, m in snap.items():
+        if m.get("kind") == "counter":
+            totals[name] = sum(s["value"] for s in m["series"])
+    report = {
+        "totals": totals,
+        "metrics": snap,
+        "flight": wd.recorder().dump(),
+    }
+    if extra:
+        report.update(extra)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    return report

@@ -1,0 +1,98 @@
+"""Offline post-mortem CLI for collective flight-recorder dumps (ISSUE 3).
+
+A hung job leaves one ``flightdump.<rank>.json`` per rank in the worker
+log dir (written by ``paddle_tpu.distributed.watchdog`` when
+``FLAGS_collective_timeout`` fires, or collected live into
+``flight_report.json`` by the launch controller). This tool merges and
+diffs those dumps after the fact — on a workstation, without the job:
+
+    python tools/flight_recorder.py merge LOGDIR [-o report.json]
+        merge every flightdump.*.json under LOGDIR (files also accepted)
+        into one report: per-rank last-completed seq, the lagging rank,
+        the first divergence, and the union of records sorted by seq.
+
+    python tools/flight_recorder.py diff LOGDIR
+        print just the desync verdict: the first seq where ranks disagree
+        (op/shape mismatch, a non-ok status, or a rank that never got
+        there) and which ranks are behind.
+
+Exit code: 0 = ranks consistent, 1 = divergence found, 2 = no dumps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed import watchdog  # noqa: E402
+
+
+def load_dumps(paths):
+    """Expand dirs to their flightdump.*.json files and parse everything."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(
+                os.path.join(p, "flightdump.*.json"))))
+        else:
+            files.append(p)
+    dumps = []
+    for f in files:
+        try:
+            with open(f) as fh:
+                dumps.append(json.load(fh))
+        except (OSError, ValueError) as e:
+            print(f"skipping {f}: {e}", file=sys.stderr)
+    return dumps
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flight_recorder",
+        description="merge/diff per-rank collective flight dumps")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="merge dumps into one report")
+    mp.add_argument("paths", nargs="+",
+                    help="log dirs (globbed for flightdump.*.json) or files")
+    mp.add_argument("-o", "--output", default=None,
+                    help="write the merged report here (default: stdout)")
+    dp = sub.add_parser("diff", help="print the first cross-rank divergence")
+    dp.add_argument("paths", nargs="+",
+                    help="log dirs (globbed for flightdump.*.json) or files")
+    args = ap.parse_args(argv)
+
+    dumps = load_dumps(args.paths)
+    if not dumps:
+        print("no flight dumps found", file=sys.stderr)
+        return 2
+    report = watchdog.merge_dumps(dumps)
+    div = report["first_divergence"]
+
+    if args.cmd == "merge":
+        text = json.dumps(report, indent=2)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text + "\n")
+            print(f"wrote {args.output} ({len(report['records'])} records, "
+                  f"{report['world']} ranks)")
+        else:
+            print(text)
+    else:
+        if div is None:
+            print(f"{report['world']} ranks consistent through seq "
+                  f"{max(report['last_completed_seq'].values(), default=0)}")
+        else:
+            print(json.dumps({"lagging_rank": report["lagging_rank"],
+                              "last_completed_seq":
+                                  report["last_completed_seq"],
+                              "first_divergence": div}, indent=2))
+    return 1 if div is not None else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
