@@ -1,0 +1,20 @@
+"""paddle_tpu.analysis — AST-based static analysis for TPU/JAX hazards.
+
+Pure-stdlib (``ast`` only): importing this package never imports jax, so
+``tools/paddlelint.py`` can run in any environment, including CI hosts
+with no accelerator stack. Rules PT001-PT006 are documented in
+docs/ANALYSIS.md; the CLI lives in :mod:`paddle_tpu.analysis.cli`.
+"""
+
+from .baseline import load as load_baseline
+from .baseline import save as save_baseline
+from .baseline import split as split_baseline
+from .callgraph import PackageIndex
+from .model import RULES, Config, Finding
+from .runner import analyze_paths, analyze_source
+
+__all__ = [
+    "PackageIndex", "RULES", "Config", "Finding",
+    "analyze_paths", "analyze_source",
+    "load_baseline", "save_baseline", "split_baseline",
+]

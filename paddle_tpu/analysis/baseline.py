@@ -1,0 +1,60 @@
+"""Baseline file handling.
+
+The baseline is a checked-in JSON list of accepted pre-existing findings,
+keyed by :attr:`Finding.baseline_key` (``rule|path|qualname|detail`` — no
+line numbers, so entries survive unrelated edits). Every entry carries a
+one-line justification; ``--write-baseline`` refuses to invent them and
+stamps ``TODO: justify`` so review catches unexplained acceptances.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from .model import Finding
+
+VERSION = 1
+
+
+def load(path: str) -> Dict[str, str]:
+    """-> baseline_key -> justification."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != VERSION:
+        raise ValueError(f"unsupported baseline version: "
+                         f"{data.get('version')!r}")
+    return {e["key"]: e.get("justification", "")
+            for e in data.get("entries", [])}
+
+
+def save(path: str, findings: List[Finding],
+         justifications: Dict[str, str]) -> None:
+    entries = []
+    seen = set()
+    for f in sorted(findings, key=lambda x: (x.path, x.rule, x.qualname)):
+        k = f.baseline_key
+        if k in seen:
+            continue
+        seen.add(k)
+        entries.append({
+            "key": k,
+            "justification": justifications.get(k, "TODO: justify"),
+        })
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": VERSION, "entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+def split(findings: List[Finding], baseline: Dict[str, str]
+          ) -> Tuple[List[Finding], List[str]]:
+    """-> (non-baselined findings, stale baseline keys no longer hit)."""
+    hit = set()
+    fresh = []
+    for f in findings:
+        if f.baseline_key in baseline:
+            hit.add(f.baseline_key)
+        else:
+            fresh.append(f)
+    stale = sorted(set(baseline) - hit)
+    return fresh, stale

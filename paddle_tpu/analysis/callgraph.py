@@ -1,0 +1,441 @@
+"""Package index + call graph for paddlelint.
+
+Everything here is pure ``ast`` — no framework import, no execution. The
+index parses every file once and exposes the three derived facts the rule
+passes share:
+
+- **call graph** — best-effort edges ``module:qualname -> module:qualname``
+  resolved through local defs, ``self.method``, package-relative imports
+  and module aliases. Unresolvable receivers keep the bare attribute name
+  so name-based passes (PT003 host-sync) can still match.
+- **traced region** — functions whose bodies run under a JAX tracer:
+  functions decorated with / passed to ``jit``/``pjit``/``shard_map``/
+  ``pallas_call``/``lax.scan``-family calls, lambdas inline in those
+  calls, closure-factory products (``body = make_body(...)`` then
+  ``jax.jit(body)`` marks the inner ``def`` that ``make_body`` returns —
+  the dominant idiom in ``generation.py``/``trainer/pretrain.py``), plus
+  everything reachable from those through the call graph.
+- **thread region** — functions reachable from a ``threading.Thread(
+  target=...)`` entry, for the PT006 static race pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .model import collect_suppressions
+
+# call names that introduce a tracer scope for function-valued arguments
+TRACE_WRAPPERS = {
+    "jit", "pjit", "shard_map", "pallas_call", "vmap", "pmap", "grad",
+    "value_and_grad", "checkpoint", "remat", "custom_vjp", "custom_jvp",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan",
+}
+# subset that constructs a compiled-callable cache entry (PT002)
+JIT_CONSTRUCTORS = {"jit", "pjit"}
+
+
+def _last_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _dotted(func: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FunctionInfo:
+    __slots__ = ("modname", "qualname", "node", "params", "lineno",
+                 "class_name", "calls", "returned_defs", "returned_calls",
+                 "local_factory_vars")
+
+    def __init__(self, modname: str, qualname: str, node, class_name=None):
+        self.modname = modname
+        self.qualname = qualname
+        self.node = node
+        self.lineno = getattr(node, "lineno", 0)
+        self.class_name = class_name
+        if isinstance(node, ast.Lambda):
+            a = node.args
+        else:
+            a = node.args
+        self.params = ([p.arg for p in a.posonlyargs] +
+                       [p.arg for p in a.args] +
+                       ([a.vararg.arg] if a.vararg else []) +
+                       [p.arg for p in a.kwonlyargs] +
+                       ([a.kwarg.arg] if a.kwarg else []))
+        # filled by the index:
+        self.calls: List[Tuple[Set[str], Optional[str], ast.Call]] = []
+        self.returned_defs: Set[str] = set()    # keys of local defs returned
+        self.returned_calls: Set[str] = set()   # keys of callees whose result is returned
+        self.local_factory_vars: Dict[str, Set[str]] = {}  # var -> callee keys
+
+    @property
+    def key(self) -> str:
+        return f"{self.modname}:{self.qualname}"
+
+
+def body_statements(node):
+    """Direct statements of a function body, excluding nested defs (those
+    are their own FunctionInfo)."""
+    if isinstance(node, ast.Lambda):
+        return [ast.Expr(node.body)]
+    return list(node.body)
+
+
+def walk_shallow(node):
+    """ast.walk that does NOT descend into nested function/class defs."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class ModuleInfo:
+    __slots__ = ("modname", "path", "rel", "source", "tree", "functions",
+                 "import_mods", "import_names", "module_globals",
+                 "global_safe_types", "suppress_lines", "suppress_file",
+                 "thread_targets")
+
+    def __init__(self, modname: str, path: str, rel: str, source: str):
+        self.modname = modname
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source)
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.import_mods: Dict[str, str] = {}    # alias -> module dotted name
+        self.import_names: Dict[str, Tuple[str, str]] = {}  # alias -> (mod, name)
+        self.module_globals: Set[str] = set()
+        # global name -> constructor dotted name at module level (for
+        # thread-safe-type exclusion: threading.Lock/Event/local, Queue...)
+        self.global_safe_types: Dict[str, str] = {}
+        self.suppress_lines, self.suppress_file = collect_suppressions(source)
+        self.thread_targets: Set[str] = set()    # function keys
+
+
+_SAFE_GLOBAL_CTORS = {
+    "Lock", "RLock", "Event", "Condition", "Semaphore", "BoundedSemaphore",
+    "Barrier", "local", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+}
+
+
+def _resolve_relative(modname: str, level: int, module: Optional[str]) -> str:
+    parts = modname.split(".")
+    base = parts[: len(parts) - level]
+    if module:
+        base = base + module.split(".")
+    return ".".join(base)
+
+
+class PackageIndex:
+    """Parsed view of a set of python files with call graph and the
+    traced/thread regions."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.traced: Set[str] = set()
+        self.traced_roots: Set[str] = set()
+        self.thread_region: Set[str] = set()
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def from_files(cls, files: List[Tuple[str, str, str]]) -> "PackageIndex":
+        """files: list of (modname, abs_path, rel_path)."""
+        idx = cls()
+        for modname, path, rel in files:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            idx.add_source(modname, src, path=path, rel=rel)
+        idx.finalize()
+        return idx
+
+    @classmethod
+    def from_source(cls, source: str, modname: str = "m",
+                    rel: str = "m.py") -> "PackageIndex":
+        idx = cls()
+        idx.add_source(modname, source, path=rel, rel=rel)
+        idx.finalize()
+        return idx
+
+    def add_source(self, modname: str, source: str, path: str, rel: str):
+        mi = ModuleInfo(modname, path, rel, source)
+        self.modules[modname] = mi
+        self._collect_imports(mi)
+        self._collect_globals(mi)
+        self._collect_functions(mi)
+
+    def _collect_imports(self, mi: ModuleInfo) -> None:
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.import_mods[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                src = (_resolve_relative(mi.modname, node.level, node.module)
+                       if node.level else (node.module or ""))
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if a.name == "*":
+                        continue
+                    # `from . import foo` binds a module; `from .x import f`
+                    # may bind either — record both views, resolution picks
+                    mi.import_mods.setdefault(bound, f"{src}.{a.name}"
+                                              if src else a.name)
+                    mi.import_names[bound] = (src, a.name)
+
+    def _collect_globals(self, mi: ModuleInfo) -> None:
+        for node in mi.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.target is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    mi.module_globals.add(t.id)
+                    if isinstance(value, ast.Call):
+                        ctor = _last_name(value.func)
+                        if ctor in _SAFE_GLOBAL_CTORS:
+                            mi.global_safe_types[t.id] = ctor
+
+    def _collect_functions(self, mi: ModuleInfo) -> None:
+        def visit(node, prefix: str, class_name: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    fi = FunctionInfo(mi.modname, qn, child, class_name)
+                    mi.functions[qn] = fi
+                    self.functions[fi.key] = fi
+                    visit(child, qn + ".", class_name)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", child.name)
+                elif isinstance(child, ast.Lambda):
+                    qn = f"{prefix}<lambda:{child.lineno}>"
+                    fi = FunctionInfo(mi.modname, qn, child, class_name)
+                    mi.functions[qn] = fi
+                    self.functions[fi.key] = fi
+                else:
+                    visit(child, prefix, class_name)
+
+        visit(mi.tree, "", None)
+
+    # -- resolution ----------------------------------------------------
+    def _resolve_call(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                      call: ast.Call) -> Tuple[Set[str], Optional[str]]:
+        """-> (candidate function keys, bare attribute/function name)."""
+        func = call.func
+        keys: Set[str] = set()
+        if isinstance(func, ast.Name):
+            name = func.id
+            # nested def in the enclosing chain, then module-level def
+            if fi is not None:
+                parts = fi.qualname.split(".")
+                for i in range(len(parts), -1, -1):
+                    qn = ".".join(parts[:i] + [name]) if i else name
+                    if qn in mi.functions:
+                        keys.add(f"{mi.modname}:{qn}")
+                        break
+            if not keys and name in mi.functions:
+                keys.add(f"{mi.modname}:{name}")
+            if not keys and name in mi.import_names:
+                src, orig = mi.import_names[name]
+                if f"{src}:{orig}" in self.functions:
+                    keys.add(f"{src}:{orig}")
+            return keys, name
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            recv = func.value
+            if isinstance(recv, ast.Name):
+                if recv.id == "self" and fi is not None and fi.class_name:
+                    qn = f"{fi.class_name}.{attr}"
+                    if qn in mi.functions:
+                        keys.add(f"{mi.modname}:{qn}")
+                elif recv.id in mi.import_mods:
+                    target = mi.import_mods[recv.id]
+                    if f"{target}:{attr}" in self.functions:
+                        keys.add(f"{target}:{attr}")
+            return keys, attr
+        return keys, None
+
+    def finalize(self) -> None:
+        for mi in self.modules.values():
+            for fi in mi.functions.values():
+                self._finalize_function(mi, fi)
+        self._compute_traced()
+        self._compute_thread_region()
+
+    def _finalize_function(self, mi: ModuleInfo, fi: FunctionInfo) -> None:
+        root = (fi.node if not isinstance(fi.node, ast.Lambda)
+                else ast.Module(body=[ast.Expr(fi.node.body)],
+                                type_ignores=[]))
+        # pass 1: record local vars bound to factory-call results, so pass 2
+        # can resolve `body = make_body(...); jax.jit(body)` regardless of
+        # traversal order
+        for node in walk_shallow(root):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                ckeys, _ = self._resolve_call(mi, fi, node.value)
+                if ckeys:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            fi.local_factory_vars[t.id] = ckeys
+        for node in walk_shallow(root):
+            if isinstance(node, ast.Call):
+                keys, bare = self._resolve_call(mi, fi, node)
+                # calls through a local var holding a factory result:
+                if not keys and isinstance(node.func, ast.Name) \
+                        and node.func.id in fi.local_factory_vars:
+                    keys = set()
+                    for fk in fi.local_factory_vars[node.func.id]:
+                        keys |= self._returned_defs(fk, set())
+                fi.calls.append((keys, bare, node))
+            elif isinstance(node, ast.Return) and node.value is not None:
+                v = node.value
+                if isinstance(v, ast.Name):
+                    qn = f"{fi.qualname}.{v.id}"
+                    if qn in mi.functions:
+                        fi.returned_defs.add(f"{mi.modname}:{qn}")
+                    elif v.id in fi.local_factory_vars:
+                        fi.returned_calls.update(fi.local_factory_vars[v.id])
+                elif isinstance(v, ast.Call):
+                    ckeys, _ = self._resolve_call(mi, fi, v)
+                    fi.returned_calls.update(ckeys)
+                elif isinstance(v, ast.Lambda):
+                    qn = f"{fi.qualname}.<lambda:{v.lineno}>"
+                    if qn in mi.functions:
+                        fi.returned_defs.add(f"{mi.modname}:{qn}")
+
+
+    def _returned_defs(self, key: str, seen: Set[str]) -> Set[str]:
+        """Transitive closure of 'functions this factory returns'."""
+        if key in seen or key not in self.functions:
+            return set()
+        seen.add(key)
+        fi = self.functions[key]
+        out = set(fi.returned_defs)
+        for ck in fi.returned_calls:
+            out |= self._returned_defs(ck, seen)
+        return out
+
+    # -- traced region -------------------------------------------------
+    def _trace_roots(self) -> Set[str]:
+        roots: Set[str] = set()
+        for mi in self.modules.values():
+            # decorators
+            for fi in mi.functions.values():
+                node = fi.node
+                for dec in getattr(node, "decorator_list", []):
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _last_name(target) in TRACE_WRAPPERS:
+                        roots.add(fi.key)
+                    # @partial(jax.jit, ...)
+                    if isinstance(dec, ast.Call) and dec.args:
+                        if _last_name(dec.args[0]) in TRACE_WRAPPERS or \
+                                (_dotted(dec.args[0]) or "").split(".")[-1] \
+                                in TRACE_WRAPPERS:
+                            roots.add(fi.key)
+            # call sites (anywhere in the module, incl. inside functions)
+            for fi_or_none, call in self._all_calls(mi):
+                if _last_name(call.func) not in TRACE_WRAPPERS:
+                    continue
+                for arg in list(call.args) + [kw.value for kw in
+                                              call.keywords]:
+                    roots |= self._funcs_from_arg(mi, fi_or_none, arg)
+        return roots
+
+    def _all_calls(self, mi: ModuleInfo):
+        for fi in mi.functions.values():
+            for _, _, call in fi.calls:
+                yield fi, call
+        # module level (outside any def)
+        for node in walk_shallow(mi.tree):
+            if isinstance(node, ast.Call):
+                yield None, node
+
+    def _funcs_from_arg(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                        arg: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        if isinstance(arg, ast.Lambda):
+            prefix = f"{fi.qualname}." if fi is not None else ""
+            qn = f"{prefix}<lambda:{arg.lineno}>"
+            if qn in mi.functions:
+                out.add(f"{mi.modname}:{qn}")
+        elif isinstance(arg, ast.Name):
+            # a def (nested or module-level) ...
+            if fi is not None:
+                qn = f"{fi.qualname}.{arg.id}"
+                if qn in mi.functions:
+                    out.add(f"{mi.modname}:{qn}")
+            if not out and arg.id in mi.functions:
+                out.add(f"{mi.modname}:{arg.id}")
+            # ... or a local var holding a factory product
+            if not out and fi is not None \
+                    and arg.id in fi.local_factory_vars:
+                for fk in fi.local_factory_vars[arg.id]:
+                    out |= self._returned_defs(fk, set())
+        elif isinstance(arg, ast.Call):
+            # jax.jit(make_body(...)) — the factory's returned defs
+            ckeys, _ = self._resolve_call(mi, fi, arg)
+            for ck in ckeys:
+                out |= self._returned_defs(ck, set())
+        return out
+
+    def _closure(self, roots: Set[str]) -> Set[str]:
+        seen = set(roots)
+        frontier = list(roots)
+        while frontier:
+            key = frontier.pop()
+            fi = self.functions.get(key)
+            if fi is None:
+                continue
+            for keys, _, _ in fi.calls:
+                for ck in keys:
+                    if ck not in seen and ck in self.functions:
+                        seen.add(ck)
+                        frontier.append(ck)
+        return seen
+
+    def _compute_traced(self) -> None:
+        self.traced_roots = self._trace_roots()
+        self.traced = self._closure(self.traced_roots)
+
+    # -- thread region ---------------------------------------------------
+    def _compute_thread_region(self) -> None:
+        targets: Set[str] = set()
+        for mi in self.modules.values():
+            for fi_or_none, call in self._all_calls(mi):
+                if _last_name(call.func) != "Thread":
+                    continue
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        targets |= self._funcs_from_arg(mi, fi_or_none,
+                                                        kw.value)
+            mi.thread_targets = {t for t in targets
+                                 if t.startswith(mi.modname + ":")}
+        self.thread_region = self._closure(targets)
+
+    # -- reachability helper (PT003) -------------------------------------
+    def reachable_from(self, entry_keys: Set[str]) -> Set[str]:
+        return self._closure(entry_keys)

@@ -1,0 +1,121 @@
+"""Command-line front end (``python tools/paddlelint.py``).
+
+Exit codes: 0 clean (all findings baselined/suppressed), 1 fresh findings,
+2 usage error. ``--write-baseline`` records the current findings as the
+accepted baseline (new entries get ``TODO: justify`` — fill them in before
+committing). Stale baseline entries (keys no longer produced) are reported
+so the file shrinks as debt is paid, but do not fail the run unless
+``--fail-stale`` is given.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .model import RULES, Config
+from .runner import analyze_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddlelint",
+        description="TPU/JAX-aware static analysis for paddle_tpu "
+                    "(rules PT001-PT006; see docs/ANALYSIS.md)")
+    p.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                   help="package dirs or files to analyze "
+                        "(default: paddle_tpu)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (one JSON object)")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="accepted-findings file "
+                        "(tools/paddlelint_baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to --baseline "
+                        "(preserving existing justifications) and exit 0")
+    p.add_argument("--strict", action="store_true",
+                   help="also report info-severity findings")
+    p.add_argument("--rules", metavar="IDS",
+                   help="comma-separated subset, e.g. PT001,PT003")
+    p.add_argument("--fail-stale", action="store_true",
+                   help="exit 1 when baseline entries no longer match")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid]}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = {r.strip().upper() for r in args.rules.split(",")
+                 if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"paddlelint: unknown rule(s): "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+    cfg = Config(rules=rules, strict=args.strict)
+
+    findings = analyze_paths(args.paths or ["paddle_tpu"], cfg)
+
+    base = {}
+    if args.baseline and not args.write_baseline:
+        try:
+            base = baseline_mod.load(args.baseline)
+        except FileNotFoundError:
+            print(f"paddlelint: baseline file not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+    if args.write_baseline:
+        if not args.baseline:
+            print("paddlelint: --write-baseline requires --baseline",
+                  file=sys.stderr)
+            return 2
+        try:
+            existing = baseline_mod.load(args.baseline)
+        except (FileNotFoundError, ValueError):
+            existing = {}
+        baseline_mod.save(args.baseline, findings, existing)
+        print(f"paddlelint: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    fresh, stale = baseline_mod.split(findings, base)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in fresh],
+            "baselined": len(findings) - len(fresh),
+            "stale_baseline_keys": stale,
+            "rules": RULES,
+        }, indent=2))
+    else:
+        for f in fresh:
+            print(f.render())
+        counts = Counter(f.rule for f in fresh)
+        summary = ", ".join(f"{r}:{n}" for r, n in sorted(counts.items()))
+        print(f"paddlelint: {len(fresh)} finding(s)"
+              + (f" [{summary}]" if summary else "")
+              + (f", {len(findings) - len(fresh)} baselined" if base else "")
+              + (f", {len(stale)} stale baseline entr"
+                 f"{'y' if len(stale) == 1 else 'ies'}" if stale else ""))
+        for k in stale:
+            print(f"  stale baseline (no longer produced): {k}")
+    if fresh:
+        return 1
+    if stale and args.fail_stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Core data model for paddlelint (docs/ANALYSIS.md).
+
+A :class:`Finding` is one reported hazard: rule id, severity, location,
+the enclosing function's qualname, a human message and a fix hint, plus a
+``detail`` token — a short, line-number-free signature of the offending
+construct so baseline entries survive unrelated edits to the file
+(:attr:`Finding.baseline_key` is ``rule|path|qualname|detail``).
+
+Suppressions are source comments, matched against the finding's line:
+
+    x = float(t)          # paddlelint: disable=PT001
+    # paddlelint: disable-file=PT003   (anywhere in the file: whole file)
+
+Severity ladder: ``error`` (will break or silently mis-trace at runtime),
+``warning`` (perf/correctness hazard worth an explicit decision), ``info``
+(patterns that are often deliberate — reported only under ``--strict``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set
+
+SEVERITIES = ("info", "warning", "error")
+
+#: rule id -> one-line description (filled by the rule modules at import)
+RULES: Dict[str, str] = {}
+
+
+def register_rule(rule_id: str, description: str) -> None:
+    RULES[rule_id] = description
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str                 # "PT001" .. "PT006"
+    severity: str             # "error" | "warning" | "info"
+    path: str                 # repo-relative posix path
+    line: int
+    col: int
+    qualname: str             # enclosing function ("<module>" at top level)
+    message: str
+    hint: str = ""
+    detail: str = ""          # stable construct signature for baselining
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.qualname}|{self.detail}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "qualname": self.qualname, "message": self.message,
+                "hint": self.hint, "detail": self.detail,
+                "baseline_key": self.baseline_key}
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        hint = f"\n      hint: {self.hint}" if self.hint else ""
+        return (f"{loc}: {self.rule} [{self.severity}] "
+                f"({self.qualname}) {self.message}{hint}")
+
+
+@dataclasses.dataclass
+class Config:
+    """Analyzer knobs. ``hot_entry_patterns`` are regexes matched against
+    ``module:qualname`` (module relative to the package root) — the PT003
+    reachability roots."""
+    rules: Optional[Set[str]] = None     # None = all registered
+    strict: bool = False                 # include info-severity findings
+    hot_entry_patterns: List[str] = dataclasses.field(default_factory=lambda: [
+        r"(^|[.:])training_step$",
+        r"(^|[.:])_run_loop$",
+        r"_step_body$",
+        r"(^|[.:])generate_cached$",
+        r"(^|[.:])generate_compiled$",
+        r"Predictor\.run$",
+    ])
+
+    def wants(self, rule_id: str) -> bool:
+        return self.rules is None or rule_id in self.rules
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*paddlelint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_,\s]+?)\s*(?:#|$)")
+
+
+def collect_suppressions(source: str):
+    """-> (line_no -> set(rule_ids or {'all'}), file-wide set)."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip().upper() if r.strip().lower() != "all" else "all"
+                 for r in m.group(2).split(",") if r.strip()}
+        if m.group(1) == "disable-file":
+            file_wide |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, file_wide
+
+
+def is_suppressed(f: Finding, per_line, file_wide) -> bool:
+    if "all" in file_wide or f.rule in file_wide:
+        return True
+    rules = per_line.get(f.line, ())
+    return "all" in rules or f.rule in rules

@@ -1,0 +1,84 @@
+"""PT003: host synchronization inside a hot path.
+
+A "hot path" is anything reachable (through the call graph) from the
+configured hot entry points — the trainer step/loop, the generation step
+bodies, and the serving predictor (``Config.hot_entry_patterns``). Inside
+that region, every ``block_until_ready()``, ``jax.device_get()``,
+``.item()``, ``.numpy()``, ``.tolist()`` and ``np.asarray(device_array)``
+stalls the Python thread until the device catches up, serializing the
+dispatch pipeline — the classic decode-loop throughput killer.
+
+Severity is ``warning``: some syncs are deliberate (fetching the loss once
+per logging interval). Those get a baseline entry or an inline
+``# paddlelint: disable=PT003`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from .callgraph import PackageIndex, _dotted, _last_name, walk_shallow
+from .model import Config, Finding, register_rule
+
+register_rule("PT003", "host sync (block_until_ready/device_get/.item/"
+                       ".numpy) in a hot path")
+
+_SYNC_METHODS = {"block_until_ready", "item", "numpy", "tolist",
+                 "copy_to_host_async"}
+_SYNC_FUNCS = {"device_get", "block_until_ready"}
+_NP_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def hot_entries(index: PackageIndex, cfg: Config) -> Set[str]:
+    pats = [re.compile(p) for p in cfg.hot_entry_patterns]
+    out: Set[str] = set()
+    for key in index.functions:
+        if any(p.search(key) for p in pats):
+            out.add(key)
+    return out
+
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    if not cfg.wants("PT003"):
+        return []
+    findings: List[Finding] = []
+    region = index.reachable_from(hot_entries(index, cfg))
+    for key in sorted(region):
+        fi = index.functions.get(key)
+        if fi is None:
+            continue
+        mi = index.modules[fi.modname]
+        nodes = (ast.walk(fi.node.body) if isinstance(fi.node, ast.Lambda)
+                 else walk_shallow(fi.node))
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_name(node.func)
+            dotted = _dotted(node.func) or ""
+            hit = None
+            if isinstance(node.func, ast.Attribute) \
+                    and name in _SYNC_METHODS and not node.args:
+                hit = f".{name}()"
+            elif name in _SYNC_FUNCS and (
+                    isinstance(node.func, ast.Name)
+                    or dotted.startswith(("jax.", "api."))):
+                hit = f"{name}()"
+            elif dotted in _NP_FUNCS and node.args:
+                hit = f"{dotted}()"
+            if hit is None:
+                continue
+            try:
+                frag = " ".join(ast.unparse(node).split())[:48]
+            except Exception:  # pragma: no cover
+                frag = hit
+            findings.append(Finding(
+                "PT003", "warning", mi.rel, node.lineno, node.col_offset,
+                fi.qualname,
+                f"host sync `{hit}` on a hot path (reachable from a "
+                f"trainer/generation/serving entry)",
+                hint="batch the fetch outside the step, or make it "
+                     "conditional on the logging interval",
+                detail=f"sync:{hit}:{frag}"))
+    return findings

@@ -1,0 +1,142 @@
+"""PT004: PRNG hygiene.
+
+Two hazards:
+
+1. **Key reuse without split** — the same PRNG key fed to two or more
+   ``jax.random.*`` samplers produces *correlated* draws (identical, for
+   the same sampler+shape). Flow: track names bound from ``PRNGKey(...)``
+   / ``split(...)`` / ``fold_in(...)``; the second consumption of a key
+   name without an intervening rebind is an error.
+2. **Host RNG in traced code** — ``np.random.*`` / stdlib ``random.*``
+   inside a traced body executes once at trace time and bakes a constant
+   into the compiled program: every step "samples" the same numbers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .callgraph import PackageIndex, FunctionInfo, _dotted, _last_name
+from .model import Config, Finding, register_rule
+
+register_rule("PT004", "PRNG hygiene: key reuse without split, host RNG "
+                       "in traced code")
+
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "clone"}
+# jax.random samplers that consume a key as their first argument
+_CONSUMERS = {"normal", "uniform", "bernoulli", "randint", "categorical",
+              "truncated_normal", "gumbel", "permutation", "shuffle",
+              "choice", "bits", "exponential", "gamma", "beta", "poisson",
+              "laplace", "cauchy", "dirichlet", "multivariate_normal",
+              "rademacher", "ball", "orthogonal", "t"}
+_HOST_RNG_PREFIXES = ("np.random.", "numpy.random.", "random.",
+                      "onp.random.")
+
+
+def _key_name(node: ast.AST) -> Optional[str]:
+    """Name (or name of an attribute chain root like self.key) used as a
+    key argument."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        root = _dotted(node)
+        return root
+    if isinstance(node, ast.Subscript) and isinstance(node.value, ast.Name):
+        # keys[i] — treat each subscript expr as distinct enough; use text
+        try:
+            return ast.unparse(node)
+        except Exception:  # pragma: no cover
+            return None
+    return None
+
+
+def _check_key_reuse(fi: FunctionInfo, mi, findings: List[Finding]) -> None:
+    if isinstance(fi.node, ast.Lambda):
+        return
+    key_vars: Set[str] = {p for p in fi.params
+                          if p in ("key", "rng", "prng_key", "rng_key",
+                                   "seed_key")}
+    consumed: Set[str] = set()
+
+    def _targets(assign: ast.Assign) -> Set[str]:
+        out: Set[str] = set()
+        for t in assign.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        return out
+
+    def _is_key_expr(value: ast.AST) -> bool:
+        for n in ast.walk(value):
+            if isinstance(n, ast.Call) \
+                    and _last_name(n.func) in _KEY_MAKERS:
+                return True
+        return False
+
+    def visit(node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Assign):
+            visit(node.value)  # consumption inside the RHS happens first
+            rebound = _targets(node)
+            if _is_key_expr(node.value):
+                key_vars.update(rebound)
+            for name in rebound:
+                consumed.discard(name)
+            return
+        if isinstance(node, ast.Call):
+            name = _last_name(node.func)
+            if name in _CONSUMERS and node.args:
+                k = _key_name(node.args[0])
+                if k is not None and (k in key_vars
+                                      or k.endswith("key")
+                                      or k == "rng"):
+                    if k in consumed:
+                        findings.append(Finding(
+                            "PT004", "error", mi.rel, node.lineno,
+                            node.col_offset, fi.qualname,
+                            f"PRNG key `{k}` consumed again without a "
+                            f"`split` — draws are correlated",
+                            hint="key, sub = jax.random.split(key) "
+                                 "before each consumption",
+                            detail=f"key-reuse:{k}"))
+                    consumed.add(k)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fi.node.body:
+        visit(stmt)
+
+
+def _check_host_rng(fi: FunctionInfo, mi, findings: List[Finding]) -> None:
+    nodes = (ast.walk(fi.node.body) if isinstance(fi.node, ast.Lambda)
+             else ast.walk(fi.node))
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func) or ""
+        if dotted.startswith(_HOST_RNG_PREFIXES):
+            findings.append(Finding(
+                "PT004", "error", mi.rel, node.lineno, node.col_offset,
+                fi.qualname,
+                f"host RNG `{dotted}` inside traced code — it runs once at "
+                f"trace time, so every compiled call reuses the same draw",
+                hint="thread a jax.random key through the traced function",
+                detail=f"host-rng:{dotted}"))
+
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    if not cfg.wants("PT004"):
+        return []
+    findings: List[Finding] = []
+    for mi in index.modules.values():
+        for fi in mi.functions.values():
+            _check_key_reuse(fi, mi, findings)
+    for key in sorted(index.traced):
+        fi = index.functions.get(key)
+        if fi is None:
+            continue
+        _check_host_rng(fi, index.modules[fi.modname], findings)
+    return findings

@@ -1,0 +1,148 @@
+"""PT006: unguarded shared state touched from a background thread.
+
+The thread region is everything reachable from a ``threading.Thread(
+target=...)`` entry point (watchdog monitor loops, heartbeat senders,
+async checkpoint writers, DataLoader producers). Inside that region, a
+write to module-level mutable state — ``global X`` rebinding, ``X[k] = v``,
+``X.append(...)`` — races with the main thread unless it happens under a
+``with <lock>:`` block.
+
+Thread-safe containers are excluded by construction: module globals bound
+to ``threading.Lock/RLock/Event/Condition/local`` or ``queue.Queue``
+(their ctors are tracked by the index) never need an external lock.
+Lock detection is name-based on the ``with`` subject: any ``Name`` or
+attribute whose identifier ends in ``lock``/``mutex`` or is a tracked
+Lock-typed global counts as a guard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .callgraph import PackageIndex, FunctionInfo, ModuleInfo, _last_name
+from .model import Config, Finding, register_rule
+
+register_rule("PT006", "module-level mutable state written from a "
+                       "background thread without the owning lock")
+
+_MUTATORS = {"append", "add", "pop", "update", "setdefault", "extend",
+             "remove", "clear", "insert", "discard", "popleft",
+             "appendleft", "__setitem__"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore", "Barrier"}
+# ctor names whose instances are themselves safe to touch without a lock
+_SAFE_INSTANCE_CTORS = {"Lock", "RLock", "Event", "Condition", "Semaphore",
+                        "BoundedSemaphore", "Barrier", "local", "Queue",
+                        "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+
+def _is_lock_expr(node: ast.AST, mi: ModuleInfo) -> bool:
+    if isinstance(node, ast.Call):
+        # `with lock_factory():` / `with self._lock:`-style `.acquire()` —
+        # judge by the callee name
+        return _is_lock_expr(node.func, mi)
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+        if name in mi.global_safe_types \
+                and mi.global_safe_types[name] in _LOCK_CTORS:
+            return True
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    if name is None:
+        return False
+    low = name.lower()
+    return low.endswith("lock") or low.endswith("mutex") \
+        or low in ("acquire", "locked")
+
+
+def _declared_globals(fi: FunctionInfo) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(fi.node, ast.Lambda):
+        return out
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _root_name(node: ast.AST):
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _check_function(fi: FunctionInfo, mi: ModuleInfo,
+                    findings: List[Finding]) -> None:
+    if isinstance(fi.node, ast.Lambda):
+        return
+    declared = _declared_globals(fi)
+
+    def shared(name) -> bool:
+        if name is None or name not in mi.module_globals:
+            return False
+        if mi.global_safe_types.get(name) in _SAFE_INSTANCE_CTORS:
+            return False
+        return True
+
+    def report(node, name: str, what: str) -> None:
+        findings.append(Finding(
+            "PT006", "warning", mi.rel, node.lineno, node.col_offset,
+            fi.qualname,
+            f"module global `{name}` {what} from a background-thread "
+            f"path without holding a lock",
+            hint="wrap the write in `with <owning lock>:` (or move the "
+                 "state into a Queue/threading.local)",
+            detail=f"write:{name}"))
+
+    def visit(node, lock_depth: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(child, ast.With):
+                guarded = any(_is_lock_expr(item.context_expr, mi)
+                              for item in child.items)
+                visit(child, lock_depth + (1 if guarded else 0))
+                continue
+            if lock_depth == 0:
+                if isinstance(child, (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign)):
+                    targets = (child.targets
+                               if isinstance(child, ast.Assign)
+                               else [child.target])
+                    for t in targets:
+                        if t is None:
+                            continue
+                        if isinstance(t, ast.Name):
+                            # plain name rebind races only via `global`
+                            if t.id in declared and shared(t.id):
+                                report(child, t.id, "rebound")
+                        else:
+                            root = _root_name(t)
+                            if shared(root):
+                                report(child, root,
+                                       "mutated (item/attr store)")
+                elif isinstance(child, ast.Call) \
+                        and isinstance(child.func, ast.Attribute) \
+                        and child.func.attr in _MUTATORS:
+                    root = _root_name(child.func.value)
+                    if shared(root):
+                        report(child, root,
+                               f"mutated (`.{child.func.attr}`)")
+            visit(child, lock_depth)
+
+    visit(fi.node, 0)
+
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    if not cfg.wants("PT006"):
+        return []
+    findings: List[Finding] = []
+    for key in sorted(index.thread_region):
+        fi = index.functions.get(key)
+        if fi is None:
+            continue
+        _check_function(fi, index.modules[fi.modname], findings)
+    return findings

@@ -1,0 +1,464 @@
+"""Trace-time rules: PT001 tracer-leak, PT002 retrace-hazard, PT005
+FLAGS-mutation-at-trace-time.
+
+PT001 runs an interprocedural taint analysis over the traced region: the
+parameters of every trace root (a function decorated with / passed to
+``jit``/``shard_map``/``pallas_call``/...) start tainted, and taint flows
+through resolved call edges **per argument** — a callee parameter is only
+tainted when some traced call site actually passes it a tainted value.
+That keeps shape-helper functions (``_largest_dividing_block(S)`` called
+with ``S = q.shape[1]``) out of the findings: shapes are concrete at
+trace time and ``.shape``/``.ndim``/``.dtype``/``len()``/``isinstance()``
+break taint.
+
+Reported as PT001 (error): ``float()/int()/bool()/np.asarray`` over a
+tainted value, ``.item()/.tolist()/.numpy()`` on a tainted receiver, and
+Python ``if``/``while`` tests that depend on a tainted value — each of
+these forces a concrete value out of a tracer and raises (or silently
+constant-folds) at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import (JIT_CONSTRUCTORS, PackageIndex, FunctionInfo,
+                        _last_name, _dotted, walk_shallow)
+from .model import Config, Finding, register_rule
+
+register_rule("PT001", "tracer leak: host conversion or Python control "
+                       "flow on a traced value")
+register_rule("PT002", "retrace hazard: jit construction in a loop, "
+                       "unhashable static args, shape-dependent branch")
+register_rule("PT005", "FLAGS mutation at trace time (set_flags/"
+                       "flags_guard/define_flag inside a traced body)")
+
+# attribute reads that yield concrete (non-tracer) values at trace time
+_BREAKER_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "device",
+                  "aval", "weak_type", "itemsize", "nbytes"}
+# calls whose result is concrete regardless of argument taint
+_BREAKER_FUNCS = {"len", "isinstance", "type", "hasattr", "callable", "id",
+                  "repr", "str", "format", "getattr_static", "issubclass",
+                  "eval_shape", "ShapeDtypeStruct"}
+_HOST_CONVERTERS = {"float", "int", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
+_NP_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "onp.asarray", "onp.array"}
+_STATIC_COMPARE_OPS = (ast.Is, ast.IsNot, ast.In, ast.NotIn)
+
+_FLAGS_MUTATORS = {"set_flags", "flags_guard", "define_flag"}
+
+
+def _unparse(node: ast.AST, limit: int = 60) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover - py<3.9 or exotic node
+        s = type(node).__name__
+    s = " ".join(s.split())
+    return s if len(s) <= limit else s[: limit - 3] + "..."
+
+
+def _isinstance_guarded(fi: FunctionInfo) -> Set[str]:
+    """Names checked with isinstance() anywhere in the function: by
+    contract they are static Python values (the ``isinstance(start, int)``
+    idiom in generation step bodies), so they never carry taint."""
+    out: Set[str] = set()
+    for node in walk_shallow(fi.node):
+        if isinstance(node, ast.Call) and _last_name(node.func) == \
+                "isinstance" and node.args \
+                and isinstance(node.args[0], ast.Name):
+            out.add(node.args[0].id)
+    return out
+
+
+class _Ctx:
+    """Interprocedural context for taint queries: ``callmap`` resolves a
+    Call node (by identity) to candidate callee keys, ``returns_tainted``
+    is the current return-taint fixpoint state. A call whose every
+    resolved callee provably returns an untainted value (shape math,
+    routing strings, eligibility bools) does not taint — this is what
+    keeps `path = sdpa_path(q, k, ...); if path == "flash"` clean."""
+    __slots__ = ("callmap", "returns_tainted")
+
+    def __init__(self, callmap, returns_tainted):
+        self.callmap = callmap
+        self.returns_tainted = returns_tainted
+
+
+def _expr_tainted(node: ast.AST, tainted: Set[str],
+                  ctx: Optional[_Ctx] = None) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _BREAKER_ATTRS:
+            return False
+        return _expr_tainted(node.value, tainted, ctx)
+    if isinstance(node, ast.Call):
+        if _last_name(node.func) in _BREAKER_FUNCS:
+            return False
+        if ctx is not None:
+            keys = ctx.callmap.get(id(node))
+            if keys and all(k in ctx.returns_tainted
+                            and not ctx.returns_tainted[k] for k in keys):
+                return False
+        if isinstance(node.func, ast.Attribute) \
+                and _expr_tainted(node.func.value, tainted, ctx):
+            return True
+        return any(_expr_tainted(a, tainted, ctx) for a in node.args) or \
+            any(_expr_tainted(kw.value, tainted, ctx)
+                for kw in node.keywords)
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, _STATIC_COMPARE_OPS) for op in node.ops):
+            return False
+        return _expr_tainted(node.left, tainted, ctx) or \
+            any(_expr_tainted(c, tainted, ctx) for c in node.comparators)
+    if isinstance(node, (ast.Lambda, ast.Constant)):
+        return False
+    return any(_expr_tainted(c, tainted, ctx)
+               for c in ast.iter_child_nodes(node)
+               if isinstance(c, ast.expr))
+
+
+def _assign_targets(node: ast.AST) -> List[str]:
+    out: List[str] = []
+    if isinstance(node, ast.Name):
+        out.append(node.id)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            out.extend(_assign_targets(elt))
+    elif isinstance(node, ast.Starred):
+        out.extend(_assign_targets(node.value))
+    return out
+
+
+def _decorator_static_specs(node) -> Tuple[Set[int], Set[str]]:
+    """Positions/names pinned static by a decorator: ``static_argnums``,
+    ``static_argnames``, and custom_vjp/custom_jvp ``nondiff_argnums``
+    (nondiff args are concrete Python values through the vjp machinery in
+    this codebase's usage — eps, block sizes, causal switches)."""
+    pos: Set[int] = set()
+    names: Set[str] = set()
+    for dec in getattr(node, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames",
+                             "nondiff_argnums"):
+                continue
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                continue
+            if isinstance(val, (int, str)):
+                val = (val,)
+            if isinstance(val, (tuple, list)):
+                for v in val:
+                    if isinstance(v, int):
+                        pos.add(v)
+                    elif isinstance(v, str):
+                        names.add(v)
+    return pos, names
+
+
+def _root_taint_params(fi: FunctionInfo) -> Set[str]:
+    """Trace-root parameters assumed to carry tracers: everything except
+    self/cls, parameters with a constant scalar default (static config
+    knobs like ``causal=True``), and parameters pinned static by
+    static_argnums/static_argnames/nondiff_argnums decorators."""
+    node = fi.node
+    a = node.args
+    defaults: Dict[str, ast.AST] = {}
+    pos = ([p.arg for p in getattr(a, "posonlyargs", [])] +
+           [p.arg for p in a.args])
+    for name, d in zip(reversed(pos), reversed(a.defaults)):
+        defaults[name] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            defaults[p.arg] = d
+    static_pos, static_names = _decorator_static_specs(node)
+    static_by_pos = {pos[i] for i in static_pos if i < len(pos)}
+    out: Set[str] = set()
+    for p in fi.params:
+        if p in ("self", "cls"):
+            continue
+        if p in static_names or p in static_by_pos:
+            continue
+        d = defaults.get(p)
+        if d is not None and isinstance(d, ast.Constant) \
+                and isinstance(d.value, (bool, int, float, str, type(None))):
+            continue
+        out.add(p)
+    return out
+
+
+def _local_taint(fi: FunctionInfo, seed: Set[str],
+                 ctx: Optional[_Ctx] = None) -> Set[str]:
+    """Gen-only fixpoint of name taint inside one function body (kills are
+    ignored — fine for a linter, keeps the walk flow-insensitive)."""
+    tainted = set(seed) - _isinstance_guarded(fi)
+    if isinstance(fi.node, ast.Lambda):
+        return tainted
+    changed = True
+    while changed:
+        changed = False
+        for node in walk_shallow(fi.node):
+            targets: List[str] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                value = node.value
+                for t in node.targets:
+                    targets.extend(_assign_targets(t))
+            elif isinstance(node, ast.AugAssign):
+                value = node.value
+                targets.extend(_assign_targets(node.target))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value = node.value
+                targets.extend(_assign_targets(node.target))
+            elif isinstance(node, ast.For):
+                value = node.iter
+                targets.extend(_assign_targets(node.target))
+            elif isinstance(node, ast.NamedExpr):
+                value = node.value
+                targets.extend(_assign_targets(node.target))
+            if value is None or not targets:
+                continue
+            if _expr_tainted(value, tainted, ctx):
+                for t in targets:
+                    if t not in tainted:
+                        tainted.add(t)
+                        changed = True
+    return tainted
+
+
+def _returns_tainted(fi: FunctionInfo, local: Set[str],
+                     ctx: _Ctx) -> bool:
+    if isinstance(fi.node, ast.Lambda):
+        return _expr_tainted(fi.node.body, local, ctx)
+    for node in walk_shallow(fi.node):
+        if isinstance(node, ast.Return) and node.value is not None \
+                and _expr_tainted(node.value, local, ctx):
+            return True
+    return False
+
+
+def _propagate_taint(index: PackageIndex):
+    """Optimistic whole-region fixpoint: per-function tainted params
+    (monotone growing from trace roots, flowing through call arguments)
+    and per-function return taint (monotone False -> True). Converges in
+    a handful of sweeps on this codebase."""
+    taint: Dict[str, Set[str]] = defaultdict(set)
+    for key in index.traced_roots:
+        fi = index.functions.get(key)
+        if fi is not None:
+            taint[key] = _root_taint_params(fi)
+    rt: Dict[str, bool] = {key: False for key in index.traced}
+    callmaps: Dict[str, Dict[int, Set[str]]] = {}
+    for key in index.traced:
+        fi = index.functions.get(key)
+        if fi is not None:
+            callmaps[key] = {id(call): keys for keys, _, call in fi.calls
+                             if keys}
+    order = sorted(index.traced)
+    changed = True
+    sweeps = 0
+    while changed and sweeps < 50:
+        changed = False
+        sweeps += 1
+        for key in order:
+            fi = index.functions.get(key)
+            if fi is None:
+                continue
+            ctx = _Ctx(callmaps.get(key, {}), rt)
+            local = _local_taint(fi, taint[key], ctx)
+            if not rt[key] and _returns_tainted(fi, local, ctx):
+                rt[key] = True
+                changed = True
+            for keys, _, call in fi.calls:
+                for ck in keys:
+                    cfi = index.functions.get(ck)
+                    if cfi is None or ck not in index.traced:
+                        continue
+                    new = set()
+                    params = [p for p in cfi.params
+                              if p not in ("self", "cls")]
+                    for i, arg in enumerate(call.args):
+                        if i < len(params) and _expr_tainted(arg, local,
+                                                            ctx):
+                            new.add(params[i])
+                    for kw in call.keywords:
+                        if kw.arg in params \
+                                and _expr_tainted(kw.value, local, ctx):
+                            new.add(kw.arg)
+                    if new - taint[ck]:
+                        taint[ck] |= new
+                        changed = True
+    return taint, rt, callmaps
+
+
+def _check_traced_function(fi: FunctionInfo, mi, tainted: Set[str],
+                           findings: List[Finding], cfg: Config,
+                           ctx: Optional[_Ctx] = None) -> None:
+    if isinstance(fi.node, ast.Lambda):
+        body_nodes = list(ast.walk(fi.node.body))
+    else:
+        body_nodes = list(walk_shallow(fi.node))
+    for node in body_nodes:
+        if cfg.wants("PT001") and isinstance(node, (ast.If, ast.While)):
+            if _expr_tainted(node.test, tainted, ctx):
+                findings.append(Finding(
+                    "PT001", "error", mi.rel, node.test.lineno,
+                    node.test.col_offset, fi.qualname,
+                    f"Python `{'while' if isinstance(node, ast.While) else 'if'}` "
+                    f"on a traced value: `{_unparse(node.test)}`",
+                    hint="use lax.cond/jnp.where, or hoist the decision to "
+                         "a static argument",
+                    detail=f"branch:{_unparse(node.test, 48)}"))
+        if not isinstance(node, ast.Call):
+            continue
+        name = _last_name(node.func)
+        dotted = _dotted(node.func) or ""
+        if cfg.wants("PT001"):
+            if name in _HOST_CONVERTERS and isinstance(node.func, ast.Name) \
+                    and any(_expr_tainted(a, tainted, ctx)
+                            for a in node.args):
+                findings.append(Finding(
+                    "PT001", "error", mi.rel, node.lineno, node.col_offset,
+                    fi.qualname,
+                    f"`{name}()` forces a traced value to host at trace "
+                    f"time: `{_unparse(node)}`",
+                    hint="keep the value on device (jnp ops) or mark the "
+                         "argument static",
+                    detail=f"host:{name}:{_unparse(node, 40)}"))
+            elif dotted in _NP_CONVERTERS \
+                    and any(_expr_tainted(a, tainted, ctx)
+                            for a in node.args):
+                findings.append(Finding(
+                    "PT001", "error", mi.rel, node.lineno, node.col_offset,
+                    fi.qualname,
+                    f"`{dotted}()` materializes a traced value as a numpy "
+                    f"array at trace time",
+                    hint="use jnp.asarray, or compute on host before "
+                         "entering the traced function",
+                    detail=f"host:{dotted}:{_unparse(node, 40)}"))
+            elif name in _HOST_METHODS \
+                    and isinstance(node.func, ast.Attribute) \
+                    and _expr_tainted(node.func.value, tainted, ctx):
+                findings.append(Finding(
+                    "PT001", "error", mi.rel, node.lineno, node.col_offset,
+                    fi.qualname,
+                    f"`.{name}()` on a traced value inside a traced body",
+                    hint="return the array and convert outside the jitted "
+                         "function",
+                    detail=f"host:.{name}:{_unparse(node, 40)}"))
+        if cfg.wants("PT005") and (name in _FLAGS_MUTATORS):
+            findings.append(Finding(
+                "PT005", "warning", mi.rel, node.lineno, node.col_offset,
+                fi.qualname,
+                f"`{name}()` mutates the FLAGS registry inside a traced "
+                f"body — the write happens once at trace time, not per "
+                f"call, and is invisible to retraces",
+                hint="set flags before tracing, or pass the knob as a "
+                     "static argument",
+                detail=f"flags:{name}"))
+
+
+def _check_shape_branches(fi: FunctionInfo, mi,
+                          findings: List[Finding]) -> None:
+    """PT002 (info): Python branches on `.shape`-derived values inside
+    traced bodies are legal (shapes are static) but bake the decision into
+    the compiled program — every new shape recompiles. Often deliberate;
+    surfaced only under --strict."""
+    def mentions_shape(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim"):
+                return True
+            if isinstance(n, ast.Call) and _last_name(n.func) == "len":
+                return True
+        return False
+
+    if isinstance(fi.node, ast.Lambda):
+        return
+    for node in walk_shallow(fi.node):
+        if isinstance(node, (ast.If, ast.While)) \
+                and mentions_shape(node.test):
+            findings.append(Finding(
+                "PT002", "info", mi.rel, node.test.lineno,
+                node.test.col_offset, fi.qualname,
+                f"shape-dependent Python branch in a traced body: "
+                f"`{_unparse(node.test)}` — compiled per shape bucket",
+                hint="fine if the shape set is bounded; otherwise pad to "
+                     "buckets or use lax.cond",
+                detail=f"shape-branch:{_unparse(node.test, 48)}"))
+
+
+def _check_retrace(index: PackageIndex, findings: List[Finding]) -> None:
+    """PT002: jit/pjit constructed under a loop (a fresh jit object has an
+    empty compile cache — constructing one per iteration retraces every
+    call), and unhashable static_argnums/static_argnames containers."""
+    for mi in index.modules.values():
+        # loop-nesting walk per function and at module level
+        scopes = [(fi.qualname, fi.node) for fi in mi.functions.values()
+                  if not isinstance(fi.node, ast.Lambda)]
+        scopes.append(("<module>", mi.tree))
+
+        def visit(node, qual: str, loop_depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue  # separate scope
+                inc = isinstance(child, (ast.For, ast.While))
+                if isinstance(child, ast.Call):
+                    name = _last_name(child.func)
+                    if name in JIT_CONSTRUCTORS:
+                        if loop_depth > 0:
+                            findings.append(Finding(
+                                "PT002", "warning", mi.rel, child.lineno,
+                                child.col_offset, qual,
+                                f"`{name}(...)` constructed inside a loop — "
+                                f"each iteration builds a fresh compile "
+                                f"cache and retraces",
+                                hint="hoist the jit() out of the loop (or "
+                                     "cache it on self/module scope)",
+                                detail=f"jit-in-loop:{_unparse(child, 40)}"))
+                        for kw in child.keywords:
+                            if kw.arg in ("static_argnums",
+                                          "static_argnames") \
+                                    and isinstance(kw.value,
+                                                   (ast.Dict, ast.Set)):
+                                findings.append(Finding(
+                                    "PT002", "warning", mi.rel,
+                                    kw.value.lineno, kw.value.col_offset,
+                                    qual,
+                                    f"unhashable `{kw.arg}` container "
+                                    f"passed to `{name}` — jit requires "
+                                    f"hashable static specs",
+                                    hint="use a tuple of ints/names",
+                                    detail=f"static-args:{kw.arg}"))
+                visit(child, qual, loop_depth + (1 if inc else 0))
+
+        for qual, scope in scopes:
+            visit(scope, qual, 0)
+
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    if cfg.wants("PT001") or cfg.wants("PT005"):
+        taint, rt, callmaps = _propagate_taint(index)
+        for key in sorted(index.traced):
+            fi = index.functions.get(key)
+            if fi is None:
+                continue
+            mi = index.modules[fi.modname]
+            ctx = _Ctx(callmaps.get(key, {}), rt)
+            local = _local_taint(fi, taint.get(key, set()), ctx)
+            _check_traced_function(fi, mi, local, findings, cfg, ctx)
+    if cfg.wants("PT002"):
+        _check_retrace(index, findings)
+        for key in sorted(index.traced):
+            fi = index.functions.get(key)
+            if fi is None:
+                continue
+            _check_shape_branches(fi, index.modules[fi.modname], findings)
+    return findings

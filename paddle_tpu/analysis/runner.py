@@ -1,0 +1,82 @@
+"""Discovery + orchestration: build one :class:`PackageIndex` over the
+requested files, run every rule pass, drop suppressed / out-of-severity
+findings, return the rest sorted by location."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from . import rules_hostsync, rules_rng, rules_threads, rules_trace
+from .callgraph import PackageIndex
+from .model import Config, Finding, is_suppressed
+
+_PASSES = (rules_trace, rules_hostsync, rules_rng, rules_threads)
+
+
+def discover(root: str) -> List[Tuple[str, str, str]]:
+    """-> [(modname, abs_path, rel_path)] for every .py under ``root``.
+    Module names are dotted paths rooted at the basename of ``root`` so
+    intra-package imports (absolute and relative) resolve."""
+    root = os.path.abspath(root)
+    base = os.path.basename(root)
+    out = []
+    if os.path.isfile(root):
+        rel = os.path.basename(root)
+        return [(os.path.splitext(rel)[0], root, rel)]
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith((".", "__pycache__")))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            parts = os.path.relpath(path, root).replace(os.sep, "/")
+            mod = parts[:-3].replace("/", ".")
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            modname = base if mod == "__init__" else f"{base}.{mod}"
+            out.append((modname, path, rel.replace(os.sep, "/")))
+    return out
+
+
+def _filter(findings: List[Finding], index: PackageIndex,
+            cfg: Config) -> List[Finding]:
+    by_rel = {mi.rel: mi for mi in index.modules.values()}
+    out = []
+    for f in findings:
+        if f.severity == "info" and not cfg.strict:
+            continue
+        mi = by_rel.get(f.path)
+        if mi is not None and is_suppressed(f, mi.suppress_lines,
+                                            mi.suppress_file):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def analyze_paths(paths: List[str],
+                  cfg: Optional[Config] = None) -> List[Finding]:
+    cfg = cfg or Config()
+    files: List[Tuple[str, str, str]] = []
+    for p in paths:
+        files.extend(discover(p))
+    index = PackageIndex.from_files(files)
+    findings: List[Finding] = []
+    for p in _PASSES:
+        findings.extend(p.run(index, cfg))
+    return _filter(findings, index, cfg)
+
+
+def analyze_source(source: str, cfg: Optional[Config] = None,
+                   modname: str = "snippet",
+                   rel: str = "snippet.py") -> List[Finding]:
+    """Single-snippet entry point for the fixture tests."""
+    cfg = cfg or Config()
+    index = PackageIndex.from_source(source, modname=modname, rel=rel)
+    findings: List[Finding] = []
+    for p in _PASSES:
+        findings.extend(p.run(index, cfg))
+    return _filter(findings, index, cfg)

@@ -515,7 +515,8 @@ def _maybe_auto_attach() -> Optional[_Attached]:
                          is_master=False, world_size=1, timeout=5.0)
         attach_store(store)
     except Exception:
-        _auto_attach_failed = True
+        with _attach_lock:
+            _auto_attach_failed = True
         return None
     return _attached
 

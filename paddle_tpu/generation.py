@@ -612,12 +612,16 @@ def _llama_cached_step_body(cfg, max_len: int, moe_static=None):
                 # [*, S, max_len] f32 scores, which both OOMs long
                 # contexts and wastes the (max_len - S) masked columns
                 # (same routing as the buffer-model forward)
-                from .ops.flash_attention import sdpa
+                from .ops.flash_attention import sdpa_prefill
                 kr = jnp.repeat(k, rep, 2) if rep > 1 else k
                 vr = jnp.repeat(v, rep, 2) if rep > 1 else v
-                with flags_guard(flash_impl=flash_impl):
-                    o = sdpa(q, kr, vr,
-                             causal=True).reshape(B, S, Hh * D)
+                # trace-time pin of the kernel route for this compiled
+                # step; re-applied on every retrace by construction.
+                # sdpa_prefill pads non-128-multiple prompts through the
+                # segment-id flash kernel instead of the dense fallback.
+                with flags_guard(flash_impl=flash_impl):  # paddlelint: disable=PT005
+                    o = sdpa_prefill(q, kr, vr,
+                                     causal=True).reshape(B, S, Hh * D)
             elif rep > 1:
                 # GQA WITHOUT materializing jnp.repeat of the cache: the
                 # repeat wrote+read rep x the KV bytes per step — at the
@@ -691,9 +695,10 @@ def _gpt_cached_step_body(cfg, max_len: int):
             new_caches.append((ck, cv))
             if S > 1 and isinstance(start, int) and start == 0:
                 # flash prefill — see _llama_cached_step_body
-                from .ops.flash_attention import sdpa
-                with flags_guard(flash_impl=flash_impl):
-                    o = sdpa(q, k, v, causal=True).reshape(B, S, -1)
+                from .ops.flash_attention import sdpa_prefill
+                # trace-time pin, re-applied on every retrace
+                with flags_guard(flash_impl=flash_impl):  # paddlelint: disable=PT005
+                    o = sdpa_prefill(q, k, v, causal=True).reshape(B, S, -1)
             else:
                 scores = jnp.einsum("bshd,bthd->bhst", q, ck) \
                     * (hd ** -0.5)
@@ -788,7 +793,8 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
                      jnp.broadcast_to(k_pe[:, :, None, :], (B, S, nh, dr))],
                     -1)
                 q_h = jnp.concatenate([q_nope, q_pe], -1)
-                with flags_guard(flash_impl=flash_impl):
+                # trace-time pin, re-applied on every retrace
+                with flags_guard(flash_impl=flash_impl):  # paddlelint: disable=PT005
                     o_v = sdpa_padded_heads(q_h, k_h, kv[..., dn:],
                                             causal=True, scale=scale)
                 x = x + _mm_w(o_v.reshape(B, S, nh * dv), L, "wo")

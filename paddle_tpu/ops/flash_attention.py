@@ -207,6 +207,40 @@ def sdpa(q, k, v, mask=None, causal: bool = False, dropout_p: float = 0.0,
                           dropout_p=dropout_p, scale=scale)
 
 
+def sdpa_prefill(q, k, v, *, causal: bool = True,
+                 scale: Optional[float] = None,
+                 pad_to_flash_min: int = 1024):
+    """Prefill-shaped SDPA ([B,S,H,D], self-attention, no mask). `sdpa`
+    silently falls back to the O(S^2) f32 composite whenever S is not
+    block-divisible (a 12289-token prompt misses the flash gate by one
+    token); here the window is zero-padded to the next 128-multiple and
+    routed through the segment-id flash kernel — real tokens segment 1,
+    padding segment 0. Numerically exact: causal + same-segment masking
+    means no real query row ever attends a padded key, and the padded
+    output rows are sliced off. Prompts shorter than `pad_to_flash_min`
+    (or already divisible, or flash-ineligible configs) take the plain
+    `sdpa` route unchanged."""
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    Sp = -(-S // 128) * 128
+    if (Sp == S or S < pad_to_flash_min
+            or k.shape[1] != S
+            or not _tpu_flash_available()
+            or _flash_impl() == "composite"
+            or not ((D <= 128 and D % 64 == 0) or D % 128 == 0)):
+        return sdpa(q, k, v, causal=causal, scale=scale)
+    pad = [(0, Sp - S) if i == 1 else (0, 0) for i in range(4)]
+    qp = jnp.pad(q, pad)
+    kp = jnp.pad(k, pad)
+    vp = jnp.pad(v, pad)
+    seg = jnp.broadcast_to(
+        (jnp.arange(Sp) < S).astype(jnp.int32)[None, :], (B, Sp))
+    _count_kernel("flash_prefill_padded")
+    out = sdpa_segmented(qp, kp, vp, seg, causal=causal, scale=scale)
+    return out[:, :S]
+
+
 def sdpa_padded_heads(q, k, v, *, causal: bool = True,
                       scale: Optional[float] = None):
     """SDPA for MLA-geometry heads where the q/k head dim differs from
@@ -226,7 +260,10 @@ def sdpa_padded_heads(q, k, v, *, causal: bool = True,
         q, k = jnp.pad(q, pad), jnp.pad(k, pad)
     if Dv != Dp:
         v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(0, Dp - Dv)])
-    out = sdpa(q, k, v, causal=causal, scale=scale)
+    # prefill route: also rescues non-128-multiple prompt lengths (pads
+    # the seq dim through the segment-id kernel) — MLA long-context
+    # prefill hits both misalignments at once
+    out = sdpa_prefill(q, k, v, causal=causal, scale=scale)
     return out[..., :Dv]
 
 
@@ -370,4 +407,5 @@ def flashmask_attention(query, key, value, startend_row_indices,
     return out, None
 
 
-__all__ += ["sdpa_segmented", "flash_attn_unpadded", "flashmask_attention"]
+__all__ += ["sdpa_segmented", "sdpa_prefill", "flash_attn_unpadded",
+            "flashmask_attention"]

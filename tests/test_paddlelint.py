@@ -1,0 +1,585 @@
+"""paddlelint (paddle_tpu.analysis): per-rule true-positive/negative
+fixtures, suppression comments, baseline round-trip, the whole-repo CI
+gate, and seeded-defect detection in scratch copies of real modules.
+
+The fixtures are the rule contract: each PTxxx has at least one snippet
+the rule MUST flag and one structurally-similar snippet it must NOT flag
+(the negative encodes the false-positive class the analyzer was tuned
+against — shape branches, split-then-use keys, lock-guarded writes)."""
+
+import json
+import os
+import shutil
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (Config, analyze_paths, analyze_source,
+                                 load_baseline, save_baseline,
+                                 split_baseline)
+from paddle_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src, **cfg_kw):
+    return analyze_source(textwrap.dedent(src), Config(**cfg_kw))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------- PT001
+
+class TestPT001TracerLeak:
+    def test_branch_on_traced_value(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return x * 2
+        """)
+        assert _rules(fs) == ["PT001"]
+        assert fs[0].severity == "error"
+        assert "branch" in fs[0].detail
+
+    def test_host_conversion_of_traced_value(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) * 2
+        """)
+        assert _rules(fs) == ["PT001"]
+        assert "float" in fs[0].detail
+
+    def test_item_on_traced_value(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                y = x + 1
+                return y.item()
+        """)
+        assert _rules(fs) == ["PT001"]
+
+    def test_taint_propagates_through_local_call(self):
+        # interprocedural: leak is in a helper only reachable with a
+        # traced argument
+        fs = _lint("""
+            import jax
+
+            def helper(v):
+                if v > 0:
+                    return v
+                return -v
+
+            @jax.jit
+            def f(x):
+                return helper(x * 2)
+        """)
+        assert "PT001" in _rules(fs)
+        assert any(f.qualname == "helper" for f in fs)
+
+    def test_shape_branch_is_not_a_leak(self):
+        # .shape / .ndim / len() are static under trace
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x.shape[0] > 1 and x.ndim == 2:
+                    return x * 2
+                return x
+        """)
+        assert "PT001" not in _rules(fs)
+
+    def test_static_argnums_param_exempt(self):
+        fs = _lint("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, mode):
+                if mode == "fast":
+                    return x * 2
+                return x
+        """)
+        assert "PT001" not in _rules(fs)
+
+    def test_isinstance_guard_exempts_name(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def f(x, s=None):
+                if isinstance(s, int) and s == 0:
+                    return x
+                return x * 2
+        """)
+        assert "PT001" not in _rules(fs)
+
+
+# ---------------------------------------------------------------- PT002
+
+class TestPT002RetraceHazard:
+    def test_jit_inside_loop(self):
+        fs = _lint("""
+            import jax
+
+            def build(fns):
+                outs = []
+                for fn in fns:
+                    outs.append(jax.jit(fn))
+                return outs
+        """)
+        assert _rules(fs) == ["PT002"]
+        assert "jit-in-loop" in fs[0].detail
+
+    def test_unhashable_static_argnums(self):
+        fs = _lint("""
+            import jax
+
+            def build(fn):
+                return jax.jit(fn, static_argnums={1, 2})
+        """)
+        assert _rules(fs) == ["PT002"]
+        assert "static-args" in fs[0].detail
+
+    def test_module_level_jit_ok(self):
+        fs = _lint("""
+            import jax
+
+            def step(x):
+                return x * 2
+
+            jitted = jax.jit(step)
+        """)
+        assert "PT002" not in _rules(fs)
+
+    def test_shape_branch_reported_only_under_strict(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x.shape[0] > 1:
+                    return x * 2
+                return x
+        """
+        assert "PT002" not in _rules(_lint(src))
+        strict = [f for f in _lint(src, strict=True) if f.rule == "PT002"]
+        assert strict and strict[0].severity == "info"
+
+
+# ---------------------------------------------------------------- PT003
+
+class TestPT003HostSync:
+    def test_sync_in_hot_entry(self):
+        fs = _lint("""
+            class Trainer:
+                def training_step(self, batch):
+                    loss = self.step(batch)
+                    return loss.item()
+        """)
+        assert _rules(fs) == ["PT003"]
+        assert "sync" in fs[0].detail
+
+    def test_sync_reachable_from_hot_entry(self):
+        fs = _lint("""
+            def _log(loss):
+                return float(loss.numpy())
+
+            def training_step(batch):
+                loss = batch * 2
+                return _log(loss)
+        """)
+        assert "PT003" in _rules(fs)
+        assert any(f.qualname == "_log" for f in fs)
+
+    def test_sync_outside_hot_region_ok(self):
+        fs = _lint("""
+            def summarize(loss):
+                return loss.item()
+
+            def unrelated(batch):
+                return summarize(batch)
+        """)
+        assert "PT003" not in _rules(fs)
+
+
+# ---------------------------------------------------------------- PT004
+
+class TestPT004RngHygiene:
+    def test_key_reuse(self):
+        fs = _lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+        """)
+        assert _rules(fs) == ["PT004"]
+        assert "key-reuse" in fs[0].detail
+
+    def test_split_then_use_ok(self):
+        fs = _lint("""
+            import jax
+
+            def sample(key):
+                key, sub = jax.random.split(key)
+                a = jax.random.normal(sub, (2,))
+                key, sub = jax.random.split(key)
+                b = jax.random.uniform(sub, (2,))
+                return a + b
+        """)
+        assert "PT004" not in _rules(fs)
+
+    def test_host_rng_in_traced_code(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                noise = np.random.randn(4)
+                return x + noise
+        """)
+        assert "PT004" in _rules(fs)
+        assert any("host-rng" in f.detail for f in fs)
+
+    def test_host_rng_outside_trace_ok(self):
+        fs = _lint("""
+            import numpy as np
+
+            def make_batch(n):
+                return np.random.randn(n, 4)
+        """)
+        assert "PT004" not in _rules(fs)
+
+
+# ---------------------------------------------------------------- PT005
+
+class TestPT005FlagsAtTraceTime:
+    def test_flags_guard_in_traced_function(self):
+        fs = _lint("""
+            import jax
+            from paddle_tpu.flags import flags_guard
+
+            @jax.jit
+            def f(x):
+                with flags_guard(flash_impl="composite"):
+                    return x * 2
+        """)
+        assert _rules(fs) == ["PT005"]
+        assert "flags" in fs[0].detail
+
+    def test_set_flags_in_traced_function(self):
+        fs = _lint("""
+            import jax
+            import paddle_tpu
+
+            @jax.jit
+            def f(x):
+                paddle_tpu.set_flags({"FLAGS_flash_impl": "intree"})
+                return x * 2
+        """)
+        assert _rules(fs) == ["PT005"]
+
+    def test_flags_outside_trace_ok(self):
+        fs = _lint("""
+            import paddle_tpu
+
+            def configure():
+                paddle_tpu.set_flags({"FLAGS_flash_impl": "intree"})
+        """)
+        assert "PT005" not in _rules(fs)
+
+
+# ---------------------------------------------------------------- PT006
+
+class TestPT006SharedState:
+    def test_unguarded_global_write_from_thread(self):
+        fs = _lint("""
+            import threading
+
+            _events = []
+            _count = 0
+
+            def _worker():
+                global _count
+                _count += 1
+                _events.append("tick")
+
+            def start():
+                threading.Thread(target=_worker, daemon=True).start()
+        """)
+        assert _rules(fs) == ["PT006"]
+        assert {f.detail for f in fs} == {"write:_count", "write:_events"}
+
+    def test_lock_guarded_write_ok(self):
+        fs = _lint("""
+            import threading
+
+            _lock = threading.Lock()
+            _count = 0
+
+            def _worker():
+                global _count
+                with _lock:
+                    _count += 1
+
+            def start():
+                threading.Thread(target=_worker, daemon=True).start()
+        """)
+        assert "PT006" not in _rules(fs)
+
+    def test_local_rebind_ok(self):
+        # a local that shadows a module global is not shared state
+        fs = _lint("""
+            import threading
+
+            _count = 0
+
+            def _worker():
+                _count = 1
+                return _count
+
+            def start():
+                threading.Thread(target=_worker, daemon=True).start()
+        """)
+        assert "PT006" not in _rules(fs)
+
+    def test_same_write_outside_thread_region_ok(self):
+        fs = _lint("""
+            _events = []
+
+            def record(e):
+                _events.append(e)
+        """)
+        assert "PT006" not in _rules(fs)
+
+
+# ----------------------------------------------------------- suppression
+
+class TestSuppression:
+    LEAKY = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:{comment}
+                return x
+            return x * 2
+    """
+
+    def test_line_suppression(self):
+        src = self.LEAKY.format(comment="  # paddlelint: disable=PT001")
+        assert _lint(src) == []
+
+    def test_wrong_rule_does_not_suppress(self):
+        src = self.LEAKY.format(comment="  # paddlelint: disable=PT003")
+        assert _rules(_lint(src)) == ["PT001"]
+
+    def test_file_wide_suppression(self):
+        src = ("# paddlelint: disable-file=PT001\n"
+               + textwrap.dedent(self.LEAKY.format(comment="")))
+        assert analyze_source(src, Config()) == []
+
+    def test_disable_all(self):
+        src = self.LEAKY.format(comment="  # paddlelint: disable=all")
+        assert _lint(src) == []
+
+
+# -------------------------------------------------------------- baseline
+
+class TestBaseline:
+    def _findings(self):
+        return _lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """)
+
+    def test_round_trip(self, tmp_path):
+        fs = self._findings()
+        path = str(tmp_path / "baseline.json")
+        save_baseline(path, fs, {fs[0].baseline_key: "accepted: legacy"})
+        loaded = load_baseline(path)
+        assert loaded == {fs[0].baseline_key: "accepted: legacy"}
+        fresh, stale = split_baseline(fs, loaded)
+        assert fresh == [] and stale == []
+
+    def test_key_is_line_number_free(self):
+        a = self._findings()[0]
+        b = _lint("""
+            import jax
+
+            # shifted down by a comment block: the baseline key must
+            # not move with the line number
+            @jax.jit
+            def f(x):
+                return float(x)
+        """)[0]
+        assert a.line != b.line
+        assert a.baseline_key == b.baseline_key
+
+    def test_split_reports_fresh_and_stale(self, tmp_path):
+        fs = self._findings()
+        fresh, stale = split_baseline(fs, {"PT999|gone.py|f|x": "old"})
+        assert [f.rule for f in fresh] == ["PT001"]
+        assert stale == ["PT999|gone.py|f|x"]
+
+    def test_missing_justification_stamped(self, tmp_path):
+        fs = self._findings()
+        path = str(tmp_path / "baseline.json")
+        save_baseline(path, fs, {})
+        with open(path) as f:
+            data = json.load(f)
+        assert data["entries"][0]["justification"] == "TODO: justify"
+
+
+# ------------------------------------------------------------------ CLI
+
+class TestCli:
+    def _write(self, tmp_path, src):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(src))
+        return str(p)
+
+    LEAKY = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+    """
+
+    def test_exit_one_on_findings(self, tmp_path, capsys):
+        assert lint_main([self._write(tmp_path, self.LEAKY)]) == 1
+        out = capsys.readouterr().out
+        assert "PT001" in out and "1 finding(s)" in out
+
+    def test_exit_zero_when_clean(self, tmp_path, capsys):
+        assert lint_main([self._write(tmp_path, "x = 1\n")]) == 0
+
+    def test_json_output(self, tmp_path, capsys):
+        assert lint_main(["--json", self._write(tmp_path, self.LEAKY)]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["findings"][0]["rule"] == "PT001"
+        assert "PT001" in data["rules"]
+
+    def test_baseline_gates_to_zero(self, tmp_path, capsys):
+        mod = self._write(tmp_path, self.LEAKY)
+        base = str(tmp_path / "base.json")
+        assert lint_main([mod, "--baseline", base,
+                          "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert lint_main([mod, "--baseline", base]) == 0
+        assert "1 baselined" in capsys.readouterr().out
+
+    def test_stale_baseline_reported(self, tmp_path, capsys):
+        mod = self._write(tmp_path, self.LEAKY)
+        base = str(tmp_path / "base.json")
+        assert lint_main([mod, "--baseline", base,
+                          "--write-baseline"]) == 0
+        clean = self._write(tmp_path, "x = 1\n")
+        capsys.readouterr()
+        assert lint_main([clean, "--baseline", base]) == 0
+        assert "stale baseline" in capsys.readouterr().out
+        assert lint_main([clean, "--baseline", base,
+                          "--fail-stale"]) == 1
+
+    def test_rules_subset(self, tmp_path):
+        mod = self._write(tmp_path, self.LEAKY)
+        assert lint_main(["--rules", "PT006", mod]) == 0
+        assert lint_main(["--rules", "PT001", mod]) == 1
+
+    def test_unknown_rule_is_usage_error(self, tmp_path):
+        assert lint_main(["--rules", "PT999",
+                          self._write(tmp_path, "x = 1\n")]) == 2
+
+
+# ------------------------------------------------- whole-repo CI gate
+
+class TestRepoGate:
+    def test_package_clean_against_baseline(self, capsys):
+        """The tier-1 gate: paddlelint over paddle_tpu/ must produce zero
+        non-baselined findings (same invocation as tools/paddlelint.py)."""
+        rc = lint_main([os.path.join(REPO, "paddle_tpu"), "--baseline",
+                        os.path.join(REPO, "tools",
+                                     "paddlelint_baseline.json")])
+        out = capsys.readouterr().out
+        assert rc == 0, f"paddlelint gate failed:\n{out}"
+        assert "0 finding(s)" in out
+
+    def test_baseline_entries_are_justified(self):
+        base = load_baseline(os.path.join(
+            REPO, "tools", "paddlelint_baseline.json"))
+        for key, justification in base.items():
+            assert justification and "TODO" not in justification, key
+
+
+# ------------------------------------------- seeded-defect detection
+
+class TestSeededDefects:
+    """Acceptance check: the analyzer must catch a tracer leak and an
+    unguarded shared-state write seeded into scratch copies of the real
+    modules it is meant to police."""
+
+    def _scratch(self, tmp_path, rel, appended):
+        dst = tmp_path / os.path.basename(rel)
+        shutil.copy(os.path.join(REPO, rel), dst)
+        with open(dst, "a") as f:
+            f.write(textwrap.dedent(appended))
+        return str(dst)
+
+    def test_seeded_tracer_leak_in_trainer(self, tmp_path):
+        clean = analyze_paths(
+            [self._scratch(tmp_path, "paddle_tpu/trainer/trainer.py", "")])
+        seeded = analyze_paths([self._scratch(
+            tmp_path, "paddle_tpu/trainer/trainer.py", """
+
+            import jax as _seeded_jax
+
+            @_seeded_jax.jit
+            def _seeded_step(loss):
+                if loss > 0:
+                    return loss
+                return float(loss)
+            """)])
+        new = {f.baseline_key for f in seeded} - {f.baseline_key
+                                                  for f in clean}
+        hits = [f for f in seeded if f.baseline_key in new
+                and f.rule == "PT001" and f.qualname == "_seeded_step"]
+        assert len(hits) == 2  # the branch AND the float()
+
+    def test_seeded_unguarded_write_in_watchdog(self, tmp_path):
+        clean = analyze_paths([self._scratch(
+            tmp_path, "paddle_tpu/distributed/watchdog.py", "")])
+        assert not [f for f in clean if f.rule == "PT006"]
+        seeded = analyze_paths([self._scratch(
+            tmp_path, "paddle_tpu/distributed/watchdog.py", """
+
+            _seeded_flight_log = []
+
+            def _seeded_recorder_loop():
+                _seeded_flight_log.append("tick")
+
+            def _seeded_start_recorder():
+                threading.Thread(target=_seeded_recorder_loop,
+                                 daemon=True).start()
+            """)])
+        hits = [f for f in seeded if f.rule == "PT006"
+                and f.qualname == "_seeded_recorder_loop"]
+        assert len(hits) == 1
+        assert hits[0].detail == "write:_seeded_flight_log"

@@ -76,6 +76,10 @@ MODULE_ROLES = {
                    "cross-rank desync diagnosis — docs/RESILIENCE.md; "
                    "upstream: ProcessGroupNCCL watchdog/async error "
                    "handling)",
+    "analysis": "paddlelint static-analysis suite: TPU/JAX hazard rules "
+                "PT001-PT006 over the package source (docs/ANALYSIS.md; "
+                "CLI tools/paddlelint.py; no upstream equivalent — "
+                "covers tracer-leak/retrace/host-sync classes JAX adds)",
 }
 
 

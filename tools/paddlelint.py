@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""paddlelint — TPU/JAX-aware static analysis gate (docs/ANALYSIS.md).
+
+Usage (from the repo root; this is the tier-1-adjacent CI invocation):
+
+    python tools/paddlelint.py --baseline tools/paddlelint_baseline.json
+
+The analyzer itself is ``paddle_tpu.analysis`` (pure stdlib). Importing
+the ``paddle_tpu`` package normally would pull in jax; to keep this tool
+runnable on hosts with no accelerator stack, we register a stub parent
+package with the right ``__path__`` so ``paddle_tpu.analysis`` imports
+WITHOUT executing ``paddle_tpu/__init__.py``.
+"""
+
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "paddle_tpu")
+
+if "paddle_tpu" not in sys.modules:
+    stub = types.ModuleType("paddle_tpu")
+    stub.__path__ = [_PKG]  # namespace-style parent: submodules import fine
+    sys.modules["paddle_tpu"] = stub
+
+from paddle_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    os.chdir(_REPO)  # repo-relative paths in findings + default targets
+    sys.exit(main())
