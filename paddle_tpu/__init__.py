@@ -55,7 +55,7 @@ for _sub in ("nn", "optimizer", "amp", "io", "jit", "distribution",
              "quantization", "profiler", "vision", "hapi", "incubate",
              "native", "generation", "static", "utils", "text", "trainer",
              "regularizer", "sysconfig", "version", "onnx", "hub",
-             "observability", "resilience", "analysis"):
+             "observability", "resilience", "analysis", "serving"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError:

@@ -513,17 +513,14 @@ def _mm_w(h, L, key):
     (key_q4) contract even/odd input rows against the nibble planes so
     the unpack fuses into the dot operand loads (_int4_halves)."""
     if key + "_q4" in L:
-        q4, sc = L[key + "_q4"], L[key + "_s"]
-        if q4.shape[1] % 128 == 0:
-            # in-kernel unpack: packed int4 is the only weight HBM
-            # traffic (XLA cannot fuse the shift chain into the MXU
-            # feed, so the split below materializes bf16 planes and
-            # runs at bf16 speed — measured r5)
-            from .ops.quant import weight_only_linear
-            return weight_only_linear(h, q4, sc,
-                                      algo="weight_only_int4")
-        lo, hi = _int4_halves(q4, sc.astype(h.dtype))
-        return h[..., 0::2] @ lo + h[..., 1::2] @ hi
+        # in-kernel unpack for ANY N: packed int4 is the only weight HBM
+        # traffic (XLA cannot fuse the shift chain into the MXU feed, so
+        # a host-side plane split materializes bf16 planes and runs at
+        # bf16 speed — measured r5). Non-128-aligned N (the vocab-16032
+        # head) is zero-padded inside the kernel launch and sliced back.
+        from .ops.quant import weight_only_linear
+        return weight_only_linear(h, L[key + "_q4"], L[key + "_s"],
+                                  algo="weight_only_int4")
     return h @ _dq(L, key, h.dtype)
 
 

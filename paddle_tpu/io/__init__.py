@@ -326,7 +326,8 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False, worker_mode: str = "thread",
-                 mp_context: str = "fork", max_batch_retries: int = 0):
+                 mp_context: Optional[str] = None,
+                 max_batch_retries: int = 0):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -342,11 +343,16 @@ class DataLoader:
             raise ValueError(f"worker_mode {worker_mode!r}: expected "
                              "'thread' or 'process'")
         self.worker_mode = worker_mode
-        # fork matches the reference's default and avoids pickling the
-        # dataset, but forking a jax-initialized parent is only safe
-        # because workers are forbidden to touch device state (enforced
-        # in _process_worker); pass "spawn" for full isolation (dataset,
-        # collate_fn and worker_init_fn must then be picklable)
+        # None (default) resolves per-iteration: "fork" while the parent
+        # has NOT initialized a jax backend (cheap, nothing to pickle —
+        # the reference's default), "spawn" once it has. Forking a
+        # jax-initialized parent duplicates the client's locked mutexes
+        # and cached device handles into the child — workers are
+        # forbidden to touch device state (enforced in _process_worker)
+        # but the runtime's own background threads make even innocent
+        # forks flaky, so isolation wins. Under spawn the dataset,
+        # collate_fn and worker_init_fn must be picklable. Pass "fork"/
+        # "spawn"/"forkserver" explicitly to pin a context.
         self.mp_context = mp_context
         self.is_iterable = isinstance(dataset, IterableDataset)
         if worker_mode == "process" and self.is_iterable:
@@ -432,9 +438,15 @@ class DataLoader:
                 raise item
             yield item
 
+    def _resolve_mp_context(self) -> str:
+        if self.mp_context is not None:
+            return self.mp_context
+        from jax._src import xla_bridge
+        return "spawn" if getattr(xla_bridge, "_backends", None) else "fork"
+
     def _iter_processes(self):
         import multiprocessing as mp
-        ctx = mp.get_context(self.mp_context)
+        ctx = mp.get_context(self._resolve_mp_context())
         batches = list(self.batch_sampler)
         if not batches:
             return
@@ -450,7 +462,7 @@ class DataLoader:
                 target=_process_worker,
                 args=(self.dataset, user_collate, task_q,
                       w, W, base_seed, self.worker_init_fn, result_q,
-                      self.use_shared_memory),
+                      self.use_shared_memory, _res._FAULT_FLAG.value),
                 daemon=True)
             p.start()
             procs.append(p)
@@ -687,7 +699,7 @@ class _BatchError:
 
 def _process_worker(dataset, user_collate, task_q, worker_id,
                     num_workers, base_seed, init_fn, out_q,
-                    use_shared_memory=True):
+                    use_shared_memory=True, fault_spec=""):
     """Worker-process body: seed, run init_fn, then pull (batch_idx,
     indices) tasks from the shared task queue until a None stop token.
     Sends (global_batch_idx, collated_numpy) tuples — array leaves ride
@@ -696,6 +708,10 @@ def _process_worker(dataset, user_collate, task_q, worker_id,
     import random as _random
     err = None
     try:
+        if fault_spec:
+            # spawned workers don't inherit the parent's FLAGS state the
+            # way forked ones do — re-arm worker-targeted fault rules
+            _res.set_fault_spec(fault_spec)
         np.random.seed((base_seed + worker_id) % (2 ** 32))
         _random.seed(base_seed + worker_id)
         _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)

@@ -134,13 +134,23 @@ def _wol_int4_fwd_impl(x2, qw, scale):
     if pad_m:
         x2 = jnp.pad(x2, ((0, pad_m), (0, 0)))
     Mp = M + pad_m
+    # non-lane-aligned N (e.g. the vocab-16032 lm head): pad the packed
+    # weight and its scales with zero columns to the next 128 multiple —
+    # the pad columns dequantize to 0 and are sliced off the output, so
+    # the hot decode path keeps the int4-bandwidth kernel instead of
+    # falling back to dequantize-then-matmul (bf16 weight bytes)
+    pad_n = (-N) % 128
+    if pad_n:
+        qw = jnp.pad(qw, ((0, 0), (0, pad_n)))
+        scale = jnp.pad(scale.reshape(-1), (0, pad_n))
+    Np = N + pad_n
     xs = x2.reshape(Mp, K // 2, 2)
     xe, xo = xs[:, :, 0], xs[:, :, 1]
     bm = 128 if Mp % 128 == 0 else 8
-    bn = next((c for c in (2048, 1024, 512, 256, 128) if N % c == 0), N)
+    bn = next((c for c in (2048, 1024, 512, 256, 128) if Np % c == 0), Np)
     out = pl.pallas_call(
         _wol4_kernel,
-        grid=(Mp // bm, N // bn),
+        grid=(Mp // bm, Np // bn),
         in_specs=[pl.BlockSpec((bm, K // 2), lambda i, j: (i, 0)),
                   pl.BlockSpec((bm, K // 2), lambda i, j: (i, 0)),
                   pl.BlockSpec((K // 2, bn), lambda i, j: (0, j)),
@@ -148,10 +158,10 @@ def _wol_int4_fwd_impl(x2, qw, scale):
                   # with blocked Mosaic operands (T(1024) vs T(bn))
                   pl.BlockSpec((1, bn), lambda i, j: (0, j))],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((Mp, N), x2.dtype),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), x2.dtype),
         interpret=jax.default_backend() != "tpu",
-    )(xe, xo, qw, scale.reshape(1, N))
-    return out[:M] if pad_m else out
+    )(xe, xo, qw, scale.reshape(1, Np))
+    return out[:M, :N]
 
 
 @jax.custom_vjp
@@ -186,14 +196,9 @@ def weight_only_linear(x, qweight, scale, bias=None,
     K = shape[-1]
     x2 = x.reshape(-1, K)
     if algo == "weight_only_int4":
-        if qweight.shape[1] % 128 == 0:
-            out = _wol_int4(x2, qweight, scale)
-        else:
-            # non-lane-aligned N (e.g. the vocab-16032 head): the Mosaic
-            # block would be illegal on a real chip — dequantize-then-
-            # matmul keeps these shapes working as before
-            w = weight_dequantize(qweight, scale, algo).astype(x.dtype)
-            out = x2 @ w
+        # any N: _wol_int4_fwd_impl zero-pads non-128-aligned N (e.g. the
+        # vocab-16032 head) inside the kernel launch and slices it back
+        out = _wol_int4(x2, qweight, scale)
     else:
         out = _wol_int8(x2, qweight, scale)
     if bias is not None:

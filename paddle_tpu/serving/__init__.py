@@ -1,0 +1,34 @@
+"""Continuous-batching serving subsystem.
+
+Three modules over the Pallas paged-decode kernel
+(`ops/pallas_paged.py` via `ops.paged_attention`):
+
+  - `block_allocator`: fixed pool of page_size-token KV blocks with
+    refcounts, per-sequence page tables, copy-on-write prefix sharing,
+    and utilization/fragmentation gauges;
+  - `scheduler`: FCFS in-flight request scheduler — requests join
+    mid-decode, leave instantly on EOS/max-tokens, with admission
+    backpressure (`inference.Config.set_admission`) and per-request
+    deadlines (`set_deadline` → falsy TimeoutResult partials);
+  - `engine`: `ServingEngine.add_request/step/collect`, a fixed-shape
+    jitted decode step (one compile per model/slot-count) plus chunked
+    prefill, for the llama/moe, gpt and mla families.
+
+See docs/SERVING.md ("Continuous batching") for sizing and usage.
+"""
+
+from typing import Any, Dict
+
+from .. import observability as _obs
+from .block_allocator import PageBlockAllocator
+from .engine import ServingEngine
+from .scheduler import Request, Scheduler
+
+__all__ = ["ServingEngine", "Request", "Scheduler", "PageBlockAllocator",
+           "metrics"]
+
+
+def metrics() -> Dict[str, Any]:
+    """The serving.engine.* slice of the registry snapshot."""
+    return {k: v for k, v in _obs.registry().snapshot().items()
+            if k.startswith("serving.engine.")}

@@ -1,0 +1,776 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+`ServingEngine.add_request/step/collect` drives a FIXED-SHAPE jitted
+decode step (static `max_slots` batch, per-slot active masking through
+the page tables) over `paged_attention`/`append_to_cache`, with the
+per-family math of the generation.py cached step bodies. Requests join
+mid-decode (chunked prefill between decode steps), leave the instant
+they hit EOS/max-tokens (their pages return to the pool immediately),
+and never retrace the decode program — one compile per
+(model-config, slot-count) pair, checked by the PT002-gated tests.
+
+Inactive slots point their whole page table at the allocator's trash
+page 0 with length 0: the decode step writes their (garbage) K/V into
+the trash page and their logits are ignored on the host, so joins and
+leaves are pure data changes, never shape changes.
+
+Greedy decoding only: the exactness contract (engine tokens ==
+solo `generate_cached` tokens per request, the acceptance test) is a
+greedy property; sampling strategies belong to the batch APIs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _obs
+from .. import resilience as _res
+from ..generation import (_decode_params, _dq, _ffn_apply, _llama_weights,
+                          _mm_w)
+from ..ops.paged_attention import append_to_cache, paged_attention
+from .block_allocator import PageBlockAllocator
+from .scheduler import DECODE, PREFILL, Request, Scheduler
+
+__all__ = ["ServingEngine"]
+
+_REQS = _obs.registry().counter(
+    "serving.engine.requests", "engine requests by outcome",
+    labels=("outcome",))
+_STEPS = _obs.registry().counter(
+    "serving.engine.steps", "device steps launched", labels=("phase",))
+_TOKENS = _obs.registry().counter(
+    "serving.engine.tokens", "tokens processed", labels=("phase",))
+_ACTIVE = _obs.registry().gauge(
+    "serving.engine.active_slots", "slots holding an in-flight request")
+_WAITING = _obs.registry().gauge(
+    "serving.engine.waiting", "requests queued for admission")
+
+
+def _lcp(a: np.ndarray, b: np.ndarray) -> int:
+    n = min(a.size, b.size)
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if neq.size else n
+
+
+class ServingEngine:
+    """Continuous-batching engine for llama/moe, gpt and mla families.
+
+    Typical loop::
+
+        eng = ServingEngine(model, max_slots=4, page_size=16)
+        eng.add_request(prompt_ids, max_new_tokens=32, eos_token_id=2)
+        while eng.has_work():
+            eng.step()
+        results = eng.collect()   # {request_id: np.int32[max_new]}
+
+    `config` (inference.Config) carries serving policy: `set_admission`
+    bounds in-flight requests (Overloaded backpressure), `set_deadline`
+    sets the default per-request budget (falsy TimeoutResult partials).
+    """
+
+    def __init__(self, model, max_slots: int = 4, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 max_context: Optional[int] = None,
+                 prefill_chunk: int = 32,
+                 weight_only_int8: bool = False,
+                 weight_only_quant=None,
+                 config=None,
+                 prefix_sharing: bool = True):
+        p = _decode_params(model, weight_only_int8, weight_only_quant)
+        cfg = p["cfg"]
+        self._p = p
+        self._w = _llama_weights(p)
+        self._family = p["family"]
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.max_context = int(max_context or cfg.max_position_embeddings)
+        if self.max_context > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_context {self.max_context} exceeds "
+                f"max_position_embeddings {cfg.max_position_embeddings}")
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.pages_per_seq = -(-self.max_context // self.page_size)
+        if num_pages is None:
+            num_pages = self.max_slots * self.pages_per_seq + 1
+        self.num_pages = int(num_pages)
+        self.prefix_sharing = bool(prefix_sharing)
+        self.allocator = PageBlockAllocator(
+            self.num_pages, self.page_size, self.pages_per_seq)
+        admission = getattr(config, "_admission", None)
+        self._default_deadline_s = getattr(config, "_deadline_s", None)
+        self.scheduler = Scheduler(
+            self.max_slots,
+            max_inflight=admission[0] if admission else None,
+            queue_timeout_s=admission[1] if admission else 0.0)
+        self._prefill_fifo: List[Request] = []
+
+        # family geometry + device page pools
+        dt = p["embed"].dtype
+        n_layers = len(p["layers"])
+        if self._family == "gpt":
+            kv, d = cfg.num_attention_heads, cfg.head_dim
+        elif self._family == "mla":
+            kv, d = 1, cfg.kv_lora_rank + cfg.qk_rope_head_dim
+        else:
+            kv, d = cfg.num_key_value_heads, cfg.head_dim
+        shape = (kv, self.num_pages, self.page_size, d)
+        if self._family == "mla":
+            # one pool per layer: each row is [latent | rope-key], read
+            # as both K and V by the concat-dot absorbed decode
+            self._pools = [jnp.zeros(shape, dt) for _ in range(n_layers)]
+        else:
+            self._pools = [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                           for _ in range(n_layers)]
+
+        # the two fixed-shape programs: built ONCE here, never in the
+        # step loop (paddlelint PT002)
+        self._jit_decode = jax.jit(self._make_decode_body())
+        self._jit_prefill = jax.jit(self._make_prefill_body())
+
+    # ------------------------------------------------------------- public
+    def add_request(self, prompt, max_new_tokens: int = 20,
+                    eos_token_id: Optional[int] = None,
+                    pad_token_id: int = 0,
+                    deadline_s: Optional[float] = None,
+                    request_id=None) -> Request:
+        """Enqueue a request (FCFS). Raises resilience.Overloaded when
+        admission backpressure refuses it at the door."""
+        req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
+                      pad_token_id=pad_token_id,
+                      deadline_s=(deadline_s if deadline_s is not None
+                                  else self._default_deadline_s),
+                      request_id=request_id)
+        if req.total_tokens > self.max_context:
+            raise ValueError(
+                f"prompt+max_new_tokens = {req.total_tokens} exceeds "
+                f"max_context {self.max_context}")
+        try:
+            self.scheduler.submit(req)
+        except _res.Overloaded:
+            if _obs.enabled():
+                _REQS.labels(outcome="overloaded").inc()
+            raise
+        if _obs.enabled():
+            _REQS.labels(outcome="submitted").inc()
+        return req
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    def step(self) -> Dict[str, int]:
+        """One engine iteration: cull expired requests, admit waiting
+        ones into free slots, run one prefill chunk for the oldest
+        prefilling request, then one fused decode step for every
+        decoding slot. Returns counts for observability/benching."""
+        out = {"admitted": 0, "prefill_tokens": 0, "decoded": 0,
+               "finished": 0}
+        for req in self.scheduler.expire_waiting():
+            if _obs.enabled():
+                _REQS.labels(outcome="overloaded"
+                             if isinstance(req.result, _res.Overloaded)
+                             else "timeout").inc()
+            out["finished"] += 1
+        # deadline sweep over in-flight requests: partial result, pages
+        # freed immediately
+        for _, req in list(self.scheduler.active()):
+            if req.deadline_expired():
+                self._finish(req)
+                out["finished"] += 1
+        out["admitted"] = self._admit()
+        out["prefill_tokens"], fin = self._prefill_chunk()
+        out["finished"] += fin
+        out["decoded"], fin = self._decode()
+        out["finished"] += fin
+        if _obs.enabled():
+            _ACTIVE.set(self.scheduler.inflight)
+            _WAITING.set(len(self.scheduler.waiting))
+        self.allocator.publish_gauges()
+        return out
+
+    def collect(self) -> Dict[object, object]:
+        """Results of every request finished since the last collect():
+        {request_id: np.int32[max_new_tokens] | TimeoutResult |
+        Overloaded}."""
+        return {r.request_id: r.result
+                for r in self.scheduler.drain_finished()}
+
+    def run_to_completion(self) -> Dict[object, object]:
+        """Step until idle; collect everything."""
+        results: Dict[object, object] = {}
+        while self.has_work():
+            self.step()
+            results.update(self.collect())
+        results.update(self.collect())
+        return results
+
+    # ---------------------------------------------------------- admission
+    def _admit(self) -> int:
+        admitted = 0
+        while (req := self.scheduler.next_admittable()) is not None:
+            share, donor = 0, None
+            if self.prefix_sharing:
+                for _, cand in self.scheduler.active():
+                    # only the donor's PREFILLED prompt tokens are
+                    # reusable; cap at len(prompt)-1 so the last prompt
+                    # token is always re-run for this request's logits
+                    s = min(_lcp(req.prompt, cand.prompt),
+                            cand.prefill_pos, int(req.prompt.size) - 1)
+                    if s > share:
+                        share, donor = s, cand
+            try:
+                if share > 0:
+                    self.allocator.fork(donor.request_id, req.request_id,
+                                        share, req.total_tokens)
+                else:
+                    self.allocator.allocate(req.request_id,
+                                            req.total_tokens)
+            except _res.Overloaded:
+                break   # head-of-line waits for pages; FCFS, no skip
+            self.scheduler.admit(req)
+            req.prefill_pos = share
+            req.shared_tokens = share
+            self._prefill_fifo.append(req)
+            admitted += 1
+        return admitted
+
+    # ------------------------------------------------------------ prefill
+    def _prefill_chunk(self) -> Tuple[int, int]:
+        """One chunk of prompt prefill for the OLDEST prefilling request
+        — bounded work between decode steps so long prompts never stall
+        the in-flight batch."""
+        while self._prefill_fifo and \
+                self._prefill_fifo[0].state != PREFILL:
+            self._prefill_fifo.pop(0)
+        if not self._prefill_fifo:
+            return 0, 0
+        req = self._prefill_fifo[0]
+        n = min(self.prefill_chunk, int(req.prompt.size) - req.prefill_pos)
+        start = req.prefill_pos
+        self._apply_copies(self.allocator.extend(req.request_id, n))
+        ids = np.zeros((1, self.prefill_chunk), np.int32)
+        ids[0, :n] = req.prompt[start:start + n]
+        table = self.allocator.table(req.request_id)[None]
+        logits, self._pools = self._jit_prefill(
+            self._w, jnp.asarray(ids), self._pools, jnp.asarray(table),
+            np.int32(start), np.int32(n))
+        req.prefill_pos += n
+        if _obs.enabled():
+            _STEPS.labels(phase="prefill").inc()
+            _TOKENS.labels(phase="prefill").inc(n)
+        finished = 0
+        if req.prefill_pos == int(req.prompt.size):
+            self._prefill_fifo.pop(0)
+            req.state = DECODE
+            tok = int(np.argmax(np.asarray(logits[0])))
+            finished += self._emit(req, tok)
+        return n, finished
+
+    # ------------------------------------------------------------- decode
+    def _decode(self) -> Tuple[int, int]:
+        active = self.scheduler.active(DECODE)
+        if not active:
+            return 0, 0
+        B = self.max_slots
+        tok = np.zeros(B, np.int32)
+        lengths = np.zeros(B, np.int32)
+        tables = np.zeros((B, self.pages_per_seq), np.int32)
+        for slot, req in active:
+            tok[slot] = req.pending
+            lengths[slot] = self.allocator.seq_length(req.request_id)
+            self._apply_copies(self.allocator.extend(req.request_id, 1))
+            tables[slot] = self.allocator.table(req.request_id)
+        logits, self._pools = self._jit_decode(
+            self._w, jnp.asarray(tok), self._pools, jnp.asarray(lengths),
+            jnp.asarray(tables))
+        logits = np.asarray(logits)
+        if _obs.enabled():
+            _STEPS.labels(phase="decode").inc()
+            _TOKENS.labels(phase="decode").inc(len(active))
+        finished = 0
+        for slot, req in active:
+            finished += self._emit(req, int(np.argmax(logits[slot])))
+        return len(active), finished
+
+    def _emit(self, req: Request, tok: int) -> int:
+        """Record one sampled token; finish on EOS/max-tokens (pages
+        freed the same step), else stage it for the next decode step."""
+        req.tokens.append(tok)
+        done = (req.eos_token_id is not None and tok == req.eos_token_id) \
+            or len(req.tokens) >= req.max_new_tokens
+        if done:
+            self._finish(req)
+            return 1
+        req.pending = tok
+        return 0
+
+    def _finish(self, req: Request) -> None:
+        req.finalize()
+        self.allocator.free(req.request_id)
+        self.scheduler.release(req)
+        if _obs.enabled():
+            _REQS.labels(outcome="timeout"
+                         if isinstance(req.result, _res.TimeoutResult)
+                         else "completed").inc()
+
+    def _apply_copies(self, copies) -> None:
+        """Apply the allocator's copy-on-write page copies to the device
+        pools before the write that triggered them."""
+        if not copies:
+            return
+        src = np.asarray([c[0] for c in copies])
+        dst = np.asarray([c[1] for c in copies])
+        if self._family == "mla":
+            self._pools = [pool.at[:, dst].set(pool[:, src])
+                           for pool in self._pools]
+        else:
+            self._pools = [(kp.at[:, dst].set(kp[:, src]),
+                            vp.at[:, dst].set(vp[:, src]))
+                           for kp, vp in self._pools]
+
+    # ----------------------------------------------------- jitted bodies
+    def _make_decode_body(self):
+        if self._family == "gpt":
+            return self._gpt_decode_body()
+        if self._family == "mla":
+            return self._mla_decode_body()
+        return self._llama_decode_body()
+
+    def _make_prefill_body(self):
+        if self._family == "gpt":
+            return self._gpt_prefill_body()
+        if self._family == "mla":
+            return self._mla_prefill_body()
+        return self._llama_prefill_body()
+
+    # -- llama / moe ---------------------------------------------------
+    def _llama_decode_body(self):
+        cfg = self._p["cfg"]
+        Hh, KV, D = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        eps = cfg.rms_norm_eps
+        moe_static = self._p.get("moe_static")
+        from ..flags import flag, flags_guard
+        # pinned at engine construction like the cached bodies' flash
+        # pin: the jit traces lazily and must compile the impl this
+        # engine was built under
+        paged_impl = flag("FLAGS_paged_impl")
+
+        def rms(h, wt):
+            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
+                           keepdims=True)
+            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * wt
+
+        def step(w, tok, pools, lengths, tables):
+            B = tok.shape[0]
+            x = w["embed"][tok][:, None]                 # [B, 1, H]
+            c = w["cos"][lengths]                        # [B, D/2]
+            s = w["sin"][lengths]
+
+            def rope(t):                                 # [B, 1, h, D]
+                d2 = t.shape[-1] // 2
+                t1, t2 = t[..., :d2], t[..., d2:]
+                cc = c[:, None, None, :].astype(t.dtype)
+                ss = s[:, None, None, :].astype(t.dtype)
+                return jnp.concatenate(
+                    [t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
+
+            new_pools = []
+            sts = moe_static or (None,) * len(w["layers"])
+            with flags_guard(paged_impl=paged_impl):  # paddlelint: disable=PT005
+                for L, (kp, vp), st in zip(w["layers"], pools, sts):
+                    h = rms(x, L["ln1"])
+                    q, k, v = (_mm_w(h, L, "wq"), _mm_w(h, L, "wk"),
+                               _mm_w(h, L, "wv"))
+                    if "bq" in L:
+                        q, k, v = q + L["bq"], k + L["bk"], v + L["bv"]
+                    q = rope(q.reshape(B, 1, Hh, D))
+                    k = rope(k.reshape(B, 1, KV, D))
+                    v = v.reshape(B, 1, KV, D)
+                    kp, vp, _ = append_to_cache(kp, vp, k[:, 0], v[:, 0],
+                                                lengths, tables)
+                    new_pools.append((kp, vp))
+                    o = paged_attention(q[:, 0], kp, vp, lengths + 1,
+                                        tables, scale=D ** -0.5)
+                    x = x + _mm_w(o.reshape(B, 1, Hh * D), L, "wo")
+                    h2 = rms(x, L["ln2"])
+                    x = x + _ffn_apply(L, h2, st)
+            x = rms(x, w["norm"])
+            last = x[:, -1]
+            if "head_q" in w or "head_q4" in w:
+                logits = _mm_w(last, w, "head")
+            else:
+                logits = last @ (w["head"] if w["head"] is not None
+                                 else w["embed"].T)
+            return logits, new_pools
+
+        return step
+
+    def _llama_prefill_body(self):
+        cfg = self._p["cfg"]
+        Hh, KV, D = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        eps = cfg.rms_norm_eps
+        rep = Hh // KV
+        moe_static = self._p.get("moe_static")
+        C = self.prefill_chunk
+        ps, nj = self.page_size, self.pages_per_seq
+        T = nj * ps
+
+        def rms(h, wt):
+            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
+                           keepdims=True)
+            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * wt
+
+        def prefill(w, ids, pools, table, start, n_valid):
+            x = w["embed"][ids]                          # [1, C, H]
+            pos = start + jnp.arange(C)
+            posc = jnp.clip(pos, 0, w["cos"].shape[0] - 1)
+            c, s = w["cos"][posc], w["sin"][posc]        # [C, D/2]
+
+            def rope(t):                                 # [1, C, h, D]
+                d2 = t.shape[-1] // 2
+                t1, t2 = t[..., :d2], t[..., d2:]
+                cc = c[None, :, None, :].astype(t.dtype)
+                ss = s[None, :, None, :].astype(t.dtype)
+                return jnp.concatenate(
+                    [t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
+
+            valid = jnp.arange(C) < n_valid
+            # pad positions write to the trash page; real positions to
+            # this sequence's pages
+            pg = jnp.where(valid, table[0, jnp.clip(pos // ps, 0, nj - 1)],
+                           0)
+            off = jnp.where(valid, pos % ps, 0)
+            pos_t = jnp.arange(T)
+            vis = pos_t[None, :] <= pos[:, None]         # [C, T]
+
+            def write(pages, new):                       # new [C, kv, D]
+                def body(pages, i):
+                    return pages.at[:, pg[i], off[i], :].set(new[i]), None
+                pages, _ = jax.lax.scan(body, pages, jnp.arange(C))
+                return pages
+
+            new_pools = []
+            sts = moe_static or (None,) * len(w["layers"])
+            for L, (kp, vp), st in zip(w["layers"], pools, sts):
+                h = rms(x, L["ln1"])
+                q, k, v = (_mm_w(h, L, "wq"), _mm_w(h, L, "wk"),
+                           _mm_w(h, L, "wv"))
+                if "bq" in L:
+                    q, k, v = q + L["bq"], k + L["bk"], v + L["bv"]
+                q = rope(q.reshape(1, C, Hh, D))
+                k = rope(k.reshape(1, C, KV, D))
+                v = v.reshape(1, C, KV, D)
+                kp = write(kp, k[0])
+                vp = write(vp, v[0])
+                new_pools.append((kp, vp))
+                ks = kp[:, table[0]].reshape(KV, T, D)
+                vs = vp[:, table[0]].reshape(KV, T, D)
+                qg = q.reshape(1, C, KV, rep, D)
+                scores = jnp.einsum("bsgrd,gtd->bgrst", qg, ks) \
+                    * (D ** -0.5)
+                scores = jnp.where(vis[None, None, None],
+                                   scores.astype(jnp.float32), -1e30)
+                aw = jax.nn.softmax(scores, axis=-1).astype(vs.dtype)
+                o = jnp.einsum("bgrst,gtd->bsgrd", aw, vs).reshape(
+                    1, C, Hh * D)
+                x = x + _mm_w(o, L, "wo")
+                h2 = rms(x, L["ln2"])
+                x = x + _ffn_apply(L, h2, st)
+            x = rms(x, w["norm"])
+            last = jax.lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
+                                                keepdims=False)[None]
+            if "head_q" in w or "head_q4" in w:
+                logits = _mm_w(last, w, "head")
+            else:
+                logits = last @ (w["head"] if w["head"] is not None
+                                 else w["embed"].T)
+            return logits, new_pools
+
+        return prefill
+
+    # -- gpt -----------------------------------------------------------
+    def _gpt_decode_body(self):
+        cfg = self._p["cfg"]
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        eps = cfg.layer_norm_eps
+        from ..flags import flag, flags_guard
+        paged_impl = flag("FLAGS_paged_impl")
+
+        def ln(h, wt, b):
+            h32 = h.astype(jnp.float32)
+            mu = jnp.mean(h32, -1, keepdims=True)
+            var = jnp.var(h32, -1, keepdims=True)
+            return (((h32 - mu) * jax.lax.rsqrt(var + eps))
+                    .astype(h.dtype) * wt + b)
+
+        def step(w, tok, pools, lengths, tables):
+            B = tok.shape[0]
+            x = w["embed"][tok][:, None] + w["pos"][lengths][:, None]
+            new_pools = []
+            with flags_guard(paged_impl=paged_impl):  # paddlelint: disable=PT005
+                for L, (kp, vp) in zip(w["layers"], pools):
+                    h = ln(x, L["ln1w"], L["ln1b"])
+                    qkv = h @ L["wqkv"] + L["bqkv"]
+                    q, k, v = jnp.split(qkv, 3, axis=-1)
+                    q = q.reshape(B, 1, nh, hd)
+                    k = k.reshape(B, 1, nh, hd)
+                    v = v.reshape(B, 1, nh, hd)
+                    kp, vp, _ = append_to_cache(kp, vp, k[:, 0], v[:, 0],
+                                                lengths, tables)
+                    new_pools.append((kp, vp))
+                    o = paged_attention(q[:, 0], kp, vp, lengths + 1,
+                                        tables, scale=hd ** -0.5)
+                    x = x + (o.reshape(B, 1, nh * hd) @ L["wo"] + L["bo"])
+                    h2 = ln(x, L["ln2w"], L["ln2b"])
+                    x = x + (jax.nn.gelu(h2 @ L["wi"] + L["bi"],
+                                         approximate=True) @ L["wf"]
+                             + L["bf"])
+            x = ln(x, w["normw"], w["normb"])
+            last = x[:, -1]
+            logits = last @ (w["head"] if w["head"] is not None
+                             else w["embed"].T)
+            return logits, new_pools
+
+        return step
+
+    def _gpt_prefill_body(self):
+        cfg = self._p["cfg"]
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        eps = cfg.layer_norm_eps
+        C = self.prefill_chunk
+        ps, nj = self.page_size, self.pages_per_seq
+        T = nj * ps
+
+        def ln(h, wt, b):
+            h32 = h.astype(jnp.float32)
+            mu = jnp.mean(h32, -1, keepdims=True)
+            var = jnp.var(h32, -1, keepdims=True)
+            return (((h32 - mu) * jax.lax.rsqrt(var + eps))
+                    .astype(h.dtype) * wt + b)
+
+        def prefill(w, ids, pools, table, start, n_valid):
+            pos = start + jnp.arange(C)
+            posc = jnp.clip(pos, 0, w["pos"].shape[0] - 1)
+            x = w["embed"][ids] + w["pos"][posc][None]
+            valid = jnp.arange(C) < n_valid
+            pg = jnp.where(valid, table[0, jnp.clip(pos // ps, 0, nj - 1)],
+                           0)
+            off = jnp.where(valid, pos % ps, 0)
+            pos_t = jnp.arange(T)
+            vis = pos_t[None, :] <= pos[:, None]
+
+            def write(pages, new):
+                def body(pages, i):
+                    return pages.at[:, pg[i], off[i], :].set(new[i]), None
+                pages, _ = jax.lax.scan(body, pages, jnp.arange(C))
+                return pages
+
+            new_pools = []
+            for L, (kp, vp) in zip(w["layers"], pools):
+                h = ln(x, L["ln1w"], L["ln1b"])
+                qkv = h @ L["wqkv"] + L["bqkv"]
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                q = q.reshape(1, C, nh, hd)
+                k = k.reshape(1, C, nh, hd)
+                v = v.reshape(1, C, nh, hd)
+                kp = write(kp, k[0])
+                vp = write(vp, v[0])
+                new_pools.append((kp, vp))
+                ks = kp[:, table[0]].reshape(nh, T, hd)
+                vs = vp[:, table[0]].reshape(nh, T, hd)
+                scores = jnp.einsum("bshd,htd->bhst", q, ks) \
+                    * (hd ** -0.5)
+                scores = jnp.where(vis[None, None],
+                                   scores.astype(jnp.float32), -1e30)
+                aw = jax.nn.softmax(scores, axis=-1).astype(vs.dtype)
+                o = jnp.einsum("bhst,htd->bshd", aw, vs).reshape(
+                    1, C, nh * hd)
+                x = x + (o @ L["wo"] + L["bo"])
+                h2 = ln(x, L["ln2w"], L["ln2b"])
+                x = x + (jax.nn.gelu(h2 @ L["wi"] + L["bi"],
+                                     approximate=True) @ L["wf"]
+                         + L["bf"])
+            x = ln(x, w["normw"], w["normb"])
+            last = jax.lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
+                                                keepdims=False)[None]
+            logits = last @ (w["head"] if w["head"] is not None
+                             else w["embed"].T)
+            return logits, new_pools
+
+        return prefill
+
+    # -- mla -----------------------------------------------------------
+    def _mla_decode_body(self):
+        cfg = self._p["cfg"]
+        nh = cfg.num_attention_heads
+        dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                      cfg.v_head_dim)
+        r = cfg.kv_lora_rank
+        eps = cfg.rms_norm_eps
+        scale = 1.0 / float(math.sqrt(dn + dr))
+        moe_static = self._p.get("moe_static")
+        from ..flags import flag, flags_guard
+        paged_impl = flag("FLAGS_paged_impl")
+
+        def rms(h, wt):
+            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
+                           keepdims=True)
+            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * wt
+
+        def step(w, tok, pools, lengths, tables):
+            B = tok.shape[0]
+            x = w["embed"][tok][:, None]
+            c = w["cos"][lengths]                        # [B, dr/2]
+            s = w["sin"][lengths]
+
+            def rope(t):                                 # [B, 1, h, dr]
+                d2 = t.shape[-1] // 2
+                t1, t2 = t[..., :d2], t[..., d2:]
+                cc = c[:, None, None, :].astype(t.dtype)
+                ss = s[:, None, None, :].astype(t.dtype)
+                return jnp.concatenate(
+                    [t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
+
+            new_pools = []
+            sts = moe_static or (None,) * len(w["layers"])
+            with flags_guard(paged_impl=paged_impl):  # paddlelint: disable=PT005
+                for L, pool, st in zip(w["layers"], pools, sts):
+                    h = rms(x, L["ln1"])
+                    if "wqa" in L or "wqa_q" in L or "wqa_q4" in L:
+                        q = _mm_w(rms(_mm_w(h, L, "wqa"), L["gq"]),
+                                  L, "wqb")
+                    else:
+                        q = _mm_w(h, L, "wq")
+                    q = q.reshape(B, 1, nh, dn + dr)
+                    q_nope, q_pe = q[..., :dn], q[..., dn:]
+                    q_pe = rope(q_pe)
+                    kv_a = _mm_w(h, L, "wkva")           # [B, 1, r+dr]
+                    lat = rms(kv_a[..., :r], L["gkv"])
+                    k_pe = rope(kv_a[..., r:][:, :, None, :])[:, :, 0]
+                    row = jnp.concatenate([lat, k_pe], -1)[:, 0]
+                    pool = append_to_cache(pool, pool, row[:, None],
+                                           row[:, None], lengths,
+                                           tables)[0]
+                    new_pools.append(pool)
+                    wkb = _dq(L, "wkvb", x.dtype).reshape(r, nh, dn + dv)
+                    w_k, w_v = wkb[..., :dn], wkb[..., dn:]
+                    # absorbed concat-dot: softmax((q_eff|q_pe)·row) over
+                    # rows [lat|k_pe]; the weighted row sum sliced to the
+                    # latent part IS the latent attention output
+                    q_eff = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_k)
+                    q_cat = jnp.concatenate([q_eff, q_pe], -1)[:, 0]
+                    o_cat = paged_attention(q_cat, pool, pool,
+                                            lengths + 1, tables,
+                                            scale=scale)
+                    o = jnp.einsum("bnr,rnv->bnv", o_cat[..., :r], w_v)
+                    x = x + _mm_w(o.reshape(B, 1, nh * dv), L, "wo")
+                    h2 = rms(x, L["ln2"])
+                    x = x + _ffn_apply(L, h2, st)
+            x = rms(x, w["norm"])
+            last = x[:, -1]
+            if "head_q" in w or "head_q4" in w:
+                logits = _mm_w(last, w, "head")
+            else:
+                logits = last @ (w["head"] if w["head"] is not None
+                                 else w["embed"].T)
+            return logits, new_pools
+
+        return step
+
+    def _mla_prefill_body(self):
+        cfg = self._p["cfg"]
+        nh = cfg.num_attention_heads
+        dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                      cfg.v_head_dim)
+        r = cfg.kv_lora_rank
+        eps = cfg.rms_norm_eps
+        scale = 1.0 / float(math.sqrt(dn + dr))
+        moe_static = self._p.get("moe_static")
+        C = self.prefill_chunk
+        ps, nj = self.page_size, self.pages_per_seq
+        T = nj * ps
+
+        def rms(h, wt):
+            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
+                           keepdims=True)
+            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * wt
+
+        def prefill(w, ids, pools, table, start, n_valid):
+            x = w["embed"][ids]
+            pos = start + jnp.arange(C)
+            posc = jnp.clip(pos, 0, w["cos"].shape[0] - 1)
+            c, s = w["cos"][posc], w["sin"][posc]
+
+            def rope(t):                                 # [1, C, h, dr]
+                d2 = t.shape[-1] // 2
+                t1, t2 = t[..., :d2], t[..., d2:]
+                cc = c[None, :, None, :].astype(t.dtype)
+                ss = s[None, :, None, :].astype(t.dtype)
+                return jnp.concatenate(
+                    [t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
+
+            valid = jnp.arange(C) < n_valid
+            pg = jnp.where(valid, table[0, jnp.clip(pos // ps, 0, nj - 1)],
+                           0)
+            off = jnp.where(valid, pos % ps, 0)
+            pos_t = jnp.arange(T)
+            vis = pos_t[None, :] <= pos[:, None]
+
+            def write(pages, new):                       # new [C, 1, Dc]
+                def body(pages, i):
+                    return pages.at[:, pg[i], off[i], :].set(new[i]), None
+                pages, _ = jax.lax.scan(body, pages, jnp.arange(C))
+                return pages
+
+            new_pools = []
+            sts = moe_static or (None,) * len(w["layers"])
+            for L, pool, st in zip(w["layers"], pools, sts):
+                h = rms(x, L["ln1"])
+                if "wqa" in L or "wqa_q" in L or "wqa_q4" in L:
+                    q = _mm_w(rms(_mm_w(h, L, "wqa"), L["gq"]), L, "wqb")
+                else:
+                    q = _mm_w(h, L, "wq")
+                q = q.reshape(1, C, nh, dn + dr)
+                q_nope, q_pe = q[..., :dn], q[..., dn:]
+                q_pe = rope(q_pe)
+                kv_a = _mm_w(h, L, "wkva")               # [1, C, r+dr]
+                lat = rms(kv_a[..., :r], L["gkv"])
+                k_pe = rope(kv_a[..., r:][:, :, None, :])[:, :, 0]
+                rows_new = jnp.concatenate([lat, k_pe], -1)  # [1, C, Dc]
+                pool = write(pool, rows_new[0][:, None])
+                new_pools.append(pool)
+                wkb = _dq(L, "wkvb", x.dtype).reshape(r, nh, dn + dv)
+                w_k, w_v = wkb[..., :dn], wkb[..., dn:]
+                q_eff = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_k)
+                q_cat = jnp.concatenate([q_eff, q_pe], -1)  # [1,C,nh,Dc]
+                rows = pool[0, table[0]].reshape(T, r + dr)
+                scores = jnp.einsum("bsnd,td->bnst", q_cat, rows) * scale
+                scores = jnp.where(vis[None, None],
+                                   scores.astype(jnp.float32), -1e30)
+                aw = jax.nn.softmax(scores, axis=-1).astype(rows.dtype)
+                o_cat = jnp.einsum("bnst,td->bsnd", aw, rows)
+                o = jnp.einsum("bsnr,rnv->bsnv", o_cat[..., :r], w_v)
+                x = x + _mm_w(o.reshape(1, C, nh * dv), L, "wo")
+                h2 = rms(x, L["ln2"])
+                x = x + _ffn_apply(L, h2, st)
+            x = rms(x, w["norm"])
+            last = jax.lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
+                                                keepdims=False)[None]
+            if "head_q" in w or "head_q4" in w:
+                logits = _mm_w(last, w, "head")
+            else:
+                logits = last @ (w["head"] if w["head"] is not None
+                                 else w["embed"].T)
+            return logits, new_pools
+
+        return prefill

@@ -1,0 +1,210 @@
+"""In-flight (continuous-batching) request scheduler.
+
+Pure host-side state machine — the engine (engine.py) owns the device
+work and drives this scheduler once per `step()`:
+
+  - FCFS admission into a FIXED number of decode slots (the jitted
+    decode step has a static batch dimension; joining or leaving a slot
+    never retraces it — paddlelint PT002);
+  - admission backpressure reusing `inference.Config.set_admission`
+    semantics: `max_inflight` bounds admitted requests, and with
+    `queue_timeout_s == 0` a submit that cannot be admitted is refused
+    with `resilience.Overloaded` at the door (the Predictor's
+    non-blocking gate); with a positive timeout requests may queue and
+    are expired with an `Overloaded` result once they wait longer;
+  - per-request deadlines (`inference.Config.set_deadline` or
+    `Request(deadline_s=...)`) produce falsy `resilience.TimeoutResult`
+    partial results, never hangs;
+  - head-of-line order is never bypassed (no skip-ahead admission), so
+    a seeded request trace schedules deterministically.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import resilience as _res
+
+__all__ = ["Request", "Scheduler",
+           "WAITING", "PREFILL", "DECODE", "FINISHED"]
+
+WAITING = "waiting"
+PREFILL = "prefill"
+DECODE = "decode"
+FINISHED = "finished"
+
+_ids = itertools.count()
+
+
+class Request:
+    """One generation request. `tokens` accumulates greedy output ids;
+    after FINISHED, `result` is an int32 array padded to max_new_tokens
+    with pad_token_id (the generate_cached row convention), a falsy
+    `resilience.TimeoutResult` carrying the partial tokens on a deadline
+    miss, or a `resilience.Overloaded` instance if the request timed out
+    of the admission queue."""
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: int = 0,
+                 deadline_s: Optional[float] = None,
+                 request_id=None):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = int(pad_token_id)
+        self.deadline_s = deadline_s
+        self.request_id = request_id if request_id is not None \
+            else next(_ids)
+        self.state = WAITING
+        self.slot: Optional[int] = None
+        self.tokens: List[int] = []
+        self.result = None
+        self.pending: Optional[int] = None   # last sampled, not yet fed
+        self.prefill_pos = 0                 # prompt tokens in cache
+        self.shared_tokens = 0               # prefix tokens riding a donor
+        self._deadline: Optional[_res.Deadline] = None
+        self._enqueued_at: Optional[float] = None
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self.prompt.size) + self.max_new_tokens
+
+    def start_deadline(self) -> None:
+        if self.deadline_s:
+            self._deadline = _res.Deadline(self.deadline_s)
+
+    def deadline_expired(self) -> bool:
+        return self._deadline is not None and self._deadline.expired()
+
+    def finalize(self) -> None:
+        """Pad tokens to max_new_tokens (generate_cached row shape)."""
+        out = np.full(self.max_new_tokens, self.pad_token_id, np.int32)
+        out[:len(self.tokens)] = self.tokens
+        if self._deadline is not None and self._deadline.expired():
+            _res.deadline_miss()
+            self.result = _res.TimeoutResult(
+                kind="serving_engine", budget_s=self._deadline.budget_s,
+                elapsed_s=self._deadline.elapsed_s,
+                completed=len(self.tokens), partial=out)
+        else:
+            self.result = out
+
+    def __repr__(self):
+        return (f"Request(id={self.request_id}, state={self.state}, "
+                f"prompt={self.prompt.size}, out={len(self.tokens)}/"
+                f"{self.max_new_tokens})")
+
+
+class Scheduler:
+    """FCFS continuous-batching scheduler over `max_slots` decode slots."""
+
+    def __init__(self, max_slots: int, max_inflight: Optional[int] = None,
+                 queue_timeout_s: float = 0.0):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_slots = int(max_slots)
+        self.max_inflight = min(int(max_inflight), self.max_slots) \
+            if max_inflight else self.max_slots
+        self.backpressure = max_inflight is not None
+        self.queue_timeout_s = float(queue_timeout_s)
+        self.waiting: deque = deque()
+        self.slots: List[Optional[Request]] = [None] * self.max_slots
+        self.finished: List[Request] = []
+
+    # ------------------------------------------------------------- queries
+    @property
+    def inflight(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    def active(self, state: Optional[str] = None):
+        """(slot, request) pairs, optionally filtered by state."""
+        return [(i, r) for i, r in enumerate(self.slots)
+                if r is not None and (state is None or r.state == state)]
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.inflight > 0
+
+    # ----------------------------------------------------------- lifecycle
+    def submit(self, req: Request) -> Request:
+        """Enqueue FCFS. With backpressure and queue_timeout_s == 0, a
+        request that cannot be admitted right now is refused with
+        `Overloaded` (the Predictor's non-blocking admission gate)."""
+        if self.backpressure and self.queue_timeout_s <= 0 \
+                and self.inflight + len(self.waiting) >= self.max_inflight:
+            raise _res.Overloaded(
+                f"admission gate full ({self.max_inflight} inflight)")
+        req.state = WAITING
+        req._enqueued_at = time.monotonic()
+        req.start_deadline()
+        self.waiting.append(req)
+        return req
+
+    def expire_waiting(self) -> List[Request]:
+        """Cull queued requests past the admission timeout (and queued
+        requests whose own deadline already expired): they finish with
+        an Overloaded / TimeoutResult result without touching a slot."""
+        expired = []
+        keep = deque()
+        now = time.monotonic()
+        for req in self.waiting:
+            timed_out = (self.backpressure and self.queue_timeout_s > 0
+                         and now - req._enqueued_at > self.queue_timeout_s)
+            if timed_out:
+                req.state = FINISHED
+                req.result = _res.Overloaded(
+                    f"request {req.request_id} waited "
+                    f"{now - req._enqueued_at:.3f}s > queue_timeout_s="
+                    f"{self.queue_timeout_s}")
+                expired.append(req)
+            elif req.deadline_expired():
+                req.state = FINISHED
+                req.finalize()
+                expired.append(req)
+            else:
+                keep.append(req)
+        self.waiting = keep
+        self.finished.extend(expired)
+        return expired
+
+    def next_admittable(self) -> Optional[Request]:
+        """Head-of-line request if a slot and an inflight credit are
+        free; None otherwise. FCFS: nothing behind the head ever jumps
+        it (deterministic under a seeded trace)."""
+        if not self.waiting or self.inflight >= self.max_inflight \
+                or all(r is not None for r in self.slots):
+            return None
+        return self.waiting[0]
+
+    def admit(self, req: Request) -> int:
+        """Bind the head-of-line request to the lowest free slot."""
+        assert self.waiting and self.waiting[0] is req, \
+            "admit() must take the head of the FCFS queue"
+        slot = next(i for i, r in enumerate(self.slots) if r is None)
+        self.waiting.popleft()
+        req.state = PREFILL
+        req.slot = slot
+        self.slots[slot] = req
+        return slot
+
+    def release(self, req: Request) -> None:
+        """Free the slot the instant a request finishes — the next
+        step() can admit into it (no drain barrier)."""
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+        req.state = FINISHED
+        self.finished.append(req)
+
+    def drain_finished(self) -> List[Request]:
+        done, self.finished = self.finished, []
+        return done

@@ -1,0 +1,252 @@
+"""Paged KV block allocator (serving.block_allocator): alloc/free/
+refcount invariants, fragmentation under churn, prefix-share
+copy-on-write, and OOM-pool behavior (clean Overloaded, never
+corruption)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import resilience as res
+from paddle_tpu.serving import PageBlockAllocator
+
+
+def _check_invariants(a: PageBlockAllocator):
+    """Global conservation: every usable page is on the free list xor
+    referenced; refcounts equal the number of sequences holding the
+    page; reservations never exceed the free list."""
+    free = set(a._free)
+    assert len(free) == len(a._free), "free list has duplicates"
+    assert 0 not in free, "trash page leaked to the free list"
+    held = {}
+    for seq in a._seqs.values():
+        assert len(set(seq.pages)) == len(seq.pages)
+        for pg in seq.pages:
+            held[pg] = held.get(pg, 0) + 1
+    for pg in range(1, a.num_pages):
+        if pg in free:
+            assert a.refcount(pg) == 0, pg
+            assert pg not in held, pg
+        else:
+            assert a.refcount(pg) == held.get(pg, 0) > 0, pg
+    assert a.refcount(0) >= 1
+    assert 0 <= a._reserved_total <= len(a._free)
+    assert a._reserved_total == sum(s.reserved for s in a._seqs.values())
+
+
+class TestAllocFree:
+    def test_basic_lifecycle_and_tables(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        assert a.free_pages == 8
+        a.allocate("s0", total_tokens=10)        # needs 3 pages
+        assert a.available_pages == 8 - 3
+        # pages materialize lazily on extend, at page boundaries
+        assert a.seq_pages("s0") == []
+        a.extend("s0", 5)
+        assert len(a.seq_pages("s0")) == 2
+        t = a.table("s0")
+        assert t.dtype == np.int32 and t.shape == (4,)
+        assert list(t[:2]) == a.seq_pages("s0") and all(t[2:] == 0)
+        a.extend("s0", 5)
+        assert a.seq_length("s0") == 10
+        assert len(a.seq_pages("s0")) == 3
+        _check_invariants(a)
+        a.free("s0")
+        assert a.free_pages == 8 and a.available_pages == 8
+        _check_invariants(a)
+
+    def test_deterministic_page_order(self):
+        a = PageBlockAllocator(num_pages=6, page_size=2, pages_per_seq=3)
+        a.allocate("s", 6)
+        a.extend("s", 6)
+        assert a.seq_pages("s") == [1, 2, 3]
+
+    def test_reservation_guarantees_extend(self):
+        # two sequences admitted up to their worst case can always
+        # extend, in any interleaving
+        a = PageBlockAllocator(num_pages=7, page_size=2, pages_per_seq=3)
+        a.allocate("a", 6)
+        a.allocate("b", 6)
+        with pytest.raises(res.Overloaded):
+            a.allocate("c", 1)   # 6 usable pages, all reserved
+        for i in range(6):
+            a.extend("a" if i % 2 == 0 else "b", 1)
+            a.extend("b" if i % 2 == 0 else "a", 1)
+            _check_invariants(a)
+        assert a.seq_length("a") == a.seq_length("b") == 6
+
+    def test_bad_args(self):
+        a = PageBlockAllocator(num_pages=4, page_size=2, pages_per_seq=2)
+        with pytest.raises(ValueError):
+            a.allocate("s", 0)
+        with pytest.raises(ValueError):
+            a.allocate("s", 5)           # > pages_per_seq * page_size
+        a.allocate("s", 4)
+        with pytest.raises(ValueError):
+            a.allocate("s", 2)           # duplicate id
+        a.extend("s", 4)
+        with pytest.raises(ValueError):
+            a.extend("s", 1)             # past pages_per_seq
+        with pytest.raises(ValueError):
+            PageBlockAllocator(1, 2, 2)  # no room for the trash page
+
+
+class TestOOM:
+    def test_clean_overloaded_no_state_change(self):
+        a = PageBlockAllocator(num_pages=5, page_size=4, pages_per_seq=4)
+        a.allocate("big", 12)            # 3 of 4 usable pages
+        before = (a.free_pages, a.available_pages, a._reserved_total)
+        with pytest.raises(res.Overloaded):
+            a.allocate("huge", 8)        # needs 2, only 1 available
+        assert (a.free_pages, a.available_pages,
+                a._reserved_total) == before
+        _check_invariants(a)
+        a.allocate("ok", 4)              # the last page still admits
+        a.extend("big", 12)
+        a.extend("ok", 4)
+        _check_invariants(a)
+
+    def test_churn_never_corrupts(self):
+        rng = np.random.RandomState(0)
+        a = PageBlockAllocator(num_pages=17, page_size=4,
+                               pages_per_seq=6)
+        live = {}
+        for step in range(300):
+            sid = f"s{step}"
+            total = int(rng.randint(1, 24))
+            if a.can_admit(total):
+                a.allocate(sid, total)
+                live[sid] = total
+            else:
+                with pytest.raises(res.Overloaded):
+                    a.allocate(sid, total)
+            for s, tot in list(live.items()):
+                if a.seq_length(s) < tot:
+                    a.extend(s, 1)
+                if rng.rand() < 0.15 or a.seq_length(s) >= tot:
+                    a.free(s)
+                    del live[s]
+            _check_invariants(a)
+            st = a.stats()
+            assert 0.0 <= st["utilization"] <= 1.0
+            assert 0.0 <= st["fragmentation"] < 1.0 or \
+                st["pages_used"] == 0
+
+
+class TestPrefixShareCOW:
+    def test_fork_shares_and_cow_on_write(self):
+        a = PageBlockAllocator(num_pages=11, page_size=4, pages_per_seq=4)
+        a.allocate("p", 12)
+        a.extend("p", 8)                 # 2 full pages cached
+        a.fork("p", "c", share_tokens=8, total_tokens=12)
+        assert a.seq_pages("c") == a.seq_pages("p")
+        assert all(a.refcount(pg) == 2 for pg in a.seq_pages("p"))
+        assert a.seq_length("c") == 8
+        # child writes into fresh territory: new page, no copy
+        assert a.extend("c", 1) == []
+        assert len(a.seq_pages("c")) == 3
+        # parent extends into its OWN fully-shared page space: its page
+        # 2 boundary is fresh (length 8 = 2 full pages), no copy either
+        assert a.extend("p", 1) == []
+        _check_invariants(a)
+
+    def test_partial_page_cow_both_directions(self):
+        a = PageBlockAllocator(num_pages=11, page_size=4, pages_per_seq=4)
+        a.allocate("p", 12)
+        a.extend("p", 6)                 # page 1 full, page 2 half
+        a.fork("p", "c", share_tokens=6, total_tokens=12)
+        shared = a.seq_pages("p")[1]
+        # whoever writes the shared partial page first pays the copy
+        copies = a.extend("p", 1)
+        assert len(copies) == 1 and copies[0][0] == shared
+        assert a.seq_pages("p")[1] != shared
+        assert a.seq_pages("c")[1] == shared
+        assert a.refcount(shared) == 1
+        # child's next write: page now privately held, no further copy
+        assert a.extend("c", 1) == []
+        _check_invariants(a)
+
+    def test_fork_content_isolation_under_reservation_pressure(self):
+        # regression: sharing a partial page puts the DONOR on the COW
+        # hook; its copy must come from a reserved page, never steal
+        # another sequence's guarantee
+        a = PageBlockAllocator(num_pages=8, page_size=4, pages_per_seq=4)
+        a.allocate("p", 8)
+        a.extend("p", 6)
+        a.fork("p", "c", share_tokens=6, total_tokens=8)
+        # pool: 7 usable; p holds 2, c shares; fill the rest
+        i = 0
+        while a.can_admit(4):
+            a.allocate(f"f{i}", 4)
+            i += 1
+        a.extend("p", 2)                 # donor COW: must not raise
+        a.extend("c", 2)
+        _check_invariants(a)
+
+    def test_free_with_live_sharer_keeps_pages(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("p", 8)
+        a.extend("p", 8)
+        a.fork("p", "c", share_tokens=8, total_tokens=12)
+        pages = a.seq_pages("p")
+        a.free("p")
+        for pg in pages:
+            assert a.refcount(pg) == 1   # child still holds them
+        assert a.seq_pages("c") == pages
+        a.free("c")
+        assert a.free_pages == 8
+        _check_invariants(a)
+
+    def test_fork_oom_is_clean(self):
+        a = PageBlockAllocator(num_pages=5, page_size=4, pages_per_seq=4)
+        a.allocate("p", 8)
+        a.extend("p", 8)
+        a.allocate("x", 8)               # pool now fully committed
+        before = (a.free_pages, a.available_pages, a._reserved_total,
+                  a.refcount(a.seq_pages("p")[0]))
+        with pytest.raises(res.Overloaded):
+            a.fork("p", "c", share_tokens=8, total_tokens=16)
+        assert (a.free_pages, a.available_pages, a._reserved_total,
+                a.refcount(a.seq_pages("p")[0])) == before
+        assert "c" not in a._seqs
+        _check_invariants(a)
+
+    def test_fork_zero_share_is_allocate(self):
+        a = PageBlockAllocator(num_pages=5, page_size=4, pages_per_seq=4)
+        a.allocate("p", 4)
+        a.fork("p", "c", share_tokens=0, total_tokens=4)
+        a.extend("c", 4)
+        assert a.refcount(a.seq_pages("c")[0]) == 1
+        _check_invariants(a)
+
+
+class TestStatsAndGauges:
+    def test_fragmentation_counts_tail_waste(self):
+        a = PageBlockAllocator(num_pages=9, page_size=8, pages_per_seq=4)
+        a.allocate("s", 9)
+        a.extend("s", 9)                 # 2 pages, 9/16 slots live
+        st = a.stats()
+        assert st["pages_used"] == 2
+        assert st["fragmentation"] == pytest.approx(1 - 9 / 16)
+        assert st["utilization"] == pytest.approx(2 / 8)
+
+    def test_shared_pages_counted_once(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("p", 8)
+        a.extend("p", 8)
+        a.fork("p", "c", share_tokens=8, total_tokens=8)
+        st = a.stats()
+        assert st["pages_used"] == 2     # physically two pages
+        assert st["fragmentation"] == 0.0
+
+    def test_gauges_published(self):
+        from paddle_tpu import serving as srv
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("s", 8)
+        a.extend("s", 8)
+        # extend is the per-token hot path and does not auto-publish;
+        # the engine publishes once per step
+        a.publish_gauges()
+        m = srv.metrics()
+        assert m["serving.engine.pages_used"]["series"][0]["value"] == 2
+        assert m["serving.engine.page_utilization"]["series"][0]["value"] \
+            == pytest.approx(2 / 8)

@@ -162,6 +162,26 @@ class TestLlamaInt4:
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                    atol=1e-4)
 
+    def test_int4_kernel_unaligned_n_matches_dequant(self):
+        # the packed kernel tiles N in 128-lane blocks; a non-128-multiple
+        # N (the vocab-16032 lm-head shape, scaled down) used to fall back
+        # to the bf16 _int4_halves path — now it zero-pads to the next 128
+        # inside the launch and slices back, and must stay EXACT against
+        # the whole-dequant reference
+        from paddle_tpu.ops.quant import (weight_quantize,
+                                          weight_dequantize,
+                                          weight_only_linear)
+        rng = np.random.RandomState(1)
+        for N in (160, 8, 136):
+            w = jnp.asarray(rng.randn(32, N), jnp.float32)
+            h = jnp.asarray(rng.randn(3, 32), jnp.float32)
+            q4, s = weight_quantize(w, algo="weight_only_int4")
+            got = weight_only_linear(h, q4, s, algo="weight_only_int4")
+            exp = h @ weight_dequantize(q4, s, algo="weight_only_int4")
+            assert got.shape == (3, N)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                       atol=1e-4, err_msg=f"N={N}")
+
     def test_int4_body_matches_dequantized_reference(self, model):
         # the MECHANISM must be exact: running the int4 body equals
         # running the fp body on the SAME quantized weights dequantized

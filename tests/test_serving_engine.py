@@ -1,0 +1,240 @@
+"""Continuous-batching ServingEngine: exact-match vs solo
+generate_cached under seeded join/leave traces (llama, gpt, mla),
+compile-once decode (no retrace per join/leave), prefix-sharing
+exactness, and the Config-driven deadline/backpressure paths."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import resilience as res
+from paddle_tpu.generation import generate_cached
+from paddle_tpu.inference import Config
+from paddle_tpu.serving import ServingEngine
+
+
+def _solo(model, prompt, max_new):
+    out, _ = generate_cached(model, paddle.to_tensor(prompt[None]),
+                             max_new_tokens=max_new,
+                             decode_strategy="greedy_search")
+    return out.numpy()[0]
+
+
+def _trace(V, n, seed, smin=2, smax=11, mmin=2, mmax=7):
+    """Seeded request trace: (prompt, max_new, submit_at_step)."""
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, V, rng.randint(smin, smax)).astype(np.int32),
+             int(rng.randint(mmin, mmax)), int(rng.randint(0, 4)))
+            for _ in range(n)]
+
+
+def _run_trace(model, V, n, seed, **engine_kw):
+    """Drive a seeded join/leave trace; return ({rid: result},
+    {rid: solo_reference}, engine)."""
+    trace = _trace(V, n, seed)
+    eng = ServingEngine(model, **engine_kw)
+    ref, pending = {}, list(enumerate(trace))
+    results, step = {}, 0
+    while pending or eng.has_work():
+        still = []
+        for i, (prompt, max_new, at) in pending:
+            if at <= step:
+                eng.add_request(prompt, max_new_tokens=max_new,
+                                request_id=i)
+                ref[i] = _solo(model, prompt, max_new)
+            else:
+                still.append((i, (prompt, max_new, at)))
+        pending = still
+        eng.step()
+        results.update(eng.collect())
+        step += 1
+    return results, ref, eng
+
+
+class TestExactMatch:
+    """Acceptance: every request's engine output equals its solo
+    generate_cached greedy output, with requests joining and leaving
+    mid-decode."""
+
+    def test_llama_seeded_trace(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        c = llama_tiny_config(num_hidden_layers=2)
+        m = LlamaForCausalLM(c)
+        m.eval()
+        results, ref, eng = _run_trace(m, c.vocab_size, 5, seed=1,
+                                       max_slots=2, page_size=4,
+                                       prefill_chunk=4)
+        assert set(results) == set(ref)
+        for rid in ref:
+            np.testing.assert_array_equal(results[rid], ref[rid])
+        # no retrace per join/leave: one decode program, one prefill
+        assert eng._jit_decode._cache_size() == 1
+        assert eng._jit_prefill._cache_size() == 1
+
+    def test_gpt_seeded_trace(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+        paddle.seed(0)
+        c = gpt_tiny_config(max_position_embeddings=64)
+        m = GPTForCausalLM(c)
+        m.eval()
+        results, ref, eng = _run_trace(m, c.vocab_size, 4, seed=2,
+                                       max_slots=2, page_size=4,
+                                       prefill_chunk=4)
+        for rid in ref:
+            np.testing.assert_array_equal(results[rid], ref[rid])
+        assert eng._jit_decode._cache_size() == 1
+
+    def test_mla_seeded_trace(self):
+        from paddle_tpu.models.deepseek import (DeepSeekV2ForCausalLM,
+                                                deepseek_v2_tiny_config)
+        paddle.seed(0)
+        c = deepseek_v2_tiny_config(moe_dropless=True, num_hidden_layers=2)
+        m = DeepSeekV2ForCausalLM(c)
+        m.eval()
+        results, ref, eng = _run_trace(m, c.vocab_size, 4, seed=3,
+                                       max_slots=2, page_size=4,
+                                       prefill_chunk=4)
+        for rid in ref:
+            np.testing.assert_array_equal(results[rid], ref[rid])
+        assert eng._jit_decode._cache_size() == 1
+
+    def test_trace_deterministic_across_runs(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        c = llama_tiny_config(num_hidden_layers=1)
+        m = LlamaForCausalLM(c)
+        m.eval()
+        r1, _, _ = _run_trace(m, c.vocab_size, 4, seed=9, max_slots=2,
+                              page_size=4, prefill_chunk=4)
+        r2, _, _ = _run_trace(m, c.vocab_size, 4, seed=9, max_slots=2,
+                              page_size=4, prefill_chunk=4)
+        assert set(r1) == set(r2)
+        for rid in r1:
+            np.testing.assert_array_equal(r1[rid], r2[rid])
+
+
+class TestEngineSemantics:
+    @pytest.fixture(scope="class")
+    def model(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=1))
+        m.eval()
+        return m
+
+    def test_eos_stops_and_pads(self, model):
+        V = model.config.vocab_size
+        rng = np.random.RandomState(5)
+        prompt = rng.randint(0, V, 5).astype(np.int32)
+        first = _solo(model, prompt, 1)
+        eos = int(first[0])
+        eng = ServingEngine(model, max_slots=2, page_size=4,
+                            prefill_chunk=4)
+        r = eng.add_request(prompt, max_new_tokens=5, eos_token_id=eos)
+        out = eng.run_to_completion()[r.request_id]
+        assert out[0] == eos
+        np.testing.assert_array_equal(out[1:], 0)
+
+    def test_prefix_sharing_exact(self, model):
+        # same long prefix, different tails: the fork rides the donor's
+        # pages (COW) and every stream still exact-matches its solo run
+        V = model.config.vocab_size
+        rng = np.random.RandomState(6)
+        base = rng.randint(0, V, 10).astype(np.int32)
+        p1 = base.copy()
+        p2 = np.concatenate([base[:8], rng.randint(0, V, 3)
+                             .astype(np.int32)])
+        eng = ServingEngine(model, max_slots=2, page_size=4,
+                            prefill_chunk=4, prefix_sharing=True)
+        r1 = eng.add_request(p1, max_new_tokens=4)
+        eng.step()            # admit + start prefill of r1
+        r2 = eng.add_request(p2, max_new_tokens=4)
+        out = eng.run_to_completion()
+        np.testing.assert_array_equal(out[r1.request_id],
+                                      _solo(model, p1, 4))
+        np.testing.assert_array_equal(out[r2.request_id],
+                                      _solo(model, p2, 4))
+        assert r2.shared_tokens > 0
+        assert eng._jit_decode._cache_size() == 1
+
+    def test_backpressure_overloaded_at_door(self, model):
+        cfg = Config()
+        cfg.set_admission(1, queue_timeout_s=0.0)
+        eng = ServingEngine(model, max_slots=2, page_size=4,
+                            prefill_chunk=4, config=cfg)
+        V = model.config.vocab_size
+        p = np.arange(4, dtype=np.int32) % V
+        eng.add_request(p, max_new_tokens=3)
+        with pytest.raises(res.Overloaded):
+            eng.add_request(p, max_new_tokens=3)
+
+    def test_queue_timeout_expires_waiting(self, model):
+        cfg = Config()
+        cfg.set_admission(1, queue_timeout_s=0.02)
+        eng = ServingEngine(model, max_slots=2, page_size=4,
+                            prefill_chunk=4, config=cfg)
+        V = model.config.vocab_size
+        p = np.arange(4, dtype=np.int32) % V
+        r1 = eng.add_request(p, max_new_tokens=8)
+        r2 = eng.add_request(p, max_new_tokens=8)   # queues behind r1
+        out = eng.run_to_completion()
+        assert isinstance(out[r2.request_id], res.Overloaded)
+        assert out[r1.request_id].shape == (8,)
+
+    def test_deadline_partial_result(self, model):
+        cfg = Config()
+        cfg.set_deadline(1e-6)
+        eng = ServingEngine(model, max_slots=2, page_size=4,
+                            prefill_chunk=4, config=cfg)
+        V = model.config.vocab_size
+        p = np.arange(4, dtype=np.int32) % V
+        r = eng.add_request(p, max_new_tokens=4)
+        out = eng.run_to_completion()[r.request_id]
+        assert isinstance(out, res.TimeoutResult) and not out
+        assert out.kind == "serving_engine"
+        assert out.partial.shape == (4,)
+
+    def test_pool_exhaustion_waits_not_corrupts(self, model):
+        # pool sized for ~one sequence: the second request waits for the
+        # first to free its pages, then completes exactly
+        V = model.config.vocab_size
+        rng = np.random.RandomState(8)
+        p1 = rng.randint(0, V, 6).astype(np.int32)
+        p2 = rng.randint(0, V, 6).astype(np.int32)
+        eng = ServingEngine(model, max_slots=2, page_size=4,
+                            prefill_chunk=4, num_pages=4,
+                            max_context=12, prefix_sharing=False)
+        r1 = eng.add_request(p1, max_new_tokens=3)
+        r2 = eng.add_request(p2, max_new_tokens=3)
+        out = eng.run_to_completion()
+        np.testing.assert_array_equal(out[r1.request_id],
+                                      _solo(model, p1, 3))
+        np.testing.assert_array_equal(out[r2.request_id],
+                                      _solo(model, p2, 3))
+
+    def test_context_overflow_rejected(self, model):
+        eng = ServingEngine(model, max_slots=1, page_size=4,
+                            max_context=8)
+        with pytest.raises(ValueError, match="max_context"):
+            eng.add_request(np.arange(6, dtype=np.int32),
+                            max_new_tokens=6)
+
+    def test_metrics_slice(self, model):
+        from paddle_tpu import serving as srv
+        V = model.config.vocab_size
+        eng = ServingEngine(model, max_slots=2, page_size=4,
+                            prefill_chunk=4)
+        r = eng.add_request(np.arange(5, dtype=np.int32) % V,
+                            max_new_tokens=3)
+        eng.run_to_completion()
+        m = srv.metrics()
+        toks = {s["labels"]["phase"]: s["value"]
+                for s in m["serving.engine.tokens"]["series"]}
+        assert toks["prefill"] >= 5 and toks["decode"] >= 2
+        outcomes = {s["labels"]["outcome"]: s["value"]
+                    for s in m["serving.engine.requests"]["series"]}
+        assert outcomes.get("completed", 0) >= 1

@@ -80,6 +80,11 @@ MODULE_ROLES = {
                 "PT001-PT006 over the package source (docs/ANALYSIS.md; "
                 "CLI tools/paddlelint.py; no upstream equivalent — "
                 "covers tracer-leak/retrace/host-sync classes JAX adds)",
+    "serving": "continuous-batching engine: paged KV block allocator "
+               "(refcount/COW prefix sharing), FCFS in-flight scheduler, "
+               "fixed-shape jitted decode over the paged kernel "
+               "(docs/SERVING.md; upstream: FastDeploy/PaddleNLP "
+               "PagedAttention serving)",
 }
 
 

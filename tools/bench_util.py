@@ -133,6 +133,38 @@ def write_resilience_report(path: str, extra: dict | None = None) -> dict:
     return report
 
 
+def write_serving_report(path: str, extra: dict | None = None) -> dict:
+    """Dump the serving.engine.* metric slice after a continuous-batching
+    run (docs/SERVING.md): requests by outcome, prefill/decode token and
+    step counts, page-pool utilization/fragmentation, COW copies and
+    shared prefix tokens. The totals line makes 'did every admitted
+    request complete' a one-field check; pass the throughput row as
+    `extra` so the artifact records rate AND what the engine actually did
+    (shares, copies, pool pressure) in one file. Returns the report dict;
+    writes JSON to `path`."""
+    import json
+    import os
+
+    from paddle_tpu import serving as srv
+
+    snap = srv.metrics()
+    totals = {}
+    for name, m in snap.items():
+        if m.get("kind") == "counter":
+            totals[name] = sum(s["value"] for s in m["series"])
+    report = {
+        "totals": totals,
+        "metrics": snap,
+    }
+    if extra:
+        report.update(extra)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    return report
+
+
 def write_watchdog_report(path: str, extra: dict | None = None) -> dict:
     """Dump the watchdog.* metric slice plus the live flight-recorder ring
     after a run (docs/RESILIENCE.md): collectives recorded, timeouts per
