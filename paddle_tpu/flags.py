@@ -170,6 +170,17 @@ define_flag("FLAGS_metrics", True,
             "counters/gauges/histograms from ops dispatch, jit caches, "
             "trainer, serving and collectives. Off = every instrumented "
             "site degrades to one attribute test (near-zero overhead)")
+define_flag("FLAGS_request_tracing", True,
+            "record per-request / per-train-step span timelines "
+            "(paddle_tpu.observability.tracing): enqueue/admit/prefill/"
+            "token events in the serving engine and data/fwd/bwd/opt "
+            "phases in the trainer, with chrome-trace export and "
+            "TTFT/TPOT/e2e SLO histograms. Off = every stamp degrades "
+            "to one attribute test (near-zero overhead)")
+define_flag("FLAGS_trace_ring_size", 2048,
+            "finished request/step traces kept in the in-memory ring "
+            "buffer for export (oldest evicted first)",
+            validator=lambda v: v >= 1)
 define_flag("FLAGS_eager_op_cache_size", 4096,
             "max entries in the per-op jitted computation cache")
 define_flag("FLAGS_fault_spec", "",

@@ -503,3 +503,13 @@ class StepLogger:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+# per-request / per-train-step span timelines (imported last: tracing
+# builds on the registry, DEFAULT_BUCKETS and the span-id sequence above)
+from . import tracing                                    # noqa: E402
+from .tracing import (RequestTrace, TraceRecorder,       # noqa: E402,F401
+                      percentile, percentiles, slo_summary)
+
+__all__ += ["tracing", "RequestTrace", "TraceRecorder", "percentile",
+            "percentiles", "slo_summary"]

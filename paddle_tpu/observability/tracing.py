@@ -1,0 +1,516 @@
+"""paddle_tpu.observability.tracing — per-request span timelines and SLO
+histograms (ISSUE 6 tentpole).
+
+The metrics registry (``observability``) answers "how much / how fast in
+aggregate"; this module answers "what happened to request 17": a
+`TraceRecorder` keyed by request id collects monotonic `TraceEvent`
+stamps from the serving path (enqueue → admit → prefill chunks → one
+`token` event per decode step → finish/timeout/overloaded/refused, plus
+copy-on-write page events) and from the trainer (data/fwd/bwd/opt phase
+events per optimizer step), so one timeline covers both workloads.
+
+Terminal events derive the serving SLOs a serving tier is operated by
+and observe them into registry histograms:
+
+  - ``serving.engine.queue_wait_seconds``  (enqueue → admit)
+  - ``serving.engine.ttft_seconds``        (enqueue → first token)
+  - ``serving.engine.tpot_seconds``        (inter-token, steady decode)
+  - ``serving.engine.e2e_seconds``         (enqueue → completion)
+
+`percentile()` / `percentiles()` compute p50/p90/p99 from the cumulative
+bucket counts (linear interpolation within the landing bucket — exact
+whenever observations sit on bucket bounds), and `slo_summary()` renders
+the standard serving table. `TraceRecorder.export_chrome_trace` writes
+the timelines as chrome-trace JSON whose span ids share the namespace
+(and the ``name[span=<pid>-<seq>]`` convention) of the host-profiler
+events `observability.span` emits, so request rows and host-profiler
+spans correlate in one viewer; each stamp taken inside an engine step
+additionally carries the step's host span id in its args.
+
+Overhead contract (same as the metrics layer): every entry point checks
+the cached ``FLAGS_request_tracing`` flag object FIRST, so with tracing
+off a stamp costs one function call + one attribute test. Gated at <5%
+alongside the metrics gate in tests/test_observability.py::TestOverhead.
+
+Thread discipline (paddlelint PT006): all recorder state — the live
+table, the finished-trace ring, the exporter file handle — is touched
+only under ``self._lock``; the optional background flush thread
+(`start_exporter`) shares exactly that state and that lock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+from .. import flags as _flags
+from . import DEFAULT_BUCKETS, Histogram, _span_seq, registry
+
+__all__ = ["TraceEvent", "RequestTrace", "TraceRecorder", "recorder",
+           "enabled", "set_enabled", "percentile", "percentiles",
+           "slo_summary", "SLO_METRICS"]
+
+_FLAG = _flags._registry["FLAGS_request_tracing"]
+
+
+def enabled() -> bool:
+    """Whether trace stamps are recorded (FLAGS_request_tracing)."""
+    return _FLAG.value
+
+
+def set_enabled(on: bool) -> None:
+    _flags.set_flags({"FLAGS_request_tracing": bool(on)})
+
+
+def _now_us() -> int:
+    # same clock family as the host profiler's pure-python fallback
+    # (perf_counter_ns // 1000), so exported timelines share an epoch
+    return time.perf_counter_ns() // 1000
+
+
+# the four serving SLO histograms; registered here so importing the
+# tracing module is what creates them (engine/scheduler only stamp)
+SLO_METRICS: Tuple[str, ...] = (
+    "serving.engine.queue_wait_seconds",
+    "serving.engine.ttft_seconds",
+    "serving.engine.tpot_seconds",
+    "serving.engine.e2e_seconds",
+)
+_H_QWAIT = registry().histogram(
+    "serving.engine.queue_wait_seconds",
+    "enqueue -> admit wait per request", buckets=DEFAULT_BUCKETS)
+_H_TTFT = registry().histogram(
+    "serving.engine.ttft_seconds",
+    "enqueue -> first generated token per request",
+    buckets=DEFAULT_BUCKETS)
+_H_TPOT = registry().histogram(
+    "serving.engine.tpot_seconds",
+    "steady-state inter-token latency per request "
+    "((last - first token) / (tokens - 1))", buckets=DEFAULT_BUCKETS)
+_H_E2E = registry().histogram(
+    "serving.engine.e2e_seconds",
+    "enqueue -> completion per finished request", buckets=DEFAULT_BUCKETS)
+
+
+class TraceEvent:
+    """One monotonic stamp: name, microsecond timestamp, optional meta
+    (token index, chunk size, host-profiler span id, explicit dur_us)."""
+
+    __slots__ = ("name", "t_us", "meta")
+
+    def __init__(self, name: str, t_us: int,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.t_us = int(t_us)
+        self.meta = meta
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "t_us": self.t_us}
+        if self.meta:
+            d.update(self.meta)
+        return d
+
+    def __repr__(self):
+        return f"TraceEvent({self.name!r}, t_us={self.t_us})"
+
+
+class RequestTrace:
+    """The event timeline of one request (or one train step).
+
+    Events are appended by the owning `TraceRecorder` under its lock;
+    readers get copies via `timeline()`. Derived latencies return None
+    until the required events exist.
+    """
+
+    __slots__ = ("request_id", "kind", "span_id", "outcome", "meta",
+                 "_events")
+
+    def __init__(self, request_id, kind: str = "request",
+                 meta: Optional[Dict[str, Any]] = None):
+        self.request_id = request_id
+        self.kind = kind
+        # same namespace + format as observability.span host spans
+        self.span_id = f"{os.getpid()}-{next(_span_seq)}"
+        self.outcome: Optional[str] = None
+        self.meta = dict(meta) if meta else {}
+        self._events: List[TraceEvent] = []
+
+    # -- queries -----------------------------------------------------------
+    def timeline(self) -> List[TraceEvent]:
+        return list(self._events)
+
+    def first(self, name: str) -> Optional[TraceEvent]:
+        for e in self._events:
+            if e.name == name:
+                return e
+        return None
+
+    def last(self, name: str) -> Optional[TraceEvent]:
+        for e in reversed(self._events):
+            if e.name == name:
+                return e
+        return None
+
+    def count(self, name: str) -> int:
+        return sum(e.name == name for e in self._events)
+
+    # -- derived SLOs ------------------------------------------------------
+    def _gap_s(self, a: Optional[TraceEvent],
+               b: Optional[TraceEvent]) -> Optional[float]:
+        if a is None or b is None:
+            return None
+        return (b.t_us - a.t_us) / 1e6
+
+    def queue_wait_s(self) -> Optional[float]:
+        return self._gap_s(self.first("enqueue"), self.first("admit"))
+
+    def ttft_s(self) -> Optional[float]:
+        return self._gap_s(self.first("enqueue"), self.first("token"))
+
+    def tpot_s(self) -> Optional[float]:
+        n = self.count("token")
+        if n < 2:
+            return None
+        gap = self._gap_s(self.first("token"), self.last("token"))
+        return gap / (n - 1) if gap is not None else None
+
+    def e2e_s(self) -> Optional[float]:
+        if not self._events:
+            return None
+        return self._gap_s(self.first("enqueue"), self._events[-1])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"request_id": self.request_id, "kind": self.kind,
+                "span_id": self.span_id, "outcome": self.outcome,
+                "meta": self.meta,
+                "queue_wait_s": self.queue_wait_s(),
+                "ttft_s": self.ttft_s(), "tpot_s": self.tpot_s(),
+                "e2e_s": self.e2e_s(),
+                "events": [e.to_dict() for e in self._events]}
+
+    def __repr__(self):
+        return (f"RequestTrace(id={self.request_id!r}, kind={self.kind}, "
+                f"events={len(self._events)}, outcome={self.outcome})")
+
+
+_TERMINAL_OBSERVES_E2E = ("finish",)
+
+
+class TraceRecorder:
+    """Process-wide request/step timeline recorder.
+
+    All mutation goes through `begin` / `stamp` / `finish`, each gated on
+    FLAGS_request_tracing first. Finished traces move to a bounded ring
+    (FLAGS_trace_ring_size, oldest evicted) so a long-lived serving
+    process cannot grow without bound. An optional background exporter
+    thread drains finished traces to JSONL; it shares the same lock as
+    every other accessor (paddlelint PT006 discipline).
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(_flags.flag("FLAGS_trace_ring_size"))
+        self._lock = threading.Lock()
+        self._live: Dict[Any, RequestTrace] = {}
+        self._done: deque = deque(maxlen=int(capacity))
+        self._host_span: Optional[str] = None
+        self._export_f = None
+        self._export_thread: Optional[threading.Thread] = None
+        self._export_stop: Optional[threading.Event] = None
+        self._pending_export: deque = deque()
+
+    # ------------------------------------------------------------ recording
+    def begin(self, request_id, kind: str = "request",
+              **meta) -> Optional[RequestTrace]:
+        """Open a trace for `request_id` (replacing any live one) and
+        stamp nothing; returns None with tracing off."""
+        if not _FLAG.value:
+            return None
+        tr = RequestTrace(request_id, kind=kind, meta=meta or None)
+        with self._lock:
+            self._live[request_id] = tr
+        return tr
+
+    def stamp(self, request_id, name: str, **meta) -> None:
+        """Append one monotonic event to the live trace of `request_id`;
+        silently ignored when tracing is off or the id is unknown (a
+        request admitted before tracing was switched on)."""
+        if not _FLAG.value:
+            return
+        t = _now_us()
+        with self._lock:
+            tr = self._live.get(request_id)
+            if tr is None:
+                return
+            hs = self._host_span
+            if hs is not None and "host_span" not in meta:
+                meta["host_span"] = hs
+            tr._events.append(TraceEvent(name, t, meta or None))
+
+    def finish(self, request_id, outcome: str = "finish", **meta) -> None:
+        """Stamp the terminal event, derive the SLOs into the registry
+        histograms, and move the trace to the finished ring. Overloaded /
+        Timeout / refused requests go through here too — they appear in
+        the timeline instead of vanishing."""
+        if not _FLAG.value:
+            return
+        self.stamp(request_id, outcome, **meta)
+        with self._lock:
+            tr = self._live.pop(request_id, None)
+            if tr is None:
+                return
+            tr.outcome = outcome
+            self._done.append(tr)
+            if self._export_f is not None:
+                self._pending_export.append(tr)
+        if tr.kind != "request":
+            return
+        qw, ttft, tpot = (tr.queue_wait_s(), tr.ttft_s(), tr.tpot_s())
+        if qw is not None:
+            _H_QWAIT.observe(qw)
+        if ttft is not None:
+            _H_TTFT.observe(ttft)
+        if tpot is not None:
+            _H_TPOT.observe(tpot)
+        if outcome in _TERMINAL_OBSERVES_E2E:
+            e2e = tr.e2e_s()
+            if e2e is not None:
+                _H_E2E.observe(e2e)
+
+    def set_host_span(self, span_id: Optional[str]) -> None:
+        """Record the host-profiler span id of the engine step currently
+        executing; subsequent stamps carry it for trace correlation."""
+        if not _FLAG.value:
+            return
+        with self._lock:
+            self._host_span = span_id
+
+    # -------------------------------------------------------------- queries
+    def trace(self, request_id) -> Optional[RequestTrace]:
+        """Most recent trace for `request_id`: live first, then the
+        newest matching finished one."""
+        with self._lock:
+            tr = self._live.get(request_id)
+            if tr is not None:
+                return tr
+            for t in reversed(self._done):
+                if t.request_id == request_id:
+                    return t
+        return None
+
+    def live(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._live.values())
+
+    def finished(self, kind: Optional[str] = None) -> List[RequestTrace]:
+        with self._lock:
+            done = list(self._done)
+        return [t for t in done if kind is None or t.kind == kind]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._live.clear()
+            self._done.clear()
+            self._pending_export.clear()
+            self._host_span = None
+
+    # ------------------------------------------------------- chrome export
+    def export_chrome_trace(self, path: str,
+                            include_live: bool = True) -> int:
+        """Write every trace as chrome-trace JSON: one `tid` row per
+        request/step, an enclosing lifetime span named
+        ``<kind>:<id>[span=<span_id>]`` (the observability.span naming
+        convention, so ids join against host-profiler exports), phase
+        spans (queue / prefill / decode or the trainer phases), and an
+        instant per point event. Returns the event count; the file
+        round-trips through `profiler.load_profiler_result`."""
+        with self._lock:
+            traces = list(self._done) + \
+                (list(self._live.values()) if include_live else [])
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = []
+        for tid, tr in enumerate(traces, start=1):
+            evs = tr.timeline()
+            if not evs:
+                continue
+            t0, t1 = evs[0].t_us, evs[-1].t_us
+            args = {"span_id": tr.span_id, "outcome": tr.outcome}
+            args.update(tr.meta)
+            events.append({
+                "name": f"{tr.kind}:{tr.request_id}[span={tr.span_id}]",
+                "ph": "X", "pid": pid, "tid": tid, "ts": t0,
+                "dur": max(t1 - t0, 1), "cat": tr.kind, "args": args})
+            events.extend(self._phase_events(tr, evs, pid, tid))
+            for e in evs:
+                rec = {"name": e.name, "ph": "i", "pid": pid, "tid": tid,
+                       "ts": e.t_us, "s": "t", "cat": "event"}
+                if e.meta:
+                    rec["args"] = dict(e.meta)
+                    dur = e.meta.get("dur_us")
+                    if dur:
+                        rec.update(ph="X", dur=int(dur),
+                                   ts=e.t_us - int(dur), cat="phase")
+                events.append(rec)
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"traceEvents": events}, f)
+        return len(events)
+
+    @staticmethod
+    def _phase_events(tr: RequestTrace, evs: List[TraceEvent], pid: int,
+                      tid: int) -> List[Dict[str, Any]]:
+        """Queue / prefill / decode phase spans for request traces (the
+        trainer stamps its phases with explicit dur_us instead)."""
+        if tr.kind != "request":
+            return []
+        out = []
+        enq, adm = tr.first("enqueue"), tr.first("admit")
+        tok1, tokn = tr.first("token"), tr.last("token")
+        spans = [("queue", enq, adm or (evs[-1] if enq else None)),
+                 ("prefill", adm, tok1), ("decode", tok1, tokn)]
+        for name, a, b in spans:
+            if a is None or b is None or b.t_us < a.t_us:
+                continue
+            out.append({"name": name, "ph": "X", "pid": pid, "tid": tid,
+                        "ts": a.t_us, "dur": max(b.t_us - a.t_us, 1),
+                        "cat": "phase",
+                        "args": {"span_id": tr.span_id}})
+        return out
+
+    # -------------------------------------------------- background export
+    def start_exporter(self, path: str,
+                       interval_s: float = 1.0) -> None:
+        """Start the background flush thread: finished traces are
+        appended to `path` as JSONL (one trace per line). Idempotent per
+        recorder; `stop_exporter` joins the thread and closes the file."""
+        with self._lock:
+            if self._export_thread is not None:
+                return
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            self._export_f = open(path, "a", encoding="utf-8")
+            self._export_stop = threading.Event()
+            stop = self._export_stop
+            t = threading.Thread(
+                target=self._export_loop, args=(stop, float(interval_s)),
+                name="trace-exporter", daemon=True)
+            self._export_thread = t
+        t.start()
+
+    def _export_loop(self, stop: threading.Event,
+                     interval_s: float) -> None:
+        while not stop.wait(interval_s):
+            self._flush_pending()
+        self._flush_pending()
+
+    def _flush_pending(self) -> None:
+        # drain + write under the one recorder lock: the flush thread
+        # touches no state outside it (paddlelint PT006)
+        with self._lock:
+            if self._export_f is None:
+                return
+            while self._pending_export:
+                tr = self._pending_export.popleft()
+                self._export_f.write(json.dumps(tr.to_dict()) + "\n")
+            self._export_f.flush()
+
+    def stop_exporter(self) -> None:
+        with self._lock:
+            t, stop = self._export_thread, self._export_stop
+            self._export_thread = self._export_stop = None
+        if t is None:
+            return
+        stop.set()
+        t.join(timeout=5.0)
+        self._flush_pending()
+        with self._lock:
+            if self._export_f is not None:
+                self._export_f.close()
+                self._export_f = None
+
+
+_default_recorder = TraceRecorder()
+
+
+def recorder() -> TraceRecorder:
+    """The process-wide recorder the serving engine and trainer stamp
+    into (module-level singleton, assigned once at import — readers
+    never mutate the binding)."""
+    return _default_recorder
+
+
+# ---------------------------------------------------------------------------
+# percentiles from cumulative buckets
+# ---------------------------------------------------------------------------
+
+def _hist_state(h: Union[Histogram, Mapping[str, Any]],
+                buckets: Optional[Sequence[float]] = None):
+    """(bounds, per-bucket counts, total) from a Histogram or a snapshot
+    series dict ({'counts': [...], 'count': n} + buckets argument)."""
+    if isinstance(h, Histogram):
+        with h._lock:
+            return h.buckets, list(h._counts), h._count
+    if buckets is None:
+        raise ValueError("snapshot series needs explicit buckets")
+    return tuple(buckets), list(h["counts"]), int(h["count"])
+
+
+def percentile(h: Union[Histogram, Mapping[str, Any]], q: float,
+               buckets: Optional[Sequence[float]] = None
+               ) -> Optional[float]:
+    """q-th percentile (0..100) from cumulative bucket counts.
+
+    Linear interpolation inside the landing bucket (the first bucket's
+    lower edge is 0) — exact whenever observations sit on bucket bounds.
+    Returns None on an empty histogram; a percentile landing in the +Inf
+    bucket clamps to the largest finite bound (the Prometheus
+    `histogram_quantile` convention)."""
+    if not 0 <= q <= 100:
+        raise ValueError(f"percentile q must be in [0, 100], got {q}")
+    bounds, counts, total = _hist_state(h, buckets)
+    if total == 0:
+        return None
+    target = q / 100.0 * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if cum + c >= target and c > 0:
+            if i >= len(bounds):          # +Inf bucket: clamp
+                return float(bounds[-1])
+            lo = 0.0 if i == 0 else float(bounds[i - 1])
+            hi = float(bounds[i])
+            return lo + (hi - lo) * (target - cum) / c
+        cum += c
+    return float(bounds[-1])
+
+
+def percentiles(h: Union[Histogram, Mapping[str, Any]],
+                qs: Sequence[float] = (50, 90, 99),
+                buckets: Optional[Sequence[float]] = None
+                ) -> Dict[str, Optional[float]]:
+    return {f"p{g:g}": percentile(h, g, buckets=buckets) for g in qs}
+
+
+def slo_summary(names: Sequence[str] = SLO_METRICS, reg=None,
+                qs: Sequence[float] = (50, 90, 99)) -> Dict[str, Any]:
+    """{metric: {count, mean, p50, p90, p99}} for the serving SLO
+    histograms (or any histogram names passed); metrics that never
+    observed report count 0 and None quantiles."""
+    reg = reg or registry()
+    out: Dict[str, Any] = {}
+    for name in names:
+        h = reg._metrics.get(name) if name in reg._metrics else None
+        if h is None or h.kind != "histogram":
+            continue
+        with h._lock:
+            count, total = h._count, h._sum
+        row: Dict[str, Any] = {
+            "count": count,
+            "mean": (total / count) if count else None}
+        row.update(percentiles(h, qs))
+        out[name] = row
+    return out

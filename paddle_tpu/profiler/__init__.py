@@ -8,8 +8,6 @@ of the CUPTI tracer) writes TensorBoard-compatible traces. A scheduler
 from __future__ import annotations
 
 import os
-import time
-from collections import defaultdict
 from enum import Enum
 from typing import Callable, Optional, Sequence
 
@@ -17,7 +15,7 @@ from ..native import (RecordEvent, prof_clear, prof_enable,  # noqa: F401
                       prof_event_count, prof_export)
 
 __all__ = ["Profiler", "ProfilerTarget", "RecordEvent", "make_scheduler",
-           "export_chrome_tracing", "SummaryView"]
+           "export_chrome_tracing", "SummaryView", "statistic"]
 # load_profiler_result appended below (__all__ extended there)
 
 
@@ -106,7 +104,9 @@ class Profiler:
         self.timer_only = timer_only
         self.step_num = 0
         self.last_export_path = None
+        self.last_statistic = None
         self._device_trace_dir = None
+        self._last_device_trace_dir = None
         self._recording = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -150,6 +150,7 @@ class Profiler:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+            self._last_device_trace_dir = self._device_trace_dir
             self._device_trace_dir = None
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -173,33 +174,18 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
-        """Aggregate host events into a per-name table (printed + returned)."""
-        import json
-        import tempfile
-        # round-trip through a private temp file that is always unlinked
-        # (the old fixed /tmp/_pt_prof_<pid>.json leaked one file per pid)
-        fd, tmp = tempfile.mkstemp(prefix="_pt_prof_", suffix=".json")
-        try:
-            os.close(fd)
-            prof_export(tmp, pid=os.getpid())
-            with open(tmp) as f:
-                events = json.load(f)["traceEvents"]
-        finally:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-        agg = defaultdict(lambda: [0, 0.0])
-        for e in events:
-            agg[e["name"]][0] += 1
-            agg[e["name"]][1] += e["dur"] / 1000.0
-        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
-        print(f"{'Name':<40}{'Calls':<8}{'Total(ms)':<12}{'Avg(ms)':<12}")
-        for name, (calls, total) in rows:
-            print(f"{name:<40}{calls:<8}{total:<12.3f}"
-                  f"{total / max(calls, 1):<12.3f}")
-        return {name: {"calls": c, "total_ms": t} for name, (c, t)
-                in rows}
+        """Render the per-op statistic table (statistic.summarize over
+        the live host trace, merged with the XPlane dump when a device
+        capture ran) and return the historical {name: {'calls',
+        'total_ms'}} mapping. The full result is kept on
+        `self.last_statistic` for tooling / JSON dumps."""
+        from . import statistic as _statistic
+        res = _statistic.summarize(
+            device_dir=self._device_trace_dir
+            or self._last_device_trace_dir)
+        self.last_statistic = res
+        print(res.render(time_unit=time_unit))
+        return res.compat_table()
 
 
 def load_profiler_result(filename: str):
@@ -214,3 +200,5 @@ def load_profiler_result(filename: str):
 
 
 __all__ += ["load_profiler_result"]
+
+from . import statistic  # noqa: E402,F401  (needs load_profiler_result)

@@ -1,0 +1,260 @@
+"""Profiler statistics (ref: python/paddle/profiler/profiler_statistic.py).
+
+`summarize(result)` turns a captured profile — the host RecordEvent
+trace, a chrome-trace file, or the merged host+XPlane event list — into
+a `StatisticResult`: the per-op summary table (time by op/kernel, call
+counts, min/avg/max, % of wall), a category split (host vs device), a
+step-phase breakdown (the trainer's data/fwd/bwd/opt and the serving
+engine's queue/prefill/decode phase events from
+`observability.tracing`), and memory peaks when events carry byte
+counts in their args. `Profiler.summary()` renders it; `to_json` dumps
+it for tooling (tools/perf_gate.py, FLAGSHIP.md residual tables).
+
+Span-id suffixes (``name[span=<pid>-<seq>]``, the correlation handle
+minted by `observability.span`) are stripped before aggregation so every
+launch of an op lands in one row; the distinct-span count is kept per
+row so fan-out stays visible.
+
+Device events come from the XPlane dump `jax.profiler.start_trace`
+writes under ``<dir>/plugins/profile/<run>/``; `load_xplane_events` is
+best-effort (returns [] when the dir is absent — the CPU-only tier-1
+case) and tags everything it reads ``cat="device"``.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from collections import defaultdict
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Union
+
+__all__ = ["StatisticResult", "summarize", "load_xplane_events",
+           "STEP_PHASES"]
+
+_SPAN_RE = re.compile(r"\[span=[^\]]*\]$")
+
+# phase names stamped by observability.tracing: the trainer's
+# optimizer-step sections and the serving engine's request sections
+STEP_PHASES = ("data", "fwd", "bwd", "opt", "queue", "prefill", "decode")
+
+_MEM_KEYS = ("bytes", "bytes_in_use", "peak_bytes", "allocated_bytes")
+
+
+def _base_name(name: str) -> str:
+    return _SPAN_RE.sub("", name)
+
+
+def _span_id(name: str) -> Optional[str]:
+    m = _SPAN_RE.search(name)
+    return m.group(0)[6:-1] if m else None
+
+
+class StatisticResult:
+    """Aggregated view of one captured profile. `ops` rows are sorted by
+    total time descending; all durations are microseconds internally."""
+
+    def __init__(self, ops: List[Dict[str, Any]],
+                 by_cat: Dict[str, float],
+                 steps: List[Dict[str, Any]],
+                 memory: Dict[str, Any], total_us: float,
+                 event_count: int):
+        self.ops = ops
+        self.by_cat = by_cat
+        self.steps = steps
+        self.memory = memory
+        self.total_us = total_us
+        self.event_count = event_count
+
+    # -- renderers ---------------------------------------------------------
+    def render(self, time_unit: str = "ms", max_rows: int = 40) -> str:
+        div = {"s": 1e6, "ms": 1e3, "us": 1.0}.get(time_unit, 1e3)
+        u = time_unit if time_unit in ("s", "ms", "us") else "ms"
+        out = [f"{'Name':<44}{'Cat':<8}{'Calls':>7}{f'Total({u})':>12}"
+               f"{f'Avg({u})':>11}{f'Min({u})':>11}{f'Max({u})':>11}"
+               f"{'%':>7}"]
+        out.append("-" * len(out[0]))
+        for r in self.ops[:max_rows]:
+            out.append(
+                f"{r['name'][:43]:<44}{r['cat'][:7]:<8}{r['calls']:>7}"
+                f"{r['total_us'] / div:>12.3f}{r['avg_us'] / div:>11.3f}"
+                f"{r['min_us'] / div:>11.3f}{r['max_us'] / div:>11.3f}"
+                f"{r['pct']:>6.1f}%")
+        if len(self.ops) > max_rows:
+            out.append(f"... {len(self.ops) - max_rows} more rows")
+        if self.steps:
+            out.append("")
+            out.append(f"{'Step phase':<20}{'Calls':>7}{f'Total({u})':>12}"
+                       f"{f'Avg({u})':>11}{'%':>7}")
+            out.append("-" * 57)
+            for r in self.steps:
+                out.append(f"{r['phase']:<20}{r['calls']:>7}"
+                           f"{r['total_us'] / div:>12.3f}"
+                           f"{r['avg_us'] / div:>11.3f}{r['pct']:>6.1f}%")
+        if self.by_cat:
+            cats = "  ".join(f"{c}: {t / div:.3f}{u}"
+                             for c, t in sorted(self.by_cat.items()))
+            out.append("")
+            out.append(f"time by category — {cats}")
+        if self.memory.get("peak_bytes"):
+            out.append(f"peak memory: {self.memory['peak_bytes']} bytes "
+                       f"({self.memory.get('peak_name', '?')})")
+        return "\n".join(out)
+
+    def compat_table(self) -> Dict[str, Dict[str, float]]:
+        """The historical Profiler.summary() return shape:
+        {name: {'calls', 'total_ms'}}."""
+        return {r["name"]: {"calls": r["calls"],
+                            "total_ms": r["total_us"] / 1e3}
+                for r in self.ops}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"ops": self.ops, "by_cat": self.by_cat,
+                "steps": self.steps, "memory": self.memory,
+                "total_us": self.total_us,
+                "event_count": self.event_count}
+
+    def to_json(self, path: Optional[str] = None) -> Dict[str, Any]:
+        d = self.to_dict()
+        if path is not None:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(d, f, indent=1)
+        return d
+
+    def __repr__(self):
+        return (f"StatisticResult(ops={len(self.ops)}, "
+                f"events={self.event_count}, "
+                f"total_us={self.total_us:.0f})")
+
+
+def _host_events() -> List[Dict[str, Any]]:
+    """Current host RecordEvent trace via the prof_export round-trip
+    (private temp file, always unlinked — the Profiler.summary hygiene
+    contract)."""
+    import tempfile
+
+    from ..native import prof_export
+    fd, tmp = tempfile.mkstemp(prefix="_pt_prof_", suffix=".json")
+    try:
+        os.close(fd)
+        prof_export(tmp, pid=os.getpid())
+        with open(tmp, encoding="utf-8") as f:
+            return json.load(f).get("traceEvents", [])
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load_xplane_events(trace_dir: str) -> List[Dict[str, Any]]:
+    """Device-side events from a jax.profiler XPlane dump directory:
+    every ``*.trace.json[.gz]`` under ``plugins/profile/`` (the
+    TensorBoard layout) is read and its complete events returned with
+    ``cat="device"``. Best-effort: a missing/empty dir (CPU-only tier-1)
+    returns []."""
+    out: List[Dict[str, Any]] = []
+    if not trace_dir or not os.path.isdir(trace_dir):
+        return out
+    pats = [os.path.join(trace_dir, "plugins", "profile", "*",
+                         "*.trace.json*"),
+            os.path.join(trace_dir, "*.trace.json*")]
+    for pat in pats:
+        for path in sorted(glob.glob(pat)):
+            try:
+                op = gzip.open if path.endswith(".gz") else open
+                with op(path, "rt", encoding="utf-8") as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                continue
+            evs = data.get("traceEvents", data) \
+                if isinstance(data, dict) else data
+            for e in evs:
+                if not isinstance(e, dict) or "name" not in e:
+                    continue
+                e = dict(e)
+                e.setdefault("cat", "device")
+                if e["cat"] != "device":
+                    e["cat"] = "device"
+                out.append(e)
+    return out
+
+
+def summarize(result: Union[None, str, Sequence[Mapping[str, Any]],
+                            Mapping[str, Any]] = None,
+              device_dir: Optional[str] = None) -> StatisticResult:
+    """Build the per-op statistic table from a captured profile.
+
+    `result` may be: None (snapshot the live host RecordEvent trace), a
+    chrome-trace path (as written by `Profiler.export` or
+    `TraceRecorder.export_chrome_trace`), a ``{"traceEvents": [...]}``
+    mapping, or a bare event list (the `load_profiler_result` shape).
+    `device_dir` optionally merges an XPlane dump (see
+    `load_xplane_events`) so device kernel rows sit beside host ops.
+    """
+    if result is None:
+        events = _host_events()
+    elif isinstance(result, str):
+        from . import load_profiler_result
+        events = load_profiler_result(result)
+    elif isinstance(result, Mapping):
+        events = list(result.get("traceEvents", []))
+    else:
+        events = list(result)
+    if device_dir is not None:
+        events = list(events) + load_xplane_events(device_dir)
+
+    agg: Dict[tuple, Dict[str, Any]] = {}
+    by_cat: Dict[str, float] = defaultdict(float)
+    phase_agg: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0])
+    mem_peak, mem_name = 0, None
+    total_us = 0.0
+    n_complete = 0
+    for e in events:
+        if not isinstance(e, dict) or "name" not in e:
+            continue
+        args = e.get("args") or {}
+        for k in _MEM_KEYS:
+            v = args.get(k)
+            if isinstance(v, (int, float)) and v > mem_peak:
+                mem_peak, mem_name = int(v), _base_name(str(e["name"]))
+        if e.get("ph", "X") not in ("X", "B") or "dur" not in e:
+            continue
+        name = _base_name(str(e["name"]))
+        cat = str(e.get("cat", "host"))
+        dur = float(e["dur"])
+        n_complete += 1
+        total_us += dur
+        by_cat[cat] += dur
+        if name in STEP_PHASES:
+            phase_agg[name][0] += 1
+            phase_agg[name][1] += dur
+        row = agg.get((name, cat))
+        if row is None:
+            row = agg[(name, cat)] = {
+                "name": name, "cat": cat, "calls": 0, "total_us": 0.0,
+                "min_us": dur, "max_us": dur, "spans": 0}
+        row["calls"] += 1
+        row["total_us"] += dur
+        row["min_us"] = min(row["min_us"], dur)
+        row["max_us"] = max(row["max_us"], dur)
+        if _span_id(str(e["name"])) is not None:
+            row["spans"] += 1
+    ops = sorted(agg.values(), key=lambda r: -r["total_us"])
+    for r in ops:
+        r["avg_us"] = r["total_us"] / max(r["calls"], 1)
+        r["pct"] = 100.0 * r["total_us"] / total_us if total_us else 0.0
+    steps = [{"phase": p, "calls": c, "total_us": t,
+              "avg_us": t / max(c, 1),
+              "pct": 100.0 * t / total_us if total_us else 0.0}
+             for p, (c, t) in
+             sorted(phase_agg.items(), key=lambda kv: -kv[1][1])]
+    memory: Dict[str, Any] = {"peak_bytes": mem_peak}
+    if mem_name is not None:
+        memory["peak_name"] = mem_name
+    return StatisticResult(ops, dict(by_cat), steps, memory, total_us,
+                           n_complete)

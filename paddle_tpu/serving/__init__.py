@@ -20,15 +20,25 @@ See docs/SERVING.md ("Continuous batching") for sizing and usage.
 from typing import Any, Dict
 
 from .. import observability as _obs
+from ..observability import tracing as _tracing
 from .block_allocator import PageBlockAllocator
 from .engine import ServingEngine
 from .scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "Request", "Scheduler", "PageBlockAllocator",
-           "metrics"]
+           "metrics", "slo"]
 
 
 def metrics() -> Dict[str, Any]:
     """The serving.engine.* slice of the registry snapshot."""
     return {k: v for k, v in _obs.registry().snapshot().items()
             if k.startswith("serving.engine.")}
+
+
+def slo(qs=(50, 90, 99)) -> Dict[str, Any]:
+    """Percentile summary of the per-request SLO histograms the tracing
+    layer derives at each terminal event:
+    {"serving.engine.ttft_seconds": {count, mean, p50, p90, p99}, ...}
+    for queue-wait / TTFT / TPOT / e2e. Histograms with no finished
+    requests yet report count 0 with None quantiles."""
+    return _tracing.slo_summary(qs=qs)

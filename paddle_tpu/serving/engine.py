@@ -30,6 +30,7 @@ import numpy as np
 
 from .. import observability as _obs
 from .. import resilience as _res
+from ..observability import tracing as _tracing
 from ..generation import (_decode_params, _dq, _ffn_apply, _llama_weights,
                           _mm_w)
 from ..ops.paged_attention import append_to_cache, paged_attention
@@ -49,6 +50,7 @@ _ACTIVE = _obs.registry().gauge(
     "serving.engine.active_slots", "slots holding an in-flight request")
 _WAITING = _obs.registry().gauge(
     "serving.engine.waiting", "requests queued for admission")
+_TRACE = _tracing.recorder()
 
 
 def _lcp(a: np.ndarray, b: np.ndarray) -> int:
@@ -236,6 +238,9 @@ class ServingEngine:
             except _res.Overloaded:
                 break   # head-of-line waits for pages; FCFS, no skip
             self.scheduler.admit(req)
+            if share > 0:
+                _TRACE.stamp(req.request_id, "prefix_share", tokens=share,
+                             donor=donor.request_id)
             req.prefill_pos = share
             req.shared_tokens = share
             self._prefill_fifo.append(req)
@@ -255,13 +260,24 @@ class ServingEngine:
         req = self._prefill_fifo[0]
         n = min(self.prefill_chunk, int(req.prompt.size) - req.prefill_pos)
         start = req.prefill_pos
-        self._apply_copies(self.allocator.extend(req.request_id, n))
+        self._apply_copies(self.allocator.extend(req.request_id, n), req)
         ids = np.zeros((1, self.prefill_chunk), np.int32)
         ids[0, :n] = req.prompt[start:start + n]
         table = self.allocator.table(req.request_id)[None]
-        logits, self._pools = self._jit_prefill(
-            self._w, jnp.asarray(ids), self._pools, jnp.asarray(table),
-            np.int32(start), np.int32(n))
+        if _tracing.enabled():
+            # the host span's id rides along on every stamp taken inside
+            # this launch, so request timelines join the profiler trace
+            with _obs.span("serving.engine.prefill_chunk") as sp:
+                logits, self._pools = self._jit_prefill(
+                    self._w, jnp.asarray(ids), self._pools,
+                    jnp.asarray(table), np.int32(start), np.int32(n))
+            _TRACE.set_host_span(sp.span_id)
+            _TRACE.stamp(req.request_id, "prefill_chunk", tokens=n,
+                         start=start)
+        else:
+            logits, self._pools = self._jit_prefill(
+                self._w, jnp.asarray(ids), self._pools, jnp.asarray(table),
+                np.int32(start), np.int32(n))
         req.prefill_pos += n
         if _obs.enabled():
             _STEPS.labels(phase="prefill").inc()
@@ -272,6 +288,7 @@ class ServingEngine:
             req.state = DECODE
             tok = int(np.argmax(np.asarray(logits[0])))
             finished += self._emit(req, tok)
+        _TRACE.set_host_span(None)
         return n, finished
 
     # ------------------------------------------------------------- decode
@@ -286,11 +303,19 @@ class ServingEngine:
         for slot, req in active:
             tok[slot] = req.pending
             lengths[slot] = self.allocator.seq_length(req.request_id)
-            self._apply_copies(self.allocator.extend(req.request_id, 1))
+            self._apply_copies(self.allocator.extend(req.request_id, 1),
+                               req)
             tables[slot] = self.allocator.table(req.request_id)
-        logits, self._pools = self._jit_decode(
-            self._w, jnp.asarray(tok), self._pools, jnp.asarray(lengths),
-            jnp.asarray(tables))
+        if _tracing.enabled():
+            with _obs.span("serving.engine.decode_step") as sp:
+                logits, self._pools = self._jit_decode(
+                    self._w, jnp.asarray(tok), self._pools,
+                    jnp.asarray(lengths), jnp.asarray(tables))
+            _TRACE.set_host_span(sp.span_id)
+        else:
+            logits, self._pools = self._jit_decode(
+                self._w, jnp.asarray(tok), self._pools,
+                jnp.asarray(lengths), jnp.asarray(tables))
         logits = np.asarray(logits)
         if _obs.enabled():
             _STEPS.labels(phase="decode").inc()
@@ -298,12 +323,14 @@ class ServingEngine:
         finished = 0
         for slot, req in active:
             finished += self._emit(req, int(np.argmax(logits[slot])))
+        _TRACE.set_host_span(None)
         return len(active), finished
 
     def _emit(self, req: Request, tok: int) -> int:
         """Record one sampled token; finish on EOS/max-tokens (pages
         freed the same step), else stage it for the next decode step."""
         req.tokens.append(tok)
+        _TRACE.stamp(req.request_id, "token", index=len(req.tokens) - 1)
         done = (req.eos_token_id is not None and tok == req.eos_token_id) \
             or len(req.tokens) >= req.max_new_tokens
         if done:
@@ -316,16 +343,20 @@ class ServingEngine:
         req.finalize()
         self.allocator.free(req.request_id)
         self.scheduler.release(req)
+        timeout = isinstance(req.result, _res.TimeoutResult)
+        _TRACE.finish(req.request_id, "timeout" if timeout else "finish",
+                      tokens=len(req.tokens))
         if _obs.enabled():
-            _REQS.labels(outcome="timeout"
-                         if isinstance(req.result, _res.TimeoutResult)
+            _REQS.labels(outcome="timeout" if timeout
                          else "completed").inc()
 
-    def _apply_copies(self, copies) -> None:
+    def _apply_copies(self, copies, req: Optional[Request] = None) -> None:
         """Apply the allocator's copy-on-write page copies to the device
         pools before the write that triggered them."""
         if not copies:
             return
+        if req is not None:
+            _TRACE.stamp(req.request_id, "cow", pages=len(copies))
         src = np.asarray([c[0] for c in copies])
         dst = np.asarray([c[1] for c in copies])
         if self._family == "mla":

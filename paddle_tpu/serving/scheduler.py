@@ -29,6 +29,9 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .. import resilience as _res
+from ..observability import tracing as _tracing
+
+_TRACE = _tracing.recorder()
 
 __all__ = ["Request", "Scheduler",
            "WAITING", "PREFILL", "DECODE", "FINISHED"]
@@ -141,12 +144,23 @@ class Scheduler:
         `Overloaded` (the Predictor's non-blocking admission gate)."""
         if self.backpressure and self.queue_timeout_s <= 0 \
                 and self.inflight + len(self.waiting) >= self.max_inflight:
+            # refused requests still get a (one-event) timeline so the
+            # trace shows WHY they never produced tokens
+            _TRACE.begin(req.request_id,
+                         prompt_len=int(req.prompt.size),
+                         max_new_tokens=req.max_new_tokens)
+            _TRACE.stamp(req.request_id, "enqueue")
+            _TRACE.finish(req.request_id, "refused",
+                          inflight=self.max_inflight)
             raise _res.Overloaded(
                 f"admission gate full ({self.max_inflight} inflight)")
         req.state = WAITING
         req._enqueued_at = time.monotonic()
         req.start_deadline()
         self.waiting.append(req)
+        _TRACE.begin(req.request_id, prompt_len=int(req.prompt.size),
+                     max_new_tokens=req.max_new_tokens)
+        _TRACE.stamp(req.request_id, "enqueue")
         return req
 
     def expire_waiting(self) -> List[Request]:
@@ -166,10 +180,13 @@ class Scheduler:
                     f"{now - req._enqueued_at:.3f}s > queue_timeout_s="
                     f"{self.queue_timeout_s}")
                 expired.append(req)
+                _TRACE.finish(req.request_id, "overloaded",
+                              waited_s=now - req._enqueued_at)
             elif req.deadline_expired():
                 req.state = FINISHED
                 req.finalize()
                 expired.append(req)
+                _TRACE.finish(req.request_id, "timeout", where="queue")
             else:
                 keep.append(req)
         self.waiting = keep
@@ -194,6 +211,7 @@ class Scheduler:
         req.state = PREFILL
         req.slot = slot
         self.slots[slot] = req
+        _TRACE.stamp(req.request_id, "admit", slot=slot)
         return slot
 
     def release(self, req: Request) -> None:

@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import observability as _obs
 from .. import resilience as _res
+from ..observability import tracing as _tracing
 
 __all__ = ["TrainingArguments", "Trainer"]
 
@@ -52,6 +53,7 @@ _H_GNORM = _obs.registry().histogram(
              100.0, 1e3, 1e4))
 _C_STEPS = _obs.registry().counter(
     "pt_train_steps_total", "optimizer steps taken")
+_TRACE = _tracing.recorder()
 
 
 @dataclasses.dataclass
@@ -131,6 +133,7 @@ class Trainer:
         self._bad_streak = 0
         self._last_good: Optional[Dict[str, Any]] = None
         self._preempted = False
+        self._step_trace = None   # live train-step trace id (tracing)
         paddle.seed(self.args.seed)
 
     # -- construction helpers ------------------------------------------------
@@ -198,6 +201,20 @@ class Trainer:
             return out[0]
         return out
 
+    def _stamp_phase(self, name: str, dur_s: float) -> None:
+        """One step-phase event (data/fwd/bwd/opt) on the current
+        optimizer-step trace (kind='train'): the same mechanism request
+        timelines use, so one chrome-trace export covers both workloads.
+        Phase durations come from the existing metrics timers, so stamps
+        fire only when metrics AND tracing are both enabled."""
+        if not _tracing.enabled():
+            return
+        if self._step_trace is None:
+            gs = self.state["global_step"] + 1
+            self._step_trace = f"train-step-{gs}"
+            _TRACE.begin(self._step_trace, kind="train", step=gs)
+        _TRACE.stamp(self._step_trace, name, dur_us=int(dur_s * 1e6))
+
     def training_step(self, batch) -> float:
         paddle = self.paddle
         mx = _obs.enabled()
@@ -211,10 +228,13 @@ class Trainer:
         if mx:
             t1 = time.perf_counter()
             _T_FWD.observe(t1 - t0)
+            self._stamp_phase("fwd", t1 - t0)
         scaled = loss / self.args.gradient_accumulation_steps
         scaled.backward()
         if mx:
-            _T_BWD.observe(time.perf_counter() - t1)
+            t_bwd = time.perf_counter() - t1
+            _T_BWD.observe(t_bwd)
+            self._stamp_phase("bwd", t_bwd)
         return float(loss.numpy())
 
     def _grad_global_norm(self) -> Optional[float]:
@@ -318,7 +338,9 @@ class Trainer:
                 except StopIteration:
                     break
                 if mx:
-                    _T_DATA.observe(time.perf_counter() - td)
+                    t_data = time.perf_counter() - td
+                    _T_DATA.observe(t_data)
+                    self._stamp_phase("data", t_data)
                 if skip > 0:
                     skip -= 1
                     continue
@@ -349,7 +371,12 @@ class Trainer:
                 if self.lr_scheduler is not None:
                     self.lr_scheduler.step()
                 if mx:
-                    _T_OPT.observe(time.perf_counter() - to)
+                    t_opt = time.perf_counter() - to
+                    _T_OPT.observe(t_opt)
+                    self._stamp_phase("opt", t_opt)
+                    if self._step_trace is not None:
+                        _TRACE.finish(self._step_trace, "finish")
+                        self._step_trace = None
                     _C_STEPS.inc()
                 self.state["global_step"] += 1
                 gs = self.state["global_step"]

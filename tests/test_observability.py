@@ -327,9 +327,11 @@ class TestSubsystems:
 
 class TestOverhead:
     def test_disabled_overhead_under_5pct(self):
+        from paddle_tpu.observability import tracing as tr
         r = Registry()
         c = r.counter("ov_total")
         h = r.histogram("ov_seconds")
+        rec = tr.TraceRecorder(capacity=8)
         a = np.random.RandomState(0).randn(160, 160).astype(np.float32)
         n = 600
 
@@ -341,13 +343,15 @@ class TestOverhead:
 
         def instrumented():
             t0 = time.perf_counter()
-            for _ in range(n):
+            for i in range(n):
                 a.dot(a)
                 c.inc()
                 h.observe(1.0)
+                rec.stamp(i, "token", index=i)
             return time.perf_counter() - t0
 
         obs.set_enabled(False)
+        tr.set_enabled(False)
         try:
             # warm both paths, then interleave rounds and compare the best
             # observation of each (min filters scheduler noise)
@@ -359,7 +363,9 @@ class TestOverhead:
                 ti.append(instrumented())
         finally:
             obs.set_enabled(True)
+            tr.set_enabled(True)
         assert c.value == 0  # the flag really gated recording
+        assert not rec.live() and not rec.finished()  # stamps gated too
         assert min(ti) < min(tp) * 1.05, (
             f"disabled-metrics loop {min(ti):.4f}s vs plain {min(tp):.4f}s "
             f"(+{(min(ti) / min(tp) - 1) * 100:.1f}%)")

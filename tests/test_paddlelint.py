@@ -366,6 +366,45 @@ class TestPT006SharedState:
         """)
         assert "PT006" not in _rules(fs)
 
+    def test_trace_ring_exporter_unguarded_flagged(self):
+        # the observability.tracing background-exporter shape with the
+        # lock REMOVED: flush thread drains a module-level ring — PT006
+        fs = _lint("""
+            import threading
+
+            _ring = []
+
+            def _flush_loop():
+                while _ring:
+                    _ring.pop()
+
+            def start_exporter():
+                threading.Thread(target=_flush_loop,
+                                 daemon=True).start()
+        """)
+        assert "PT006" in _rules(fs)
+        assert any(f.detail == "write:_ring" for f in fs)
+
+    def test_trace_ring_exporter_lock_guarded_ok(self):
+        # the shipped recorder discipline: every ring access from the
+        # flush thread sits under the one module lock
+        fs = _lint("""
+            import threading
+
+            _lock = threading.Lock()
+            _ring = []
+
+            def _flush_loop():
+                with _lock:
+                    while _ring:
+                        _ring.pop()
+
+            def start_exporter():
+                threading.Thread(target=_flush_loop,
+                                 daemon=True).start()
+        """)
+        assert "PT006" not in _rules(fs)
+
 
 # ----------------------------------------------------------- suppression
 

@@ -1,0 +1,137 @@
+"""tools/perf_gate.py: band derivation from the committed BENCH /
+SERVING_BENCH artifacts, pass on current values, fail on a synthetically
+regressed candidate row, and the non-fatal no-artifact path the verify
+wiring relies on."""
+
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perf_gate  # noqa: E402
+
+
+@pytest.fixture()
+def mini_repo(tmp_path):
+    """A scratch repo with one pretrain round + repeats + one serving
+    row, so band math is assertable exactly."""
+    (tmp_path / "docs").mkdir()
+    with open(tmp_path / "BENCH_r01.json", "w") as f:
+        json.dump({"parsed": {"metric": "pretrain_tps", "value": 1000.0}},
+                  f)
+    with open(tmp_path / "BENCH_r02.json", "w") as f:
+        json.dump({"parsed": {"metric": "pretrain_tps", "value": 1010.0}},
+                  f)
+    with open(tmp_path / "docs" / "BENCH_REPEATS_r2.json", "w") as f:
+        json.dump({"metric": "pretrain_tps",
+                   "runs": [995.0, 1005.0, 1015.0],
+                   "r1_band": [990.0, 1020.0]}, f)
+    with open(tmp_path / "docs" / "SERVING_BENCH.json", "w") as f:
+        json.dump({"decode": {"decode_tokens_per_s_per_chip": 200.0},
+                   "note": "not a row"}, f)
+    return str(tmp_path)
+
+
+class TestBands:
+    def test_pretrain_band_is_union_of_runs_and_bands(self, mini_repo):
+        rows = perf_gate.pretrain_rows(mini_repo, margin=0.0)
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["key"] == "pretrain.pretrain_tps"
+        assert r["value"] == 1010.0          # latest round wins
+        assert r["band"] == [990.0, 1020.0]  # union(runs, r1_band)
+        assert r["ok"]
+
+    def test_margin_widens_band(self, mini_repo):
+        r = perf_gate.pretrain_rows(mini_repo, margin=0.01)[0]
+        assert r["band"][0] == pytest.approx(990.0 * 0.99)
+        assert r["band"][1] == pytest.approx(1020.0 * 1.01)
+
+    def test_serving_rows_banded_by_noise(self, mini_repo):
+        rows = perf_gate.serving_rows(mini_repo, noise=0.10)
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["key"] == "serving.decode.decode_tokens_per_s_per_chip"
+        assert r["band"] == [pytest.approx(180.0), pytest.approx(220.0)]
+        assert r["ok"]
+
+    def test_no_repeats_falls_back_to_round_spread(self, mini_repo):
+        os.unlink(os.path.join(mini_repo, "docs",
+                               "BENCH_REPEATS_r2.json"))
+        r = perf_gate.pretrain_rows(mini_repo, margin=0.0)[0]
+        assert r["band"] == [1000.0, 1010.0]
+
+
+class TestCheck:
+    def test_regressed_candidate_fails(self, mini_repo, tmp_path):
+        cand = tmp_path / "cand.json"
+        with open(cand, "w") as f:
+            json.dump({"pretrain.pretrain_tps": 900.0}, f)
+        rc = perf_gate.main(["--repo", mini_repo, "--check", str(cand)])
+        assert rc == 1
+
+    def test_inband_candidate_passes(self, mini_repo, tmp_path):
+        cand = tmp_path / "cand.json"
+        with open(cand, "w") as f:
+            json.dump({"pretrain.pretrain_tps": 1012.0,
+                       "serving.decode.decode_tokens_per_s_per_chip":
+                           190.0}, f)
+        rc = perf_gate.main(["--repo", mini_repo, "--check", str(cand)])
+        assert rc == 0
+
+    def test_above_band_is_rerate_not_failure(self, mini_repo):
+        rows = perf_gate.gate_rows(mini_repo, margin=0.0)
+        out = perf_gate.check_candidate(
+            {"pretrain.pretrain_tps": 5000.0}, rows)
+        assert out[0]["ok"]   # higher-is-better: exceeding band passes
+
+    def test_unknown_key_fails_loudly(self, mini_repo):
+        rows = perf_gate.gate_rows(mini_repo)
+        out = perf_gate.check_candidate({"pretrain.typo_tps": 1.0}, rows)
+        assert not out[0]["ok"]
+        assert out[0]["why"] == "unknown metric key"
+
+
+class TestCli:
+    def test_no_artifacts_exit_zero(self, tmp_path):
+        rc = perf_gate.main(["--repo", str(tmp_path)])
+        assert rc == 0
+
+    def test_self_check_on_committed_artifacts(self, capsys):
+        # the real repo's own artifacts must gate green (the acceptance
+        # criterion + the verify-skill wiring)
+        rc = perf_gate.main(["--repo", REPO])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pretrain." in out and "serving." in out
+
+    def test_synthetic_regression_on_committed_artifacts(self, tmp_path):
+        # copy the real artifacts, regress the pretrain row 20%, expect 1
+        shutil.copytree(os.path.join(REPO, "docs"),
+                        str(tmp_path / "docs"),
+                        ignore=shutil.ignore_patterns("*.md"))
+        import glob
+        for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+            shutil.copy(p, str(tmp_path))
+        latest = sorted(glob.glob(str(tmp_path / "BENCH_r*.json")))[-1]
+        with open(latest) as f:
+            d = json.load(f)
+        d["parsed"]["value"] *= 0.8
+        with open(latest, "w") as f:
+            json.dump(d, f)
+        rc = perf_gate.main(["--repo", str(tmp_path)])
+        assert rc == 1
+
+    def test_json_mode(self, mini_repo, capsys):
+        rc = perf_gate.main(["--repo", mini_repo, "--json"])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["failed"] == 0
+        assert {r["key"] for r in rep["rows"]} == {
+            "pretrain.pretrain_tps",
+            "serving.decode.decode_tokens_per_s_per_chip"}

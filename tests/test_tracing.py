@@ -1,0 +1,371 @@
+"""observability.tracing: percentile-from-cumulative-buckets math (exact
+on synthetic distributions), span-event ordering/monotonicity under a
+seeded join/leave serving trace, chrome-trace round-trip via
+load_profiler_result with host-span correlation, terminal events for
+refused/overloaded/timeout requests, the ring buffer + background
+exporter, and trainer step-phase spans."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import serving as srv
+from paddle_tpu.observability import Histogram, Registry
+from paddle_tpu.observability import tracing as tr
+from paddle_tpu.profiler import load_profiler_result
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    tr.recorder().clear()
+    yield
+    tr.recorder().clear()
+    tr.set_enabled(True)
+
+
+# ---------------------------------------------------------------- percentiles
+
+class TestPercentile:
+    def test_exact_on_bucket_bounds(self):
+        # 100 observations at 1.0 and 100 at 2.0 on bounds (1,2,4):
+        # p50 interpolates to exactly 1.0, p100 to exactly 2.0
+        h = Histogram(buckets=(1.0, 2.0, 4.0))
+        for _ in range(100):
+            h.observe(1.0)
+        for _ in range(100):
+            h.observe(2.0)
+        assert tr.percentile(h, 50) == pytest.approx(1.0)
+        assert tr.percentile(h, 100) == pytest.approx(2.0)
+        # p75: target=150 lands mid-bucket (1,2] -> 1 + (150-100)/100
+        assert tr.percentile(h, 75) == pytest.approx(1.5)
+
+    def test_uniform_interpolation(self):
+        # uniform mass in one bucket: quantiles scale linearly
+        h = Histogram(buckets=(0.0, 10.0))
+        for _ in range(10):
+            h.observe(5.0)
+        assert tr.percentile(h, 50) == pytest.approx(5.0)
+        assert tr.percentile(h, 90) == pytest.approx(9.0)
+        assert tr.percentile(h, 10) == pytest.approx(1.0)
+
+    def test_empty_is_none(self):
+        h = Histogram(buckets=(1.0,))
+        assert tr.percentile(h, 50) is None
+        assert tr.percentiles(h) == {"p50": None, "p90": None, "p99": None}
+
+    def test_inf_bucket_clamps_to_last_finite(self):
+        h = Histogram(buckets=(1.0, 2.0))
+        h.observe(100.0)   # lands in +Inf bucket
+        assert tr.percentile(h, 99) == pytest.approx(2.0)
+
+    def test_invalid_q_raises(self):
+        h = Histogram(buckets=(1.0,))
+        with pytest.raises(ValueError):
+            tr.percentile(h, 101)
+
+    def test_snapshot_series_form(self):
+        # the snapshot dict shape ({counts, count}) + explicit buckets
+        h = Histogram(buckets=(1.0, 2.0))
+        for _ in range(4):
+            h.observe(1.0)
+        series = {"counts": list(h._counts), "count": h.count}
+        assert tr.percentile(series, 100, buckets=h.buckets) == \
+            pytest.approx(1.0)
+        with pytest.raises(ValueError):
+            tr.percentile(series, 50)   # buckets required
+
+    def test_slo_summary_shape(self):
+        reg = Registry()
+        h = reg.histogram("serving.engine.ttft_seconds", buckets=(1.0, 2.0))
+        h.observe(1.0)
+        s = tr.slo_summary(["serving.engine.ttft_seconds"], reg=reg)
+        row = s["serving.engine.ttft_seconds"]
+        assert row["count"] == 1
+        assert row["mean"] == pytest.approx(1.0)
+        assert set(row) == {"count", "mean", "p50", "p90", "p99"}
+
+
+# ------------------------------------------------------------------ recorder
+
+class TestRecorder:
+    def test_event_ordering_monotonic(self):
+        rec = tr.TraceRecorder(capacity=4)
+        rec.begin("r1")
+        for name in ("enqueue", "admit", "token", "token"):
+            rec.stamp("r1", name)
+        rec.finish("r1", "finish")
+        t = rec.trace("r1")
+        ts = [e.t_us for e in t.timeline()]
+        assert ts == sorted(ts)
+        assert [e.name for e in t.timeline()] == \
+            ["enqueue", "admit", "token", "token", "finish"]
+        assert t.outcome == "finish"
+
+    def test_derived_latencies(self):
+        rec = tr.TraceRecorder(capacity=4)
+        rec.begin("r")
+        rec.stamp("r", "enqueue")
+        rec.stamp("r", "admit")
+        rec.stamp("r", "token")
+        rec.stamp("r", "token")
+        rec.stamp("r", "token")
+        rec.finish("r", "finish")
+        t = rec.trace("r")
+        assert t.queue_wait_s() >= 0
+        assert t.ttft_s() >= t.queue_wait_s()
+        # 3 tokens -> tpot = (last-first)/2
+        gap = (t.last("token").t_us - t.first("token").t_us) / 1e6
+        assert t.tpot_s() == pytest.approx(gap / 2)
+        assert t.e2e_s() >= t.ttft_s()
+
+    def test_unknown_id_stamp_ignored(self):
+        rec = tr.TraceRecorder(capacity=4)
+        rec.stamp("ghost", "token")
+        rec.finish("ghost")
+        assert rec.trace("ghost") is None
+
+    def test_ring_eviction_oldest_first(self):
+        rec = tr.TraceRecorder(capacity=3)
+        for i in range(5):
+            rec.begin(i)
+            rec.stamp(i, "enqueue")
+            rec.finish(i, "finish")
+        done = rec.finished()
+        assert [t.request_id for t in done] == [2, 3, 4]
+
+    def test_disabled_records_nothing(self):
+        rec = tr.TraceRecorder(capacity=4)
+        tr.set_enabled(False)
+        try:
+            assert rec.begin("r") is None
+            rec.stamp("r", "enqueue")
+            rec.finish("r")
+        finally:
+            tr.set_enabled(True)
+        assert not rec.live() and not rec.finished()
+
+    def test_trace_prefers_live_then_latest_done(self):
+        rec = tr.TraceRecorder(capacity=4)
+        rec.begin("r")
+        rec.stamp("r", "enqueue")
+        rec.finish("r", "finish")
+        rec.begin("r")           # same id re-submitted
+        rec.stamp("r", "enqueue")
+        assert rec.trace("r").outcome is None       # the live one
+        rec.finish("r", "finish")
+        assert rec.trace("r").outcome == "finish"
+
+    def test_background_exporter_jsonl(self, tmp_path):
+        rec = tr.TraceRecorder(capacity=16)
+        path = str(tmp_path / "traces.jsonl")
+        rec.start_exporter(path, interval_s=0.01)
+        try:
+            for i in range(4):
+                rec.begin(i)
+                rec.stamp(i, "enqueue")
+                rec.stamp(i, "token")
+                rec.finish(i, "finish")
+        finally:
+            rec.stop_exporter()
+        lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+        assert len(lines) == 4
+        assert {r["request_id"] for r in lines} == {0, 1, 2, 3}
+        assert all(r["outcome"] == "finish" for r in lines)
+        assert all(e["t_us"] for r in lines for e in r["events"])
+
+    def test_exporter_thread_shares_recorder_lock(self):
+        # the flush thread must only touch state under the recorder lock
+        # (the PT006 discipline): hammer finish() from the main thread
+        # while the exporter drains, then verify nothing was lost
+        rec = tr.TraceRecorder(capacity=512)
+        stop = threading.Event()
+
+        def producer():
+            for i in range(200):
+                rec.begin(("p", i))
+                rec.stamp(("p", i), "enqueue")
+                rec.finish(("p", i), "finish")
+            stop.set()
+
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            rec.start_exporter(d + "/t.jsonl", interval_s=0.001)
+            th = threading.Thread(target=producer)
+            th.start()
+            th.join(timeout=10)
+            rec.stop_exporter()
+            assert stop.is_set()
+            lines = [json.loads(ln) for ln in open(d + "/t.jsonl")
+                     if ln.strip()]
+        assert len(lines) == 200
+
+
+# ------------------------------------------------- serving-engine integration
+
+def _tiny_engine(**kw):
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    cfg = llama_tiny_config(num_hidden_layers=1)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefill_chunk", 4)
+    return srv.ServingEngine(LlamaForCausalLM(cfg), **kw), cfg
+
+
+@pytest.mark.slow
+class TestEngineTracing:
+    def test_seeded_join_leave_trace_timeline(self):
+        eng, cfg = _tiny_engine()
+        rng = np.random.RandomState(0)
+        for i in range(3):
+            eng.add_request(rng.randint(0, cfg.vocab_size, 5).astype(
+                np.int32), max_new_tokens=3, request_id=i)
+        eng.run_to_completion()
+        done = {t.request_id: t for t in tr.recorder().finished("request")}
+        assert set(done) == {0, 1, 2}
+        for t in done.values():
+            names = [e.name for e in t.timeline()]
+            # monotonic timestamps, canonical order, terminal last
+            ts = [e.t_us for e in t.timeline()]
+            assert ts == sorted(ts)
+            assert names[0] == "enqueue" and names[-1] == "finish"
+            assert names.index("admit") < names.index("prefill_chunk") \
+                < names.index("token")
+            assert t.count("token") == 3
+            assert t.outcome == "finish"
+            # every request produced the full SLO set
+            assert t.queue_wait_s() is not None
+            assert t.ttft_s() is not None
+            assert t.tpot_s() is not None
+            assert t.e2e_s() is not None
+        # SLO percentiles come out of serving.slo()
+        s = srv.slo()
+        assert s["serving.engine.ttft_seconds"]["count"] >= 3
+        assert s["serving.engine.ttft_seconds"]["p99"] is not None
+
+    def test_chrome_export_round_trip_and_host_correlation(self, tmp_path):
+        eng, cfg = _tiny_engine()
+        eng.add_request(np.arange(5, dtype=np.int32) % cfg.vocab_size,
+                        max_new_tokens=2, request_id="rt")
+        eng.run_to_completion()
+        path = str(tmp_path / "trace.json")
+        n = tr.recorder().export_chrome_trace(path)
+        events = load_profiler_result(path)
+        assert len(events) == n > 0
+        req_trace = tr.recorder().trace("rt")
+        # the request's lifetime span carries its span id in the
+        # observability.span naming convention
+        spans = [e for e in events
+                 if e["name"].endswith(f"[span={req_trace.span_id}]")]
+        assert len(spans) == 1 and spans[0]["ph"] == "X"
+        assert spans[0]["args"]["outcome"] == "finish"
+        # phase rows nest inside the lifetime span
+        phases = {e["name"] for e in events if e.get("cat") == "phase"}
+        assert {"queue", "prefill", "decode"} <= phases
+        # token stamps carry the host-profiler span id of their engine
+        # step -> joinable against the host chrome trace
+        toks = [e for e in events if e["name"] == "token"]
+        assert toks and all("host_span" in e["args"] for e in toks)
+
+    def test_refused_request_appears_in_timeline(self):
+        from paddle_tpu import resilience as res
+        from paddle_tpu.inference import Config
+        cfg = Config()
+        cfg.set_admission(max_inflight=1, queue_timeout_s=0.0)
+        eng, mcfg = _tiny_engine(config=cfg, max_slots=1)
+        eng.add_request(np.arange(4, dtype=np.int32) % mcfg.vocab_size,
+                        max_new_tokens=2, request_id="a")
+        with pytest.raises(res.Overloaded):
+            eng.add_request(np.arange(4, dtype=np.int32) % mcfg.vocab_size,
+                            max_new_tokens=2, request_id="b")
+        t = tr.recorder().trace("b")
+        assert t is not None and t.outcome == "refused"
+        assert [e.name for e in t.timeline()] == ["enqueue", "refused"]
+        eng.run_to_completion()
+        assert tr.recorder().trace("a").outcome == "finish"
+
+    def test_queue_timeout_stamps_overloaded(self):
+        from paddle_tpu import resilience as res
+        from paddle_tpu.inference import Config
+        cfg = Config()
+        cfg.set_admission(max_inflight=1, queue_timeout_s=1e-4)
+        eng, mcfg = _tiny_engine(config=cfg, max_slots=1)
+        eng.add_request(np.arange(4, dtype=np.int32) % mcfg.vocab_size,
+                        max_new_tokens=4, request_id="x")
+        eng.add_request(np.arange(4, dtype=np.int32) % mcfg.vocab_size,
+                        max_new_tokens=4, request_id="y")
+        import time
+        time.sleep(0.01)
+        results = eng.run_to_completion()
+        assert isinstance(results["y"], res.Overloaded)
+        t = tr.recorder().trace("y")
+        assert t.outcome == "overloaded"
+        assert t.first("token") is None   # never decoded
+        assert "waited_s" in t.last("overloaded").meta
+
+    def test_deadline_timeout_stamps_terminal(self):
+        from paddle_tpu import resilience as res
+        eng, mcfg = _tiny_engine()
+        eng.add_request(np.arange(6, dtype=np.int32) % mcfg.vocab_size,
+                        max_new_tokens=8, deadline_s=1e-6,
+                        request_id="d")
+        results = eng.run_to_completion()
+        assert isinstance(results["d"], res.TimeoutResult)
+        t = tr.recorder().trace("d")
+        assert t.outcome == "timeout"
+
+    def test_tracing_off_engine_still_exact(self):
+        tr.set_enabled(False)
+        try:
+            eng, mcfg = _tiny_engine()
+            eng.add_request(np.arange(5, dtype=np.int32) % mcfg.vocab_size,
+                            max_new_tokens=3, request_id=0)
+            results = eng.run_to_completion()
+            assert results[0].shape == (3,)
+            assert tr.recorder().trace(0) is None
+        finally:
+            tr.set_enabled(True)
+
+
+# ---------------------------------------------------------- trainer phases
+
+@pytest.mark.slow
+class TestTrainerTracing:
+    def test_step_phase_spans(self):
+        from paddle_tpu import nn
+        from paddle_tpu.trainer.trainer import Trainer, TrainingArguments
+
+        class DS:
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                x = np.random.RandomState(i).randn(4).astype("float32")
+                return x, x.sum(keepdims=True).astype("float32")
+
+        t = Trainer(model=nn.Linear(4, 1),
+                    args=TrainingArguments(
+                        max_steps=2, per_device_train_batch_size=2,
+                        logging_steps=1),
+                    train_dataset=DS(), criterion=nn.MSELoss())
+        t.train()
+        done = tr.recorder().finished("train")
+        assert len(done) == 2
+        for st in done:
+            names = [e.name for e in st.timeline()]
+            assert names == ["data", "fwd", "bwd", "opt", "finish"]
+            assert all(e.meta and e.meta.get("dur_us", 0) >= 0
+                       for e in st.timeline()[:-1])
+            assert st.outcome == "finish"
+        assert done[0].meta["step"] == 1
+        # train-step traces must NOT pollute the serving SLO histograms
+        # (kind guard): export still renders them as chrome rows
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            n = tr.recorder().export_chrome_trace(d + "/t.json")
+            evs = load_profiler_result(d + "/t.json")
+        assert any(e["name"].startswith("train:train-step-")
+                   for e in evs)
+        # phase events carry explicit durations -> exported as X spans
+        assert any(e["ph"] == "X" and e["name"] == "fwd" for e in evs)

@@ -85,6 +85,18 @@ MODULE_ROLES = {
                "fixed-shape jitted decode over the paged kernel "
                "(docs/SERVING.md; upstream: FastDeploy/PaddleNLP "
                "PagedAttention serving)",
+    "observability": "metrics registry + `observability.tracing` "
+                     "per-request/per-step span timelines: SLO "
+                     "histograms (TTFT/TPOT/e2e/queue-wait) with "
+                     "percentile helpers, chrome-trace export "
+                     "correlated with host-profiler spans "
+                     "(docs/OBSERVABILITY.md; upstream: paddle "
+                     "monitoring hooks / profiler RecordEvent)",
+    "profiler": "paddle.profiler parity: host RecordEvent tracer + "
+                "device XPlane capture, scheduler, chrome export, and "
+                "`profiler.statistic.summarize` per-op/step-phase/"
+                "memory summary tables (upstream: paddle.profiler + "
+                "profiler_statistic.py)",
 }
 
 

@@ -140,7 +140,10 @@ def write_serving_report(path: str, extra: dict | None = None) -> dict:
     shared prefix tokens. The totals line makes 'did every admitted
     request complete' a one-field check; pass the throughput row as
     `extra` so the artifact records rate AND what the engine actually did
-    (shares, copies, pool pressure) in one file. Returns the report dict;
+    (shares, copies, pool pressure) in one file. The `slo` section
+    carries the per-request latency percentiles (p50/p90/p99 TTFT /
+    TPOT / e2e / queue-wait from the tracing histograms) so SERVING_BENCH
+    rows report tail latency beside throughput. Returns the report dict;
     writes JSON to `path`."""
     import json
     import os
@@ -154,6 +157,7 @@ def write_serving_report(path: str, extra: dict | None = None) -> dict:
             totals[name] = sum(s["value"] for s in m["series"])
     report = {
         "totals": totals,
+        "slo": srv.slo(),
         "metrics": snap,
     }
     if extra:

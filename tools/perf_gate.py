@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Machine-checked perf-regression gate over the committed bench
+artifacts (ROADMAP item 5, the lane that makes kernel work safe to
+iterate).
+
+Two artifact families are gated, both higher-is-better throughputs:
+
+  - **pretrain** — BENCH_r*.json (one flagship run per round, shape
+    ``{"parsed": {"metric", "value"}}``). The acceptance band comes from
+    the measured repeat spread: the union of every
+    docs/BENCH_REPEATS_r*.json ``runs`` list and recorded ``*_band``
+    ranges, widened by --margin (default 1%, on the order of the
+    measured 1.03% spread). The LATEST round's value must not fall below
+    the band floor.
+  - **serving** — docs/SERVING_BENCH.json rows (decode*/prefill*/moe*/
+    mla* throughput fields). No repeat artifacts exist per row, so each
+    committed value is its own reference with a --noise band around it
+    (default 15%, the upper edge of the file's own measurement-protocol
+    "10-15% run-to-run variation" note).
+
+Default mode self-checks the committed artifacts (they define the bands,
+so they pass by construction unless an artifact is internally
+inconsistent — e.g. a new BENCH round below the repeat band was
+committed). `--check CANDIDATE.json` gates fresh measurements against
+the committed baselines: CANDIDATE holds ``{metric_key: value}`` (keys
+as printed in the report, e.g. ``serving.decode.decode_tokens_per_s_per_chip``
+or ``pretrain.llama3_8b_shard_pretrain_tokens_per_sec_per_chip``).
+
+Exit status: 0 = every gated row inside its band (or --check candidate
+passes), 1 = regression beyond band, 0 with a notice when no artifacts
+exist at all (CPU-only tier-1 checkouts stay green — the verify-skill
+wiring relies on this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# SERVING_BENCH fields gated per row (all higher-is-better throughputs)
+SERVING_FIELDS = ("decode_tokens_per_s_per_chip", "prefill_tokens_per_s",
+                  "inflight_tokens_per_s")
+
+
+def _load(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# band derivation
+# ---------------------------------------------------------------------------
+
+def pretrain_rows(repo: str = REPO, margin: float = 0.01
+                  ) -> List[Dict[str, Any]]:
+    """One gate row per pretrain metric: the latest BENCH_r*.json value
+    vs the repeat-derived band. Band = [min, max] over every repeat run
+    and every recorded band, widened by `margin` each side."""
+    rounds: List[Tuple[int, str, float]] = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        d = _load(path)
+        p = (d or {}).get("parsed") or {}
+        if "metric" in p and isinstance(p.get("value"), (int, float)):
+            m = re.search(r"BENCH_r(\d+)", path)
+            rounds.append((int(m.group(1)) if m else 0,
+                           p["metric"], float(p["value"])))
+    if not rounds:
+        return []
+    metric = rounds[-1][1]
+    lo, hi = [], []
+    for path in sorted(glob.glob(os.path.join(repo, "docs",
+                                              "BENCH_REPEATS_r*.json"))):
+        d = _load(path) or {}
+        if d.get("metric") not in (None, metric):
+            continue
+        runs = [float(v) for v in d.get("runs", [])
+                if isinstance(v, (int, float))]
+        if runs:
+            lo.append(min(runs))
+            hi.append(max(runs))
+        for k, v in d.items():
+            if k.endswith("_band") and isinstance(v, (list, tuple)) \
+                    and len(v) == 2:
+                lo.append(float(v[0]))
+                hi.append(float(v[1]))
+    if not lo:
+        # no repeats recorded: band around the historical round values
+        vals = [v for _, m, v in rounds if m == metric]
+        lo, hi = [min(vals)], [max(vals)]
+    band_lo = min(lo) * (1.0 - margin)
+    band_hi = max(hi) * (1.0 + margin)
+    latest_round, _, latest = max(rounds)
+    return [{"key": f"pretrain.{metric}", "value": latest,
+             "band": [band_lo, band_hi],
+             "source": f"BENCH_r{latest_round:02d}.json",
+             "ok": latest >= band_lo}]
+
+
+def serving_rows(repo: str = REPO, noise: float = 0.15
+                 ) -> List[Dict[str, Any]]:
+    """One gate row per (SERVING_BENCH row, throughput field): committed
+    value ± noise. Self-check is trivially green; the bands exist for
+    --check candidates."""
+    path = os.path.join(repo, "docs", "SERVING_BENCH.json")
+    bench = _load(path)
+    if not bench:
+        return []
+    out = []
+    for name, row in bench.items():
+        if not isinstance(row, dict):
+            continue
+        for field in SERVING_FIELDS:
+            v = row.get(field)
+            if not isinstance(v, (int, float)) or v <= 0:
+                continue
+            v = float(v)
+            out.append({"key": f"serving.{name}.{field}", "value": v,
+                        "band": [v * (1.0 - noise), v * (1.0 + noise)],
+                        "source": "docs/SERVING_BENCH.json",
+                        "ok": True})
+    return out
+
+
+def gate_rows(repo: str = REPO, margin: float = 0.01,
+              noise: float = 0.15) -> List[Dict[str, Any]]:
+    return pretrain_rows(repo, margin) + serving_rows(repo, noise)
+
+
+def check_candidate(candidate: Dict[str, float],
+                    rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Re-judge `rows` against fresh measurements: for every key present
+    in `candidate`, the candidate value replaces the committed one and
+    must sit at or above the band floor (higher-is-better: exceeding the
+    band top is a rerate, not a failure). Keys the candidate omits are
+    left out of the verdict; unknown candidate keys become failing rows
+    so typos can't silently pass."""
+    by_key = {r["key"]: r for r in rows}
+    out = []
+    for key, val in candidate.items():
+        base = by_key.get(key)
+        if base is None:
+            out.append({"key": key, "value": val, "band": None,
+                        "source": "candidate", "ok": False,
+                        "why": "unknown metric key"})
+            continue
+        r = dict(base, value=float(val))
+        r["ok"] = float(val) >= r["band"][0]
+        if not r["ok"]:
+            r["why"] = (f"regressed below band floor "
+                        f"{r['band'][0]:.1f} (committed "
+                        f"{base['value']:.1f})")
+        out.append(r)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--check", metavar="CANDIDATE.json",
+                    help="gate fresh {metric_key: value} measurements "
+                         "against the committed bands")
+    ap.add_argument("--margin", type=float, default=0.01,
+                    help="extra fractional width on the pretrain repeat "
+                         "band (default 0.01)")
+    ap.add_argument("--noise", type=float, default=0.15,
+                    help="fractional band around committed serving rows "
+                         "(default 0.15 per the measurement protocol)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    rows = gate_rows(args.repo, args.margin, args.noise)
+    if not rows:
+        print("perf_gate: no bench artifacts found — nothing to gate "
+              "(ok)")
+        return 0
+    if args.check:
+        cand = _load(args.check)
+        if cand is None:
+            print(f"perf_gate: cannot read candidate {args.check}",
+                  file=sys.stderr)
+            return 2
+        rows = check_candidate(
+            {k: v for k, v in cand.items()
+             if isinstance(v, (int, float))}, rows)
+        if not rows:
+            print("perf_gate: candidate contains no gated metrics (ok)")
+            return 0
+    failed = [r for r in rows if not r["ok"]]
+    if args.json:
+        print(json.dumps({"rows": rows, "failed": len(failed)}, indent=1))
+    else:
+        for r in rows:
+            band = (f"[{r['band'][0]:.1f}, {r['band'][1]:.1f}]"
+                    if r.get("band") else "-")
+            mark = "ok  " if r["ok"] else "FAIL"
+            line = (f"{mark} {r['key']:<58} {r['value']:>12.1f}  "
+                    f"band {band}")
+            if r.get("why"):
+                line += f"  ({r['why']})"
+            print(line)
+        print(f"perf_gate: {len(rows) - len(failed)}/{len(rows)} rows "
+              f"inside band")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
