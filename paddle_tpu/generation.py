@@ -386,12 +386,19 @@ def _moe_decode_params(model, weight_only_int8: bool = False):
     return p
 
 
-def _mla_decode_params(model, weight_only_int8: bool = False):
+def _mla_decode_params(model, weight_only_int8: bool = False,
+                       algo: str = "weight_only_int8"):
     """DeepSeekV2ForCausalLM: multi-head latent attention with the
     ABSORBED decode formulation — the KV cache stores only the normalized
     latent [r] + shared rope key [dr] per token, and kv_b is folded into
     the query/output projections (DeepSeek-V2 matrix absorption; ref
-    capability: PaddleNLP deepseek_v2 fused MLA decode)."""
+    capability: PaddleNLP deepseek_v2 fused MLA decode).
+
+    ``algo`` applies to the attention projections and the head:
+    'weight_only_int4' packs them (kv_b reads whole through
+    ops.quant.int4_dequantize; the rest through _mm_w's split
+    contraction). The FFN/expert stacks always quantize int8 — their
+    3-D per-expert einsums consume weights whole."""
     inner = model.model
     cfg = model.config
     layers = []
@@ -413,7 +420,7 @@ def _mla_decode_params(model, weight_only_int8: bool = False):
             d["wq"] = a.q_proj.weight._data
         for k in ("wkva", "wkvb", "wo", "wqa", "wqb", "wq"):
             if k in d:
-                _q8(d, k, weight_only_int8)
+                _q8(d, k, weight_only_int8, algo)
         mlp_w, mlp_st = _mlp_params(lyr, weight_only_int8)
         d.update(mlp_w)
         layers.append(d)
@@ -425,7 +432,7 @@ def _mla_decode_params(model, weight_only_int8: bool = False):
              cos=inner.rope_cos._data, sin=inner.rope_sin._data,
              moe_static=tuple(moe_static))
     if weight_only_int8 and head is not None:
-        _q8(p, "head")
+        _q8(p, "head", True, algo)
         p["head"] = None
     return p
 
@@ -433,16 +440,12 @@ def _mla_decode_params(model, weight_only_int8: bool = False):
 def _decode_params(model, weight_only_int8: bool = False,
                    weight_only_quant=None):
     """Family dispatch for the cached/compiled decode paths. int4 covers
-    the llama family only — the MoE expert stacks and MLA kv_b are
-    consumed whole by einsums whose contraction the int4 split would
-    have to thread through every call site (int8 already halves them)."""
+    the llama family and the MLA attention projections (kv_b reads whole
+    through the int4_dequantize kernel; experts/FFN stay int8) — the MoE
+    expert stacks are consumed whole by 3-D per-expert einsums whose
+    contraction the int4 split would have to thread through every call
+    site (int8 already halves them)."""
     algo, enabled = _woq_algo(weight_only_int8, weight_only_quant)
-    if enabled and algo == "weight_only_int4" and (
-            getattr(model, "gpt", None) is not None
-            or getattr(model, "model", None) is not None):
-        raise NotImplementedError(
-            "weight_only_quant='int4' covers the llama family; MoE/MLA "
-            "run 'int8', the GPT family is fp-only")
     if getattr(model, "gpt", None) is not None:
         if enabled:
             raise NotImplementedError(
@@ -455,7 +458,11 @@ def _decode_params(model, weight_only_int8: bool = False,
         from .models.deepseek import DeepSeekV2Model
         from .models.moe_llm import MoEModel
         if isinstance(inner, DeepSeekV2Model):
-            return _mla_decode_params(model, enabled)
+            return _mla_decode_params(model, enabled, algo)
+        if enabled and algo == "weight_only_int4":
+            raise NotImplementedError(
+                "weight_only_quant='int4' covers the llama and MLA "
+                "families; MoE runs 'int8', the GPT family is fp-only")
         if isinstance(inner, MoEModel):
             return _moe_decode_params(model, enabled)
     return _llama_decode_params(model, weight_only_int8,
@@ -478,13 +485,19 @@ def _dq(d, key, dtype):
     _mm_w's fused matmul shape doesn't apply): int8 layouts dequantize
     in VMEM — the HBM read stays int8 and XLA fuses the scale multiply
     into the consuming einsum. 3-D stacks carry per-(expert, out-channel)
-    scales [E, N]. int4 (_q4) entries are NOT readable whole — their
-    bandwidth win requires the even/odd contraction split (_mm_w)."""
+    scales [E, N]. 2-D int4 (_q4) entries unpack through the
+    ops.quant.int4_dequantize Pallas kernel (the HBM read stays packed;
+    the MLA absorbed kv_b rides this); 3-D expert stacks stay int8-only
+    — their per-expert einsum consumers would re-materialize the planes
+    anyway."""
     if key + "_q4" in d:
-        raise NotImplementedError(
-            f"{key}: packed-int4 weights only flow through the matmul "
-            "helper (_mm_w); whole-tensor consumers (MLA kv_b, expert "
-            "stacks) are int8-only")
+        q4, s = d[key + "_q4"], d[key + "_s"]
+        if q4.ndim == 3:
+            raise NotImplementedError(
+                f"{key}: 3-D packed-int4 expert stacks are not readable "
+                "whole; experts run 'int8'")
+        from .ops.quant import int4_dequantize
+        return int4_dequantize(q4, s).astype(dtype)
     if key + "_q" in d:
         q, s = d[key + "_q"], d[key + "_s"].astype(dtype)
         if q.ndim == 3:
@@ -759,7 +772,7 @@ def _mla_cached_step_body(cfg, max_len: int, moe_static=None):
         sts = moe_static or (None,) * len(w["layers"])
         for L, (c_lat, c_pe), st in zip(w["layers"], caches, sts):
             h = rms(x, L["ln1"])
-            if "wqa" in L or "wqa_q" in L:
+            if "wqa" in L or "wqa_q" in L or "wqa_q4" in L:
                 q = _mm_w(rms(_mm_w(h, L, "wqa"), L["gq"]), L, "wqb")
             else:
                 q = _mm_w(h, L, "wq")

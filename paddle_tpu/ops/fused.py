@@ -22,13 +22,20 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_rms_norm", "fused_rope", "swiglu", "fused_layer_norm",
-           "fused_bias_residual_layer_norm", "fused_moe_dispatch_combine"]
+           "fused_bias_residual_layer_norm", "fused_moe_dispatch_combine",
+           "fused_rope_append", "fused_append_rows"]
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+# jax renamed TPUCompilerParams -> CompilerParams; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
 
 
 def _row_block(n_rows: int) -> int:
@@ -348,6 +355,148 @@ def fused_rope(q, k, cos, sin):
     """Fused rotary embedding on q [B,S,Hq,D] and k [B,S,Hk,D]
     (ref: fused_rotary_position_embedding)."""
     return _rope(q, cos, sin), _rope(k, cos, sin)
+
+
+# ---------------------------------------------------------------------------
+# rope + paged-cache append (serving decode path; no VJP — inference only)
+# ---------------------------------------------------------------------------
+
+def _rope_append_kernel(pg_ref, off_ref,              # scalar prefetch
+                        q_ref, k_ref, v_ref, c_ref, s_ref,
+                        kin_ref, vin_ref,
+                        qo_ref, kp_ref, vp_ref):
+    t = pl.program_id(0)
+    c = c_ref[:].astype(jnp.float32)                   # [1, D/2]
+    s = s_ref[:].astype(jnp.float32)
+
+    def rot(x):                                        # [h, D] f32
+        d2 = x.shape[-1] // 2
+        x1, x2 = x[:, :d2], x[:, d2:]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1)
+
+    qo_ref[0] = rot(q_ref[0].astype(jnp.float32)).astype(qo_ref.dtype)
+    # first visit of a page seeds the resident output block from the
+    # aliased input fetch; consecutive same-page tokens keep the block
+    # resident, so their earlier row writes survive (re-seeding would
+    # clobber them with the stale pre-launch page)
+    prev = pg_ref[jnp.maximum(t - 1, 0)]
+
+    @pl.when((t == 0) | (pg_ref[t] != prev))
+    def _seed():
+        kp_ref[:] = kin_ref[:]
+        vp_ref[:] = vin_ref[:]
+
+    off = off_ref[t]
+    kr = rot(k_ref[0].astype(jnp.float32)).astype(kp_ref.dtype)
+    kp_ref[:, 0, pl.dslice(off, 1), :] = kr[:, None, :]
+    vp_ref[:, 0, pl.dslice(off, 1), :] = \
+        v_ref[0].astype(vp_ref.dtype)[:, None, :]
+
+
+def fused_rope_append(q, k, v, cos, sin, k_pages, v_pages,
+                      page_idx, page_off):
+    """Rotary embedding (per-TOKEN cos/sin rows) on q and k plus the
+    paged-cache K/V row scatter in ONE pallas_call — the serving
+    engine's fused rope+append step.
+
+    q [T, Hq, D]; k/v [T, KV, D]; cos/sin [T, D/2]; k/v_pages
+    [KV, total_pages, page_size, D]; page_idx/page_off [T] int32 name
+    where token t's K/V row lands. Returns (q_roped, k_pages, v_pages)
+    with the page pools donated through input_output_aliases (the HBM
+    buffers update in place on TPU).
+
+    Contract: tokens that share a page are ADJACENT in t (the engine's
+    prefill chunk); non-adjacent revisits only happen on the trash page
+    (inactive slots), whose content is garbage by design. Identity rope
+    (cos=1, sin=0) turns this into a pure fused append for the GPT
+    family."""
+    T, Hq, D = q.shape
+    KV = k.shape[1]
+    total, psz = k_pages.shape[1], k_pages.shape[2]
+    d2 = D // 2
+
+    def tok_map(t, pg, off):
+        return (t, 0, 0)
+
+    def cs_map(t, pg, off):
+        return (t, 0)
+
+    def page_map(t, pg, off):
+        return (0, jnp.clip(pg[t], 0, total - 1), 0, 0)
+
+    page_spec = pl.BlockSpec((KV, 1, psz, D), page_map)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # page_idx, page_off
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), tok_map),
+            pl.BlockSpec((1, KV, D), tok_map),
+            pl.BlockSpec((1, KV, D), tok_map),
+            pl.BlockSpec((1, d2), cs_map),
+            pl.BlockSpec((1, d2), cs_map),
+            page_spec,
+            page_spec,
+        ],
+        out_specs=[pl.BlockSpec((1, Hq, D), tok_map),
+                   page_spec, page_spec],
+    )
+    return pl.pallas_call(
+        _rope_append_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((T, Hq, D), q.dtype),
+                   jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                   jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)],
+        # flat-input indices INCLUDE the scalar-prefetch operands
+        input_output_aliases={7: 1, 8: 2},
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(page_idx.astype(jnp.int32), page_off.astype(jnp.int32),
+      q, k, v, cos, sin, k_pages, v_pages)
+
+
+def _append_rows_kernel(pg_ref, off_ref, r_ref, pin_ref, po_ref):
+    t = pl.program_id(0)
+    prev = pg_ref[jnp.maximum(t - 1, 0)]
+
+    @pl.when((t == 0) | (pg_ref[t] != prev))
+    def _seed():
+        po_ref[:] = pin_ref[:]
+
+    po_ref[:, 0, pl.dslice(off_ref[t], 1), :] = \
+        r_ref[0].astype(po_ref.dtype)[:, None, :]
+
+
+def fused_append_rows(pages, rows, page_idx, page_off):
+    """Scatter per-token cache rows [T, KV, D] into paged pools
+    [KV, total_pages, page_size, D] at (page_idx[t], page_off[t]) in one
+    pallas_call — the MLA engine's latent-row append (its rope runs on
+    split q_pe/k_pe shapes before the rows are concatenated). Same
+    adjacency contract as fused_rope_append."""
+    T, KV, D = rows.shape
+    total, psz = pages.shape[1], pages.shape[2]
+
+    def page_map(t, pg, off):
+        return (0, jnp.clip(pg[t], 0, total - 1), 0, 0)
+
+    page_spec = pl.BlockSpec((KV, 1, psz, D), page_map)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T,),
+        in_specs=[pl.BlockSpec((1, KV, D), lambda t, pg, off: (t, 0, 0)),
+                  page_spec],
+        out_specs=page_spec,
+    )
+    return pl.pallas_call(
+        _append_rows_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(pages.shape, pages.dtype),
+        input_output_aliases={3: 0},
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(page_idx.astype(jnp.int32), page_off.astype(jnp.int32),
+      rows, pages)
 
 
 # ---------------------------------------------------------------------------

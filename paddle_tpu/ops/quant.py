@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "int4_planes"]
+           "int4_planes", "int4_dequantize"]
 
 
 def weight_quantize(w, algo: str = "weight_only_int8"):
@@ -64,6 +64,47 @@ def weight_dequantize(qw, scale, algo: str = "weight_only_int8"):
         out = out.at[0::2].set(lo).at[1::2].set(hi)
         return out.astype(jnp.float32) * scale[None, :]
     raise ValueError(f"unknown algo: {algo}")
+
+
+def _dq4_kernel(qw_ref, s_ref, o_ref):
+    # same in-VMEM nibble unpack as _wol4_kernel (int32 bit ops — Mosaic
+    # cannot legalize shifts on int8 vectors), but emitting the f32
+    # weight block instead of a matmul: the HBM weight read stays int4
+    s = s_ref[0].astype(jnp.float32)[None, :]
+    qw = qw_ref[:].astype(jnp.int32)
+    lo = (((qw & 0xF) ^ 8) - 8).astype(jnp.float32) * s
+    hi = (qw >> 4).astype(jnp.float32) * s
+    K2, bn = lo.shape
+    # interleave planes back to source-row order (lo = even rows,
+    # hi = odd) via a sublane-merging reshape — lane dim untouched
+    o_ref[:] = jnp.stack([lo, hi], axis=1).reshape(K2 * 2, bn)
+
+
+def int4_dequantize(qw, scale):
+    """Packed-int4 [K/2, N] + per-channel scale [N] -> f32 [K, N],
+    unpacked in VMEM. For WHOLE-tensor consumers that reshape/slice the
+    weight (the MLA absorbed kv_b) where the split-contraction matmul
+    (_wol4_kernel) doesn't apply. Non-128-multiple N is zero-padded
+    inside the launch and sliced back, mirroring _wol_int4_fwd_impl.
+    Must match weight_dequantize(..., 'weight_only_int4') exactly."""
+    K2, N = qw.shape
+    pad_n = (-N) % 128
+    if pad_n:
+        qw = jnp.pad(qw, ((0, 0), (0, pad_n)))
+        scale = jnp.pad(scale.reshape(-1), (0, pad_n))
+    Np = N + pad_n
+    bn = next((c for c in (2048, 1024, 512, 256, 128) if Np % c == 0), Np)
+    out = pl.pallas_call(
+        _dq4_kernel,
+        grid=(Np // bn,),
+        in_specs=[pl.BlockSpec((K2, bn), lambda j: (0, j)),
+                  # scale rides 2-D, same layout clash as _wol4
+                  pl.BlockSpec((1, bn), lambda j: (0, j))],
+        out_specs=pl.BlockSpec((K2 * 2, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((K2 * 2, Np), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(qw, scale.reshape(1, Np).astype(jnp.float32))
+    return out[:, :N]
 
 
 def _wol_kernel(x_ref, qw_ref, s_ref, o_ref):

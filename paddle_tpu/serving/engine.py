@@ -1,18 +1,32 @@
 """Continuous-batching serving engine over the paged KV cache.
 
-`ServingEngine.add_request/step/collect` drives a FIXED-SHAPE jitted
-decode step (static `max_slots` batch, per-slot active masking through
-the page tables) over `paged_attention`/`append_to_cache`, with the
-per-family math of the generation.py cached step bodies. Requests join
-mid-decode (chunked prefill between decode steps), leave the instant
-they hit EOS/max-tokens (their pages return to the pool immediately),
-and never retrace the decode program — one compile per
-(model-config, slot-count) pair, checked by the PT002-gated tests.
+`ServingEngine.add_request/step/collect` drives FIXED-SHAPE jitted
+device programs (static `max_slots` batch, per-slot active masking
+through the page tables) with the per-family math of the generation.py
+cached step bodies. Requests join mid-decode (chunked prefill),
+leave the instant they hit EOS/max-tokens (their pages return to the
+pool immediately), and never retrace — one compile per (model-config,
+slot-count) pair, checked by the PT002-gated tests.
+
+Two dispatch paths:
+
+- **ragged (default)**: ONE unified launch per step. Every decode
+  slot's token and the oldest prefill request's chunk ride a single
+  flat token buffer through a fused per-layer body
+  (fused_rms_norm → qkv → fused_rope_append → ragged_paged_attention →
+  o-proj), so a step that has both prefill and decode work issues ONE
+  device program instead of two (`serving.engine.launches` counts the
+  difference). Per-sequence row tables (seq_start / num_tokens /
+  kv_lengths / page table) make joins and leaves pure data changes.
+- **split (legacy, `ragged=False`)**: the PR-5 alternating
+  `_prefill_chunk` / `_decode` dispatches over
+  `paged_attention`/`append_to_cache`. Kept as the reference path and
+  the fallback when the ragged kernel's tiling constraints don't hold
+  on TPU (`ragged_kernel_eligible`).
 
 Inactive slots point their whole page table at the allocator's trash
-page 0 with length 0: the decode step writes their (garbage) K/V into
-the trash page and their logits are ignored on the host, so joins and
-leaves are pure data changes, never shape changes.
+page 0 with length/num_tokens 0: both paths write their (garbage) K/V
+into the trash page and their logits are ignored on the host.
 
 Greedy decoding only: the exactness contract (engine tokens ==
 solo `generate_cached` tokens per request, the acceptance test) is a
@@ -33,7 +47,11 @@ from .. import resilience as _res
 from ..observability import tracing as _tracing
 from ..generation import (_decode_params, _dq, _ffn_apply, _llama_weights,
                           _mm_w)
+from ..ops.fused import (fused_append_rows, fused_layer_norm,
+                         fused_rms_norm, fused_rope_append)
 from ..ops.paged_attention import append_to_cache, paged_attention
+from ..ops.pallas_ragged import (ragged_kernel_eligible,
+                                 ragged_paged_attention)
 from .block_allocator import PageBlockAllocator
 from .scheduler import DECODE, PREFILL, Request, Scheduler
 
@@ -44,6 +62,9 @@ _REQS = _obs.registry().counter(
     labels=("outcome",))
 _STEPS = _obs.registry().counter(
     "serving.engine.steps", "device steps launched", labels=("phase",))
+_LAUNCHES = _obs.registry().counter(
+    "serving.engine.launches", "device program launches by dispatch path",
+    labels=("path",))
 _TOKENS = _obs.registry().counter(
     "serving.engine.tokens", "tokens processed", labels=("phase",))
 _ACTIVE = _obs.registry().gauge(
@@ -84,7 +105,8 @@ class ServingEngine:
                  weight_only_int8: bool = False,
                  weight_only_quant=None,
                  config=None,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: bool = True,
+                 ragged: Optional[bool] = None):
         p = _decode_params(model, weight_only_int8, weight_only_quant)
         cfg = p["cfg"]
         self._p = p
@@ -133,10 +155,26 @@ class ServingEngine:
             self._pools = [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                            for _ in range(n_layers)]
 
-        # the two fixed-shape programs: built ONCE here, never in the
-        # step loop (paddlelint PT002)
-        self._jit_decode = jax.jit(self._make_decode_body())
-        self._jit_prefill = jax.jit(self._make_prefill_body())
+        # dispatch path: the unified ragged launch by default, unless
+        # the ragged kernel's tiling constraints don't hold on a real
+        # TPU (interpret mode has none) or the caller pins the path
+        if ragged is None:
+            ragged = (jax.default_backend() != "tpu"
+                      or ragged_kernel_eligible(
+                          cfg.num_attention_heads, kv, d, self.page_size))
+        self.ragged = bool(ragged)
+        self.launches = 0      # device program launches by THIS engine
+
+        # the fixed-shape programs: built ONCE here, never in the step
+        # loop (paddlelint PT002)
+        if self.ragged:
+            self._jit_unified = jax.jit(self._make_unified_body())
+            self._programs = {"unified": self._jit_unified}
+        else:
+            self._jit_decode = jax.jit(self._make_decode_body())
+            self._jit_prefill = jax.jit(self._make_prefill_body())
+            self._programs = {"decode": self._jit_decode,
+                              "prefill": self._jit_prefill}
 
     # ------------------------------------------------------------- public
     def add_request(self, prompt, max_new_tokens: int = 20,
@@ -170,9 +208,11 @@ class ServingEngine:
 
     def step(self) -> Dict[str, int]:
         """One engine iteration: cull expired requests, admit waiting
-        ones into free slots, run one prefill chunk for the oldest
-        prefilling request, then one fused decode step for every
-        decoding slot. Returns counts for observability/benching."""
+        ones into free slots, then run the step's device work — ONE
+        unified ragged launch carrying every decode slot's token plus
+        one prefill chunk (ragged path), or the legacy alternating
+        prefill-chunk / decode-step pair (split path). Returns counts
+        for observability/benching."""
         out = {"admitted": 0, "prefill_tokens": 0, "decoded": 0,
                "finished": 0}
         for req in self.scheduler.expire_waiting():
@@ -188,15 +228,30 @@ class ServingEngine:
                 self._finish(req)
                 out["finished"] += 1
         out["admitted"] = self._admit()
-        out["prefill_tokens"], fin = self._prefill_chunk()
-        out["finished"] += fin
-        out["decoded"], fin = self._decode()
-        out["finished"] += fin
+        if self.ragged:
+            pf, dec, fin = self._unified_step()
+            out["prefill_tokens"] = pf
+            out["decoded"] = dec
+            out["finished"] += fin
+        else:
+            out["prefill_tokens"], fin = self._prefill_chunk()
+            out["finished"] += fin
+            out["decoded"], fin = self._decode()
+            out["finished"] += fin
         if _obs.enabled():
             _ACTIVE.set(self.scheduler.inflight)
             _WAITING.set(len(self.scheduler.waiting))
         self.allocator.publish_gauges()
         return out
+
+    def program_cache_sizes(self) -> Dict[str, int]:
+        """{program name: compiled-variant count} for this engine's
+        jitted programs — the PT002 no-retrace guard's hook. Ragged
+        engines expose {"unified": n}; split engines {"decode": n,
+        "prefill": n}. Every count must stay at 1 after any join/leave
+        pattern."""
+        return {name: fn._cache_size()
+                for name, fn in self._programs.items()}
 
     def collect(self) -> Dict[object, object]:
         """Results of every request finished since the last collect():
@@ -279,7 +334,9 @@ class ServingEngine:
                 self._w, jnp.asarray(ids), self._pools, jnp.asarray(table),
                 np.int32(start), np.int32(n))
         req.prefill_pos += n
+        self.launches += 1
         if _obs.enabled():
+            _LAUNCHES.labels(path="split").inc()
             _STEPS.labels(phase="prefill").inc()
             _TOKENS.labels(phase="prefill").inc(n)
         finished = 0
@@ -317,7 +374,9 @@ class ServingEngine:
                 self._w, jnp.asarray(tok), self._pools,
                 jnp.asarray(lengths), jnp.asarray(tables))
         logits = np.asarray(logits)
+        self.launches += 1
         if _obs.enabled():
+            _LAUNCHES.labels(path="split").inc()
             _STEPS.labels(phase="decode").inc()
             _TOKENS.labels(phase="decode").inc(len(active))
         finished = 0
@@ -325,6 +384,100 @@ class ServingEngine:
             finished += self._emit(req, int(np.argmax(logits[slot])))
         _TRACE.set_host_span(None)
         return len(active), finished
+
+    # ------------------------------------------------------------ unified
+    def _unified_step(self) -> Tuple[int, int, int]:
+        """ONE ragged launch for the whole step: every decode slot's
+        pending token rides flat row `slot`, the oldest prefilling
+        request's chunk rides rows [max_slots, max_slots+n). Row tables
+        (num_tokens / kv_lengths / page tables, seq_start baked into
+        the jitted body) tell the ragged kernel who owns which rows;
+        idle rows write to the trash page and emit garbage logits the
+        host never reads. Returns (prefill_tokens, decoded, finished).
+
+        Vs the split path: a request that completes its prefill emits
+        its first token from THIS launch and takes its first decode
+        step in the NEXT one (the split path decodes it the same
+        engine step) — per-request token sequences are identical, the
+        step count shifts by at most one."""
+        while self._prefill_fifo and \
+                self._prefill_fifo[0].state != PREFILL:
+            self._prefill_fifo.pop(0)
+        preq = self._prefill_fifo[0] if self._prefill_fifo else None
+        active = self.scheduler.active(DECODE)
+        if preq is None and not active:
+            return 0, 0, 0
+        B, C = self.max_slots, self.prefill_chunk
+        T, S = B + C, B + 1
+        ps, nj = self.page_size, self.pages_per_seq
+        tok = np.zeros(T, np.int32)
+        positions = np.zeros(T, np.int32)
+        num_tokens = np.zeros(S, np.int32)
+        kv_lengths = np.zeros(S, np.int32)
+        tables = np.zeros((S, nj), np.int32)   # idle -> trash page 0
+        tok_page = np.zeros(T, np.int32)
+        tok_off = np.zeros(T, np.int32)
+        for slot, req in active:
+            ln = self.allocator.seq_length(req.request_id)
+            self._apply_copies(self.allocator.extend(req.request_id, 1),
+                               req)
+            tbl = self.allocator.table(req.request_id)
+            tok[slot] = req.pending
+            positions[slot] = ln
+            num_tokens[slot] = 1
+            kv_lengths[slot] = ln + 1
+            tables[slot] = tbl
+            tok_page[slot] = tbl[ln // ps]
+            tok_off[slot] = ln % ps
+        n, start = 0, 0
+        if preq is not None:
+            start = preq.prefill_pos
+            n = min(C, int(preq.prompt.size) - start)
+            self._apply_copies(self.allocator.extend(preq.request_id, n),
+                               preq)
+            tbl = self.allocator.table(preq.request_id)
+            rows = np.arange(n)
+            tok[B:B + n] = preq.prompt[start:start + n]
+            positions[B:B + n] = start + rows
+            num_tokens[S - 1] = n
+            kv_lengths[S - 1] = start + n
+            tables[S - 1] = tbl
+            tok_page[B:B + n] = tbl[(start + rows) // ps]
+            tok_off[B:B + n] = (start + rows) % ps
+        args = (self._w, jnp.asarray(tok), self._pools,
+                jnp.asarray(positions), jnp.asarray(num_tokens),
+                jnp.asarray(kv_lengths), jnp.asarray(tables),
+                jnp.asarray(tok_page), jnp.asarray(tok_off))
+        if _tracing.enabled():
+            with _obs.span("serving.engine.unified_step") as sp:
+                logits, self._pools = self._jit_unified(*args)
+            _TRACE.set_host_span(sp.span_id)
+            if preq is not None:
+                _TRACE.stamp(preq.request_id, "prefill_chunk", tokens=n,
+                             start=start)
+        else:
+            logits, self._pools = self._jit_unified(*args)
+        logits = np.asarray(logits)                      # [S, vocab]
+        self.launches += 1
+        if _obs.enabled():
+            _LAUNCHES.labels(path="unified").inc()
+            _STEPS.labels(phase="unified").inc()
+            if n:
+                _TOKENS.labels(phase="prefill").inc(n)
+            if active:
+                _TOKENS.labels(phase="decode").inc(len(active))
+        finished = 0
+        if preq is not None:
+            preq.prefill_pos += n
+            if preq.prefill_pos == int(preq.prompt.size):
+                self._prefill_fifo.pop(0)
+                preq.state = DECODE
+                finished += self._emit(preq,
+                                       int(np.argmax(logits[S - 1])))
+        for slot, req in active:
+            finished += self._emit(req, int(np.argmax(logits[slot])))
+        _TRACE.set_host_span(None)
+        return n, len(active), finished
 
     def _emit(self, req: Request, tok: int) -> int:
         """Record one sampled token; finish on EOS/max-tokens (pages
@@ -382,6 +535,179 @@ class ServingEngine:
             return self._mla_prefill_body()
         return self._llama_prefill_body()
 
+    def _make_unified_body(self):
+        if self._family == "gpt":
+            return self._gpt_unified_body()
+        if self._family == "mla":
+            return self._mla_unified_body()
+        return self._llama_unified_body()
+
+    # -- unified ragged step -------------------------------------------
+    # One fused launch per engine step: T = max_slots + prefill_chunk
+    # flat token rows, S = max_slots + 1 sequences with BAKED seq_start
+    # [0..B-1, B] (decode slot i owns row i; the prefill chunk owns rows
+    # B..B+n-1). The per-layer body is the fused decode chain:
+    # fused_rms_norm -> qkv -> fused_rope_append (K/V row scatter rides
+    # the rope kernel) -> ragged_paged_attention -> o-proj -> ffn.
+    # No flags_guard: nothing in the chain is flag-routed.
+
+    def _llama_unified_body(self):
+        cfg = self._p["cfg"]
+        Hh, KV, D = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        eps = cfg.rms_norm_eps
+        moe_static = self._p.get("moe_static")
+        B, C = self.max_slots, self.prefill_chunk
+        T = B + C
+        seq_start = jnp.arange(B + 1, dtype=jnp.int32)
+
+        def step(w, tok, pools, positions, num_tokens, kv_lengths,
+                 tables, tok_page, tok_off):
+            x = w["embed"][tok][None]                    # [1, T, H]
+            c = w["cos"][positions]                      # [T, D/2]
+            s = w["sin"][positions]
+            new_pools = []
+            sts = moe_static or (None,) * len(w["layers"])
+            for L, (kp, vp), st in zip(w["layers"], pools, sts):
+                h = fused_rms_norm(x, L["ln1"], eps)
+                q, k, v = (_mm_w(h, L, "wq"), _mm_w(h, L, "wk"),
+                           _mm_w(h, L, "wv"))
+                if "bq" in L:
+                    q, k, v = q + L["bq"], k + L["bk"], v + L["bv"]
+                q, kp, vp = fused_rope_append(
+                    q.reshape(T, Hh, D), k.reshape(T, KV, D),
+                    v.reshape(T, KV, D), c, s, kp, vp, tok_page, tok_off)
+                new_pools.append((kp, vp))
+                o = ragged_paged_attention(q, kp, vp, seq_start,
+                                           num_tokens, kv_lengths,
+                                           tables, scale=D ** -0.5)
+                x = x + _mm_w(o.reshape(1, T, Hh * D), L, "wo")
+                h2 = fused_rms_norm(x, L["ln2"], eps)
+                x = x + _ffn_apply(L, h2, st)
+            x = fused_rms_norm(x, w["norm"], eps)
+            # each sequence's logits come from its LAST flat row; idle
+            # slots (num_tokens 0) index garbage the host ignores
+            last = x[0, jnp.clip(seq_start + num_tokens - 1, 0, T - 1)]
+            if "head_q" in w or "head_q4" in w:
+                logits = _mm_w(last, w, "head")
+            else:
+                logits = last @ (w["head"] if w["head"] is not None
+                                 else w["embed"].T)
+            return logits, new_pools
+
+        return step
+
+    def _gpt_unified_body(self):
+        cfg = self._p["cfg"]
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        eps = cfg.layer_norm_eps
+        B, C = self.max_slots, self.prefill_chunk
+        T = B + C
+        seq_start = jnp.arange(B + 1, dtype=jnp.int32)
+
+        def step(w, tok, pools, positions, num_tokens, kv_lengths,
+                 tables, tok_page, tok_off):
+            x = (w["embed"][tok] + w["pos"][positions])[None]
+            # identity rope (cos=1, sin=0): fused_rope_append becomes a
+            # pure fused K/V append, bitwise-exact on q/k
+            c = jnp.ones((T, hd // 2), x.dtype)
+            s = jnp.zeros((T, hd // 2), x.dtype)
+            new_pools = []
+            for L, (kp, vp) in zip(w["layers"], pools):
+                h = fused_layer_norm(x, L["ln1w"], L["ln1b"], eps)
+                qkv = h @ L["wqkv"] + L["bqkv"]
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                q, kp, vp = fused_rope_append(
+                    q.reshape(T, nh, hd), k.reshape(T, nh, hd),
+                    v.reshape(T, nh, hd), c, s, kp, vp,
+                    tok_page, tok_off)
+                new_pools.append((kp, vp))
+                o = ragged_paged_attention(q, kp, vp, seq_start,
+                                           num_tokens, kv_lengths,
+                                           tables, scale=hd ** -0.5)
+                x = x + (o.reshape(1, T, nh * hd) @ L["wo"] + L["bo"])
+                h2 = fused_layer_norm(x, L["ln2w"], L["ln2b"], eps)
+                x = x + (jax.nn.gelu(h2 @ L["wi"] + L["bi"],
+                                     approximate=True) @ L["wf"]
+                         + L["bf"])
+            x = fused_layer_norm(x, w["normw"], w["normb"], eps)
+            last = x[0, jnp.clip(seq_start + num_tokens - 1, 0, T - 1)]
+            logits = last @ (w["head"] if w["head"] is not None
+                             else w["embed"].T)
+            return logits, new_pools
+
+        return step
+
+    def _mla_unified_body(self):
+        cfg = self._p["cfg"]
+        nh = cfg.num_attention_heads
+        dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                      cfg.v_head_dim)
+        r = cfg.kv_lora_rank
+        eps = cfg.rms_norm_eps
+        scale = 1.0 / float(math.sqrt(dn + dr))
+        moe_static = self._p.get("moe_static")
+        B, C = self.max_slots, self.prefill_chunk
+        T = B + C
+        seq_start = jnp.arange(B + 1, dtype=jnp.int32)
+
+        def step(w, tok, pools, positions, num_tokens, kv_lengths,
+                 tables, tok_page, tok_off):
+            x = w["embed"][tok][None]                    # [1, T, H]
+            c = w["cos"][positions]                      # [T, dr/2]
+            s = w["sin"][positions]
+
+            def rope(t):                                 # [1, T, h, dr]
+                d2 = t.shape[-1] // 2
+                t1, t2 = t[..., :d2], t[..., d2:]
+                cc = c[None, :, None, :].astype(t.dtype)
+                ss = s[None, :, None, :].astype(t.dtype)
+                return jnp.concatenate(
+                    [t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
+
+            new_pools = []
+            sts = moe_static or (None,) * len(w["layers"])
+            for L, pool, st in zip(w["layers"], pools, sts):
+                h = fused_rms_norm(x, L["ln1"], eps)
+                if "wqa" in L or "wqa_q" in L or "wqa_q4" in L:
+                    q = _mm_w(fused_rms_norm(_mm_w(h, L, "wqa"),
+                                             L["gq"], eps), L, "wqb")
+                else:
+                    q = _mm_w(h, L, "wq")
+                q = q.reshape(1, T, nh, dn + dr)
+                q_nope, q_pe = q[..., :dn], q[..., dn:]
+                # rope runs on the split q_pe/k_pe shapes (not D-halved
+                # cache rows), so the append is the row-scatter kernel
+                q_pe = rope(q_pe)
+                kv_a = _mm_w(h, L, "wkva")               # [1, T, r+dr]
+                lat = fused_rms_norm(kv_a[..., :r], L["gkv"], eps)
+                k_pe = rope(kv_a[..., r:][:, :, None, :])[:, :, 0]
+                rows = jnp.concatenate([lat, k_pe], -1)[0][:, None]
+                pool = fused_append_rows(pool, rows, tok_page, tok_off)
+                new_pools.append(pool)
+                wkb = _dq(L, "wkvb", x.dtype).reshape(r, nh, dn + dv)
+                w_k, w_v = wkb[..., :dn], wkb[..., dn:]
+                q_eff = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_k)
+                q_cat = jnp.concatenate([q_eff, q_pe], -1)[0]
+                o_cat = ragged_paged_attention(q_cat, pool, pool,
+                                               seq_start, num_tokens,
+                                               kv_lengths, tables,
+                                               scale=scale)
+                o = jnp.einsum("tnr,rnv->tnv", o_cat[..., :r], w_v)
+                x = x + _mm_w(o.reshape(1, T, nh * dv), L, "wo")
+                h2 = fused_rms_norm(x, L["ln2"], eps)
+                x = x + _ffn_apply(L, h2, st)
+            x = fused_rms_norm(x, w["norm"], eps)
+            last = x[0, jnp.clip(seq_start + num_tokens - 1, 0, T - 1)]
+            if "head_q" in w or "head_q4" in w:
+                logits = _mm_w(last, w, "head")
+            else:
+                logits = last @ (w["head"] if w["head"] is not None
+                                 else w["embed"].T)
+            return logits, new_pools
+
+        return step
+
     # -- llama / moe ---------------------------------------------------
     def _llama_decode_body(self):
         cfg = self._p["cfg"]
@@ -396,9 +722,9 @@ class ServingEngine:
         paged_impl = flag("FLAGS_paged_impl")
 
         def rms(h, wt):
-            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
-                           keepdims=True)
-            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * wt
+            # routed through the fused Pallas kernel — same op order as
+            # the inline form (ulp-level), one HBM round-trip
+            return fused_rms_norm(h, wt, eps)
 
         def step(w, tok, pools, lengths, tables):
             B = tok.shape[0]
@@ -457,9 +783,9 @@ class ServingEngine:
         T = nj * ps
 
         def rms(h, wt):
-            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
-                           keepdims=True)
-            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * wt
+            # routed through the fused Pallas kernel — same op order as
+            # the inline form (ulp-level), one HBM round-trip
+            return fused_rms_norm(h, wt, eps)
 
         def prefill(w, ids, pools, table, start, n_valid):
             x = w["embed"][ids]                          # [1, C, H]
@@ -538,11 +864,9 @@ class ServingEngine:
         paged_impl = flag("FLAGS_paged_impl")
 
         def ln(h, wt, b):
-            h32 = h.astype(jnp.float32)
-            mu = jnp.mean(h32, -1, keepdims=True)
-            var = jnp.var(h32, -1, keepdims=True)
-            return (((h32 - mu) * jax.lax.rsqrt(var + eps))
-                    .astype(h.dtype) * wt + b)
+            # routed through the fused Pallas kernel — same op order as
+            # the inline form (ulp-level), one HBM round-trip
+            return fused_layer_norm(h, wt, b, eps)
 
         def step(w, tok, pools, lengths, tables):
             B = tok.shape[0]
@@ -583,11 +907,9 @@ class ServingEngine:
         T = nj * ps
 
         def ln(h, wt, b):
-            h32 = h.astype(jnp.float32)
-            mu = jnp.mean(h32, -1, keepdims=True)
-            var = jnp.var(h32, -1, keepdims=True)
-            return (((h32 - mu) * jax.lax.rsqrt(var + eps))
-                    .astype(h.dtype) * wt + b)
+            # routed through the fused Pallas kernel — same op order as
+            # the inline form (ulp-level), one HBM round-trip
+            return fused_layer_norm(h, wt, b, eps)
 
         def prefill(w, ids, pools, table, start, n_valid):
             pos = start + jnp.arange(C)
@@ -654,9 +976,9 @@ class ServingEngine:
         paged_impl = flag("FLAGS_paged_impl")
 
         def rms(h, wt):
-            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
-                           keepdims=True)
-            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * wt
+            # routed through the fused Pallas kernel — same op order as
+            # the inline form (ulp-level), one HBM round-trip
+            return fused_rms_norm(h, wt, eps)
 
         def step(w, tok, pools, lengths, tables):
             B = tok.shape[0]
@@ -732,9 +1054,9 @@ class ServingEngine:
         T = nj * ps
 
         def rms(h, wt):
-            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1,
-                           keepdims=True)
-            return (h * jax.lax.rsqrt(var + eps).astype(h.dtype)) * wt
+            # routed through the fused Pallas kernel — same op order as
+            # the inline form (ulp-level), one HBM round-trip
+            return fused_rms_norm(h, wt, eps)
 
         def prefill(w, ids, pools, table, start, n_valid):
             x = w["embed"][ids]

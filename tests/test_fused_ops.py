@@ -47,6 +47,22 @@ class TestRmsNorm:
         np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_ulp_equal_to_inline_f32(self):
+        # the serving engine's bodies route their inline rms through
+        # this kernel: same op order (x * rsqrt(mean(x^2) + eps) * w),
+        # so any difference is last-ulp reduction/FMA reassociation —
+        # the engine-vs-solo exactness contract (greedy TOKEN equality)
+        # is checked end-to-end in test_serving_engine.py
+        x = _r(3, 7, 48, seed=5)
+        w = _r(48, seed=6) * 0.1 + 1.0
+        eps = 1e-6
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                       keepdims=True)
+        inline = (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
+        np.testing.assert_allclose(
+            np.asarray(fused_rms_norm(x, w, eps)), np.asarray(inline),
+            rtol=1e-6, atol=1e-6)
+
 
 class TestLayerNorm:
     def test_matches_reference(self):
@@ -59,6 +75,23 @@ class TestLayerNorm:
         ref = (x - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
+
+    def test_ulp_equal_to_inline_f32(self):
+        # the serving engine's GPT bodies route their inline ln through
+        # this kernel: same op order, so differences are last-ulp only
+        # (greedy token-level exactness checked in test_serving_engine)
+        x = _r(2, 5, 32, seed=8)
+        w = _r(32, seed=9) * 0.1 + 1.0
+        b = _r(32, seed=10) * 0.1
+        eps = 1e-5
+        h32 = x.astype(jnp.float32)
+        mu = jnp.mean(h32, -1, keepdims=True)
+        var = jnp.var(h32, -1, keepdims=True)
+        inline = (((h32 - mu) * jax.lax.rsqrt(var + eps))
+                  .astype(x.dtype) * w + b)
+        np.testing.assert_allclose(
+            np.asarray(fused_layer_norm(x, w, b, eps)),
+            np.asarray(inline), rtol=1e-6, atol=1e-6)
 
 
 class TestRope:

@@ -1,0 +1,237 @@
+"""Ragged mixed prefill+decode paged-attention kernel
+(ops/pallas_ragged.py) and the fused rope+append scatter kernels
+(ops/fused.fused_rope_append / fused_append_rows). The plain-XLA
+ragged_attention_reference is the correctness oracle. Runs in Pallas
+interpret mode on CPU: same kernel logic as the TPU path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.fused import fused_append_rows, fused_rope_append
+from paddle_tpu.ops.pallas_ragged import (ragged_attention_reference,
+                                          ragged_kernel_eligible,
+                                          ragged_paged_attention)
+
+
+def _setup(T, S, H, KV, D, psz, pps, seed=0, dtype=jnp.float32):
+    """Random pools + a ragged batch layout: sequence row spans are
+    chosen disjoint inside [0, T); kv_lengths include the new tokens."""
+    rng = np.random.RandomState(seed)
+    total = S * pps + 1
+    q = jnp.asarray(rng.randn(T, H, D), dtype)
+    kp = jnp.asarray(rng.randn(KV, total, psz, D), dtype)
+    vp = jnp.asarray(rng.randn(KV, total, psz, D), dtype)
+    tab = jnp.asarray(1 + rng.permutation(total - 1)[:S * pps]
+                      .reshape(S, pps), jnp.int32)
+    # carve T rows into S disjoint spans (some possibly empty)
+    cuts = np.sort(rng.choice(T + 1, S - 1, replace=False)) \
+        if S > 1 else np.array([], np.int64)
+    starts = np.concatenate([[0], cuts]).astype(np.int32)
+    ends = np.concatenate([cuts, [T]]).astype(np.int32)
+    nt = (ends - starts).astype(np.int32)
+    kvl = np.zeros(S, np.int32)
+    for i in range(S):
+        lo = max(int(nt[i]), 1)
+        kvl[i] = rng.randint(lo, pps * psz + 1)
+    kvl = np.maximum(kvl, nt)
+    return (q, kp, vp, jnp.asarray(starts), jnp.asarray(nt),
+            jnp.asarray(kvl), tab)
+
+
+def _check(q, kp, vp, ss, nt, kvl, tab, atol=2e-5, rtol=2e-5):
+    out = ragged_paged_attention(q, kp, vp, ss, nt, kvl, tab)
+    ref = ragged_attention_reference(q, kp, vp, ss, nt, kvl, tab)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=atol, rtol=rtol)
+    return out
+
+
+class TestRaggedKernelParity:
+    @pytest.mark.parametrize("T,S,H,KV,D,psz,pps", [
+        (12, 3, 8, 2, 128, 16, 4),   # GQA rep=4, mixed spans
+        (9, 4, 4, 1, 64, 16, 2),     # MQA, D=64, non-128-multiple T
+        (20, 2, 4, 4, 128, 8, 4),    # MHA rep=1, small pages
+    ])
+    def test_matches_reference(self, T, S, H, KV, D, psz, pps):
+        _check(*_setup(T, S, H, KV, D, psz, pps))
+
+    def test_mixed_prefill_decode_batch(self):
+        # the engine's exact shape: decode rows 0..B-1 (1 token each),
+        # a prefill chunk on rows B.., kv_lengths include the new rows
+        B, C, psz, pps, KV, H, D = 3, 5, 8, 4, 2, 4, 64
+        T, S = B + C, B + 1
+        rng = np.random.RandomState(1)
+        total = S * pps + 1
+        q = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+        kp = jnp.asarray(rng.randn(KV, total, psz, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(KV, total, psz, D), jnp.float32)
+        tab = jnp.asarray(1 + rng.permutation(total - 1)[:S * pps]
+                          .reshape(S, pps), jnp.int32)
+        ss = jnp.asarray(list(range(B)) + [B], jnp.int32)
+        nt = jnp.asarray([1, 1, 1, C], jnp.int32)
+        kvl = jnp.asarray([7, 19, 1, 6 + C], jnp.int32)
+        _check(q, kp, vp, ss, nt, kvl, tab)
+
+    def test_empty_slots_emit_zeros(self):
+        # num_tokens=0 rows (idle engine slots) must come back all-zero
+        q, kp, vp, ss, nt, kvl, tab = _setup(10, 3, 4, 2, 128, 16, 2,
+                                             seed=2)
+        nt = nt.at[1].set(0)
+        out = _check(q, kp, vp, ss, nt, kvl, tab)
+        lo, hi = int(ss[1]), int(ss[1]) + 0
+        covered = np.zeros(10, bool)
+        ss_np, nt_np = np.asarray(ss), np.asarray(nt)
+        for i in range(3):
+            covered[ss_np[i]:ss_np[i] + nt_np[i]] = True
+        np.testing.assert_array_equal(
+            np.asarray(out)[~covered], 0.0)
+
+    def test_sentinel_table_entries(self):
+        # dead tail pages marked -1 (allocator sentinel): clamped, never
+        # read (kv_length masks them), parity holds
+        q, kp, vp, ss, nt, kvl, tab = _setup(8, 2, 4, 2, 64, 16, 4,
+                                             seed=3)
+        kvl = jnp.minimum(kvl, 16)      # only page 0 of each seq live
+        tab = tab.at[:, 1:].set(-1)
+        _check(q, kp, vp, ss, nt, kvl, tab)
+
+    def test_single_sequence_whole_buffer(self):
+        # degenerate batch: one sequence owns every row (pure prefill)
+        T = 16
+        q, kp, vp, _, _, _, tab = _setup(T, 1, 8, 2, 128, 16, 4, seed=4)
+        ss = jnp.asarray([0], jnp.int32)
+        nt = jnp.asarray([T], jnp.int32)
+        kvl = jnp.asarray([T + 13], jnp.int32)
+        _check(q, kp, vp, ss, nt, kvl, tab)
+
+    def test_causality_within_chunk(self):
+        # a token must NOT see later chunk rows: flipping a later row's
+        # K/V leaves earlier rows' outputs unchanged
+        T, psz, pps = 6, 8, 2
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(T, 4, 64), jnp.float32)
+        kp = jnp.asarray(rng.randn(2, pps + 1, psz, 64), jnp.float32)
+        vp = jnp.asarray(rng.randn(2, pps + 1, psz, 64), jnp.float32)
+        tab = jnp.asarray([[1, 2]], jnp.int32)
+        ss = jnp.asarray([0], jnp.int32)
+        nt = jnp.asarray([T], jnp.int32)
+        kvl = jnp.asarray([T], jnp.int32)   # chunk starts the sequence
+        out1 = ragged_paged_attention(q, kp, vp, ss, nt, kvl, tab)
+        # last token's K/V row lives at position T-1 -> page tab[0, .]
+        pg, off = (T - 1) // psz, (T - 1) % psz
+        kp2 = kp.at[:, tab[0, pg], off].set(99.0)
+        vp2 = vp.at[:, tab[0, pg], off].set(-99.0)
+        out2 = ragged_paged_attention(q, kp2, vp2, ss, nt, kvl, tab)
+        np.testing.assert_array_equal(np.asarray(out1)[:T - 1],
+                                      np.asarray(out2)[:T - 1])
+
+    def test_bf16(self):
+        q, kp, vp, ss, nt, kvl, tab = _setup(12, 3, 8, 2, 128, 16, 4,
+                                             seed=6, dtype=jnp.bfloat16)
+        out = ragged_paged_attention(q, kp, vp, ss, nt, kvl, tab)
+        ref = ragged_attention_reference(q, kp, vp, ss, nt, kvl, tab)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=3e-2, rtol=3e-2)
+
+    def test_eligibility_mirrors_paged(self):
+        assert ragged_kernel_eligible(8, 2, 128, 16)
+        assert ragged_kernel_eligible(4, 1, 64, 16)
+        assert not ragged_kernel_eligible(4, 1, 24, 16)   # tiny MLA D
+        assert not ragged_kernel_eligible(3, 2, 128, 16)  # H % KV != 0
+
+
+class TestFusedRopeAppend:
+    def _setup(self, T, Hq, KV, D, psz, total, seed=0):
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(T, Hq, D), jnp.float32)
+        k = jnp.asarray(rng.randn(T, KV, D), jnp.float32)
+        v = jnp.asarray(rng.randn(T, KV, D), jnp.float32)
+        cos = jnp.asarray(rng.randn(T, D // 2), jnp.float32)
+        sin = jnp.asarray(rng.randn(T, D // 2), jnp.float32)
+        kp = jnp.asarray(rng.randn(KV, total, psz, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(KV, total, psz, D), jnp.float32)
+        return q, k, v, cos, sin, kp, vp
+
+    @staticmethod
+    def _rot(x, c, s):
+        d2 = x.shape[-1] // 2
+        x1, x2 = x[..., :d2], x[..., d2:]
+        cc, ss = c[:, None, :], s[:, None, :]
+        return jnp.concatenate([x1 * cc - x2 * ss,
+                                x2 * cc + x1 * ss], -1)
+
+    def test_rope_and_scatter(self):
+        # engine-shaped page walk: decode rows on distinct pages, then
+        # an adjacent prefill run sharing pages, idle rows on trash 0
+        T, KV, D, psz, total = 7, 2, 64, 4, 9
+        q, k, v, cos, sin, kp, vp = self._setup(T, 4, KV, D, psz, total)
+        pg = jnp.asarray([3, 5, 0, 7, 7, 7, 8], jnp.int32)
+        off = jnp.asarray([1, 3, 0, 0, 1, 2, 0], jnp.int32)
+        qo, kp2, vp2 = fused_rope_append(q, k, v, cos, sin, kp, vp,
+                                         pg, off)
+        np.testing.assert_allclose(np.asarray(qo),
+                                   np.asarray(self._rot(q, cos, sin)),
+                                   atol=1e-6)
+        kref, vref = np.array(kp), np.array(vp)
+        kr = np.asarray(self._rot(k, cos, sin))
+        vr = np.asarray(v)
+        for t in range(T):
+            kref[:, int(pg[t]), int(off[t])] = kr[t]
+            vref[:, int(pg[t]), int(off[t])] = vr[t]
+        # every page except trash 0 must match exactly (V bitwise; K is
+        # roped in f32 in both paths)
+        np.testing.assert_array_equal(np.asarray(vp2)[:, 1:],
+                                      vref[:, 1:])
+        np.testing.assert_allclose(np.asarray(kp2)[:, 1:], kref[:, 1:],
+                                   atol=1e-6)
+
+    def test_identity_rope_bitwise(self):
+        # cos=1/sin=0 (the GPT family's pure append): bitwise passthrough
+        T, KV, D, psz, total = 4, 2, 64, 4, 5
+        q, k, v, _, _, kp, vp = self._setup(T, 4, KV, D, psz, total,
+                                            seed=1)
+        cos = jnp.ones((T, D // 2), jnp.float32)
+        sin = jnp.zeros((T, D // 2), jnp.float32)
+        pg = jnp.asarray([1, 2, 3, 4], jnp.int32)
+        off = jnp.asarray([0, 1, 2, 3], jnp.int32)
+        qo, kp2, vp2 = fused_rope_append(q, k, v, cos, sin, kp, vp,
+                                         pg, off)
+        np.testing.assert_array_equal(np.asarray(qo), np.asarray(q))
+        kref, vref = np.array(kp), np.array(vp)
+        for t in range(T):
+            kref[:, int(pg[t]), int(off[t])] = np.asarray(k)[t]
+            vref[:, int(pg[t]), int(off[t])] = np.asarray(v)[t]
+        np.testing.assert_array_equal(np.asarray(kp2)[:, 1:],
+                                      kref[:, 1:])
+        np.testing.assert_array_equal(np.asarray(vp2)[:, 1:],
+                                      vref[:, 1:])
+
+    def test_append_rows(self):
+        # the MLA latent-row scatter (KV=1 single pool)
+        T, D, psz, total = 5, 24, 4, 6
+        rng = np.random.RandomState(2)
+        rows = jnp.asarray(rng.randn(T, 1, D), jnp.float32)
+        pool = jnp.asarray(rng.randn(1, total, psz, D), jnp.float32)
+        pg = jnp.asarray([2, 2, 2, 4, 5], jnp.int32)
+        off = jnp.asarray([1, 2, 3, 0, 3], jnp.int32)
+        out = fused_append_rows(pool, rows, pg, off)
+        ref = np.array(pool)
+        for t in range(T):
+            ref[:, int(pg[t]), int(off[t])] = np.asarray(rows)[t]
+        np.testing.assert_array_equal(np.asarray(out)[:, 1:],
+                                      ref[:, 1:])
+
+
+class TestRaggedJit:
+    def test_jit_no_retrace_on_data_change(self):
+        # the engine's contract: joins/leaves are data changes only
+        args1 = _setup(12, 3, 8, 2, 128, 16, 4, seed=7)
+        args2 = _setup(12, 3, 8, 2, 128, 16, 4, seed=8)
+        f = jax.jit(ragged_paged_attention)
+        f(*args1)
+        f(*args2)
+        assert f._cache_size() == 1

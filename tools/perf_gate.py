@@ -46,7 +46,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # SERVING_BENCH fields gated per row (all higher-is-better throughputs)
 SERVING_FIELDS = ("decode_tokens_per_s_per_chip", "prefill_tokens_per_s",
-                  "inflight_tokens_per_s")
+                  "inflight_tokens_per_s", "ragged_tokens_per_s")
 
 
 def _load(path: str) -> Optional[Dict[str, Any]]:

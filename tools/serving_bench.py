@@ -670,7 +670,10 @@ def _serving_engine_row(model, cfg, reqs, max_slots, page_size, rounds):
         static_tokens_per_s=round(useful * rounds / sum(sta_ts), 1),
         # per-round static_time/engine_time: >1 means in-flight wins
         inflight_vs_static=ratio_band(sta_ts, eng_ts),
-        decode_programs_compiled=eng._jit_decode._cache_size(),
+        # {program_name: cache_size} — every value must stay 1 (the
+        # engine's PT002 contract); ragged engines expose "unified",
+        # split engines "decode"/"prefill"
+        programs_compiled=eng.program_cache_sizes(),
         note="same mixed-length trace both ways; tokens/s counts only "
              "the REQUESTED new tokens, so static batching pays for its "
              "padded rows and dead decode steps. The engine decodes via "
@@ -681,6 +684,69 @@ def _serving_engine_row(model, cfg, reqs, max_slots, page_size, rounds):
     report = write_serving_report(rep_path, extra=dict(throughput=row))
     row["engine_totals"] = report["totals"]
     return row
+
+
+def bench_serving_engine_ragged(n=16, max_slots=8, page_size=16, rounds=3,
+                                smin=64, smax=513, mmin=32, mmax=257,
+                                seed=0, dtype="bfloat16"):
+    """Unified ragged dispatch vs the legacy split prefill/decode
+    dispatch on the SAME mixed-length trace and engine geometry: the
+    ragged path launches ONE fused program per engine step (the ragged
+    paged-attention kernel covers the prefill chunk and every decode row
+    in a single pallas_call) where the split path launches a prefill
+    program AND a decode program whenever both phases are in flight."""
+    from bench_util import ratio_band
+    from paddle_tpu.serving import ServingEngine
+
+    total = 1024
+    _log(f"serving_engine_ragged: init model n={n} slots={max_slots}")
+    cfg, model = _llama_bench_raw_model(total, dtype)
+    rng = np.random.RandomState(seed)
+    reqs = [(rng.randint(0, cfg.vocab_size,
+                         int(rng.randint(smin, smax))).astype(np.int32),
+             int(rng.randint(mmin, mmax)))
+            for _ in range(n)]
+    engines = {"ragged": ServingEngine(model, max_slots=max_slots,
+                                       page_size=page_size, ragged=True),
+               "split": ServingEngine(model, max_slots=max_slots,
+                                      page_size=page_size, ragged=False)}
+
+    def run(eng):
+        for p, m in reqs:
+            eng.add_request(p, max_new_tokens=m)
+        eng.run_to_completion()
+
+    useful = sum(m for _, m in reqs)
+    launches = {}
+    for name, eng in engines.items():
+        _log(f"serving_engine_ragged: warm {name}")
+        run(eng)                       # compiles the path's programs
+        eng.launches = 0
+        run(eng)
+        launches[name] = eng.launches  # steady-state launches per trace
+    ts = {"ragged": [], "split": []}
+    for _ in range(rounds):            # same-run interleaved A/B
+        for name, eng in engines.items():
+            t0 = time.time()
+            run(eng)
+            ts[name].append(time.time() - t0)
+    return dict(
+        requests=len(reqs), max_slots=max_slots, page_size=page_size,
+        prompt_tokens=int(sum(p.size for p, _ in reqs)),
+        useful_new_tokens=int(useful),
+        ragged_tokens_per_s=round(useful * rounds / sum(ts["ragged"]), 1),
+        split_tokens_per_s=round(useful * rounds / sum(ts["split"]), 1),
+        # per-round split_time/ragged_time: >1 means unified dispatch wins
+        ragged_vs_split=ratio_band(ts["split"], ts["ragged"]),
+        launches_per_trace=launches,
+        programs_compiled={name: eng.program_cache_sizes()
+                           for name, eng in engines.items()},
+        note="same trace, same model, same slots both ways; "
+             "launches_per_trace records the dispatch-count gap the "
+             "fusion removes (the unified step also skips the dead "
+             "launch a phase-empty step would pay). tokens/s counts "
+             "only the requested new tokens. CPU-host numbers are not "
+             "the record — the host-side step loop dominates tiny steps")
 
 
 def bench_serving_engine(n=16, max_slots=8, page_size=16, rounds=3,
@@ -736,6 +802,7 @@ ROWS = {
     "prefill_8k_llama": lambda: bench_prefill_long("llama"),
     "prefill_8k_mla": lambda: bench_prefill_long("mla"),
     "serving_engine": lambda: bench_serving_engine(),
+    "serving_engine_ragged": lambda: bench_serving_engine_ragged(),
     "_paged": _paged_sweep_row,
 }
 
