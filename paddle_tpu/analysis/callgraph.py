@@ -57,10 +57,20 @@ def _dotted(func: ast.AST) -> Optional[str]:
     return None
 
 
+def partial_inner(node: ast.AST) -> Optional[ast.AST]:
+    """The wrapped callable of a ``functools.partial(fn, ...)`` /
+    ``partial(fn, ...)`` call (any import alias whose last name is
+    ``partial``), else None."""
+    if isinstance(node, ast.Call) and node.args \
+            and _last_name(node.func) == "partial":
+        return node.args[0]
+    return None
+
+
 class FunctionInfo:
     __slots__ = ("modname", "qualname", "node", "params", "lineno",
                  "class_name", "calls", "returned_defs", "returned_calls",
-                 "local_factory_vars")
+                 "local_factory_vars", "local_partial_vars")
 
     def __init__(self, modname: str, qualname: str, node, class_name=None):
         self.modname = modname
@@ -82,6 +92,9 @@ class FunctionInfo:
         self.returned_defs: Set[str] = set()    # keys of local defs returned
         self.returned_calls: Set[str] = set()   # keys of callees whose result is returned
         self.local_factory_vars: Dict[str, Set[str]] = {}  # var -> callee keys
+        # var bound to functools.partial(fn, ...): var -> keys of fn ITSELF
+        # (not of what fn returns — a partial closes over the function)
+        self.local_partial_vars: Dict[str, Set[str]] = {}
 
     @property
     def key(self) -> str:
@@ -296,6 +309,14 @@ class PackageIndex:
         for node in walk_shallow(root):
             if isinstance(node, ast.Assign) \
                     and isinstance(node.value, ast.Call):
+                inner = partial_inner(node.value)
+                if inner is not None:
+                    pkeys = self._direct_func_keys(mi, fi, inner)
+                    if pkeys:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                fi.local_partial_vars[t.id] = pkeys
+                        continue
                 ckeys, _ = self._resolve_call(mi, fi, node.value)
                 if ckeys:
                     for t in node.targets:
@@ -317,11 +338,19 @@ class PackageIndex:
                     qn = f"{fi.qualname}.{v.id}"
                     if qn in mi.functions:
                         fi.returned_defs.add(f"{mi.modname}:{qn}")
+                    elif v.id in fi.local_partial_vars:
+                        fi.returned_defs.update(fi.local_partial_vars[v.id])
                     elif v.id in fi.local_factory_vars:
                         fi.returned_calls.update(fi.local_factory_vars[v.id])
                 elif isinstance(v, ast.Call):
-                    ckeys, _ = self._resolve_call(mi, fi, v)
-                    fi.returned_calls.update(ckeys)
+                    inner = partial_inner(v)
+                    if inner is not None:
+                        # a returned partial IS its wrapped function
+                        fi.returned_defs.update(
+                            self._direct_func_keys(mi, fi, inner))
+                    else:
+                        ckeys, _ = self._resolve_call(mi, fi, v)
+                        fi.returned_calls.update(ckeys)
                 elif isinstance(v, ast.Lambda):
                     qn = f"{fi.qualname}.<lambda:{v.lineno}>"
                     if qn in mi.functions:
@@ -390,17 +419,36 @@ class PackageIndex:
                     out.add(f"{mi.modname}:{qn}")
             if not out and arg.id in mi.functions:
                 out.add(f"{mi.modname}:{arg.id}")
+            # ... or a local var holding a partial (the wrapped function)
+            if not out and fi is not None \
+                    and arg.id in fi.local_partial_vars:
+                out |= fi.local_partial_vars[arg.id]
             # ... or a local var holding a factory product
             if not out and fi is not None \
                     and arg.id in fi.local_factory_vars:
                 for fk in fi.local_factory_vars[arg.id]:
                     out |= self._returned_defs(fk, set())
         elif isinstance(arg, ast.Call):
+            inner = partial_inner(arg)
+            if inner is not None:
+                # functools.partial(kernel_body, ...) passed straight to a
+                # trace wrapper (the dominant pallas_call idiom)
+                out |= self._direct_func_keys(mi, fi, inner)
+                return out
             # jax.jit(make_body(...)) — the factory's returned defs
             ckeys, _ = self._resolve_call(mi, fi, arg)
             for ck in ckeys:
                 out |= self._returned_defs(ck, set())
         return out
+
+    def _direct_func_keys(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                          arg: ast.AST) -> Set[str]:
+        """Keys of the function(s) an expression IS (a def name, lambda,
+        or nested partial) — as opposed to what a factory call returns."""
+        inner = partial_inner(arg)
+        if inner is not None:
+            return self._direct_func_keys(mi, fi, inner)
+        return self._funcs_from_arg(mi, fi, arg)
 
     def _closure(self, roots: Set[str]) -> Set[str]:
         seen = set(roots)

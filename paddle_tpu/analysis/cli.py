@@ -17,15 +17,18 @@ from collections import Counter
 from typing import List, Optional
 
 from . import baseline as baseline_mod
-from .model import RULES, Config
+from .model import RULE_SEVERITIES, RULES, Config, rule_family
 from .runner import analyze_paths
+
+#: sentinel for a bare ``--rules`` (no ids): print the rule table
+_LIST = "__list__"
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="paddlelint",
         description="TPU/JAX-aware static analysis for paddle_tpu "
-                    "(rules PT001-PT006; see docs/ANALYSIS.md)")
+                    "(rule families PT/PK/PC; see docs/ANALYSIS.md)")
     p.add_argument("paths", nargs="*", default=["paddle_tpu"],
                    help="package dirs or files to analyze "
                         "(default: paddle_tpu)")
@@ -39,8 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(preserving existing justifications) and exit 0")
     p.add_argument("--strict", action="store_true",
                    help="also report info-severity findings")
-    p.add_argument("--rules", metavar="IDS",
-                   help="comma-separated subset, e.g. PT001,PT003")
+    p.add_argument("--rules", metavar="IDS", nargs="?", const=_LIST,
+                   help="comma-separated subset, e.g. PT001,PK101; with "
+                        "no ids, print the rule table and exit")
+    p.add_argument("--only", metavar="IDS",
+                   help="alias of --rules IDS for fast local runs, "
+                        "e.g. --only PK101,PK103 (union of both flags)")
     p.add_argument("--fail-stale", action="store_true",
                    help="exit 1 when baseline entries no longer match")
     p.add_argument("--list-rules", action="store_true",
@@ -48,15 +55,21 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _print_rule_table() -> None:
+    for rid in sorted(RULES):
+        sev = RULE_SEVERITIES.get(rid, "warning")
+        print(f"{rid}  {sev:<8}  {RULES[rid]}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    if args.list_rules:
-        for rid in sorted(RULES):
-            print(f"{rid}  {RULES[rid]}")
+    if args.list_rules or args.rules == _LIST:
+        _print_rule_table()
         return 0
     rules = None
-    if args.rules:
-        rules = {r.strip().upper() for r in args.rules.split(",")
+    requested = ",".join(s for s in (args.rules, args.only) if s)
+    if requested:
+        rules = {r.strip().upper() for r in requested.split(",")
                  if r.strip()}
         unknown = rules - set(RULES)
         if unknown:
@@ -92,11 +105,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     fresh, stale = baseline_mod.split(findings, base)
 
     if args.as_json:
+        families = {}
+        for rid in sorted(RULES):
+            fam = families.setdefault(rule_family(rid),
+                                      {"fresh": 0, "baselined": 0,
+                                       "rules": []})
+            fam["rules"].append(rid)
+        for f in fresh:
+            families[rule_family(f.rule)]["fresh"] += 1
+        for f in findings:
+            if f.baseline_key in base:
+                families[rule_family(f.rule)]["baselined"] += 1
+        unjustified = sorted(
+            k for k, j in base.items()
+            if not j.strip() or j.strip().lower().startswith("todo"))
         print(json.dumps({
             "findings": [f.to_dict() for f in fresh],
             "baselined": len(findings) - len(fresh),
             "stale_baseline_keys": stale,
-            "rules": RULES,
+            "rules": {rid: {"description": RULES[rid],
+                            "severity": RULE_SEVERITIES.get(rid, "warning")}
+                      for rid in sorted(RULES)},
+            "families": families,
+            "baseline": {"total": len(base), "stale": stale,
+                         "unjustified": unjustified},
         }, indent=2))
     else:
         for f in fresh:

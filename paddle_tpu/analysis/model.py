@@ -26,10 +26,21 @@ SEVERITIES = ("info", "warning", "error")
 
 #: rule id -> one-line description (filled by the rule modules at import)
 RULES: Dict[str, str] = {}
+#: rule id -> nominal severity (a rule may still emit individual findings
+#: at a lower severity, e.g. PK102's lane-alignment advisories)
+RULE_SEVERITIES: Dict[str, str] = {}
 
 
-def register_rule(rule_id: str, description: str) -> None:
+def register_rule(rule_id: str, description: str,
+                  severity: str = "warning") -> None:
     RULES[rule_id] = description
+    RULE_SEVERITIES[rule_id] = severity
+
+
+def rule_family(rule_id: str) -> str:
+    """'PK101' -> 'PK': the alphabetic prefix groups rules into families
+    (PT python-tracing hygiene, PK pallas-kernel, PC collective)."""
+    return rule_id.rstrip("0123456789") or rule_id
 
 
 @dataclasses.dataclass

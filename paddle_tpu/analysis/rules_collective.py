@@ -1,0 +1,139 @@
+"""PC201: collective-order divergence inside ``shard_map`` regions.
+
+A collective (``psum``/``all_gather``/...) is a *program-order* rendezvous:
+every rank must issue the same collectives in the same order or the mesh
+deadlocks — the exact failure PR 3's runtime watchdog can only catch
+after the fact. The static shape of that bug is a collective issued under
+a branch inside a function that runs as a ``shard_map`` body (or anything
+it calls): a Python ``if``/``while`` around a collective, or a collective
+inside a ``lax.cond``/``switch`` branch function, makes the issue order
+data-dependent.
+
+The region is built exactly like the traced region: functions passed to
+``shard_map(...)`` plus everything reachable from them through the call
+graph. All shipped collective wrappers in ``distributed/collective.py``
+keep their ``fn`` bodies straight-line — which is the contract this rule
+enforces.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .callgraph import PackageIndex, _last_name, walk_shallow
+from .model import Config, Finding, register_rule
+
+register_rule("PC201", "collective issued under a branch inside a "
+                       "shard_map region (cross-rank deadlock shape)",
+              severity="error")
+
+#: communicating primitives — axis_index etc. are local and excluded
+COLLECTIVES = {"psum", "pmax", "pmin", "pmean", "all_gather",
+               "psum_scatter", "all_to_all", "ppermute", "pshuffle",
+               "pbroadcast", "reduce_scatter_p", "all_gather_invariant"}
+
+_BRANCH_COMBINATORS = {"cond", "switch"}
+
+
+def _unparse(node: ast.AST, limit: int = 60) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover
+        s = type(node).__name__
+    s = " ".join(s.split())
+    return s if len(s) <= limit else s[: limit - 3] + "..."
+
+
+def _shard_map_region(index: PackageIndex) -> Set[str]:
+    roots: Set[str] = set()
+    for mi in index.modules.values():
+        for fi_or_none, call in index._all_calls(mi):
+            if _last_name(call.func) != "shard_map":
+                continue
+            for arg in list(call.args) + [kw.value for kw in call.keywords
+                                          if kw.arg in (None, "f")]:
+                roots |= index._direct_func_keys(mi, fi_or_none, arg)
+    return index.reachable_from(roots)
+
+
+def _branch_fn_keys(index: PackageIndex, region: Set[str]) -> Set[str]:
+    """Functions passed as branches to lax.cond/lax.switch from inside
+    the region — their whole body is conditionally executed."""
+    out: Set[str] = set()
+    for key in region:
+        fi = index.functions.get(key)
+        if fi is None:
+            continue
+        mi = index.modules[fi.modname]
+        for _, bare, call in fi.calls:
+            if bare not in _BRANCH_COMBINATORS:
+                continue
+            for arg in list(call.args[1:]) + [kw.value
+                                              for kw in call.keywords]:
+                out |= index._direct_func_keys(mi, fi, arg)
+    return out
+
+
+def _collective_calls(node: ast.AST) -> List[ast.Call]:
+    return [n for n in walk_shallow(node)
+            if isinstance(n, ast.Call)
+            and _last_name(n.func) in COLLECTIVES]
+
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    if not cfg.wants("PC201"):
+        return findings
+    region = _shard_map_region(index)
+    branch_fns = _branch_fn_keys(index, region)
+
+    def report(fi, mi, call: ast.Call, how: str) -> None:
+        name = _last_name(call.func)
+        findings.append(Finding(
+            "PC201", "error", mi.rel, call.lineno, call.col_offset,
+            fi.qualname,
+            f"collective `{name}` issued {how} inside a shard_map "
+            f"region — ranks that take a different path skip the "
+            f"rendezvous and the mesh deadlocks",
+            hint="hoist the collective out of the branch (compute a "
+                 "masked/neutral operand instead), or branch on a "
+                 "value provably uniform across ranks",
+            detail=f"branch-collective:{name}:{_unparse(call, 40)}"))
+
+    for key in sorted(branch_fns):
+        fi = index.functions.get(key)
+        if fi is None:
+            continue
+        mi = index.modules[fi.modname]
+        node = (ast.Module(body=[ast.Expr(fi.node.body)], type_ignores=[])
+                if isinstance(fi.node, ast.Lambda) else fi.node)
+        for call in _collective_calls(node):
+            report(fi, mi, call, "from a lax.cond/switch branch function")
+
+    for key in sorted(region - branch_fns):
+        fi = index.functions.get(key)
+        if fi is None or isinstance(fi.node, ast.Lambda):
+            continue
+        mi = index.modules[fi.modname]
+
+        def visit(node: ast.AST, in_branch: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # nested scope: its own FunctionInfo
+            if isinstance(node, ast.Call) and in_branch \
+                    and _last_name(node.func) in COLLECTIVES:
+                report(fi, mi, node, "under a Python branch")
+            if isinstance(node, (ast.If, ast.While)):
+                # the test itself executes unconditionally on every rank;
+                # the bodies do not
+                visit(node.test, in_branch)
+                for part in node.body + node.orelse:
+                    visit(part, True)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_branch)
+
+        for stmt in fi.node.body:
+            visit(stmt, False)
+    return findings

@@ -1,0 +1,517 @@
+"""Pallas-kernel rules PK101-PK105 (docs/ANALYSIS.md, kernel-verification
+section).
+
+All checks run over the :mod:`kernelmodel` view of each ``pallas_call``
+site and stay strictly syntactic: a site whose specs/grid/kernel cannot
+be resolved (helper-built spec lists, ``*refs`` kernels) opts out of the
+checks that need the missing piece rather than guessing.
+
+- **PK101** (error): an index_map that reads a scalar-prefetch table
+  without routing the read through a clamp, or returns a literal
+  negative block index. Grid ids are bounded by the grid domain; table
+  contents are not — the shipped page maps all wrap table reads in
+  ``jnp.clip``/``minimum``/``maximum`` because dead slots hold sentinel
+  entries, and an unclamped read DMAs from whatever address falls out.
+- **PK102** (error; lane advisories as warning): block-shape rank vs
+  index_map return arity, index_map parameter count vs grid +
+  scalar-prefetch domain, kernel positional-ref count vs the operand
+  list ``[prefetch, inputs, outputs, scratch]``, and literal lane dims
+  that are neither 1 nor a multiple of 128.
+- **PK103** (error): ``input_output_aliases`` hygiene — alias indices in
+  range (flat *input* indices include the prefetch operands), the
+  aliased output's ShapeDtypeStruct taking shape/dtype from the very
+  array passed at the aliased input slot, structurally identical
+  in/out BlockSpecs, and no unguarded read of the aliased input ref in
+  a kernel whose block map can revisit a block (the seed-on-first-visit
+  ``pl.when`` pattern).
+- **PK104** (warning): sub-f32 VMEM scratch or ``preferred_element_type``
+  in a kernel that does matmul/softmax work — the online-softmax
+  discipline accumulates in f32 and casts on the way out.
+- **PK105** (warning): a pallas kernel unit not reachable from any
+  ``register_oracle(...)`` registration — the certification contract of
+  ROADMAP item 5: every authored kernel names an XLA reference oracle
+  and an interpret-parity test.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, List, Optional, Set
+
+from .callgraph import (FunctionInfo, ModuleInfo, PackageIndex, _last_name,
+                        partial_inner, walk_shallow)
+from .kernelmodel import (SUB_F32_DTYPES, BlockSpecModel, IndexMapModel,
+                          KernelCallSite, collect_kernel_calls,
+                          negative_components, scratch_dtype_name,
+                          shape_dtype_struct, unclamped_prefetch_reads,
+                          unparse)
+from .model import Config, Finding, register_rule
+
+register_rule("PK101", "index_map block index out of bounds: unclamped "
+                       "scalar-prefetch table read or negative literal",
+              severity="error")
+register_rule("PK102", "BlockSpec/kernel mismatch: map arity, block rank "
+                       "vs map result, ref count, lane alignment",
+              severity="error")
+register_rule("PK103", "input_output_aliases hazard: index/shape/dtype/"
+                       "spec mismatch or unguarded aliased-input read",
+              severity="error")
+register_rule("PK104", "sub-f32 accumulator in a matmul/softmax kernel",
+              severity="warning")
+register_rule("PK105", "pallas kernel without a registered XLA reference "
+                       "oracle (register_oracle certification contract)",
+              severity="warning")
+
+_MATMUL_SOFTMAX_FUNCS = {"dot", "dot_general", "matmul", "exp", "exp2",
+                         "softmax", "logsumexp", "einsum"}
+
+
+def _site_specs(site: KernelCallSite):
+    """(kind, operand-index-base, spec) triples for every resolved spec."""
+    out = []
+    if site.in_specs is not None:
+        for i, s in enumerate(site.in_specs):
+            out.append(("in", site.n_prefetch + i, s))
+    if site.out_specs is not None:
+        for i, s in enumerate(site.out_specs):
+            out.append(("out", i, s))
+    return out
+
+
+def _n_outputs(site: KernelCallSite) -> Optional[int]:
+    if site.out_shapes is not None:
+        return len(site.out_shapes)
+    if site.out_specs is not None:
+        return len(site.out_specs)
+    return None
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    """Base variable of `x`, `x.attr`, `x[i]`, `x.astype(t)` chains."""
+    while True:
+        if isinstance(expr, ast.Call) and isinstance(expr.func,
+                                                     ast.Attribute):
+            expr = expr.func.value
+        elif isinstance(expr, (ast.Attribute, ast.Subscript)):
+            expr = expr.value
+        else:
+            break
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _map_reads_table(imap: IndexMapModel, n_grid: Optional[int]) -> bool:
+    """True when the index_map indexes any scalar-prefetch operand at all
+    (clamped or not): such a map can send two grid steps to the same
+    block — the revisit precondition for the PK103 seed pattern."""
+    if n_grid is None:
+        return False
+    prefetch = set(imap.params[n_grid:])
+    if not prefetch:
+        return False
+    for stmt in imap.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Subscript):
+                base = node.value
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id in prefetch:
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# PK101
+# ---------------------------------------------------------------------------
+
+def _check_oob(site: KernelCallSite, findings: List[Finding]) -> None:
+    for kind, opidx, spec in _site_specs(site):
+        imap = spec.index_map
+        if imap is None:
+            continue
+        for read in unclamped_prefetch_reads(imap, site.grid_len):
+            findings.append(Finding(
+                "PK101", "error", site.mi.rel, getattr(read, "lineno",
+                                                       site.line),
+                getattr(read, "col_offset", 0), site.qualname,
+                f"index_map `{imap.text}` reads scalar-prefetch table "
+                f"`{unparse(read)}` without a clamp — a sentinel/stale "
+                f"entry becomes an out-of-bounds block index and the DMA "
+                f"reads garbage silently",
+                hint="wrap the table read in jnp.clip/minimum/maximum "
+                     "against the operand's block count",
+                detail=f"oob:{kind}{opidx}:{unparse(read, 40)}"))
+        for comp in negative_components(imap):
+            findings.append(Finding(
+                "PK101", "error", site.mi.rel, getattr(comp, "lineno",
+                                                       site.line),
+                getattr(comp, "col_offset", 0), site.qualname,
+                f"index_map `{imap.text}` returns literal negative block "
+                f"index `{unparse(comp)}`",
+                hint="block indices count blocks from 0; negative values "
+                     "wrap outside the operand",
+                detail=f"neg:{kind}{opidx}:{unparse(comp, 40)}"))
+
+
+# ---------------------------------------------------------------------------
+# PK102
+# ---------------------------------------------------------------------------
+
+def _check_blockspec(site: KernelCallSite, findings: List[Finding]) -> None:
+    n_grid = site.grid_len
+    for kind, opidx, spec in _site_specs(site):
+        imap = spec.index_map
+        rank = spec.rank
+        if imap is not None and rank is not None:
+            for comps in imap.returns:
+                if len(comps) != rank:
+                    findings.append(Finding(
+                        "PK102", "error", site.mi.rel, site.line, 0,
+                        site.qualname,
+                        f"{kind}_spec[{opidx - (site.n_prefetch if kind == 'in' else 0)}]: "
+                        f"index_map `{imap.text}` returns {len(comps)} "
+                        f"component(s) for a rank-{rank} block "
+                        f"{unparse(ast.Tuple(elts=spec.block_shape, ctx=ast.Load()), 40)}",
+                        hint="one block index per block-shape dimension",
+                        detail=f"rank:{kind}{opidx}:{len(comps)}!={rank}"))
+                    break
+        if imap is not None and n_grid is not None:
+            want = n_grid + site.n_prefetch
+            if len(imap.params) != want:
+                findings.append(Finding(
+                    "PK102", "error", site.mi.rel, site.line, 0,
+                    site.qualname,
+                    f"index_map `{imap.text}` takes {len(imap.params)} "
+                    f"parameter(s) but the domain is {n_grid} grid id(s) "
+                    f"+ {site.n_prefetch} scalar-prefetch ref(s)",
+                    hint="index_map params are grid ids then prefetch "
+                         "refs, in order",
+                    detail=f"arity:{kind}{opidx}:{len(imap.params)}!={want}"))
+        if spec.block_shape:
+            lane = spec.block_shape[-1]
+            if isinstance(lane, ast.Constant) and isinstance(lane.value, int) \
+                    and lane.value != 1 and lane.value % 128 != 0:
+                findings.append(Finding(
+                    "PK102", "warning", site.mi.rel,
+                    getattr(lane, "lineno", site.line),
+                    getattr(lane, "col_offset", 0), site.qualname,
+                    f"block lane dimension {lane.value} is neither 1 nor "
+                    f"a multiple of 128 — Mosaic pads every tile",
+                    hint="use a 128-multiple lane (last) dimension",
+                    detail=f"lane:{kind}{opidx}:{lane.value}"))
+    # kernel positional-ref count vs operand list
+    params = site.kernel_positional_params()
+    n_out = _n_outputs(site)
+    if params is not None and site.in_specs is not None and n_out is not None:
+        n_scratch = len(site.scratch) if site.scratch is not None else 0
+        want = site.n_prefetch + len(site.in_specs) + n_out + n_scratch
+        if len(params) != want:
+            findings.append(Finding(
+                "PK102", "error", site.mi.rel,
+                site.kernel_fi.lineno if site.kernel_fi else site.line, 0,
+                site.qualname,
+                f"kernel `{site.kernel_fi.qualname}` takes {len(params)} "
+                f"ref(s) but the call site passes {want} "
+                f"({site.n_prefetch} prefetch + {len(site.in_specs)} in + "
+                f"{n_out} out + {n_scratch} scratch)",
+                hint="kernel refs are [prefetch, inputs, outputs, scratch] "
+                     "in order",
+                detail=f"refs:{len(params)}!={want}"))
+
+
+# ---------------------------------------------------------------------------
+# PK103
+# ---------------------------------------------------------------------------
+
+def _nested_fns(site: KernelCallSite) -> List[FunctionInfo]:
+    k = site.kernel_fi
+    if k is None:
+        return []
+    prefix = k.qualname + "."
+    return [fi for qn, fi in site.mi.functions.items()
+            if qn.startswith(prefix)]
+
+
+def _has_when_decorator(fi: FunctionInfo) -> bool:
+    for dec in getattr(fi.node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _last_name(target) == "when":
+            return True
+    return False
+
+
+def _reads_of(fi_node: ast.AST, name: str) -> List[ast.AST]:
+    out = []
+    for node in walk_shallow(fi_node):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == name:
+            out.append(node)
+    return out
+
+
+def _check_aliases(site: KernelCallSite, findings: List[Finding]) -> None:
+    if site.aliases is None:
+        if site.has_alias_kw:
+            # non-literal alias dict: nothing checkable
+            pass
+        return
+    n_in = (site.n_prefetch + len(site.in_specs)
+            if site.in_specs is not None else None)
+    n_out = _n_outputs(site)
+    params = site.kernel_positional_params()
+    for k, v in sorted(site.aliases.items()):
+        where = f"{{{k}: {v}}}"
+        if k < site.n_prefetch or (n_in is not None and k >= n_in) \
+                or (n_out is not None and (v < 0 or v >= n_out)):
+            findings.append(Finding(
+                "PK103", "error", site.mi.rel, site.line, 0, site.qualname,
+                f"input_output_aliases {where} out of range: inputs are "
+                f"flat indices {site.n_prefetch}..{(n_in or 0) - 1} "
+                f"(prefetch operands included), outputs 0..{(n_out or 0) - 1}",
+                hint="recount the flat operand list — scalar-prefetch "
+                     "args occupy the first input slots",
+                detail=f"alias-range:{k}:{v}"))
+            continue
+        # shape/dtype of the aliased output must come from the aliased arg
+        if site.out_shapes is not None and v < len(site.out_shapes) \
+                and site.arg_exprs is not None and k < len(site.arg_exprs):
+            sds = shape_dtype_struct(site.out_shapes[v])
+            argroot = _root_name(site.arg_exprs[k])
+            if sds is not None and argroot is not None:
+                shape_e, dtype_e = sds
+                for what, e in (("shape", shape_e), ("dtype", dtype_e)):
+                    ok = (isinstance(e, ast.Attribute) and e.attr == what
+                          and _root_name(e) == argroot)
+                    if not ok:
+                        findings.append(Finding(
+                            "PK103", "error", site.mi.rel,
+                            getattr(e, "lineno", site.line),
+                            getattr(e, "col_offset", 0), site.qualname,
+                            f"aliased output {v} declares {what} "
+                            f"`{unparse(e)}` but aliases input "
+                            f"`{argroot}` — an aliased pair shares one "
+                            f"buffer, so shape and dtype must be taken "
+                            f"from that same array",
+                            hint=f"use `{argroot}.{what}` in the "
+                                 f"ShapeDtypeStruct",
+                            detail=f"alias-{what}:{k}:{v}:{unparse(e, 32)}"))
+        # in/out BlockSpecs of an aliased pair must be identical
+        if site.in_specs is not None and site.out_specs is not None \
+                and v < len(site.out_specs):
+            ispec = site.in_specs[k - site.n_prefetch]
+            ospec = site.out_specs[v]
+            if ispec.resolved and ospec.resolved \
+                    and unparse(ispec.node, 200) != unparse(ospec.node, 200):
+                findings.append(Finding(
+                    "PK103", "error", site.mi.rel, site.line, 0,
+                    site.qualname,
+                    f"aliased pair {where} uses different BlockSpecs "
+                    f"(`{unparse(ispec.node, 48)}` vs "
+                    f"`{unparse(ospec.node, 48)}`) — the pair walks one "
+                    f"buffer, so the block tiling must match",
+                    hint="share one BlockSpec object between the aliased "
+                         "input and output",
+                    detail=f"alias-spec:{k}:{v}"))
+        # unguarded aliased-input read when the block map can revisit
+        if params is not None and site.in_specs is not None \
+                and site.out_specs is not None and v < len(site.out_specs):
+            ospec = site.out_specs[v]
+            revisit = (ospec.index_map is not None
+                       and _map_reads_table(ospec.index_map, site.grid_len))
+            in_param = params[k] if k < len(params) else None
+            if revisit and in_param is not None:
+                offending = list(_reads_of(site.kernel_fi.node, in_param))
+                for nf in _nested_fns(site):
+                    if not _has_when_decorator(nf):
+                        offending.extend(_reads_of(nf.node, in_param))
+                for read in offending:
+                    findings.append(Finding(
+                        "PK103", "error", site.mi.rel,
+                        getattr(read, "lineno", site.line),
+                        getattr(read, "col_offset", 0),
+                        site.kernel_fi.qualname,
+                        f"aliased input ref `{in_param}` read outside a "
+                        f"`pl.when` guard, but its block map revisits "
+                        f"blocks — after the first visit the aliased "
+                        f"buffer holds this kernel's own writes, not the "
+                        f"original input",
+                        hint="seed on first visit: read the input ref "
+                             "only inside `@pl.when(first_visit)` and "
+                             "write through the output ref after",
+                        detail=f"alias-raw:{in_param}:{unparse(read, 32)}"))
+
+
+# ---------------------------------------------------------------------------
+# PK104
+# ---------------------------------------------------------------------------
+
+def _kernel_does_matmul_softmax(site: KernelCallSite) -> bool:
+    if site.kernel_fi is None:
+        return False
+    nodes = [site.kernel_fi.node] + [nf.node for nf in _nested_fns(site)]
+    for root in nodes:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) \
+                    and _last_name(node.func) in _MATMUL_SOFTMAX_FUNCS:
+                return True
+    return False
+
+
+def _check_accumulator(site: KernelCallSite,
+                       findings: List[Finding]) -> None:
+    if not _kernel_does_matmul_softmax(site):
+        return
+    for expr in site.scratch or []:
+        dt = scratch_dtype_name(expr)
+        if dt in SUB_F32_DTYPES:
+            findings.append(Finding(
+                "PK104", "warning", site.mi.rel,
+                getattr(expr, "lineno", site.line),
+                getattr(expr, "col_offset", 0), site.qualname,
+                f"{dt} scratch accumulator `{unparse(expr)}` in a "
+                f"matmul/softmax kernel — running sums in sub-f32 lose "
+                f"the online-softmax renormalization guarantees",
+                hint="accumulate in float32 scratch and cast once on the "
+                     "final store",
+                detail=f"acc:{unparse(expr, 40)}"))
+    # sub-f32 preferred_element_type on dots inside the kernel body
+    if site.kernel_fi is None:
+        return
+    roots = [site.kernel_fi.node] + [nf.node for nf in _nested_fns(site)]
+    for root in roots:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            if _last_name(node.func) not in ("dot", "dot_general", "matmul"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "preferred_element_type" \
+                        and _last_name(kw.value) in SUB_F32_DTYPES:
+                    findings.append(Finding(
+                        "PK104", "warning", site.mi.rel, node.lineno,
+                        node.col_offset, site.qualname,
+                        f"`preferred_element_type={_last_name(kw.value)}` "
+                        f"on a kernel matmul — the MXU accumulates in "
+                        f"f32; asking for a narrower result dtype "
+                        f"truncates partial sums",
+                        hint="prefer float32 and cast the final result",
+                        detail=f"pet:{unparse(node, 40)}"))
+
+
+# ---------------------------------------------------------------------------
+# PK105 — oracle certification
+# ---------------------------------------------------------------------------
+
+def _registered_kernel_keys(index: PackageIndex) -> Set[str]:
+    keys: Set[str] = set()
+    for mi in index.modules.values():
+        for fi_or_none, call in index._all_calls(mi):
+            if _last_name(call.func) != "register_oracle":
+                continue
+            kexpr = None
+            if len(call.args) > 1:
+                kexpr = call.args[1]
+            for kw in call.keywords:
+                if kw.arg == "kernel":
+                    kexpr = kw.value
+            if kexpr is None:
+                continue
+            keys |= index._direct_func_keys(mi, fi_or_none, kexpr)
+            # cross-module registration: `from .x import k; register_oracle(.., k)`
+            inner = partial_inner(kexpr)
+            target = inner if inner is not None else kexpr
+            if isinstance(target, ast.Name) \
+                    and target.id in mi.import_names:
+                src, orig = mi.import_names[target.id]
+                if f"{src}:{orig}" in index.functions:
+                    keys.add(f"{src}:{orig}")
+    return keys
+
+
+def _defvjp_edges(index: PackageIndex) -> Dict[str, Set[str]]:
+    edges: Dict[str, Set[str]] = defaultdict(set)
+    for mi in index.modules.values():
+        for fi_or_none, call in index._all_calls(mi):
+            if not (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "defvjp"):
+                continue
+            rkeys = index._funcs_from_arg(mi, fi_or_none, call.func.value)
+            akeys: Set[str] = set()
+            for a in call.args:
+                akeys |= index._direct_func_keys(mi, fi_or_none, a)
+            for rk in rkeys:
+                edges[rk] |= akeys
+    return edges
+
+
+def _cert_closure(index: PackageIndex, roots: Set[str]) -> Set[str]:
+    """Everything reachable from the registered kernels through call
+    edges, factory returns, partial bindings and custom_vjp defvjp
+    linkage — the set of functions 'covered' by some oracle."""
+    edges = _defvjp_edges(index)
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        key = frontier.pop()
+        nxt: Set[str] = set(edges.get(key, ()))
+        fi = index.functions.get(key)
+        if fi is not None:
+            for keys, _, _ in fi.calls:
+                nxt |= keys
+            nxt |= fi.returned_defs | fi.returned_calls
+            for pkeys in fi.local_partial_vars.values():
+                nxt |= pkeys
+        for ck in nxt:
+            if ck not in seen and ck in index.functions:
+                seen.add(ck)
+                frontier.append(ck)
+    return seen
+
+
+def _check_oracles(index: PackageIndex, sites: List[KernelCallSite],
+                   findings: List[Finding]) -> None:
+    covered = _cert_closure(index, _registered_kernel_keys(index))
+    reported: Set[str] = set()
+    for site in sites:
+        if site.fi is None:
+            continue
+        parts = site.fi.qualname.split(".")
+        chain = {f"{site.mi.modname}:{'.'.join(parts[:i])}"
+                 for i in range(1, len(parts) + 1)}
+        if chain & covered:
+            continue
+        unit = f"{site.mi.modname}:{site.top_qualname}"
+        if unit in reported:
+            continue
+        reported.add(unit)
+        top_fi = site.mi.functions.get(site.top_qualname)
+        findings.append(Finding(
+            "PK105", "warning", site.mi.rel,
+            top_fi.lineno if top_fi else site.line, 0, site.top_qualname,
+            f"pallas kernel unit `{site.top_qualname}` has no registered "
+            f"XLA reference oracle — nothing certifies the kernel "
+            f"against a known-good implementation",
+            hint="register_oracle(name, kernel=<public entry>, reference="
+                 "<XLA impl>, parity_test=<tests node id>) in this module",
+            detail=f"oracle:{site.top_qualname}"))
+
+
+# ---------------------------------------------------------------------------
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = collect_kernel_calls(index)
+    for site in sites:
+        if cfg.wants("PK101"):
+            _check_oob(site, findings)
+        if cfg.wants("PK102"):
+            _check_blockspec(site, findings)
+        if cfg.wants("PK103"):
+            _check_aliases(site, findings)
+        if cfg.wants("PK104"):
+            _check_accumulator(site, findings)
+    if cfg.wants("PK105"):
+        _check_oracles(index, sites, findings)
+    return findings

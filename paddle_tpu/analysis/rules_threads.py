@@ -24,7 +24,8 @@ from .callgraph import PackageIndex, FunctionInfo, ModuleInfo, _last_name
 from .model import Config, Finding, register_rule
 
 register_rule("PT006", "module-level mutable state written from a "
-                       "background thread without the owning lock")
+                       "background thread without the owning lock",
+              severity="warning")
 
 _MUTATORS = {"append", "add", "pop", "update", "setdefault", "extend",
              "remove", "clear", "insert", "discard", "popleft",

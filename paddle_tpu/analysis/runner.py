@@ -7,11 +7,13 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Tuple
 
-from . import rules_hostsync, rules_rng, rules_threads, rules_trace
+from . import (rules_collective, rules_hostsync, rules_kernel, rules_rng,
+               rules_threads, rules_trace)
 from .callgraph import PackageIndex
 from .model import Config, Finding, is_suppressed
 
-_PASSES = (rules_trace, rules_hostsync, rules_rng, rules_threads)
+_PASSES = (rules_trace, rules_hostsync, rules_rng, rules_threads,
+           rules_kernel, rules_collective)
 
 
 def discover(root: str) -> List[Tuple[str, str, str]]:

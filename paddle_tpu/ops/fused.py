@@ -557,3 +557,44 @@ def swiglu(gate, up=None):
     g2 = gate.reshape(-1, shape[-1])
     u2 = up.reshape(-1, shape[-1])
     return _swiglu(g2, u2).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# certification (ROADMAP item 5 / paddlelint PK105): every kernel entry
+# names its XLA oracle and the parity test that pins them together
+# ---------------------------------------------------------------------------
+
+from .oracles import register_oracle  # noqa: E402  (registry is leaf-light)
+
+register_oracle(
+    "fused_rms_norm", kernel=fused_rms_norm,
+    reference="paddle_tpu.ops.references:rms_norm_reference",
+    parity_test="tests/test_fused_ops.py::TestRmsNorm")
+register_oracle(
+    "fused_layer_norm", kernel=fused_layer_norm,
+    reference="paddle_tpu.ops.references:layer_norm_reference",
+    parity_test="tests/test_fused_ops.py::TestLayerNorm")
+register_oracle(
+    "fused_bias_residual_layer_norm", kernel=fused_bias_residual_layer_norm,
+    reference="paddle_tpu.ops.references:bias_residual_layer_norm_reference",
+    parity_test="tests/test_oracles.py::TestOracleParity")
+register_oracle(
+    "fused_moe_dispatch_combine", kernel=fused_moe_dispatch_combine,
+    reference="paddle_tpu.ops.references:moe_dispatch_combine_reference",
+    parity_test="tests/test_oracles.py::TestOracleParity")
+register_oracle(
+    "fused_rope", kernel=fused_rope,
+    reference="paddle_tpu.ops.references:rope_reference",
+    parity_test="tests/test_fused_ops.py::TestRope")
+register_oracle(
+    "fused_rope_append", kernel=fused_rope_append,
+    reference="paddle_tpu.ops.references:rope_append_reference",
+    parity_test="tests/test_ragged_kernel.py::TestFusedRopeAppend")
+register_oracle(
+    "fused_append_rows", kernel=fused_append_rows,
+    reference="paddle_tpu.ops.references:append_rows_reference",
+    parity_test="tests/test_oracles.py::TestOracleParity")
+register_oracle(
+    "swiglu", kernel=swiglu,
+    reference="paddle_tpu.ops.references:swiglu_reference",
+    parity_test="tests/test_fused_ops.py::TestSwiglu")

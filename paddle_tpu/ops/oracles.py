@@ -1,0 +1,69 @@
+"""XLA reference-oracle registry — the kernel certification contract
+(ROADMAP item 5, enforced statically by paddlelint rule PK105).
+
+Every authored Pallas kernel registers, *in its own module*, the triple
+that certifies it:
+
+    register_oracle(
+        "fused_rms_norm",
+        kernel=fused_rms_norm,                       # public entry point
+        reference="paddle_tpu.ops.references:rms_norm_reference",
+        parity_test="tests/test_fused_ops.py::TestRmsNorm")
+
+- ``kernel`` is the public callable whose call graph reaches the
+  ``pallas_call`` site(s) — PK105 resolves this statically, so it must
+  be a name defined or imported in the registering module.
+- ``reference`` is a plain-XLA implementation with the same signature,
+  either a callable or a lazy ``"module:attr"`` string (lazy strings
+  break import cycles: ``flash_attention.sdpa_reference`` is the oracle
+  for ``pallas_flash.flash_sdpa``, but ``flash_attention`` imports
+  ``pallas_flash``).
+- ``parity_test`` names the pytest node that pins kernel == reference in
+  interpret mode; ``tests/test_oracles.py`` asserts the node exists and
+  re-runs parity for registered examples.
+
+The registry is intentionally dumb — a dict, no framework imports — so
+both the runtime parity tests and the static analyzer agree on the same
+source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Callable, Dict, Optional, Union
+
+__all__ = ["OracleEntry", "register_oracle", "oracles",
+           "resolve_reference"]
+
+
+@dataclasses.dataclass(frozen=True)
+class OracleEntry:
+    name: str
+    kernel: Callable
+    reference: Union[Callable, str]     # callable or lazy "module:attr"
+    parity_test: str                    # pytest node id (file::name)
+
+
+_REGISTRY: Dict[str, OracleEntry] = {}
+
+
+def register_oracle(name: str, kernel: Callable,
+                    reference: Union[Callable, str], *,
+                    parity_test: str) -> OracleEntry:
+    entry = OracleEntry(name=name, kernel=kernel, reference=reference,
+                        parity_test=parity_test)
+    _REGISTRY[name] = entry
+    return entry
+
+
+def resolve_reference(entry: OracleEntry) -> Callable:
+    ref = entry.reference
+    if isinstance(ref, str):
+        modname, attr = ref.split(":")
+        return getattr(importlib.import_module(modname), attr)
+    return ref
+
+
+def oracles() -> Dict[str, OracleEntry]:
+    return dict(_REGISTRY)

@@ -370,3 +370,13 @@ def flash_sdpa(q, k, v, causal: bool = False, segment_ids_q=None,
     out = _flash_core(qh, kh, vh, seg_q, seg_kv, float(scale),
                       bool(causal), block_q, block_k, use_seg)
     return jnp.swapaxes(out, 1, 2)
+
+
+# certification (ROADMAP item 5 / paddlelint PK105): the dense-softmax
+# composite is the oracle; lazy string — flash_attention imports us
+from .oracles import register_oracle  # noqa: E402
+
+register_oracle(
+    "flash_sdpa", kernel=flash_sdpa,
+    reference="paddle_tpu.ops.flash_attention:sdpa_reference",
+    parity_test="tests/test_flash_kernel.py::TestForwardParity")

@@ -386,3 +386,14 @@ def flashmask_kernel_eligible(Sq: int, Sk: int, D: int,
                               block_k: int = 128) -> bool:
     return (Sq % block_q == 0 and Sk % block_k == 0
             and (D % 128 == 0 or (D <= 128 and D % 64 == 0)))
+
+
+# certification (ROADMAP item 5 / paddlelint PK105): the dense-mask
+# composite is the oracle; lazy string — flash_attention imports us
+from .oracles import register_oracle  # noqa: E402
+
+register_oracle(
+    "flashmask_sdpa", kernel=flashmask_sdpa,
+    reference="paddle_tpu.ops.flash_attention:sdpa_reference",
+    parity_test="tests/test_flashmask_kernel.py::"
+                "test_kernel_matches_dense_oracle")

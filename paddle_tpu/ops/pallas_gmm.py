@@ -235,3 +235,12 @@ def _gmm_vjp_bwd(block_m, block_n, res, dout):
 
 
 gmm.defvjp(_gmm_vjp_fwd, _gmm_vjp_bwd)
+
+
+# certification (ROADMAP item 5 / paddlelint PK105)
+from .oracles import register_oracle  # noqa: E402
+
+register_oracle(
+    "gmm", kernel=gmm,
+    reference="paddle_tpu.ops.references:gmm_reference",
+    parity_test="tests/test_gmm_kernel.py::TestGmmParity")

@@ -150,3 +150,12 @@ def mla_decode_attention(q_eff, q_pe, c_lat, c_pe, lengths, *,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(lens, q_eff, q_pe, c_lat, c_pe)
+
+
+# certification (ROADMAP item 5 / paddlelint PK105)
+from .oracles import register_oracle  # noqa: E402
+
+register_oracle(
+    "mla_decode_attention", kernel=mla_decode_attention,
+    reference="paddle_tpu.ops.references:mla_decode_reference",
+    parity_test="tests/test_pallas_mla.py::TestKernelParity")

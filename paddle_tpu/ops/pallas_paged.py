@@ -307,3 +307,17 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
     )(lengths.astype(jnp.int32), page_indices.astype(jnp.int32),
       qg, k_pages, v_pages)
     return out.reshape(B, H, D)
+
+
+# certification (ROADMAP item 5 / paddlelint PK105); lazy strings —
+# paged_attention imports us
+from .oracles import register_oracle  # noqa: E402
+
+register_oracle(
+    "paged_decode_attention", kernel=paged_decode_attention,
+    reference="paddle_tpu.ops.paged_attention:paged_attention_reference",
+    parity_test="tests/test_paged_kernel.py::TestPagedKernelParity")
+register_oracle(
+    "paged_decode_attention_v2", kernel=paged_decode_attention_v2,
+    reference="paddle_tpu.ops.paged_attention:paged_attention_reference",
+    parity_test="tests/test_paged_kernel.py::TestPagedV2GroupedDMA")

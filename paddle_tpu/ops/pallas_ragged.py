@@ -233,3 +233,12 @@ def ragged_attention_reference(q, k_pages, v_pages, seq_start,
                    vr.astype(jnp.float32))                # [S, H, T, D]
     out = jnp.sum(jnp.where(rv[:, None, :, None], o, 0.0), axis=0)
     return out.transpose(1, 0, 2).astype(q.dtype)
+
+
+# certification (ROADMAP item 5 / paddlelint PK105)
+from .oracles import register_oracle  # noqa: E402
+
+register_oracle(
+    "ragged_paged_attention", kernel=ragged_paged_attention,
+    reference=ragged_attention_reference,
+    parity_test="tests/test_ragged_kernel.py::TestRaggedKernelParity")

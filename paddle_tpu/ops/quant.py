@@ -245,3 +245,29 @@ def weight_only_linear(x, qweight, scale, bias=None,
     if bias is not None:
         out = out + bias
     return out.reshape(*shape[:-1], out.shape[-1])
+
+
+def weight_only_linear_reference(x, qweight, scale, bias=None,
+                                 algo: str = "weight_only_int8"):
+    """Plain-XLA oracle for weight_only_linear: whole-tensor dequant then
+    a dense f32 matmul."""
+    shape = x.shape
+    w = weight_dequantize(qweight, scale, algo)
+    out = (x.reshape(-1, shape[-1]).astype(jnp.float32) @ w).astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out.reshape(*shape[:-1], out.shape[-1])
+
+
+# certification (ROADMAP item 5 / paddlelint PK105)
+from .oracles import register_oracle  # noqa: E402
+
+register_oracle(
+    "int4_dequantize", kernel=int4_dequantize,
+    reference=lambda qw, scale: weight_dequantize(
+        qw, scale, "weight_only_int4"),
+    parity_test="tests/test_int8_families.py::TestLlamaInt4")
+register_oracle(
+    "weight_only_linear", kernel=weight_only_linear,
+    reference=weight_only_linear_reference,
+    parity_test="tests/test_fused_ops.py::TestWeightOnly")

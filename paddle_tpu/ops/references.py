@@ -1,0 +1,131 @@
+"""Plain-XLA reference implementations for the fused Pallas kernels.
+
+Each function here is the ``reference`` side of a ``register_oracle``
+entry (see :mod:`paddle_tpu.ops.oracles`): same signature and dtype
+contract as its kernel, written in straight-line jnp so a disagreement
+in interpret mode localizes the bug to the kernel. All math runs in f32
+and casts back to the input dtype — the same accumulation discipline the
+kernels follow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["rms_norm_reference", "layer_norm_reference",
+           "bias_residual_layer_norm_reference",
+           "moe_dispatch_combine_reference", "rope_reference",
+           "rope_append_reference", "append_rows_reference",
+           "swiglu_reference", "mla_decode_reference", "gmm_reference"]
+
+
+def rms_norm_reference(x, weight, eps: float = 1e-6):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm_reference(x, weight, bias, eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def bias_residual_layer_norm_reference(x, residual, bias=None, weight=None,
+                                       ln_bias=None, eps: float = 1e-5):
+    H = x.shape[-1]
+    b = jnp.zeros((H,), x.dtype) if bias is None else bias
+    w = jnp.ones((H,), x.dtype) if weight is None else weight
+    lb = jnp.zeros((H,), x.dtype) if ln_bias is None else ln_bias
+    h = (x.astype(jnp.float32) + b.astype(jnp.float32)
+         + residual.astype(jnp.float32))
+    return layer_norm_reference(h, w, lb, eps).astype(x.dtype)
+
+
+def moe_dispatch_combine_reference(keep, oh_loc, gv):
+    kf = keep.astype(jnp.float32)
+    of = oh_loc.astype(jnp.float32)
+    gf = gv.astype(jnp.float32)
+    disp = jnp.einsum("tke,tkc->tec", kf, of)
+    comb = jnp.einsum("tke,tk,tkc->tec", kf, gf, of)
+    return disp.astype(keep.dtype), comb.astype(keep.dtype)
+
+
+def _rotate_half(x, c, s):
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def rope_reference(q, k, cos, sin):
+    c = cos.astype(jnp.float32)[None, :, None, :]
+    s = sin.astype(jnp.float32)[None, :, None, :]
+    qr = _rotate_half(q.astype(jnp.float32), c, s).astype(q.dtype)
+    kr = _rotate_half(k.astype(jnp.float32), c, s).astype(k.dtype)
+    return qr, kr
+
+
+def rope_append_reference(q, k, v, cos, sin, k_pages, v_pages,
+                          page_idx, page_off):
+    c = cos.astype(jnp.float32)[:, None, :]           # [T, 1, D/2]
+    s = sin.astype(jnp.float32)[:, None, :]
+    qr = _rotate_half(q.astype(jnp.float32), c, s).astype(q.dtype)
+    kr = _rotate_half(k.astype(jnp.float32), c, s)
+    kp = k_pages.at[:, page_idx, page_off, :].set(
+        kr.astype(k_pages.dtype).swapaxes(0, 1))
+    vp = v_pages.at[:, page_idx, page_off, :].set(
+        v.astype(v_pages.dtype).swapaxes(0, 1))
+    return qr, kp, vp
+
+
+def append_rows_reference(pages, rows, page_idx, page_off):
+    return pages.at[:, page_idx, page_off, :].set(
+        rows.astype(pages.dtype).swapaxes(0, 1))
+
+
+def swiglu_reference(gate, up=None):
+    if up is None:
+        d = gate.shape[-1] // 2
+        gate, up = gate[..., :d], gate[..., d:]
+    gf = gate.astype(jnp.float32)
+    return (gf * jax.lax.logistic(gf)
+            * up.astype(jnp.float32)).astype(gate.dtype)
+
+
+def mla_decode_reference(q_eff, q_pe, c_lat, c_pe, lengths, *,
+                         scale: float, block_t: int = 1024):
+    del block_t  # tiling knob; irrelevant to the math
+    s = (jnp.einsum("bhr,btr->bht", q_eff.astype(jnp.float32),
+                    c_lat.astype(jnp.float32))
+         + jnp.einsum("bhd,btd->bht", q_pe.astype(jnp.float32),
+                      c_pe.astype(jnp.float32))) * scale
+    T = c_lat.shape[1]
+    dead = jnp.arange(T)[None, None, :] >= \
+        lengths.astype(jnp.int32)[:, None, None]
+    s = jnp.where(dead, -1e30, s)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(dead, 0.0, p)
+    out = jnp.einsum("bht,btr->bhr", p, c_lat.astype(jnp.float32))
+    return out.astype(c_lat.dtype)
+
+
+def gmm_reference(lhs, rhs, group_sizes, block_m: int = 128,
+                  block_n: int = 128):
+    del block_m, block_n  # tiling knobs; irrelevant to the math
+    M = lhs.shape[0]
+    sizes = group_sizes.astype(jnp.int32)
+    ends = jnp.cumsum(sizes)
+    starts = ends - sizes
+    rows = jnp.arange(M, dtype=jnp.int32)[:, None]
+    member = ((rows >= starts[None, :])
+              & (rows < ends[None, :])).astype(jnp.float32)   # [M, G]
+    per_g = jnp.einsum("mk,gkn->mgn", lhs.astype(jnp.float32),
+                       rhs.astype(jnp.float32))
+    out = jnp.einsum("mgn,mg->mn", per_g, member)
+    return out.astype(lhs.dtype)

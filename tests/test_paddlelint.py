@@ -622,3 +622,683 @@ class TestSeededDefects:
                 and f.qualname == "_seeded_recorder_loop"]
         assert len(hits) == 1
         assert hits[0].detail == "write:_seeded_flight_log"
+
+
+# ---------------------------------------------------- kernel rules (PK)
+
+_PALLAS_HEADER = """\
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.oracles import register_oracle
+
+
+def _ref(*args, **kwargs):
+    return args[0]
+
+"""
+
+
+_CERTIFY = """
+register_oracle("run", kernel=run, reference=_ref,
+                parity_test="tests/test_oracles.py::TestOracleParity")
+"""
+
+
+def _klint(src, certify=True, **cfg_kw):
+    """Pallas fixture: shared header (imports + a dummy reference) plus,
+    by default, a register_oracle on `run` so PK105 never pollutes the
+    other rules' assertions."""
+    body = _PALLAS_HEADER + textwrap.dedent(src)
+    if certify:
+        body += _CERTIFY
+    return analyze_source(body, Config(**cfg_kw))
+
+
+class TestPK101IndexMapOob:
+    def test_unclamped_prefetch_table_read(self):
+        fs = _klint("""
+            def _kern(tab_ref, x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x, table):
+                return pl.pallas_call(
+                    _kern,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1,
+                        grid=(4,),
+                        in_specs=[pl.BlockSpec(
+                            (1, 128), lambda i, tab: (tab[i], 0))],
+                        out_specs=pl.BlockSpec(
+                            (1, 128), lambda i, tab: (i, 0)),
+                    ),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(table, x)
+        """)
+        assert _rules(fs) == ["PK101"]
+        assert fs[0].severity == "error"
+        assert fs[0].detail.startswith("oob:in1:")
+
+    def test_clamped_table_read_ok(self):
+        fs = _klint("""
+            def _kern(tab_ref, x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x, table):
+                return pl.pallas_call(
+                    _kern,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1,
+                        grid=(4,),
+                        in_specs=[pl.BlockSpec(
+                            (1, 128),
+                            lambda i, tab: (jnp.clip(tab[i], 0, 7), 0))],
+                        out_specs=pl.BlockSpec(
+                            (1, 128), lambda i, tab: (i, 0)),
+                    ),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(table, x)
+        """)
+        assert fs == []
+
+    def test_literal_negative_block_index(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: (-1, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+        """)
+        assert _rules(fs) == ["PK101"]
+        assert fs[0].detail.startswith("neg:in0:")
+
+
+class TestPK102BlockSpecMismatch:
+    def test_index_map_return_arity_vs_block_rank(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: i)],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+        """)
+        assert _rules(fs) == ["PK102"]
+        assert "rank:in0:1!=2" == fs[0].detail
+
+    def test_index_map_param_count_vs_grid(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128),
+                                           lambda i, j: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+        """)
+        assert _rules(fs) == ["PK102"]
+        assert "arity:in0:2!=1" == fs[0].detail
+
+    def test_unaligned_lane_dim_is_warning(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((8, 100), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+        """)
+        assert _rules(fs) == ["PK102"]
+        assert all(f.severity == "warning" for f in fs)
+        assert {f.detail for f in fs} == {"lane:in0:100", "lane:out0:100"}
+
+    def test_kernel_ref_count_vs_operand_list(self):
+        fs = _klint("""
+            def _kern(x_ref, y_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+        """)
+        assert _rules(fs) == ["PK102"]
+        assert fs[0].detail == "refs:3!=2"
+
+
+class TestPK103AliasHazards:
+    def test_alias_index_out_of_range(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    input_output_aliases={5: 0},
+                )(x)
+        """)
+        assert _rules(fs) == ["PK103"]
+        assert fs[0].detail == "alias-range:5:0"
+
+    def test_widened_alias_dtype(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                    input_output_aliases={0: 0},
+                )(x)
+        """)
+        assert _rules(fs) == ["PK103"]
+        assert fs[0].detail.startswith("alias-dtype:0:0:")
+
+    def test_matching_alias_pair_ok(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    input_output_aliases={0: 0},
+                )(x)
+        """)
+        assert fs == []
+
+    def test_aliased_pair_with_different_specs(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((2, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    input_output_aliases={0: 0},
+                )(x)
+        """)
+        assert _rules(fs) == ["PK103"]
+        assert fs[0].detail == "alias-spec:0:0"
+
+    RAW = """
+        def _kern(pg_ref, xin_ref, o_ref):
+{body}
+
+        def run(x, pg):
+            def page_map(i, pg):
+                return (jnp.clip(pg[i], 0, 7), 0)
+            spec = pl.BlockSpec((1, 128), page_map)
+            return pl.pallas_call(
+                _kern,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(4,),
+                    in_specs=[spec],
+                    out_specs=spec,
+                ),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                input_output_aliases={{1: 0}},
+            )(pg, x)
+    """
+
+    def test_unguarded_aliased_read_with_revisiting_map(self):
+        fs = _klint(self.RAW.format(
+            body="            o_ref[:] = xin_ref[:] * 2"))
+        assert _rules(fs) == ["PK103"]
+        assert fs[0].detail.startswith("alias-raw:xin_ref:")
+
+    def test_seed_on_first_visit_pattern_ok(self):
+        fs = _klint(self.RAW.format(body=(
+            "            @pl.when(pl.program_id(0) == 0)\n"
+            "            def _seed():\n"
+            "                o_ref[:] = xin_ref[:]")))
+        assert fs == []
+
+
+class TestPK104SubF32Accumulator:
+    MATMUL = """
+        def _kern(x_ref, o_ref, acc_ref):
+            acc_ref[:] = jax.lax.dot(x_ref[:], x_ref[:]{pet})
+            o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+        def run(x):
+            return pl.pallas_call(
+                _kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                scratch_shapes=[pltpu.VMEM((128, 128), {acc})],
+            )(x)
+    """
+
+    def test_bf16_scratch_accumulator(self):
+        fs = _klint(self.MATMUL.format(
+            pet="", acc="jnp.bfloat16"))
+        assert _rules(fs) == ["PK104"]
+        assert fs[0].detail.startswith("acc:")
+
+    def test_f32_scratch_ok(self):
+        fs = _klint(self.MATMUL.format(
+            pet="", acc="jnp.float32"))
+        assert fs == []
+
+    def test_sub_f32_preferred_element_type(self):
+        fs = _klint(self.MATMUL.format(
+            pet=",\n                preferred_element_type=jnp.bfloat16",
+            acc="jnp.float32"))
+        assert _rules(fs) == ["PK104"]
+        assert fs[0].detail.startswith("pet:")
+
+    def test_gate_requires_matmul_or_softmax(self):
+        # bf16 scratch in a pure data-movement kernel: not an accumulator
+        fs = _klint("""
+            def _kern(x_ref, o_ref, tmp_ref):
+                tmp_ref[:] = x_ref[:]
+                o_ref[:] = tmp_ref[:]
+
+            def run(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((128, 128),
+                                           lambda i: (0, 0))],
+                    out_specs=pl.BlockSpec((128, 128),
+                                           lambda i: (0, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    scratch_shapes=[pltpu.VMEM((128, 128),
+                                               jnp.bfloat16)],
+                )(x)
+        """)
+        assert fs == []
+
+
+class TestPK105OracleCertification:
+    UNIT = """
+        def _kern(x_ref, o_ref):
+            o_ref[:] = x_ref[:]
+
+        def run(x):
+            return pl.pallas_call(
+                _kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+    """
+
+    def test_uncertified_kernel_flagged(self):
+        fs = _klint(self.UNIT, certify=False)
+        assert _rules(fs) == ["PK105"]
+        assert fs[0].detail == "oracle:run"
+        assert fs[0].severity == "warning"
+
+    def test_registration_certifies(self):
+        assert _klint(self.UNIT) == []
+
+    def test_certification_reaches_through_wrappers(self):
+        # register the public entry; the pallas_call lives two call
+        # edges down — the closure must cover it
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def _impl(x):
+                return pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+
+            def _dispatch(x):
+                return _impl(x)
+
+            def run(x):
+                return _dispatch(x)
+        """)
+        assert fs == []
+
+    def test_certification_follows_defvjp(self):
+        # custom_vjp: the kernel call sits in the fwd rule, only the
+        # public primal is registered — defvjp linkage must cover it
+        fs = _klint("""
+            def _kern(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def _fwd(x):
+                y = pl.pallas_call(
+                    _kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+                return y, x
+
+            def _bwd(res, g):
+                return (g,)
+
+            @jax.custom_vjp
+            def run(x):
+                return _fwd(x)[0]
+
+            run.defvjp(_fwd, _bwd)
+        """)
+        assert fs == []
+
+
+class TestKernelResolutionThroughIndirection:
+    """The callgraph fix this PR rides on: kernels reached through
+    functools.partial locals and factory-returned closures must resolve
+    to their FunctionInfo so the PK checks see real params."""
+
+    # indented to match the 12-space method-level fragments it is
+    # concatenated onto (dedent runs on the joined string)
+    CALL = """
+            def run(x):
+                {bind}
+                return pl.pallas_call(
+                    kern,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+    """
+
+    def test_partial_bound_kwargs_subtracted(self):
+        fs = _klint("""
+            def _kern(x_ref, o_ref, *, eps):
+                o_ref[:] = x_ref[:] + eps
+        """ + self.CALL.format(
+            bind="kern = functools.partial(_kern, eps=1e-6)"))
+        assert fs == []
+
+    def test_bad_refs_detected_through_partial(self):
+        fs = _klint("""
+            def _kern(x_ref, y_ref, o_ref, *, eps):
+                o_ref[:] = x_ref[:] + eps
+        """ + self.CALL.format(
+            bind="kern = functools.partial(_kern, eps=1e-6)"))
+        assert _rules(fs) == ["PK102"]
+        assert fs[0].detail == "refs:3!=2"
+
+    def test_bad_refs_detected_through_factory_closure(self):
+        fs = _klint("""
+            def make_kernel(eps):
+                def _kern(x_ref, y_ref, o_ref):
+                    o_ref[:] = x_ref[:] + eps
+                return _kern
+        """ + self.CALL.format(
+            bind="kern = make_kernel(0.5)"))
+        assert _rules(fs) == ["PK102"]
+        assert fs[0].detail == "refs:3!=2"
+
+
+# ------------------------------------------------ collective rule (PC)
+
+class TestPC201BranchDivergentCollective:
+    def test_psum_under_python_branch_in_shard_map_body(self):
+        fs = _lint("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            def _body(x):
+                if x.shape[0] > 128:
+                    x = jax.lax.psum(x, "dp")
+                return x
+
+            def run(mesh, x):
+                f = shard_map(_body, mesh=mesh, in_specs=None,
+                              out_specs=None)
+                return f(x)
+        """)
+        assert _rules(fs) == ["PC201"]
+        assert fs[0].severity == "error"
+        assert fs[0].qualname == "_body"
+        assert fs[0].detail.startswith("branch-collective:psum:")
+
+    def test_collective_in_cond_branch_fn(self):
+        fs = _lint("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            def _yes(x):
+                return jax.lax.psum(x, "dp")
+
+            def _no(x):
+                return x
+
+            def _body(flag, x):
+                return jax.lax.cond(flag, _yes, _no, x)
+
+            def run(mesh, flag, x):
+                return shard_map(_body, mesh=mesh, in_specs=None,
+                                 out_specs=None)(flag, x)
+        """)
+        assert _rules(fs) == ["PC201"]
+        assert fs[0].qualname == "_yes"
+        assert "branch function" in fs[0].message
+
+    def test_straight_line_collective_ok(self):
+        fs = _lint("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            def _body(x):
+                return jax.lax.psum(x * 2, "dp")
+
+            def run(mesh, x):
+                return shard_map(_body, mesh=mesh, in_specs=None,
+                                 out_specs=None)(x)
+        """)
+        assert "PC201" not in _rules(fs)
+
+    def test_branchy_collective_outside_shard_map_ok(self):
+        fs = _lint("""
+            import jax
+
+            def helper(x):
+                if x.shape[0] > 2:
+                    return jax.lax.psum(x, "dp")
+                return x
+        """)
+        assert "PC201" not in _rules(fs)
+
+
+# ------------------------------------------ CLI: rule listing / filters
+
+class TestCliRuleListing:
+    def test_bare_rules_prints_table(self, capsys):
+        assert lint_main(["--rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("PT001", "PK101", "PK105", "PC201"):
+            assert rid in out
+        # one line per rule: id, severity, one-liner
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith("PK101"))
+        assert "error" in line and "index_map" in line
+
+    def test_list_rules_includes_severity(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "PK104" in out and "warning" in out
+
+    def test_only_filters(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """))
+        assert lint_main(["--only", "PT006", str(p)]) == 0
+        assert lint_main(["--only", "PT001", str(p)]) == 1
+
+    def test_only_unknown_rule_is_usage_error(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text("x = 1\n")
+        assert lint_main(["--only", "PK999", str(p)]) == 2
+
+    def test_json_rules_carry_severity(self, tmp_path, capsys):
+        p = tmp_path / "mod.py"
+        p.write_text("x = 1\n")
+        assert lint_main(["--json", str(p)]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["rules"]["PT001"]["severity"] == "error"
+        assert data["rules"]["PK105"]["severity"] == "warning"
+        assert "description" in data["rules"]["PC201"]
+
+
+# ----------------------------------------- whole-repo JSON family gate
+
+class TestRepoJsonGate:
+    def test_per_family_summary_and_justified_baseline(self, capsys):
+        """ISSUE PR8 acceptance: every rule family reports zero fresh
+        findings over the real package and the baseline carries no
+        unjustified (empty / TODO-stamped) entries."""
+        rc = lint_main([os.path.join(REPO, "paddle_tpu"), "--baseline",
+                        os.path.join(REPO, "tools",
+                                     "paddlelint_baseline.json"),
+                        "--json"])
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert set(data["families"]) == {"PT", "PK", "PC"}
+        for fam, info in sorted(data["families"].items()):
+            assert info["fresh"] == 0, (fam, data["findings"])
+            assert info["rules"], fam
+        assert data["baseline"]["unjustified"] == []
+        assert data["baseline"]["stale"] == []
+        # the single accepted PK entry (fusion JIT's definitional oracle)
+        assert data["families"]["PK"]["baselined"] == 1
+
+
+# -------------------------------------- seeded kernel/collective defects
+
+class TestSeededKernelDefects:
+    """ISSUE PR8 acceptance: each PK/PC rule catches exactly its seeded
+    defect in a scratch copy of the real kernel modules, and stays quiet
+    on the pristine copies. Copies are analyzed statically — never
+    imported — so mutations are plain text edits."""
+
+    RAGGED = "paddle_tpu/ops/pallas_ragged.py"
+    FUSED = "paddle_tpu/ops/fused.py"
+
+    def _analyze(self, tmp_path, rel, tag, old="", new="", append=""):
+        src = open(os.path.join(REPO, rel)).read()
+        if old:
+            assert old in src, f"seed anchor vanished from {rel}: {old!r}"
+            src = src.replace(old, new, 1)
+        d = tmp_path / tag
+        d.mkdir(exist_ok=True)
+        p = d / os.path.basename(rel)   # same rel/modname as the clean
+        p.write_text(src + textwrap.dedent(append))
+        return analyze_paths([str(p)])
+
+    def _seed(self, tmp_path, rel, **kw):
+        clean = self._analyze(tmp_path, rel, "clean")
+        seeded = self._analyze(tmp_path, rel, "seeded", **kw)
+        new_keys = ({f.baseline_key for f in seeded}
+                    - {f.baseline_key for f in clean})
+        return [f for f in seeded if f.baseline_key in new_keys]
+
+    def test_pristine_copies_are_quiet(self, tmp_path):
+        for rel in (self.RAGGED, self.FUSED):
+            fs = self._analyze(tmp_path, rel, "clean")
+            assert [f for f in fs if f.rule.startswith(("PK", "PC"))] \
+                == [], rel
+
+    def test_pk101_catches_unclamped_page_table_read(self, tmp_path):
+        fresh = self._seed(
+            tmp_path, self.RAGGED,
+            old="phys = jnp.clip(tab[i, jnp.minimum(j, jmax)], 0, "
+                "total_pages - 1)",
+            new="phys = tab[i, jnp.minimum(j, jmax)]")
+        assert fresh and {f.rule for f in fresh} == {"PK101"}
+        assert all("tab" in f.detail for f in fresh)
+
+    def test_pk103_catches_widened_alias_dtype(self, tmp_path):
+        fresh = self._seed(
+            tmp_path, self.FUSED,
+            old="jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype)",
+            new="jax.ShapeDtypeStruct(k_pages.shape, jnp.float32)")
+        assert fresh and {f.rule for f in fresh} == {"PK103"}
+        assert any(f.detail.startswith("alias-dtype:7:1:")
+                   for f in fresh)
+
+    def test_pk104_catches_bf16_accumulator(self, tmp_path):
+        fresh = self._seed(
+            tmp_path, self.RAGGED,
+            old="scratch_shapes=[pltpu.VMEM((T * rep, D), jnp.float32),",
+            new="scratch_shapes=[pltpu.VMEM((T * rep, D), jnp.bfloat16),")
+        assert fresh and {f.rule for f in fresh} == {"PK104"}
+        assert fresh[0].detail.startswith("acc:")
+
+    def test_pc201_catches_branch_divergent_psum(self, tmp_path):
+        fresh = self._seed(tmp_path, self.FUSED, append="""
+
+            from jax.experimental.shard_map import shard_map
+
+            def _seeded_allreduce(x):
+                if x.shape[0] > 128:
+                    x = jax.lax.psum(x, "dp")
+                return x
+
+            def _seeded_launch(mesh, x):
+                return shard_map(_seeded_allreduce, mesh=mesh,
+                                 in_specs=None, out_specs=None)(x)
+            """)
+        assert fresh and {f.rule for f in fresh} == {"PC201"}
+        assert fresh[0].qualname == "_seeded_allreduce"
+        assert fresh[0].detail.startswith("branch-collective:psum:")
