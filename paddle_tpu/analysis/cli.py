@@ -12,13 +12,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 from collections import Counter
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from . import baseline as baseline_mod
 from .model import RULE_SEVERITIES, RULES, Config, rule_family
-from .runner import analyze_paths
+from .runner import analyze_files, analyze_paths, discover
 
 #: sentinel for a bare ``--rules`` (no ids): print the rule table
 _LIST = "__list__"
@@ -28,7 +30,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="paddlelint",
         description="TPU/JAX-aware static analysis for paddle_tpu "
-                    "(rule families PT/PK/PC; see docs/ANALYSIS.md)")
+                    "(rule families PT/PK/PC/PS; see docs/ANALYSIS.md)")
     p.add_argument("paths", nargs="*", default=["paddle_tpu"],
                    help="package dirs or files to analyze "
                         "(default: paddle_tpu)")
@@ -48,11 +50,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--only", metavar="IDS",
                    help="alias of --rules IDS for fast local runs, "
                         "e.g. --only PK101,PK103 (union of both flags)")
+    p.add_argument("--changed-only", metavar="REF", nargs="?", const="HEAD",
+                   default=None,
+                   help="restrict analysis to files named by `git diff "
+                        "--name-only REF` (default HEAD) for fast local "
+                        "pre-commit runs; falls back to the full paths "
+                        "when git is unavailable. Stale-baseline "
+                        "reporting is suppressed (unanalyzed files would "
+                        "all look stale)")
     p.add_argument("--fail-stale", action="store_true",
                    help="exit 1 when baseline entries no longer match")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     return p
+
+
+def _git_changed(ref: str) -> Optional[Set[str]]:
+    """Absolute paths of files differing from ``ref`` (working tree and
+    index), or None when git is unavailable / not a repository."""
+    try:
+        proc = subprocess.run(["git", "diff", "--name-only", ref],
+                              capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    return {os.path.abspath(line.strip())
+            for line in proc.stdout.splitlines() if line.strip()}
 
 
 def _print_rule_table() -> None:
@@ -78,7 +102,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
     cfg = Config(rules=rules, strict=args.strict)
 
-    findings = analyze_paths(args.paths or ["paddle_tpu"], cfg)
+    paths = args.paths or ["paddle_tpu"]
+    changed_rels: Optional[List[str]] = None
+    if args.changed_only is not None:
+        changed = _git_changed(args.changed_only)
+        if changed is None:
+            print("paddlelint: --changed-only: git unavailable, "
+                  "analyzing all paths", file=sys.stderr)
+            findings = analyze_paths(paths, cfg)
+        else:
+            files = [t for p_ in paths for t in discover(p_)
+                     if os.path.abspath(t[1]) in changed]
+            changed_rels = sorted(t[2] for t in files)
+            findings = analyze_files(files, cfg)
+    else:
+        findings = analyze_paths(paths, cfg)
 
     base = {}
     if args.baseline and not args.write_baseline:
@@ -103,23 +141,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     fresh, stale = baseline_mod.split(findings, base)
+    if changed_rels is not None:
+        # a restricted run produces a subset of findings — every entry
+        # from an unanalyzed file would look stale
+        stale = []
 
     if args.as_json:
         families = {}
+
+        def fam_of(rid):
+            return families.setdefault(
+                rule_family(rid),
+                {"fresh": 0, "baselined": 0, "rules": [],
+                 "per_rule": {}, "unjustified": []})
+
+        def rule_of(rid):
+            fam = fam_of(rid)
+            return fam["per_rule"].setdefault(rid,
+                                              {"fresh": 0, "baselined": 0})
+
         for rid in sorted(RULES):
-            fam = families.setdefault(rule_family(rid),
-                                      {"fresh": 0, "baselined": 0,
-                                       "rules": []})
-            fam["rules"].append(rid)
+            fam_of(rid)["rules"].append(rid)
+            rule_of(rid)
         for f in fresh:
-            families[rule_family(f.rule)]["fresh"] += 1
+            fam_of(f.rule)["fresh"] += 1
+            rule_of(f.rule)["fresh"] += 1
         for f in findings:
             if f.baseline_key in base:
-                families[rule_family(f.rule)]["baselined"] += 1
+                fam_of(f.rule)["baselined"] += 1
+                rule_of(f.rule)["baselined"] += 1
         unjustified = sorted(
             k for k, j in base.items()
             if not j.strip() or j.strip().lower().startswith("todo"))
-        print(json.dumps({
+        for k in unjustified:
+            fam_of(k.split("|", 1)[0])["unjustified"].append(k)
+        out = {
             "findings": [f.to_dict() for f in fresh],
             "baselined": len(findings) - len(fresh),
             "stale_baseline_keys": stale,
@@ -129,7 +185,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             "families": families,
             "baseline": {"total": len(base), "stale": stale,
                          "unjustified": unjustified},
-        }, indent=2))
+        }
+        if changed_rels is not None:
+            out["changed_only"] = {"ref": args.changed_only,
+                                   "files": changed_rels}
+        print(json.dumps(out, indent=2))
     else:
         for f in fresh:
             print(f.render())

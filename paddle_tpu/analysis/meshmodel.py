@@ -1,0 +1,761 @@
+"""Static model of mesh-axis environments and PartitionSpec flow
+(docs/ANALYSIS.md, sharding-verification section).
+
+Layered on :class:`PackageIndex` the way ``kernelmodel.py`` models
+``pallas_call`` sites: for each ``shard_map`` site the model recovers —
+through the same flow-insensitive local environment — the *axis
+environment* (axis names, and literal sizes where the mesh construction
+is literal), the ``in_specs``/``out_specs`` literals and their flow
+through locals and ``sanitize_spec``, the resolved body function (with
+``functools.partial`` bindings subtracted), and the outer invocation
+arguments.  ``NamedSharding``/``with_sharding_constraint`` placements and
+``vmap(axis_name=...)`` bindings get the same treatment, and every
+collective axis-name argument (``psum``/``all_gather``/``ppermute``/...)
+is extracted per function so rules can intersect it with the
+environments of the shard_map sites that reach it.
+
+Axis environments come from the constructions the distributed layer
+actually uses: ``ProcessMesh(ids, dim_names=[...])`` (sizes from a
+literal id array), ``build_hybrid_mesh(*_degree=...)`` (the fixed 6-axis
+hybrid order, sizes from literal degree kwargs, absent degrees = 1),
+``Mesh(devs, ("a", "b"))`` (including names routed through module
+constants like ``AXIS_ORDER`` and partially-symbolic tuples), and a
+``shard_map`` ``axis_names=`` literal.  A mesh that resolves to
+``get_mesh()`` / ``_mesh_of(...)`` is *ambient* — configurable at
+runtime, axes unknown.  Everything else degrades to "unknown" rather
+than guessing, so an unresolvable mesh or spec opts its site out of the
+checks that need the missing piece — the same discipline as the kernel
+model.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import (FunctionInfo, ModuleInfo, PackageIndex, _last_name,
+                        partial_inner, walk_shallow)
+from .kernelmodel import Env, _int_const, _kw, _lookup_def, unparse
+
+#: the fixed axis order ``build_hybrid_mesh`` constructs (mesh.py)
+HYBRID_AXES = ("pp", "dp", "sharding", "sep", "ep", "mp")
+
+#: call names that return the ambient / runtime-configured mesh
+AMBIENT_MESH_FUNCS = {"get_mesh", "_mesh_of", "current_mesh"}
+
+#: spec constructors; bare ``P`` counts only when imported as PartitionSpec
+SPEC_CTORS = {"PartitionSpec"}
+
+#: collectives that take an axis-name argument (name -> positional index)
+COLLECTIVE_AXIS_ARG = {"psum": 1, "pmax": 1, "pmin": 1, "pmean": 1,
+                       "all_gather": 1, "psum_scatter": 1, "all_to_all": 1,
+                       "ppermute": 1, "pshuffle": 1, "pbroadcast": 1,
+                       "axis_index": 0}
+
+#: sentinel entry for a spec element the model cannot resolve
+SYMBOLIC = object()
+
+#: array constructors whose first literal tuple argument is the shape
+_SHAPE_CTORS = {"zeros", "ones", "empty", "full", "normal", "uniform"}
+
+
+class OrderedEnv(Env):
+    """:class:`Env` whose intra-scope record order is *source order*, so
+    the last assignment to a name in each scope wins. Spec flow needs
+    this: the reassignment idiom ``spec = sanitize_spec(mesh, spec)``
+    must resolve ``spec`` to the sanitized value, not whichever binding
+    the walk happened to visit last."""
+
+    def __init__(self, mi: ModuleInfo, fi: Optional[FunctionInfo]):
+        super().__init__(mi, fi)
+        if fi is None:
+            return
+        parts = fi.qualname.split(".")
+        for i in range(1, len(parts) + 1):
+            anc = mi.functions.get(".".join(parts[:i]))
+            if anc is not None and not isinstance(anc.node, ast.Lambda):
+                assigns = sorted(
+                    (n for n in walk_shallow(anc.node)
+                     if isinstance(n, (ast.Assign, ast.AnnAssign))),
+                    key=lambda n: (n.lineno, n.col_offset))
+                for node in assigns:
+                    self._record(node)
+
+
+# ---------------------------------------------------------------------------
+# literal resolution helpers
+# ---------------------------------------------------------------------------
+
+def _module_const(index: PackageIndex, mi: ModuleInfo,
+                  name: str) -> Optional[ast.AST]:
+    """Top-level binding of ``name`` in ``mi``, following one ``from x
+    import name`` hop so constants like ``PP_AXIS``/``AXIS_ORDER`` resolve
+    across modules."""
+    for node in mi.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name:
+            return node.value
+    if name in mi.import_names:
+        src, orig = mi.import_names[name]
+        smi = index.modules.get(src)
+        if smi is not None and smi is not mi:
+            return _module_const(index, smi, orig)
+    return None
+
+
+def _resolve(index: PackageIndex, mi: ModuleInfo, env: Env,
+             node: Optional[ast.AST]) -> Optional[ast.AST]:
+    """Env.resolve plus one cross-module constant hop."""
+    node = env.resolve(node)
+    if isinstance(node, ast.Name):
+        const = _module_const(index, mi, node.id)
+        if const is not None:
+            return env.resolve(const)
+    return node
+
+
+def _str_const(index: PackageIndex, mi: ModuleInfo, env: Env,
+               node: Optional[ast.AST]) -> Optional[str]:
+    node = _resolve(index, mi, env, node)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _axis_names(index: PackageIndex, mi: ModuleInfo, env: Env,
+                node: Optional[ast.AST]) -> Optional[Tuple[List[str], bool]]:
+    """Literal axis names from a tuple/list/set/frozenset expression —
+    ``(names, complete)`` where ``complete`` is False when some element
+    was symbolic (a partially-symbolic axis tuple)."""
+    node = _resolve(index, mi, env, node)
+    if isinstance(node, ast.Call) and _last_name(node.func) in ("frozenset",
+                                                                "set",
+                                                                "tuple"):
+        if len(node.args) == 1:
+            node = _resolve(index, mi, env, node.args[0])
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value], True
+    if not isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    names: List[str] = []
+    complete = True
+    for e in node.elts:
+        s = _str_const(index, mi, env, e)
+        if s is None:
+            complete = False
+        else:
+            names.append(s)
+    return names, complete
+
+
+# ---------------------------------------------------------------------------
+# axis environments
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AxisEnv:
+    """Axis names visible to code under one mesh/shard_map construction.
+    ``complete`` means ``axes`` is the *whole* set — only then may a rule
+    claim an axis name is absent. ``sizes`` holds literal sizes (None =
+    unknown)."""
+    axes: Tuple[str, ...]
+    sizes: Dict[str, Optional[int]]
+    complete: bool
+    source: str                        # "ProcessMesh"/"build_hybrid_mesh"/...
+    ambient: bool = False              # get_mesh()/_mesh_of(): configurable
+
+    def size(self, name: str) -> Optional[int]:
+        return self.sizes.get(name)
+
+
+def _literal_shape(node: ast.AST) -> Optional[List[int]]:
+    """Shape of a literal nested list/tuple (the ProcessMesh id array)."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    dims = [len(node.elts)]
+    if node.elts and isinstance(node.elts[0], (ast.List, ast.Tuple)):
+        inner = _literal_shape(node.elts[0])
+        if inner is None:
+            return None
+        dims.extend(inner)
+    return dims
+
+
+def mesh_env(index: PackageIndex, mi: ModuleInfo, env: Env,
+             expr: Optional[ast.AST],
+             _depth: int = 0) -> Optional[AxisEnv]:
+    """Axis environment of a mesh-valued expression, or None when
+    unresolvable. ``ambient=True`` marks a mesh fetched from runtime
+    configuration (``get_mesh()``/``_mesh_of(...)``) — axes unknown but
+    *known to be configurable* (PS306's trigger)."""
+    if _depth > 4:
+        return None
+    expr = _resolve(index, mi, env, expr)
+    if expr is None:
+        return None
+    # m.jax_mesh where m is a ProcessMesh(...) construction
+    if isinstance(expr, ast.Attribute) and expr.attr == "jax_mesh":
+        return mesh_env(index, mi, env, expr.value, _depth + 1)
+    if not isinstance(expr, ast.Call):
+        return None
+    name = _last_name(expr.func)
+    if name in AMBIENT_MESH_FUNCS:
+        return AxisEnv(axes=(), sizes={}, complete=False, source=name,
+                       ambient=True)
+    if name == "mesh_context":
+        return mesh_env(index, mi, env, expr.args[0] if expr.args else None,
+                        _depth + 1)
+    if name == "ProcessMesh":
+        ids = _resolve(index, mi, env, expr.args[0] if expr.args else None)
+        dim_names = (expr.args[1] if len(expr.args) > 1
+                     else _kw(expr, "dim_names"))
+        shape = _literal_shape(ids) if ids is not None else None
+        got = _axis_names(index, mi, env, dim_names) \
+            if dim_names is not None else None
+        if got is None:
+            if shape is None:
+                return None
+            axes = tuple(f"d{i}" for i in range(len(shape)))
+            complete = True
+        else:
+            axes, complete = tuple(got[0]), got[1]
+        sizes: Dict[str, Optional[int]] = {a: None for a in axes}
+        if shape is not None and complete and len(shape) == len(axes):
+            sizes = dict(zip(axes, shape))
+        return AxisEnv(axes=axes, sizes=sizes, complete=complete,
+                       source="ProcessMesh")
+    if name == "build_hybrid_mesh":
+        sizes = {a: 1 for a in HYBRID_AXES}
+        complete = True
+        for kw in expr.keywords:
+            if kw.arg is None:
+                complete = False          # **kwargs: degrees unknown
+                continue
+            if kw.arg.endswith("_degree"):
+                axis = kw.arg[: -len("_degree")]
+                if axis in sizes:
+                    sizes[axis] = _int_const(
+                        _resolve(index, mi, env, kw.value))
+        if expr.args:
+            # positional signature: dp, mp, pp, sharding, sep, ep
+            order = ("dp", "mp", "pp", "sharding", "sep", "ep")
+            for i, arg in enumerate(expr.args[: len(order)]):
+                sizes[order[i]] = _int_const(_resolve(index, mi, env, arg))
+        return AxisEnv(axes=HYBRID_AXES, sizes=sizes, complete=complete,
+                       source="build_hybrid_mesh")
+    if name == "Mesh":
+        names_expr = (expr.args[1] if len(expr.args) > 1
+                      else _kw(expr, "axis_names"))
+        got = _axis_names(index, mi, env, names_expr) \
+            if names_expr is not None else None
+        if got is None:
+            return None
+        axes, complete = got
+        return AxisEnv(axes=tuple(axes), sizes={a: None for a in axes},
+                       complete=complete, source="Mesh")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# PartitionSpec flow
+# ---------------------------------------------------------------------------
+
+def _is_spec_ctor(mi: ModuleInfo, func: ast.AST) -> bool:
+    name = _last_name(func)
+    if name == "PartitionSpec":
+        return True
+    if name is None:
+        return False
+    imp = mi.import_names.get(name)
+    return imp is not None and imp[1] == "PartitionSpec"
+
+
+@dataclasses.dataclass
+class SpecModel:
+    """One PartitionSpec value as the model understands it. ``entries``
+    is None when the rank is unknown (``P(*...)`` star-args or a
+    non-literal); each entry is None, a str axis name, a tuple of axis
+    names, or :data:`SYMBOLIC`."""
+    node: ast.AST
+    entries: Optional[List[object]] = None
+    axes: Set[str] = dataclasses.field(default_factory=set)
+    symbolic: bool = False             # some element unresolved
+    sanitized: bool = False            # flowed through sanitize_spec
+    layer_declared: bool = False       # came from a `_sharding_spec` slot
+    resolved: bool = True              # False: value is not a spec we know
+
+    @property
+    def min_rank(self) -> Optional[int]:
+        """Entries after stripping trailing Nones — the smallest array
+        rank this spec legally applies to."""
+        if self.entries is None or self.symbolic:
+            return None
+        n = len(self.entries)
+        while n and self.entries[n - 1] is None:
+            n -= 1
+        return n
+
+    def entry_axes(self, i: int) -> Tuple[str, ...]:
+        if self.entries is None or i >= len(self.entries):
+            return ()
+        e = self.entries[i]
+        if isinstance(e, str):
+            return (e,)
+        if isinstance(e, tuple):
+            return e
+        return ()
+
+    def text(self) -> str:
+        return unparse(self.node)
+
+
+def build_spec(index: PackageIndex, mi: ModuleInfo, env: Env,
+               expr: Optional[ast.AST],
+               _depth: int = 0) -> Optional[SpecModel]:
+    """SpecModel of a spec-valued expression, or None when it resolves to
+    nothing spec-like (an unknown call, a subscript, a parameter...)."""
+    if _depth > 4 or expr is None:
+        return None
+    expr = env.resolve(expr)
+    if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.Or):
+        parts = [build_spec(index, mi, env, v, _depth + 1)
+                 for v in expr.values]
+        parts = [p for p in parts if p is not None]
+        if not parts:
+            return None
+        merged = SpecModel(node=expr, entries=None, symbolic=True)
+        for p in parts:
+            merged.axes |= p.axes
+            merged.layer_declared |= p.layer_declared
+            merged.sanitized |= p.sanitized
+        return merged
+    if isinstance(expr, ast.Attribute) and expr.attr == "_sharding_spec":
+        return SpecModel(node=expr, entries=None, symbolic=True,
+                         layer_declared=True)
+    if not isinstance(expr, ast.Call):
+        return None
+    name = _last_name(expr.func)
+    if name == "getattr" and len(expr.args) >= 2:
+        attr = expr.args[1]
+        if isinstance(attr, ast.Constant) and attr.value == "_sharding_spec":
+            return SpecModel(node=expr, entries=None, symbolic=True,
+                             layer_declared=True)
+        return None
+    if name == "sanitize_spec":
+        inner = build_spec(index, mi, env,
+                           expr.args[1] if len(expr.args) > 1
+                           else _kw(expr, "spec"), _depth + 1)
+        if inner is None:
+            inner = SpecModel(node=expr, entries=None, symbolic=True)
+        inner.sanitized = True
+        return inner
+    if not _is_spec_ctor(mi, expr.func):
+        return None
+    spec = SpecModel(node=expr, entries=[])
+    for a in expr.args:
+        if isinstance(a, ast.Starred):
+            spec.entries = None
+            spec.symbolic = True
+            continue
+        a = _resolve(index, mi, env, a)
+        entry: object = SYMBOLIC
+        if isinstance(a, ast.Constant) and a.value is None:
+            entry = None
+        elif isinstance(a, ast.Constant) and isinstance(a.value, str):
+            entry = a.value
+            spec.axes.add(a.value)
+        elif isinstance(a, (ast.Tuple, ast.List)):
+            names = []
+            ok = True
+            for e in a.elts:
+                s = _str_const(index, mi, env, e)
+                if s is None:
+                    ok = False
+                else:
+                    names.append(s)
+                    spec.axes.add(s)
+            entry = tuple(names) if ok else SYMBOLIC
+        else:
+            s = _str_const(index, mi, env, a)
+            if s is not None:
+                entry = s
+                spec.axes.add(s)
+        if entry is SYMBOLIC:
+            spec.symbolic = True
+        if spec.entries is not None:
+            spec.entries.append(entry)
+    return spec
+
+
+def _spec_seq(index: PackageIndex, mi: ModuleInfo, env: Env,
+              expr: Optional[ast.AST]
+              ) -> Tuple[Optional[List[SpecModel]], bool]:
+    """``(specs, is_sequence)`` for an in_specs/out_specs expression.
+    A literal tuple/list yields one SpecModel per element (unresolvable
+    elements become ``resolved=False`` placeholders); dict-valued specs
+    (pytree tables, e.g. pp_exec's param_specs) yield their values with
+    ``is_sequence=False`` since the tree structure is not positional."""
+    expr = env.resolve(expr)
+    if expr is None:
+        return None, False
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            if isinstance(e, ast.Starred):
+                return None, False
+            s = build_spec(index, mi, env, e)
+            out.append(s if s is not None
+                       else SpecModel(node=e, entries=None, symbolic=True,
+                                      resolved=False))
+        return out, True
+    if isinstance(expr, ast.Dict):
+        out = []
+        for v in expr.values:
+            s = build_spec(index, mi, env, v)
+            if s is not None:
+                out.append(s)
+        return (out or None), False
+    one = build_spec(index, mi, env, expr)
+    return ([one], False) if one is not None else (None, False)
+
+
+# ---------------------------------------------------------------------------
+# sites
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ShardMapSite:
+    mi: ModuleInfo
+    fi: Optional[FunctionInfo]
+    call: ast.Call
+    env: Optional[AxisEnv] = None
+    manual_axes: Optional[Tuple[str, ...]] = None   # axis_names= literal
+    in_specs: Optional[List[SpecModel]] = None
+    in_specs_seq: bool = False
+    out_specs: Optional[List[SpecModel]] = None
+    out_specs_seq: bool = False
+    body_keys: Set[str] = dataclasses.field(default_factory=set)
+    body_fi: Optional[FunctionInfo] = None
+    body_bound_kw: Set[str] = dataclasses.field(default_factory=set)
+    body_bound_pos: int = 0
+    arg_exprs: Optional[List[ast.AST]] = None       # outer (...)(*args)
+
+    @property
+    def line(self) -> int:
+        return self.call.lineno
+
+    @property
+    def qualname(self) -> str:
+        return self.fi.qualname if self.fi is not None else "<module>"
+
+    def bound_axes(self) -> Optional[Tuple[str, ...]]:
+        """Axis names this site binds for its body, or None when the
+        environment is unknown/incomplete. ``axis_names=`` narrows a
+        known mesh; alone it is exact only for the named subset."""
+        if self.env is not None and self.env.complete:
+            if self.manual_axes is not None:
+                return tuple(a for a in self.env.axes
+                             if a in set(self.manual_axes))
+            return self.env.axes
+        if self.manual_axes is not None:
+            return self.manual_axes
+        return None
+
+    def body_positional(self) -> Optional[int]:
+        """Positional-parameter count of the resolved body after
+        subtracting partial bindings (None: unresolved or *args)."""
+        if self.body_fi is None:
+            return None
+        a = self.body_fi.node.args
+        if a.vararg is not None:
+            return None
+        params = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+        params = params[self.body_bound_pos:]
+        return len([p for p in params if p not in self.body_bound_kw])
+
+
+@dataclasses.dataclass
+class ShardingSite:
+    """A ``NamedSharding(mesh, spec)`` (or pjit ``in_shardings=``)
+    placement: where PS303/PS304/PS306 look."""
+    mi: ModuleInfo
+    fi: Optional[FunctionInfo]
+    call: ast.Call
+    env: Optional[AxisEnv] = None
+    spec: Optional[SpecModel] = None
+    placed_expr: Optional[ast.AST] = None   # device_put(arr, NS(...)) arr
+
+    @property
+    def line(self) -> int:
+        return self.call.lineno
+
+    @property
+    def qualname(self) -> str:
+        return self.fi.qualname if self.fi is not None else "<module>"
+
+
+@dataclasses.dataclass
+class VmapSite:
+    mi: ModuleInfo
+    fi: Optional[FunctionInfo]
+    call: ast.Call
+    axis_name: str
+    body_keys: Set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def qualname(self) -> str:
+        return self.fi.qualname if self.fi is not None else "<module>"
+
+
+@dataclasses.dataclass
+class CollectiveUse:
+    mi: ModuleInfo
+    fi: FunctionInfo
+    call: ast.Call
+    name: str
+    axes: Optional[List[str]] = None   # literal axis names, None = symbolic
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+def literal_rank(index: PackageIndex, mi: ModuleInfo, env: Env,
+                 expr: Optional[ast.AST]) -> Optional[int]:
+    """Rank of an array expression when statically evident: a literal
+    shape constructor (``jnp.zeros((4, 8))``-style) or a
+    ``ShapeDtypeStruct((..., ...), ...)``."""
+    expr = env.resolve(expr)
+    if not isinstance(expr, ast.Call):
+        return None
+    name = _last_name(expr.func)
+    if name in _SHAPE_CTORS or name == "ShapeDtypeStruct":
+        shape = expr.args[0] if expr.args else _kw(expr, "shape")
+        shape = _resolve(index, mi, env, shape)
+        if isinstance(shape, (ast.Tuple, ast.List)):
+            return len(shape.elts)
+    return None
+
+
+def literal_shape(index: PackageIndex, mi: ModuleInfo, env: Env,
+                  expr: Optional[ast.AST]) -> Optional[List[Optional[int]]]:
+    """Per-dim literal sizes of an array expression (None entries for
+    non-literal dims), or None when the shape is not statically evident."""
+    expr = env.resolve(expr)
+    if not isinstance(expr, ast.Call):
+        return None
+    name = _last_name(expr.func)
+    if name in _SHAPE_CTORS or name == "ShapeDtypeStruct":
+        shape = expr.args[0] if expr.args else _kw(expr, "shape")
+        shape = _resolve(index, mi, env, shape)
+        if isinstance(shape, (ast.Tuple, ast.List)):
+            return [_int_const(_resolve(index, mi, env, e))
+                    for e in shape.elts]
+    return None
+
+
+def _resolve_body(site: ShardMapSite, index: PackageIndex,
+                  env: Env, expr: Optional[ast.AST]) -> None:
+    expr = env.resolve(expr)
+    if expr is None:
+        return
+    inner = partial_inner(expr)
+    while inner is not None:
+        site.body_bound_kw |= {kw.arg for kw in expr.keywords if kw.arg}
+        site.body_bound_pos += len(expr.args) - 1
+        expr = env.resolve(inner)
+        inner = partial_inner(expr) if expr is not None else None
+    if isinstance(expr, ast.Name):
+        target = _lookup_def(site.mi, site.fi, expr.id)
+        if target is not None:
+            site.body_fi = target
+    elif isinstance(expr, ast.Lambda):
+        for fi in site.mi.functions.values():
+            if fi.node is expr:
+                site.body_fi = fi
+                break
+
+
+class MeshModel:
+    """All shard_map / NamedSharding / vmap(axis_name=) sites, spec
+    literals and collective uses in one indexed package."""
+
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.shard_map_sites: List[ShardMapSite] = []
+        self.sharding_sites: List[ShardingSite] = []
+        self.vmap_sites: List[VmapSite] = []
+        #: (mi, qualname, SpecModel) for every spec literal in the package
+        self.spec_literals: List[Tuple[ModuleInfo, str, SpecModel]] = []
+        #: function key -> collective uses lexically inside it
+        self.collectives: Dict[str, List[CollectiveUse]] = {}
+        self._build()
+
+    # -- construction ----------------------------------------------------
+
+    def _build(self) -> None:
+        index = self.index
+        for mi in index.modules.values():
+            outer_of: Dict[int, ast.Call] = {}
+            for node in ast.walk(mi.tree):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Call):
+                    outer_of[id(node.func)] = node
+            seen: Set[int] = set()
+            for fi_or_none, call in index._all_calls(mi):
+                if id(call) in seen:
+                    continue
+                name = _last_name(call.func)
+                if name == "shard_map":
+                    seen.add(id(call))
+                    self._parse_shard_map(mi, fi_or_none, call,
+                                          outer_of.get(id(call)))
+                elif name == "NamedSharding":
+                    seen.add(id(call))
+                    self._parse_sharding(mi, fi_or_none, call)
+                elif name in ("vmap", "pmap"):
+                    seen.add(id(call))
+                    self._parse_vmap(mi, fi_or_none, call)
+            for fi in mi.functions.values():
+                self._collect_specs_and_collectives(mi, fi)
+            self._collect_module_specs(mi)
+            self._attach_placements(mi)
+        self.shard_map_sites.sort(key=lambda s: (s.mi.rel, s.line))
+        self.sharding_sites.sort(key=lambda s: (s.mi.rel, s.line))
+
+    def _parse_shard_map(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                         call: ast.Call, outer: Optional[ast.Call]) -> None:
+        env = OrderedEnv(mi, fi)
+        site = ShardMapSite(mi=mi, fi=fi, call=call)
+        mesh_expr = (call.args[1] if len(call.args) > 1
+                     else _kw(call, "mesh"))
+        in_expr = (call.args[2] if len(call.args) > 2
+                   else _kw(call, "in_specs"))
+        out_expr = (call.args[3] if len(call.args) > 3
+                    else _kw(call, "out_specs"))
+        site.env = mesh_env(self.index, mi, env, mesh_expr) \
+            if mesh_expr is not None else None
+        names_expr = _kw(call, "axis_names")
+        if names_expr is not None:
+            got = _axis_names(self.index, mi, env, names_expr)
+            if got is not None and got[1]:
+                site.manual_axes = tuple(got[0])
+        if in_expr is not None:
+            site.in_specs, site.in_specs_seq = _spec_seq(
+                self.index, mi, env, in_expr)
+        if out_expr is not None:
+            site.out_specs, site.out_specs_seq = _spec_seq(
+                self.index, mi, env, out_expr)
+        body_expr = call.args[0] if call.args else _kw(call, "f")
+        if body_expr is not None:
+            site.body_keys = self.index._direct_func_keys(mi, fi, body_expr)
+            _resolve_body(site, self.index, env, body_expr)
+        if outer is not None and not any(isinstance(a, ast.Starred)
+                                         for a in outer.args):
+            site.arg_exprs = list(outer.args)
+        self.shard_map_sites.append(site)
+
+    def _parse_sharding(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                        call: ast.Call) -> None:
+        env = OrderedEnv(mi, fi)
+        site = ShardingSite(mi=mi, fi=fi, call=call)
+        mesh_expr = call.args[0] if call.args else _kw(call, "mesh")
+        spec_expr = (call.args[1] if len(call.args) > 1
+                     else _kw(call, "spec"))
+        site.env = mesh_env(self.index, mi, env, mesh_expr) \
+            if mesh_expr is not None else None
+        site.spec = build_spec(self.index, mi, env, spec_expr) \
+            if spec_expr is not None else None
+        self.sharding_sites.append(site)
+
+    def _parse_vmap(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                    call: ast.Call) -> None:
+        name_expr = _kw(call, "axis_name")
+        if name_expr is None:
+            return
+        env = OrderedEnv(mi, fi)
+        axis = _str_const(self.index, mi, env, name_expr)
+        if axis is None:
+            return
+        keys = self.index._direct_func_keys(
+            mi, fi, call.args[0]) if call.args else set()
+        self.vmap_sites.append(VmapSite(mi=mi, fi=fi, call=call,
+                                        axis_name=axis, body_keys=keys))
+
+    def _collect_specs_and_collectives(self, mi: ModuleInfo,
+                                       fi: FunctionInfo) -> None:
+        env: Optional[Env] = None
+        uses: List[CollectiveUse] = []
+        for _, bare, call in fi.calls:
+            if bare in COLLECTIVE_AXIS_ARG:
+                if env is None:
+                    env = OrderedEnv(mi, fi)
+                idx = COLLECTIVE_AXIS_ARG[bare]
+                axis_expr = (call.args[idx] if len(call.args) > idx
+                             else (_kw(call, "axis_name")
+                                   or _kw(call, "axis")))
+                axes: Optional[List[str]] = None
+                if axis_expr is not None:
+                    got = _axis_names(self.index, mi, env, axis_expr)
+                    if got is not None and got[1]:
+                        axes = got[0]
+                uses.append(CollectiveUse(mi=mi, fi=fi, call=call,
+                                          name=bare, axes=axes))
+            if isinstance(call, ast.Call) \
+                    and _is_spec_ctor(mi, call.func):
+                if env is None:
+                    env = OrderedEnv(mi, fi)
+                spec = build_spec(self.index, mi, env, call)
+                if spec is not None:
+                    self.spec_literals.append((mi, fi.qualname, spec))
+        if uses:
+            self.collectives[fi.key] = uses
+
+    def _collect_module_specs(self, mi: ModuleInfo) -> None:
+        env = OrderedEnv(mi, None)
+        for node in walk_shallow(mi.tree):
+            if isinstance(node, ast.Call) and _is_spec_ctor(mi, node.func):
+                spec = build_spec(self.index, mi, env, node)
+                if spec is not None:
+                    self.spec_literals.append((mi, "<module>", spec))
+
+    def _attach_placements(self, mi: ModuleInfo) -> None:
+        """Pair each NamedSharding site with the array expression it
+        places (``device_put(arr, NS)``/``with_sharding_constraint``)."""
+        ns_by_id = {id(s.call): s for s in self.sharding_sites
+                    if s.mi is mi}
+        if not ns_by_id:
+            return
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call) or len(node.args) < 2:
+                continue
+            name = _last_name(node.func)
+            if name not in ("device_put", "global_device_put",
+                            "with_sharding_constraint"):
+                continue
+            site = ns_by_id.get(id(node.args[1]))
+            if site is not None:
+                site.placed_expr = node.args[0]
+
+    # -- queries ---------------------------------------------------------
+
+    def region_of(self, body_keys: Set[str]) -> Set[str]:
+        """Function keys reachable from a shard_map/vmap body closure."""
+        return self.index.reachable_from(set(body_keys))
+
+    def region_vmap_axes(self, region: Set[str]) -> Set[str]:
+        """Axis names bound by vmap(axis_name=...) sites whose enclosing
+        function lies in ``region`` — additionally legal for collectives
+        under that region."""
+        out: Set[str] = set()
+        for v in self.vmap_sites:
+            if (v.fi is not None and v.fi.key in region) \
+                    or any(k in region for k in v.body_keys):
+                out.add(v.axis_name)
+        return out
+
+
+def build_mesh_model(index: PackageIndex) -> MeshModel:
+    return MeshModel(index)

@@ -39,7 +39,8 @@ def register_rule(rule_id: str, description: str,
 
 def rule_family(rule_id: str) -> str:
     """'PK101' -> 'PK': the alphabetic prefix groups rules into families
-    (PT python-tracing hygiene, PK pallas-kernel, PC collective)."""
+    (PT python-tracing hygiene, PK pallas-kernel, PC collective,
+    PS sharding/mesh)."""
     return rule_id.rstrip("0123456789") or rule_id
 
 

@@ -1,0 +1,415 @@
+"""PS301–PS306: sharding verification over the static mesh/PartitionSpec
+model (``meshmodel.py``; docs/ANALYSIS.md, sharding-verification section).
+
+A wrong axis name or a non-divisible sharded dimension surfaces at
+runtime as an opaque XLA error on chip — or worse, as a silent
+full-replication slowdown. These rules check, entirely at the AST level,
+that the specs and axis names threaded through ``shard_map`` /
+``NamedSharding`` / collectives are mutually consistent. Like the kernel
+rules, every check opts out when the model could not resolve the piece
+it needs — an unknown mesh or a helper-built spec is never guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import PackageIndex, _last_name, walk_shallow
+from .meshmodel import (MeshModel, OrderedEnv, ShardMapSite, SpecModel,
+                        _str_const, build_mesh_model, literal_rank,
+                        literal_shape)
+from .model import Config, Finding, register_rule
+
+register_rule("PS301", "collective axis name not bound by an enclosing "
+                       "mesh/shard_map axis environment",
+              severity="error")
+register_rule("PS302", "in_specs/out_specs arity mismatch vs the wrapped "
+                       "function's signature or call arguments",
+              severity="error")
+register_rule("PS303", "PartitionSpec rank exceeds the sharded array's "
+                       "rank, or the same mesh axis appears twice",
+              severity="error")
+register_rule("PS304", "statically-known dimension not divisible by the "
+                       "product of the mesh axis sizes sharding it",
+              severity="warning")
+register_rule("PS305", "axis-name shadowing across nested shard_map/"
+                       "vmap(axis_name=) scopes",
+              severity="warning")
+register_rule("PS306", "unsanitized layer-declared spec reaches "
+                       "NamedSharding under a configurable mesh",
+              severity="warning")
+
+
+def _spec_dup_axes(spec: SpecModel) -> List[str]:
+    """Axis names appearing in more than one dim entry (or twice inside
+    one nested-tuple entry) of a fully-literal spec."""
+    if spec.entries is None:
+        return []
+    seen: Dict[str, int] = {}
+    for e in spec.entries:
+        names = (e,) if isinstance(e, str) else e if isinstance(e, tuple) \
+            else ()
+        for n in names:
+            seen[n] = seen.get(n, 0) + 1
+    return sorted(n for n, c in seen.items() if c > 1)
+
+
+def _site_axes(site: ShardMapSite) -> Set[str]:
+    """Every axis name the site is *known* to bind (possibly a subset of
+    the true environment when the mesh is partially symbolic)."""
+    out: Set[str] = set(site.manual_axes or ())
+    if site.env is not None:
+        out |= set(site.env.axes)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PS301 — collective axis vs environment
+# ---------------------------------------------------------------------------
+
+def _check_ps301(model: MeshModel, findings: List[Finding]) -> None:
+    reported: Set[Tuple[int, str]] = set()
+    for site in model.shard_map_sites:
+        bound = site.bound_axes()
+        if bound is None or not site.body_keys:
+            continue
+        region = model.region_of(site.body_keys)
+        allowed = set(bound) | model.region_vmap_axes(region)
+        for key in sorted(region):
+            for use in model.collectives.get(key, []):
+                if use.axes is None:
+                    continue
+                for axis in use.axes:
+                    if axis in allowed or (id(use.call), axis) in reported:
+                        continue
+                    reported.add((id(use.call), axis))
+                    findings.append(Finding(
+                        "PS301", "error", use.mi.rel, use.call.lineno,
+                        use.call.col_offset, use.fi.qualname,
+                        f"collective `{use.name}` names axis '{axis}' "
+                        f"but the shard_map environment reaching it binds "
+                        f"only {sorted(allowed)} "
+                        f"(site {site.mi.rel}:{site.qualname})",
+                        hint="pass the axis the mesh actually has, or "
+                             "thread the axis name from the shard_map "
+                             "site instead of hard-coding it",
+                        detail=f"unbound-axis:{use.name}:{axis}"))
+
+
+# ---------------------------------------------------------------------------
+# PS302 — spec arity vs signature
+# ---------------------------------------------------------------------------
+
+def _return_tuple_len(site: ShardMapSite) -> Optional[int]:
+    """Common literal-tuple length of every return of the body, or None
+    when returns are absent / non-tuple / of mixed length."""
+    fi = site.body_fi
+    if fi is None:
+        return None
+    if isinstance(fi.node, ast.Lambda):
+        body = fi.node.body
+        return len(body.elts) if isinstance(body, ast.Tuple) else None
+    lens: Set[int] = set()
+    for node in walk_shallow(fi.node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if not isinstance(node.value, ast.Tuple):
+                return None
+            lens.add(len(node.value.elts))
+    return lens.pop() if len(lens) == 1 else None
+
+
+def _check_ps302(site: ShardMapSite, findings: List[Finding]) -> None:
+    def report(what: str, detail: str) -> None:
+        findings.append(Finding(
+            "PS302", "error", site.mi.rel, site.line,
+            site.call.col_offset, site.qualname, what,
+            hint="one spec per leaf of the argument/output tree — a "
+                 "single spec (no tuple) broadcasts as a pytree prefix",
+            detail=detail))
+
+    if site.in_specs is not None and site.in_specs_seq:
+        n_specs = len(site.in_specs)
+        n_params = site.body_positional()
+        if n_params is not None and n_specs != n_params:
+            report(f"in_specs has {n_specs} spec(s) but the wrapped "
+                   f"function takes {n_params} positional argument(s)",
+                   f"in-specs-arity:{n_specs}:{n_params}")
+        if site.arg_exprs is not None and len(site.arg_exprs) != n_specs:
+            report(f"in_specs has {n_specs} spec(s) but the shard_map "
+                   f"is invoked with {len(site.arg_exprs)} argument(s)",
+                   f"in-specs-args:{n_specs}:{len(site.arg_exprs)}")
+    if site.out_specs is not None and site.out_specs_seq:
+        n_ret = _return_tuple_len(site)
+        if n_ret is not None and n_ret != len(site.out_specs):
+            report(f"out_specs has {len(site.out_specs)} spec(s) but the "
+                   f"wrapped function returns a {n_ret}-tuple",
+                   f"out-specs-arity:{len(site.out_specs)}:{n_ret}")
+
+
+# ---------------------------------------------------------------------------
+# PS303 — rank excess / duplicate axis
+# ---------------------------------------------------------------------------
+
+def _check_ps303_spec(mi_rel: str, qualname: str, spec: SpecModel,
+                      findings: List[Finding],
+                      reported: Set[int]) -> None:
+    if id(spec.node) in reported:
+        return
+    dups = _spec_dup_axes(spec)
+    if dups:
+        reported.add(id(spec.node))
+        findings.append(Finding(
+            "PS303", "error", mi_rel, spec.node.lineno,
+            spec.node.col_offset, qualname,
+            f"mesh axis{'es' if len(dups) > 1 else ''} "
+            f"{', '.join(repr(d) for d in dups)} used twice in "
+            f"{spec.text()} — an axis may shard at most one dim",
+            hint="each mesh axis may appear once per spec; merge dims "
+                 "with a nested tuple entry instead",
+            detail=f"dup-axis:{':'.join(dups)}"))
+
+
+def _check_ps303_rank(mi_rel: str, qualname: str, spec: SpecModel,
+                      rank: Optional[int], what: str,
+                      findings: List[Finding],
+                      reported: Set[int]) -> None:
+    min_rank = spec.min_rank
+    if rank is None or min_rank is None or min_rank <= rank \
+            or id(spec.node) in reported:
+        return
+    reported.add(id(spec.node))
+    findings.append(Finding(
+        "PS303", "error", mi_rel, spec.node.lineno, spec.node.col_offset,
+        qualname,
+        f"spec {spec.text()} names {min_rank} dim(s) but {what} has "
+        f"rank {rank}",
+        hint="drop the excess entries — a spec may be shorter than the "
+             "array rank (trailing dims replicate) but never longer",
+        detail=f"rank-excess:{min_rank}:{rank}"))
+
+
+# ---------------------------------------------------------------------------
+# PS304 — divisibility
+# ---------------------------------------------------------------------------
+
+def _axis_product(site_env, axes: Tuple[str, ...]) -> Optional[int]:
+    prod = 1
+    for a in axes:
+        s = site_env.size(a)
+        if s is None:
+            return None
+        prod *= s
+    return prod
+
+
+def _check_ps304_pair(mi_rel: str, qualname: str, env, spec: SpecModel,
+                      shape: Optional[List[Optional[int]]],
+                      findings: List[Finding],
+                      reported: Set[Tuple[int, int]]) -> None:
+    if spec.entries is None or env is None or not env.sizes:
+        return
+    for d in range(len(spec.entries)):
+        axes = spec.entry_axes(d)
+        if not axes:
+            continue
+        prod = _axis_product(env, axes)
+        if prod is None or prod <= 1:
+            continue
+        dim = shape[d] if shape is not None and d < len(shape) else None
+        key = (id(spec.node), d)
+        if key in reported:
+            continue
+        if dim is None:
+            reported.add(key)
+            findings.append(Finding(
+                "PS304", "info", mi_rel, spec.node.lineno,
+                spec.node.col_offset, qualname,
+                f"dim {d} sharded by {list(axes)} (product {prod}) has a "
+                f"statically-unknown size — divisibility not verified",
+                hint="advisory only: verify the dim is a multiple of "
+                     f"{prod} for every configuration that reaches here",
+                detail=f"indivisible-unverified:{d}:{prod}"))
+        elif dim % prod != 0:
+            reported.add(key)
+            findings.append(Finding(
+                "PS304", "warning", mi_rel, spec.node.lineno,
+                spec.node.col_offset, qualname,
+                f"dim {d} of size {dim} is not divisible by the mesh "
+                f"axis product {prod} ({list(axes)}) — XLA pads or "
+                f"rejects the sharding",
+                hint="pad the dim, pick a divisible degree, or replicate "
+                     "this dim (None entry) instead",
+                detail=f"indivisible:{d}:{dim}:{prod}"))
+
+
+# ---------------------------------------------------------------------------
+# PS305 — axis shadowing
+# ---------------------------------------------------------------------------
+
+def _check_ps305(model: MeshModel, findings: List[Finding]) -> None:
+    site_by_call = {id(s.call): s for s in model.shard_map_sites}
+    reported: Set[Tuple[int, str]] = set()
+
+    def scan_region(outer_axes: Set[str], body_keys: Set[str],
+                    outer_desc: str) -> None:
+        if not outer_axes or not body_keys:
+            return
+        region = model.region_of(body_keys)
+        for key in sorted(region):
+            fi = model.index.functions.get(key)
+            if fi is None:
+                continue
+            mi = model.index.modules[fi.modname]
+            for _, bare, call in fi.calls:
+                rebound: List[str] = []
+                if bare in ("vmap", "pmap"):
+                    env = OrderedEnv(mi, fi)
+                    for kw in call.keywords:
+                        if kw.arg == "axis_name":
+                            s = _str_const(model.index, mi, env, kw.value)
+                            if s is not None and s in outer_axes:
+                                rebound.append(s)
+                elif bare == "shard_map":
+                    inner = site_by_call.get(id(call))
+                    if inner is not None:
+                        rebound = sorted(_site_axes(inner) & outer_axes)
+                for axis in rebound:
+                    if (id(call), axis) in reported:
+                        continue
+                    reported.add((id(call), axis))
+                    findings.append(Finding(
+                        "PS305", "warning", mi.rel, call.lineno,
+                        call.col_offset, fi.qualname,
+                        f"axis '{axis}' rebound by nested `{bare}` inside "
+                        f"a scope that already binds it ({outer_desc}) — "
+                        f"collectives over '{axis}' silently target the "
+                        f"innermost binding",
+                        hint="rename the inner axis_name, or lift the "
+                             "nested mapping out of the shard_map body",
+                        detail=f"axis-shadow:{bare}:{axis}"))
+
+    for site in model.shard_map_sites:
+        scan_region(_site_axes(site), site.body_keys,
+                    f"shard_map at {site.mi.rel}:{site.qualname}")
+    for v in model.vmap_sites:
+        scan_region({v.axis_name}, v.body_keys,
+                    f"vmap at {v.mi.rel}:{v.qualname}")
+
+
+# ---------------------------------------------------------------------------
+# PS306 — unsanitized spec under a configurable mesh
+# ---------------------------------------------------------------------------
+
+def _check_ps306(model: MeshModel, findings: List[Finding]) -> None:
+    for site in model.sharding_sites:
+        spec = site.spec
+        if spec is None or spec.sanitized:
+            continue
+        env = site.env
+        configurable = env is not None and env.ambient
+        mesh_known = env is not None and env.complete and not env.ambient
+        if spec.layer_declared and (configurable or env is None):
+            findings.append(Finding(
+                "PS306", "warning", site.mi.rel, site.line,
+                site.call.col_offset, site.qualname,
+                "layer-declared `_sharding_spec` reaches NamedSharding "
+                "without sanitize_spec — under a mesh missing one of its "
+                "axes this raises at placement time",
+                hint="wrap the spec: sanitize_spec(mesh, spec) drops "
+                     "axis names the mesh does not have",
+                detail="unsanitized-layer-spec"))
+        elif spec.axes and configurable:
+            findings.append(Finding(
+                "PS306", "warning", site.mi.rel, site.line,
+                site.call.col_offset, site.qualname,
+                f"spec {spec.text()} names axes {sorted(spec.axes)} but "
+                f"the mesh comes from runtime configuration "
+                f"({env.source}) — a configured mesh lacking one of "
+                f"them fails at placement time",
+                hint="sanitize_spec(mesh, spec) before placing, or "
+                     "construct the mesh this spec assumes",
+                detail=f"unsanitized-spec:{':'.join(sorted(spec.axes))}"))
+        elif spec.axes and mesh_known:
+            missing = sorted(spec.axes - set(env.axes))
+            if missing:
+                findings.append(Finding(
+                    "PS306", "warning", site.mi.rel, site.line,
+                    site.call.col_offset, site.qualname,
+                    f"spec {spec.text()} names ax"
+                    f"{'es' if len(missing) > 1 else 'is'} "
+                    f"{', '.join(repr(m) for m in missing)} that the "
+                    f"{env.source} mesh ({list(env.axes)}) does not have",
+                    hint="fix the axis name or sanitize_spec() the spec "
+                         "for this mesh",
+                    detail=f"missing-axis:{':'.join(missing)}"))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    wanted = [r for r in ("PS301", "PS302", "PS303", "PS304", "PS305",
+                          "PS306") if cfg.wants(r)]
+    if not wanted:
+        return []
+    model = build_mesh_model(index)
+    findings: List[Finding] = []
+
+    if cfg.wants("PS301"):
+        _check_ps301(model, findings)
+    if cfg.wants("PS302"):
+        for site in model.shard_map_sites:
+            _check_ps302(site, findings)
+    if cfg.wants("PS303"):
+        reported: Set[int] = set()
+        for mi, qualname, spec in model.spec_literals:
+            _check_ps303_spec(mi.rel, qualname, spec, findings, reported)
+        for site in model.shard_map_sites:
+            if site.in_specs and site.in_specs_seq \
+                    and site.arg_exprs is not None:
+                env = OrderedEnv(site.mi, site.fi)
+                for i, spec in enumerate(site.in_specs):
+                    if i < len(site.arg_exprs) and spec.resolved:
+                        rank = literal_rank(index, site.mi, env,
+                                            site.arg_exprs[i])
+                        _check_ps303_rank(site.mi.rel, site.qualname, spec,
+                                          rank, f"argument {i}", findings,
+                                          reported)
+        for ssite in model.sharding_sites:
+            if ssite.spec is not None and ssite.placed_expr is not None:
+                env = OrderedEnv(ssite.mi, ssite.fi)
+                rank = literal_rank(index, ssite.mi, env, ssite.placed_expr)
+                _check_ps303_rank(ssite.mi.rel, ssite.qualname, ssite.spec,
+                                  rank, "the placed array", findings,
+                                  reported)
+    if cfg.wants("PS304"):
+        reported_div: Set[Tuple[int, int]] = set()
+        for site in model.shard_map_sites:
+            if site.in_specs and site.in_specs_seq and site.env is not None:
+                env = OrderedEnv(site.mi, site.fi)
+                for i, spec in enumerate(site.in_specs):
+                    if not spec.resolved:
+                        continue
+                    shape = None
+                    if site.arg_exprs is not None \
+                            and i < len(site.arg_exprs):
+                        shape = literal_shape(index, site.mi, env,
+                                              site.arg_exprs[i])
+                    _check_ps304_pair(site.mi.rel, site.qualname,
+                                      site.env, spec, shape, findings,
+                                      reported_div)
+        for ssite in model.sharding_sites:
+            if ssite.spec is not None and ssite.env is not None:
+                env = OrderedEnv(ssite.mi, ssite.fi)
+                shape = literal_shape(index, ssite.mi, env,
+                                      ssite.placed_expr) \
+                    if ssite.placed_expr is not None else None
+                _check_ps304_pair(ssite.mi.rel, ssite.qualname, ssite.env,
+                                  ssite.spec, shape, findings, reported_div)
+    if cfg.wants("PS305"):
+        _check_ps305(model, findings)
+    if cfg.wants("PS306"):
+        _check_ps306(model, findings)
+    return findings

@@ -8,12 +8,12 @@ import os
 from typing import List, Optional, Tuple
 
 from . import (rules_collective, rules_hostsync, rules_kernel, rules_rng,
-               rules_threads, rules_trace)
+               rules_sharding, rules_threads, rules_trace)
 from .callgraph import PackageIndex
 from .model import Config, Finding, is_suppressed
 
 _PASSES = (rules_trace, rules_hostsync, rules_rng, rules_threads,
-           rules_kernel, rules_collective)
+           rules_kernel, rules_collective, rules_sharding)
 
 
 def discover(root: str) -> List[Tuple[str, str, str]]:
@@ -59,17 +59,26 @@ def _filter(findings: List[Finding], index: PackageIndex,
     return out
 
 
-def analyze_paths(paths: List[str],
+def analyze_files(files: List[Tuple[str, str, str]],
                   cfg: Optional[Config] = None) -> List[Finding]:
+    """Analyze an explicit ``[(modname, abs_path, rel_path)]`` set — the
+    ``--changed-only`` entry point, where the caller has already filtered
+    ``discover()`` output but needs rel paths (and so baseline keys) to
+    stay repo-relative."""
     cfg = cfg or Config()
-    files: List[Tuple[str, str, str]] = []
-    for p in paths:
-        files.extend(discover(p))
     index = PackageIndex.from_files(files)
     findings: List[Finding] = []
     for p in _PASSES:
         findings.extend(p.run(index, cfg))
     return _filter(findings, index, cfg)
+
+
+def analyze_paths(paths: List[str],
+                  cfg: Optional[Config] = None) -> List[Finding]:
+    files: List[Tuple[str, str, str]] = []
+    for p in paths:
+        files.extend(discover(p))
+    return analyze_files(files, cfg)
 
 
 def analyze_source(source: str, cfg: Optional[Config] = None,

@@ -10,7 +10,8 @@ from . import env  # noqa: F401
 from .env import get_rank, get_world_size  # noqa: F401
 
 from .mesh import (AXIS_ORDER, HybridTopology, ProcessMesh,  # noqa: F401
-                   build_hybrid_mesh, get_mesh, mesh_context, set_mesh)
+                   build_hybrid_mesh, get_mesh, mesh_context, sanitize_spec,
+                   set_mesh)
 from .auto_parallel import (Partial, Replicate, Shard, dtensor_from_fn,  # noqa: F401
                             get_placements, mark_sharding, reshard,
                             shard_layer, shard_tensor)

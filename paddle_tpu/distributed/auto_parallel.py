@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
-from .mesh import ProcessMesh, get_mesh
+from .mesh import ProcessMesh, get_mesh, sanitize_spec
 
 __all__ = ["Shard", "Replicate", "Partial", "shard_tensor", "reshard",
            "dtensor_from_fn", "placements_to_spec", "shard_layer",
@@ -174,7 +174,10 @@ def shard_layer(layer, mesh=None, shard_fn=None, input_fn=None,
         for pname, p in sublayer.__dict__["_parameters"].items():
             if p is None:
                 continue
-            spec = getattr(p, "_sharding_spec", None) or PartitionSpec()
+            # layer-declared specs (TP layers pin e.g. "mp") must be
+            # sanitized: the caller's mesh is configurable and may lack
+            # the axis the layer assumed (PS306)
+            spec = sanitize_spec(m, getattr(p, "_sharding_spec", None))
             p._data = jax.device_put(p._data, NamedSharding(m, spec))
 
     fn = shard_fn or default_shard

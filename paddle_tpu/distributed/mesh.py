@@ -47,7 +47,8 @@ class mesh_context:
         return self._mesh
 
     def __exit__(self, *exc):
-        set_mesh(self._prev) if self._prev is not None else None
+        # single restore path: `_prev` came from get_mesh() and is already
+        # a raw Mesh (or None), so assign it back directly
         global _current_mesh
         _current_mesh = self._prev
         return False
@@ -138,6 +139,10 @@ def sanitize_spec(mesh, spec):
     from jax.sharding import PartitionSpec
     if spec is None:
         return PartitionSpec()
+    if mesh is None:
+        # no mesh to check against: pass the spec through unchanged so
+        # single-device paths keep the layer's declared intent
+        return spec
     names = set(mesh.axis_names)
     entries = []
     for e in spec:

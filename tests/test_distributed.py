@@ -207,3 +207,59 @@ def test_sequence_parallel_annotation_roundtrip():
     x = paddle.rand([2, 8, 4])
     out = dist.annotate_sequence_parallel(x)
     np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
+def test_nested_mesh_context_round_trip():
+    """Entering/leaving nested contexts restores each level exactly (the
+    __exit__ single-restore path), including the outermost None."""
+    assert dist.get_mesh() is None
+    m1 = dist.build_hybrid_mesh(dp_degree=8)
+    m2 = dist.build_hybrid_mesh(mp_degree=8)
+    with dist.mesh_context(m1):
+        assert dist.get_mesh() is m1
+        with dist.mesh_context(m2):
+            assert dist.get_mesh() is m2
+            # ProcessMesh nests through the same context machinery
+            pm = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                                  dim_names=["x", "y"])
+            with pm:
+                assert dist.get_mesh() is pm.jax_mesh
+            assert dist.get_mesh() is m2
+        assert dist.get_mesh() is m1
+    assert dist.get_mesh() is None
+
+
+class TestSanitizeSpec:
+    def test_none_spec_becomes_empty(self):
+        mesh = dist.build_hybrid_mesh(dp_degree=8)
+        assert dist.sanitize_spec(mesh, None) == P()
+
+    def test_empty_spec_passes_through(self):
+        mesh = dist.build_hybrid_mesh(dp_degree=8)
+        assert dist.sanitize_spec(mesh, P()) == P()
+
+    def test_none_mesh_passes_spec_through(self):
+        spec = P("mp", None)
+        assert dist.sanitize_spec(None, spec) is spec
+
+    def test_all_axes_missing_collapses_to_replicated(self):
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices(), dtype=object).reshape(8), ("x",))
+        assert dist.sanitize_spec(mesh, P("dp", "mp")) == P(None, None)
+
+    def test_nested_tuple_entries_filtered_per_member(self):
+        # hybrid mesh has dp (and mp, size 1) but no fsdp axis: the
+        # missing member is dropped from the tuple, the rest survive
+        mesh = dist.build_hybrid_mesh(dp_degree=8)
+        out = dist.sanitize_spec(mesh, P(("dp", "fsdp"), "mp"))
+        assert out == P(("dp",), "mp")
+
+    def test_nested_tuple_with_no_surviving_member_becomes_none(self):
+        mesh = dist.build_hybrid_mesh(dp_degree=8)
+        out = dist.sanitize_spec(mesh, P(("fsdp", "tp"), "dp"))
+        assert out == P(None, "dp")
+
+    def test_known_axes_kept(self):
+        mesh = dist.build_hybrid_mesh(dp_degree=4, mp_degree=2)
+        spec = P("dp", None, "mp")
+        assert dist.sanitize_spec(mesh, spec) == spec

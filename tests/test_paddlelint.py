@@ -1214,14 +1214,24 @@ class TestRepoJsonGate:
                         "--json"])
         data = json.loads(capsys.readouterr().out)
         assert rc == 0
-        assert set(data["families"]) == {"PT", "PK", "PC"}
+        assert set(data["families"]) == {"PT", "PK", "PC", "PS"}
         for fam, info in sorted(data["families"].items()):
             assert info["fresh"] == 0, (fam, data["findings"])
             assert info["rules"], fam
+            assert info["unjustified"] == [], fam
         assert data["baseline"]["unjustified"] == []
         assert data["baseline"]["stale"] == []
         # the single accepted PK entry (fusion JIT's definitional oracle)
         assert data["families"]["PK"]["baselined"] == 1
+        assert data["families"]["PK"]["per_rule"]["PK105"]["baselined"] == 1
+        # the sharding family gates the whole repo at zero: no fresh
+        # findings, no baseline debt
+        ps = data["families"]["PS"]
+        assert ps["rules"] == ["PS301", "PS302", "PS303", "PS304",
+                               "PS305", "PS306"]
+        assert ps["baselined"] == 0
+        assert all(c == {"fresh": 0, "baselined": 0}
+                   for c in ps["per_rule"].values())
 
 
 # -------------------------------------- seeded kernel/collective defects
@@ -1302,3 +1312,611 @@ class TestSeededKernelDefects:
         assert fresh and {f.rule for f in fresh} == {"PC201"}
         assert fresh[0].qualname == "_seeded_allreduce"
         assert fresh[0].detail.startswith("branch-collective:psum:")
+
+
+# ---------------------------------------------------------------- PS301
+
+class TestPS301UnboundCollectiveAxis:
+    def test_psum_over_axis_not_in_mesh(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(devs, x):
+                mesh = Mesh(devs, ("x", "y"))
+
+                def body(v):
+                    return jax.lax.psum(v, "dp")
+
+                return shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                                 out_specs=P("x"))(x)
+        """)
+        assert _rules(fs) == ["PS301"]
+        assert fs[0].detail == "unbound-axis:psum:dp"
+        assert fs[0].severity == "error"
+
+    def test_axis_present_in_mesh_is_quiet(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(devs, x):
+                mesh = Mesh(devs, ("x", "y"))
+
+                def body(v):
+                    return jax.lax.psum(v, "y")
+
+                return shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                                 out_specs=P("x"))(x)
+        """)
+        assert _rules(fs) == []
+
+    def test_vmap_bound_axis_inside_region_is_quiet(self):
+        # body vmaps a helper with its own axis_name: that name is bound
+        # even though the mesh doesn't carry it
+        fs = _lint("""
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(devs, x):
+                mesh = Mesh(devs, ("x",))
+
+                def inner(u):
+                    return jax.lax.psum(u, "v")
+
+                def body(v):
+                    return jax.vmap(inner, axis_name="v")(v)
+
+                return shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                                 out_specs=P("x"))(x)
+        """)
+        assert _rules(fs) == []
+
+    def test_symbolic_mesh_axes_are_quiet(self):
+        # axis tuple not statically known: must degrade to no finding
+        fs = _lint("""
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(devs, names, x):
+                mesh = Mesh(devs, names)
+
+                def body(v):
+                    return jax.lax.psum(v, "dp")
+
+                return shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                                 out_specs=P("x"))(x)
+        """)
+        assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------- PS302
+
+class TestPS302SpecArity:
+    def test_more_in_specs_than_body_params(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(mesh, x, y):
+                def body(v):
+                    return v
+
+                return shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                                 out_specs=P())(x, y)
+        """)
+        assert _rules(fs) == ["PS302"]
+        assert fs[0].detail == "in-specs-arity:2:1"
+        assert fs[0].severity == "error"
+
+    def test_out_specs_tuple_vs_returned_tuple(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(mesh, x):
+                def body(v):
+                    return v, v, v
+
+                return shard_map(body, mesh=mesh, in_specs=(P(),),
+                                 out_specs=(P(), P()))(x)
+        """)
+        assert _rules(fs) == ["PS302"]
+        assert fs[0].detail == "out-specs-arity:2:3"
+
+    def test_matching_arity_is_quiet(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(mesh, x, y):
+                def body(v, w):
+                    return v + w
+
+                return shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                                 out_specs=P())(x, y)
+        """)
+        assert _rules(fs) == []
+
+    def test_single_spec_for_any_arity_is_quiet(self):
+        # a bare (non-sequence) in_specs broadcasts over all args in the
+        # repo's _compat.shard_map — no arity claim to check
+        fs = _lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(mesh, x, y):
+                def body(v, w):
+                    return v + w
+
+                return shard_map(body, mesh=mesh, in_specs=P(),
+                                 out_specs=P())(x, y)
+        """)
+        assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------- PS303
+
+class TestPS303SpecShape:
+    def test_duplicate_axis_across_entries(self):
+        fs = _lint("""
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("dp", ("dp", "mp"))
+        """)
+        assert _rules(fs) == ["PS303"]
+        assert fs[0].detail == "dup-axis:dp"
+        assert fs[0].severity == "error"
+
+    def test_spec_rank_exceeds_array_rank(self):
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def f(mesh):
+                arr = jnp.zeros((4, 8))
+                return jax.device_put(
+                    arr, NamedSharding(mesh, P(None, None, "mp")))
+        """)
+        assert _rules(fs) == ["PS303"]
+        assert fs[0].detail == "rank-excess:3:2"
+
+    def test_trailing_nones_do_not_count_toward_rank(self):
+        # P("dp", None) on a rank-1 array: min_rank is 1 after stripping
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def f(mesh):
+                arr = jnp.zeros((4,))
+                return jax.device_put(arr, NamedSharding(mesh, P("dp", None)))
+        """)
+        assert _rules(fs) == []
+
+    def test_shorter_spec_is_quiet(self):
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def f(mesh):
+                arr = jnp.zeros((4, 8))
+                return jax.device_put(arr, NamedSharding(mesh, P("dp")))
+        """)
+        assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------- PS304
+
+class TestPS304Divisibility:
+    def test_statically_indivisible_dim(self):
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def f():
+                mesh = build_hybrid_mesh(dp_degree=4)
+                x = jnp.zeros((6, 128))
+                return jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+        """)
+        assert _rules(fs) == ["PS304"]
+        assert fs[0].detail == "indivisible:0:6:4"
+        assert fs[0].severity == "warning"
+
+    def test_divisible_dim_is_quiet(self):
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def f():
+                mesh = build_hybrid_mesh(dp_degree=4)
+                x = jnp.zeros((8, 128))
+                return jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+        """)
+        assert _rules(fs) == []
+
+    def test_symbolic_dim_is_advisory_under_strict_only(self):
+        src = """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def f(x):
+                mesh = build_hybrid_mesh(dp_degree=4)
+                return jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+        """
+        assert _rules(_lint(src)) == []
+        strict = _lint(src, strict=True)
+        assert _rules(strict) == ["PS304"]
+        assert strict[0].severity == "info"
+        assert strict[0].detail == "indivisible-unverified:0:4"
+
+    def test_unknown_axis_size_is_quiet(self):
+        # degree comes in as a parameter: product is symbolic
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def f(n):
+                mesh = build_hybrid_mesh(dp_degree=n)
+                x = jnp.zeros((6, 128))
+                return jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+        """)
+        assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------- PS305
+
+class TestPS305AxisShadowing:
+    def test_vmap_axis_name_shadows_mesh_axis(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(devs, x):
+                mesh = Mesh(devs, ("dp", "mp"))
+
+                def inner(u):
+                    return u * 2
+
+                def body(v):
+                    return jax.vmap(inner, axis_name="dp")(v)
+
+                return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=P("dp"))(x)
+        """)
+        assert _rules(fs) == ["PS305"]
+        assert fs[0].detail == "axis-shadow:vmap:dp"
+        assert fs[0].severity == "warning"
+
+    def test_distinct_vmap_axis_name_is_quiet(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def f(devs, x):
+                mesh = Mesh(devs, ("dp", "mp"))
+
+                def inner(u):
+                    return u * 2
+
+                def body(v):
+                    return jax.vmap(inner, axis_name="batch")(v)
+
+                return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=P("dp"))(x)
+        """)
+        assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------- PS306
+
+class TestPS306UnsanitizedSpec:
+    def test_layer_declared_spec_under_ambient_mesh(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import NamedSharding
+            from paddle_tpu.distributed.mesh import get_mesh
+
+            def place(p):
+                mesh = get_mesh()
+                spec = getattr(p, "_sharding_spec", None)
+                return jax.device_put(p, NamedSharding(mesh, spec))
+        """)
+        assert _rules(fs) == ["PS306"]
+        assert fs[0].detail == "unsanitized-layer-spec"
+        assert fs[0].severity == "warning"
+
+    def test_sanitized_layer_spec_is_quiet(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import NamedSharding
+            from paddle_tpu.distributed.mesh import get_mesh, sanitize_spec
+
+            def place(p):
+                mesh = get_mesh()
+                spec = sanitize_spec(mesh, getattr(p, "_sharding_spec", None))
+                return jax.device_put(p, NamedSharding(mesh, spec))
+        """)
+        assert _rules(fs) == []
+
+    def test_literal_axes_under_ambient_mesh(self):
+        fs = _lint("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import get_mesh
+
+            def place(x):
+                mesh = get_mesh()
+                return jax.device_put(x, NamedSharding(mesh, P("mp")))
+        """)
+        assert _rules(fs) == ["PS306"]
+        assert fs[0].detail == "unsanitized-spec:mp"
+
+    def test_parameter_mesh_with_literal_spec_is_quiet(self):
+        # a mesh handed in by the caller is a contract, not a
+        # configuration point — pretrain.py's pattern
+        fs = _lint("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def place(mesh, x):
+                return jax.device_put(x, NamedSharding(mesh, P("mp")))
+        """)
+        assert _rules(fs) == []
+
+    def test_known_mesh_covering_spec_axes_is_quiet(self):
+        # env is complete and contains every axis the spec names
+        fs = _lint("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def place(x):
+                mesh = build_hybrid_mesh(mp_degree=4)
+                return jax.device_put(x, NamedSharding(mesh, P("mp")))
+        """)
+        assert _rules(fs) == []
+
+
+# ----------------------------------------- seeded sharding/mesh defects
+
+class TestSeededShardingDefects:
+    """ISSUE PR9 acceptance: each PS rule catches exactly its seeded
+    defect in a scratch copy of the real distributed modules, and stays
+    quiet on the pristine copies. Copies are analyzed statically — never
+    imported — so mutations are plain text edits."""
+
+    MESH = "paddle_tpu/distributed/mesh.py"
+    PP_EXEC = "paddle_tpu/distributed/pp_exec.py"
+    SHARDING = "paddle_tpu/distributed/sharding.py"
+
+    def _analyze(self, tmp_path, rel, tag, old="", new="", append=""):
+        src = open(os.path.join(REPO, rel)).read()
+        if old:
+            assert old in src, f"seed anchor vanished from {rel}: {old!r}"
+            src = src.replace(old, new, 1)
+        d = tmp_path / tag
+        d.mkdir(exist_ok=True)
+        p = d / os.path.basename(rel)   # same rel/modname as the clean
+        p.write_text(src + textwrap.dedent(append))
+        return analyze_paths([str(p)])
+
+    def _seed(self, tmp_path, rel, **kw):
+        clean = self._analyze(tmp_path, rel, "clean")
+        seeded = self._analyze(tmp_path, rel, "seeded", **kw)
+        new_keys = ({f.baseline_key for f in seeded}
+                    - {f.baseline_key for f in clean})
+        return [f for f in seeded if f.baseline_key in new_keys]
+
+    def test_pristine_copies_are_quiet(self, tmp_path):
+        for rel in (self.MESH, self.PP_EXEC, self.SHARDING):
+            fs = self._analyze(tmp_path, rel, "clean")
+            assert [f for f in fs if f.rule.startswith("PS")] == [], rel
+
+    def test_ps301_catches_psum_over_missing_axis(self, tmp_path):
+        fresh = self._seed(tmp_path, self.MESH, append="""
+
+            from jax.experimental.shard_map import shard_map
+
+            def _seed_allreduce(x):
+                mesh = build_hybrid_mesh(dp_degree=4, mp_degree=2)
+
+                def body(v):
+                    return jax.lax.psum(v, "tp")
+
+                return shard_map(body, mesh=mesh,
+                                 in_specs=(PartitionSpec("dp"),),
+                                 out_specs=PartitionSpec("dp"))(x)
+            """)
+        assert fresh and {f.rule for f in fresh} == {"PS301"}
+        assert fresh[0].detail == "unbound-axis:psum:tp"
+
+    def test_ps302_catches_spec_arity_mismatch(self, tmp_path):
+        fresh = self._seed(tmp_path, self.MESH, append="""
+
+            from jax.experimental.shard_map import shard_map
+
+            def _seed_badarity(x, y):
+                mesh = build_hybrid_mesh(dp_degree=4)
+
+                def body(v):
+                    return v
+
+                return shard_map(body, mesh=mesh,
+                                 in_specs=(PartitionSpec("dp"),
+                                           PartitionSpec()),
+                                 out_specs=PartitionSpec("dp"))(x, y)
+            """)
+        assert fresh and {f.rule for f in fresh} == {"PS302"}
+        assert fresh[0].detail == "in-specs-arity:2:1"
+
+    def test_ps303_catches_dup_axis_and_rank_excess(self, tmp_path):
+        fresh = self._seed(tmp_path, self.MESH, append="""
+
+            import jax.numpy as jnp
+
+            def _seed_badspec(mesh):
+                arr = jnp.zeros((4, 8))
+                spec = PartitionSpec("dp", ("dp", "mp"))
+                return jax.device_put(
+                    arr, NamedSharding(mesh, PartitionSpec(None, None, "mp")))
+            """)
+        assert fresh and {f.rule for f in fresh} == {"PS303"}
+        assert {f.detail for f in fresh} == {"dup-axis:dp", "rank-excess:3:2"}
+
+    def test_ps304_catches_indivisible_dim(self, tmp_path):
+        fresh = self._seed(tmp_path, self.MESH, append="""
+
+            import jax.numpy as jnp
+
+            def _seed_indivisible():
+                mesh = build_hybrid_mesh(dp_degree=4)
+                x = jnp.zeros((6, 128))
+                return jax.device_put(
+                    x, NamedSharding(mesh, PartitionSpec("dp", None)))
+            """)
+        assert fresh and {f.rule for f in fresh} == {"PS304"}
+        assert fresh[0].detail == "indivisible:0:6:4"
+
+    def test_ps305_catches_vmap_axis_shadow(self, tmp_path):
+        fresh = self._seed(tmp_path, self.MESH, append="""
+
+            from jax.experimental.shard_map import shard_map
+
+            def _seed_shadow(x):
+                mesh = build_hybrid_mesh(dp_degree=4)
+
+                def inner(u):
+                    return u * 2
+
+                def body(v):
+                    return jax.vmap(inner, axis_name="dp")(v)
+
+                return shard_map(body, mesh=mesh,
+                                 in_specs=(PartitionSpec("dp"),),
+                                 out_specs=PartitionSpec("dp"))(x)
+            """)
+        assert fresh and {f.rule for f in fresh} == {"PS305"}
+        assert fresh[0].detail == "axis-shadow:vmap:dp"
+
+    def test_ps306_catches_dropped_sanitize_in_sharding(self, tmp_path):
+        fresh = self._seed(
+            tmp_path, self.SHARDING,
+            old='        base = sanitize_spec(mesh, getattr(p, '
+                '"_sharding_spec", None))\n'
+                '        spec = compose_sharding_spec(base, arr.shape, '
+                'axis, size)',
+            new='        spec = getattr(p, "_sharding_spec", None)')
+        assert fresh and {f.rule for f in fresh} == {"PS306"}
+        assert fresh[0].detail == "unsanitized-layer-spec"
+
+
+# ------------------------------------------------- --changed-only mode
+
+class TestChangedOnly:
+    def _repo(self, tmp_path):
+        """A tiny git repo: committed clean module + uncommitted leaky
+        one. --changed-only must analyze only the latter."""
+        import subprocess
+        def git(*a):
+            subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                           capture_output=True,
+                           env={**os.environ,
+                                "GIT_AUTHOR_NAME": "t",
+                                "GIT_AUTHOR_EMAIL": "t@t",
+                                "GIT_COMMITTER_NAME": "t",
+                                "GIT_COMMITTER_EMAIL": "t@t"})
+        git("init", "-q")
+        (tmp_path / "clean.py").write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def g(x):
+                return float(x)
+        """))
+        git("add", "clean.py")
+        git("commit", "-qm", "seed")
+        (tmp_path / "leaky.py").write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """))
+        git("add", "leaky.py")  # staged => in `git diff HEAD`
+        return tmp_path
+
+    def test_only_changed_files_analyzed(self, tmp_path, capsys,
+                                         monkeypatch):
+        repo = self._repo(tmp_path)
+        monkeypatch.chdir(repo)
+        rc = lint_main(["--changed-only", "HEAD", "--json",
+                        str(repo / "clean.py"), str(repo / "leaky.py")])
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert data["changed_only"]["ref"] == "HEAD"
+        assert data["changed_only"]["files"] == ["leaky.py"]
+        # the committed-clean module's finding is NOT reported
+        assert {f["path"] for f in data["findings"]} == {"leaky.py"}
+        assert data["stale_baseline_keys"] == []
+
+    def test_no_changes_exits_zero(self, tmp_path, capsys, monkeypatch):
+        repo = self._repo(tmp_path)
+        import subprocess
+        subprocess.run(["git", "add", "-A"], cwd=repo, check=True)
+        subprocess.run(["git", "commit", "-qm", "all"], cwd=repo,
+                       check=True, capture_output=True,
+                       env={**os.environ,
+                            "GIT_AUTHOR_NAME": "t",
+                            "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+        monkeypatch.chdir(repo)
+        rc = lint_main(["--changed-only", "--json",
+                        str(repo / "clean.py"), str(repo / "leaky.py")])
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["changed_only"]["files"] == []
+        assert data["findings"] == []
+
+    def test_git_unavailable_falls_back_to_full_run(self, tmp_path,
+                                                    capsys, monkeypatch):
+        # no .git anywhere up from tmp_path/sub: `git diff` fails and the
+        # CLI analyzes everything, warning on stderr
+        sub = tmp_path / "sub"
+        sub.mkdir()
+        (sub / "leaky.py").write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """))
+        monkeypatch.chdir(sub)
+        monkeypatch.setenv("GIT_DIR", str(sub / "nonexistent"))
+        # path first: a greedy `--changed-only PATH` would read the
+        # path as its optional REF value
+        rc = lint_main([str(sub / "leaky.py"), "--changed-only"])
+        cap = capsys.readouterr()
+        assert rc == 1
+        assert "git unavailable" in cap.err
+        assert "PT001" in cap.out
