@@ -61,6 +61,7 @@ class Config:
         self._switches: Dict[str, bool] = {}
         self._deadline_s: Optional[float] = None
         self._admission: Optional[tuple] = None
+        self._prefix_cache: Optional[bool] = None
 
     def set_deadline(self, seconds: Optional[float]):
         """Per-request wall-clock budget for Predictor.run: an expired
@@ -73,6 +74,13 @@ class Config:
         cannot get a slot within queue_timeout_s raises
         resilience.Overloaded instead of queueing unboundedly."""
         self._admission = (int(max_inflight), float(queue_timeout_s))
+
+    def set_prefix_cache(self, enabled: bool):
+        """Toggle the serving engine's global radix prefix cache
+        (cross-request KV reuse of identical prompt prefixes). Default
+        on; exactness is unaffected either way — the cache only skips
+        recomputing KV that is bit-identical by construction."""
+        self._prefix_cache = bool(enabled)
 
     def set_prog_file(self, path: str):
         self._model_prefix = path

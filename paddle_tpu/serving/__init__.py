@@ -1,15 +1,21 @@
 """Continuous-batching serving subsystem.
 
-Three modules over the Pallas paged-decode kernel
+Modules over the Pallas paged-decode kernel
 (`ops/pallas_paged.py` via `ops.paged_attention`):
 
   - `block_allocator`: fixed pool of page_size-token KV blocks with
     refcounts, per-sequence page tables, copy-on-write prefix sharing,
-    and utilization/fragmentation gauges;
-  - `scheduler`: FCFS in-flight request scheduler — requests join
-    mid-decode, leave instantly on EOS/max-tokens, with admission
+    trie pins, and utilization/fragmentation gauges;
+  - `prefix_cache`: global radix trie of pinned prompt pages — a new
+    request whose prompt extends a cached prefix admits with those
+    pages shared and only the tail prefilled (LRU eviction under pool
+    pressure);
+  - `scheduler`: in-flight request scheduler — FCFS within a priority
+    class, per-tenant token budgets, page-intact preemption, admission
     backpressure (`inference.Config.set_admission`) and per-request
     deadlines (`set_deadline` → falsy TimeoutResult partials);
+  - `spec_decode`: n-gram self-drafting speculative decoding, verified
+    in the engine's single ragged launch per step;
   - `engine`: `ServingEngine.add_request/step/collect`, a fixed-shape
     jitted decode step (one compile per model/slot-count) plus chunked
     prefill, for the llama/moe, gpt and mla families.
@@ -23,16 +29,18 @@ from .. import observability as _obs
 from ..observability import tracing as _tracing
 from .block_allocator import PageBlockAllocator
 from .engine import ServingEngine
+from .prefix_cache import PrefixCache
 from .scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "Request", "Scheduler", "PageBlockAllocator",
-           "metrics", "slo"]
+           "PrefixCache", "metrics", "slo"]
 
 
 def metrics() -> Dict[str, Any]:
-    """The serving.engine.* slice of the registry snapshot."""
+    """The serving.* slice of the registry snapshot (engine, prefix
+    cache, and speculative-decode metric families)."""
     return {k: v for k, v in _obs.registry().snapshot().items()
-            if k.startswith("serving.engine.")}
+            if k.startswith("serving.")}
 
 
 def slo(qs=(50, 90, 99)) -> Dict[str, Any]:

@@ -82,6 +82,9 @@ class PageBlockAllocator:
         self._ref[0] = 1            # trash page: pinned forever
         self._seqs: Dict[object, _Seq] = {}
         self._reserved_total = 0
+        # pins: refcounts held by parties that are not sequences (the
+        # prefix-cache trie). A pin keeps a page alive across free().
+        self._pinned = np.zeros(self.num_pages, np.int64)
 
     # ---------------------------------------------------------------- pool
     @property
@@ -96,6 +99,34 @@ class PageBlockAllocator:
     def refcount(self, page: int) -> int:
         return int(self._ref[page])
 
+    def pinned(self, page: int) -> int:
+        """Pin count on `page` (refcounts held by non-sequence owners)."""
+        return int(self._pinned[page])
+
+    def pin(self, page: int) -> None:
+        """Take an extra refcount on an ALLOCATED page so it survives
+        every holder's `free()`. Used by the prefix-cache trie to keep
+        prompt pages warm across requests."""
+        if page <= 0 or page >= self.num_pages:
+            raise ValueError(f"cannot pin page {page}")
+        if self._ref[page] < 1:
+            raise ValueError(f"cannot pin free page {page}")
+        self._ref[page] += 1
+        self._pinned[page] += 1
+
+    def unpin(self, page: int) -> bool:
+        """Drop one pin; returns True when the page went back to the
+        free list (no sequence and no other pin still holds it)."""
+        if page <= 0 or page >= self.num_pages or self._pinned[page] < 1:
+            raise ValueError(f"page {page} is not pinned")
+        self._pinned[page] -= 1
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+            self.publish_gauges()
+            return True
+        return False
+
     def _need_pages(self, total_tokens: int, share_tokens: int = 0) -> int:
         """Free-list pages a sequence of `total_tokens` may consume when
         `share_tokens` of its prefix ride on a donor's pages: every
@@ -104,6 +135,12 @@ class PageBlockAllocator:
         ps = self.page_size
         n_total = -(-total_tokens // ps)
         return n_total - share_tokens // ps
+
+    def pages_needed(self, total_tokens: int, share_tokens: int = 0) -> int:
+        """Free-list pages an admission would consume (public mirror of
+        the internal reservation math, used by the engine's
+        evict-then-retry path)."""
+        return self._need_pages(total_tokens, share_tokens)
 
     def can_admit(self, total_tokens: int, share_tokens: int = 0) -> bool:
         return self._need_pages(total_tokens, share_tokens) \
@@ -167,6 +204,39 @@ class PageBlockAllocator:
             _SHARED_TOK.inc(share_tokens)
         self.publish_gauges()
 
+    def adopt(self, seq_id, pages: List[int], share_tokens: int,
+              total_tokens: int) -> None:
+        """Admit `seq_id` sharing `share_tokens` tokens that live in the
+        given FULL `pages` (a prefix-cache trie match). Unlike `fork`
+        there is no donor sequence: the pages are held alive by trie
+        pins, the share is page-aligned (share_tokens == len(pages) *
+        page_size), so the adopter's first write lands on a fresh page —
+        no COW and no donor_extra charge. Raises `resilience.Overloaded`
+        pre-mutation when the pool cannot cover the tail."""
+        self._check_new(seq_id, total_tokens)
+        ps = self.page_size
+        if share_tokens != len(pages) * ps:
+            raise ValueError(
+                f"adopt share must be page-aligned: {share_tokens} tokens "
+                f"vs {len(pages)} pages of {ps}")
+        if total_tokens < share_tokens:
+            raise ValueError("total_tokens < share_tokens")
+        for pg in pages:
+            if pg <= 0 or pg >= self.num_pages or self._ref[pg] < 1:
+                raise ValueError(f"cannot adopt dead page {pg}")
+        need = self._need_pages(total_tokens, share_tokens)
+        if need > self.available_pages:
+            raise _res.Overloaded(
+                f"page pool exhausted: adopt needs {need} pages, "
+                f"{self.available_pages} available")
+        for pg in pages:
+            self._ref[pg] += 1
+        self._seqs[seq_id] = _Seq(list(pages), share_tokens, need)
+        self._reserved_total += need
+        if _obs.enabled():
+            _SHARED_TOK.inc(share_tokens)
+        self.publish_gauges()
+
     def extend(self, seq_id, n_tokens: int = 1) -> List[Tuple[int, int]]:
         """Make the next `n_tokens` write slots physically writable:
         allocates fresh pages at page boundaries and copies-on-write any
@@ -196,6 +266,20 @@ class PageBlockAllocator:
         seq.length += n_tokens
         return copies
 
+    def shrink(self, seq_id, n_tokens: int) -> None:
+        """Roll the sequence's logical length back by `n_tokens`
+        (speculative-decode rejection). Pages stay attached — the
+        positions are within the reservation and will be rewritten; the
+        attention row tables never read past `seq_length`, so stale KV
+        beyond the new length is unobservable."""
+        if n_tokens < 0:
+            raise ValueError("n_tokens must be >= 0")
+        seq = self._seqs[seq_id]
+        if n_tokens > seq.length:
+            raise ValueError(
+                f"cannot shrink {seq.length}-token sequence by {n_tokens}")
+        seq.length -= n_tokens
+
     def free(self, seq_id) -> None:
         """Release a finished sequence: derefs its pages (returning
         refcount-0 pages to the free list) and drops its remaining
@@ -216,6 +300,9 @@ class PageBlockAllocator:
         t[:len(pages)] = pages
         return t
 
+    def has_seq(self, seq_id) -> bool:
+        return seq_id in self._seqs
+
     def seq_length(self, seq_id) -> int:
         return self._seqs[seq_id].length
 
@@ -235,6 +322,10 @@ class PageBlockAllocator:
                              self.page_size)
                 if filled > 0:
                     occ[pg] = max(occ.get(pg, 0), filled)
+        # trie-pinned pages are full by construction (only whole prompt
+        # pages are inserted), so they are occupancy, not waste
+        for pg in np.nonzero(self._pinned)[0]:
+            occ[int(pg)] = self.page_size
         cap = used * self.page_size
         live = sum(occ.values())
         return {
@@ -244,6 +335,7 @@ class PageBlockAllocator:
             "fragmentation": 1.0 - live / cap if cap else 0.0,
             "reserved": self._reserved_total,
             "sequences": len(self._seqs),
+            "pinned_pages": int((self._pinned > 0).sum()),
         }
 
     def publish_gauges(self) -> None:

@@ -53,7 +53,9 @@ from ..ops.paged_attention import append_to_cache, paged_attention
 from ..ops.pallas_ragged import (ragged_kernel_eligible,
                                  ragged_paged_attention)
 from .block_allocator import PageBlockAllocator
+from .prefix_cache import PrefixCache
 from .scheduler import DECODE, PREFILL, Request, Scheduler
+from .spec_decode import accept_length, ngram_draft, record_verify
 
 __all__ = ["ServingEngine"]
 
@@ -71,6 +73,10 @@ _ACTIVE = _obs.registry().gauge(
     "serving.engine.active_slots", "slots holding an in-flight request")
 _WAITING = _obs.registry().gauge(
     "serving.engine.waiting", "requests queued for admission")
+_PREEMPTIONS = _obs.registry().counter(
+    "serving.engine.preemptions",
+    "low-priority decodes re-queued (pages intact) for a higher-"
+    "priority arrival")
 _TRACE = _tracing.recorder()
 
 
@@ -95,7 +101,22 @@ class ServingEngine:
 
     `config` (inference.Config) carries serving policy: `set_admission`
     bounds in-flight requests (Overloaded backpressure), `set_deadline`
-    sets the default per-request budget (falsy TimeoutResult partials).
+    sets the default per-request budget (falsy TimeoutResult partials),
+    `set_prefix_cache` toggles the global radix prefix cache.
+
+    Multi-tenant fast path (all greedy-exact — engine output always
+    matches solo `generate_cached`):
+
+      - `enable_prefix_cache` (default on): prompt pages are cached in
+        a global radix trie after prefill; a request whose prompt
+        extends a cached prefix skips prefilling the shared pages;
+      - `add_request(priority=, tenant=)` + `tenant_budgets`: priority
+        classes with per-tenant in-flight token budgets; `preemption`
+        lets a higher-priority arrival re-queue a low-priority decode
+        with its pages intact (resume without re-prefill);
+      - `spec_decode=k`: n-gram self-drafting speculative decoding —
+        up to k drafted tokens per slot verified in the SAME unified
+        ragged launch, greedy accept/rollback.
     """
 
     def __init__(self, model, max_slots: int = 4, page_size: int = 16,
@@ -106,7 +127,11 @@ class ServingEngine:
                  weight_only_quant=None,
                  config=None,
                  prefix_sharing: bool = True,
-                 ragged: Optional[bool] = None):
+                 ragged: Optional[bool] = None,
+                 enable_prefix_cache: Optional[bool] = None,
+                 spec_decode: int = 0,
+                 preemption: bool = True,
+                 tenant_budgets: Optional[dict] = None):
         p = _decode_params(model, weight_only_int8, weight_only_quant)
         cfg = p["cfg"]
         self._p = p
@@ -134,8 +159,16 @@ class ServingEngine:
         self.scheduler = Scheduler(
             self.max_slots,
             max_inflight=admission[0] if admission else None,
-            queue_timeout_s=admission[1] if admission else 0.0)
+            queue_timeout_s=admission[1] if admission else 0.0,
+            tenant_budgets=tenant_budgets)
         self._prefill_fifo: List[Request] = []
+        # global radix prefix cache: engine kwarg wins, then the
+        # inference.Config knob (set_prefix_cache), default on
+        if enable_prefix_cache is None:
+            enable_prefix_cache = getattr(config, "_prefix_cache", None)
+        self.prefix_cache = PrefixCache(self.allocator) \
+            if enable_prefix_cache in (None, True) else None
+        self.preemption = bool(preemption)
 
         # family geometry + device page pools
         dt = p["embed"].dtype
@@ -163,6 +196,13 @@ class ServingEngine:
                       or ragged_kernel_eligible(
                           cfg.num_attention_heads, kv, d, self.page_size))
         self.ragged = bool(ragged)
+        if spec_decode < 0:
+            raise ValueError("spec_decode must be >= 0")
+        # speculative decoding: each decode slot owns 1 + spec_k flat
+        # rows of the unified step (n-gram drafts verified in the SAME
+        # ragged launch). The split path has no multi-row slots, so
+        # spec decoding rides the ragged path only.
+        self.spec_k = int(spec_decode) if self.ragged else 0
         self.launches = 0      # device program launches by THIS engine
 
         # the fixed-shape programs: built ONCE here, never in the step
@@ -181,14 +221,18 @@ class ServingEngine:
                     eos_token_id: Optional[int] = None,
                     pad_token_id: int = 0,
                     deadline_s: Optional[float] = None,
-                    request_id=None) -> Request:
-        """Enqueue a request (FCFS). Raises resilience.Overloaded when
-        admission backpressure refuses it at the door."""
+                    request_id=None,
+                    priority: int = 0,
+                    tenant: Optional[str] = None) -> Request:
+        """Enqueue a request (FCFS within its priority class). Raises
+        resilience.Overloaded when admission backpressure refuses it at
+        the door."""
         req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
                       pad_token_id=pad_token_id,
                       deadline_s=(deadline_s if deadline_s is not None
                                   else self._default_deadline_s),
-                      request_id=request_id)
+                      request_id=request_id,
+                      priority=priority, tenant=tenant)
         if req.total_tokens > self.max_context:
             raise ValueError(
                 f"prompt+max_new_tokens = {req.total_tokens} exceeds "
@@ -216,6 +260,11 @@ class ServingEngine:
         out = {"admitted": 0, "prefill_tokens": 0, "decoded": 0,
                "finished": 0}
         for req in self.scheduler.expire_waiting():
+            # a PREEMPTED request expiring in the queue still owns its
+            # allocator sequence (pages kept for the resume that never
+            # came) — free it here or the pool leaks
+            if self.allocator.has_seq(req.request_id):
+                self.allocator.free(req.request_id)
             if _obs.enabled():
                 _REQS.labels(outcome="overloaded"
                              if isinstance(req.result, _res.Overloaded)
@@ -272,35 +321,125 @@ class ServingEngine:
     # ---------------------------------------------------------- admission
     def _admit(self) -> int:
         admitted = 0
-        while (req := self.scheduler.next_admittable()) is not None:
-            share, donor = 0, None
-            if self.prefix_sharing:
-                for _, cand in self.scheduler.active():
-                    # only the donor's PREFILLED prompt tokens are
-                    # reusable; cap at len(prompt)-1 so the last prompt
-                    # token is always re-run for this request's logits
-                    s = min(_lcp(req.prompt, cand.prompt),
-                            cand.prefill_pos, int(req.prompt.size) - 1)
-                    if s > share:
-                        share, donor = s, cand
-            try:
-                if share > 0:
-                    self.allocator.fork(donor.request_id, req.request_id,
-                                        share, req.total_tokens)
-                else:
-                    self.allocator.allocate(req.request_id,
-                                            req.total_tokens)
-            except _res.Overloaded:
-                break   # head-of-line waits for pages; FCFS, no skip
+        while True:
+            req = self.scheduler.next_admittable()
+            if req is None:
+                req = self._preempt_for_waiting()
+                if req is None:
+                    break
+                continue   # the freed slot re-enters next_admittable
+            if req.preempted:
+                # resume: the allocator sequence — pages, length,
+                # pending token — survived preemption untouched, so the
+                # request goes straight back to DECODE. No re-prefill.
+                self.scheduler.admit(req)
+                admitted += 1
+                continue
+            if not self._reserve_pages(req):
+                break   # head-of-class waits for pages; no skip
             self.scheduler.admit(req)
-            if share > 0:
-                _TRACE.stamp(req.request_id, "prefix_share", tokens=share,
-                             donor=donor.request_id)
-            req.prefill_pos = share
-            req.shared_tokens = share
+            if req.shared_tokens > 0:
+                _TRACE.stamp(req.request_id,
+                             "prefix_hit" if req._share_source == "cache"
+                             else "prefix_share",
+                             tokens=req.shared_tokens,
+                             **req._share_meta)
             self._prefill_fifo.append(req)
             admitted += 1
         return admitted
+
+    def _reserve_pages(self, req: Request) -> bool:
+        """Reserve the request's pages, sharing the longest available
+        prefix — a live donor's prefilled prompt (token-granular fork)
+        or the global prefix cache (page-granular adopt), whichever is
+        longer. Under pool pressure, cold trie pages are evicted and
+        the reservation retried ONCE. Every failure path releases the
+        lookup's pins (no leaked refcounts); returns False so the
+        request keeps waiting."""
+        share, donor = 0, None
+        if self.prefix_sharing:
+            for _, cand in self.scheduler.active():
+                # only the donor's PREFILLED prompt tokens are
+                # reusable; cap at len(prompt)-1 so the last prompt
+                # token is always re-run for this request's logits
+                s = min(_lcp(req.prompt, cand.prompt),
+                        cand.prefill_pos, int(req.prompt.size) - 1)
+                if s > share:
+                    share, donor = s, cand
+        match = self.prefix_cache.lookup(req.prompt) \
+            if self.prefix_cache is not None else None
+        use_cache = match is not None and match.tokens > share
+
+        def take() -> None:
+            if use_cache:
+                self.allocator.adopt(req.request_id, match.pages,
+                                     match.tokens, req.total_tokens)
+            elif share > 0:
+                self.allocator.fork(donor.request_id, req.request_id,
+                                    share, req.total_tokens)
+            else:
+                self.allocator.allocate(req.request_id, req.total_tokens)
+
+        try:
+            try:
+                take()
+            except _res.Overloaded:
+                if self.prefix_cache is None:
+                    raise
+                eff = match.tokens if use_cache else share
+                need = self.allocator.pages_needed(req.total_tokens, eff)
+                if self.prefix_cache.evict(
+                        need - self.allocator.available_pages) <= 0:
+                    raise
+                take()
+        except _res.Overloaded:
+            if match is not None:
+                match.release()
+            return False
+        if use_cache:
+            self.prefix_cache.note_adopted(match.tokens)
+            req._share_source = "cache"
+            req._share_meta = {"pages": len(match.pages)}
+            req.prefill_pos = req.shared_tokens = match.tokens
+        elif share > 0:
+            req._share_source = "donor"
+            req._share_meta = {"donor": donor.request_id}
+            req.prefill_pos = req.shared_tokens = share
+        else:
+            req._share_source = None
+            req._share_meta = {}
+            req.prefill_pos = req.shared_tokens = 0
+        if match is not None:
+            match.release()   # adopt holds its own refcounts by now
+        return True
+
+    def _preempt_for_waiting(self) -> Optional[Request]:
+        """Make room for the highest-priority waiting request by
+        re-queueing a strictly lower-priority DECODE victim with its
+        pages intact. Only fires when the candidate's pages would
+        actually fit (the victim keeps its pages, so preempting for a
+        pool-blocked candidate would just thrash)."""
+        if not self.preemption:
+            return None
+        cand = self.scheduler.next_candidate()
+        if cand is None:
+            return None
+        victim = self.scheduler.pick_victim(cand.priority)
+        if victim is None:
+            return None
+        if not cand.preempted:
+            share = self.prefix_cache.match_length(cand.prompt) \
+                if self.prefix_cache is not None else 0
+            need = self.allocator.pages_needed(cand.total_tokens, share)
+            spare = self.allocator.available_pages + (
+                self.prefix_cache.evictable_pages()
+                if self.prefix_cache is not None else 0)
+            if need > spare:
+                return None
+        self.scheduler.preempt(victim)
+        if _obs.enabled():
+            _PREEMPTIONS.inc()
+        return cand
 
     # ------------------------------------------------------------ prefill
     def _prefill_chunk(self) -> Tuple[int, int]:
@@ -343,6 +482,11 @@ class ServingEngine:
         if req.prefill_pos == int(req.prompt.size):
             self._prefill_fifo.pop(0)
             req.state = DECODE
+            # cache the full prompt pages BEFORE _emit can finish the
+            # request and return its pages — trie pins keep them warm
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(
+                    req.prompt, self.allocator.seq_pages(req.request_id))
             tok = int(np.argmax(np.asarray(logits[0])))
             finished += self._emit(req, tok)
         _TRACE.set_host_span(None)
@@ -387,13 +531,21 @@ class ServingEngine:
 
     # ------------------------------------------------------------ unified
     def _unified_step(self) -> Tuple[int, int, int]:
-        """ONE ragged launch for the whole step: every decode slot's
-        pending token rides flat row `slot`, the oldest prefilling
-        request's chunk rides rows [max_slots, max_slots+n). Row tables
-        (num_tokens / kv_lengths / page tables, seq_start baked into
-        the jitted body) tell the ragged kernel who owns which rows;
-        idle rows write to the trash page and emit garbage logits the
-        host never reads. Returns (prefill_tokens, decoded, finished).
+        """ONE ragged launch for the whole step: decode slot `s` owns
+        flat rows [s*R, s*R + 1 + k) with R = 1 + spec_k — its pending
+        token plus k n-gram-drafted tokens verified in the SAME launch
+        — and the oldest prefilling request's chunk rides rows
+        [max_slots*R, max_slots*R + n). Row tables (num_tokens /
+        kv_lengths / page tables, seq_start baked into the jitted body)
+        tell the ragged kernel who owns which rows; idle rows write to
+        the trash page and emit garbage logits the host never reads.
+        Returns (prefill_tokens, decoded, finished).
+
+        Speculative accept/rollback is greedy-exact: position j's argmax
+        depends only on rows 0..j of the slot (per-row causality), so
+        drafted tokens are accepted while they match the argmax chain
+        and the KV length is shrunk past the first mismatch — engine
+        output is bit-identical to plain decode, just fewer launches.
 
         Vs the split path: a request that completes its prefill emits
         its first token from THIS launch and takes its first decode
@@ -407,8 +559,10 @@ class ServingEngine:
         active = self.scheduler.active(DECODE)
         if preq is None and not active:
             return 0, 0, 0
-        B, C = self.max_slots, self.prefill_chunk
-        T, S = B + C, B + 1
+        B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
+        R = 1 + K
+        base = B * R
+        T, S = base + C, B + 1
         ps, nj = self.page_size, self.pages_per_seq
         tok = np.zeros(T, np.int32)
         positions = np.zeros(T, np.int32)
@@ -417,18 +571,34 @@ class ServingEngine:
         tables = np.zeros((S, nj), np.int32)   # idle -> trash page 0
         tok_page = np.zeros(T, np.int32)
         tok_off = np.zeros(T, np.int32)
+        drafts: Dict[int, List[int]] = {}
         for slot, req in active:
             ln = self.allocator.seq_length(req.request_id)
-            self._apply_copies(self.allocator.extend(req.request_id, 1),
+            d: List[int] = []
+            if K:
+                # never draft past max_new - 1: the verify step itself
+                # emits up to k+1 tokens
+                cap = req.max_new_tokens - len(req.tokens) - 1
+                if cap > 0:
+                    d = ngram_draft(
+                        np.concatenate([req.prompt, req.tokens]),
+                        min(K, cap))
+            drafts[slot] = d
+            nt = 1 + len(d)
+            self._apply_copies(self.allocator.extend(req.request_id, nt),
                                req)
             tbl = self.allocator.table(req.request_id)
-            tok[slot] = req.pending
-            positions[slot] = ln
-            num_tokens[slot] = 1
-            kv_lengths[slot] = ln + 1
+            r0 = slot * R
+            pos = ln + np.arange(nt)
+            tok[r0:r0 + nt] = [req.pending] + d
+            positions[r0:r0 + nt] = pos
+            num_tokens[slot] = nt
+            kv_lengths[slot] = ln + nt
             tables[slot] = tbl
-            tok_page[slot] = tbl[ln // ps]
-            tok_off[slot] = ln % ps
+            tok_page[r0:r0 + nt] = tbl[pos // ps]
+            tok_off[r0:r0 + nt] = pos % ps
+            if d:
+                _TRACE.stamp(req.request_id, "draft", tokens=len(d))
         n, start = 0, 0
         if preq is not None:
             start = preq.prefill_pos
@@ -437,13 +607,13 @@ class ServingEngine:
                                preq)
             tbl = self.allocator.table(preq.request_id)
             rows = np.arange(n)
-            tok[B:B + n] = preq.prompt[start:start + n]
-            positions[B:B + n] = start + rows
+            tok[base:base + n] = preq.prompt[start:start + n]
+            positions[base:base + n] = start + rows
             num_tokens[S - 1] = n
             kv_lengths[S - 1] = start + n
             tables[S - 1] = tbl
-            tok_page[B:B + n] = tbl[(start + rows) // ps]
-            tok_off[B:B + n] = (start + rows) % ps
+            tok_page[base:base + n] = tbl[(start + rows) // ps]
+            tok_off[base:base + n] = (start + rows) % ps
         args = (self._w, jnp.asarray(tok), self._pools,
                 jnp.asarray(positions), jnp.asarray(num_tokens),
                 jnp.asarray(kv_lengths), jnp.asarray(tables),
@@ -457,27 +627,59 @@ class ServingEngine:
                              start=start)
         else:
             logits, self._pools = self._jit_unified(*args)
-        logits = np.asarray(logits)                      # [S, vocab]
+        logits = np.asarray(logits)         # [S, vocab]; [T, vocab] K>0
         self.launches += 1
         if _obs.enabled():
             _LAUNCHES.labels(path="unified").inc()
             _STEPS.labels(phase="unified").inc()
             if n:
                 _TOKENS.labels(phase="prefill").inc(n)
-            if active:
-                _TOKENS.labels(phase="decode").inc(len(active))
         finished = 0
         if preq is not None:
             preq.prefill_pos += n
             if preq.prefill_pos == int(preq.prompt.size):
                 self._prefill_fifo.pop(0)
                 preq.state = DECODE
-                finished += self._emit(preq,
-                                       int(np.argmax(logits[S - 1])))
+                # cache the full prompt pages BEFORE _emit can finish
+                # the request and return its pages — trie pins keep
+                # them warm for the next tenant
+                if self.prefix_cache is not None:
+                    self.prefix_cache.insert(
+                        preq.prompt,
+                        self.allocator.seq_pages(preq.request_id))
+                row = logits[base + n - 1] if K else logits[S - 1]
+                finished += self._emit(preq, int(np.argmax(row)))
+        decoded = 0
         for slot, req in active:
-            finished += self._emit(req, int(np.argmax(logits[slot])))
+            d = drafts[slot]
+            if not d:
+                row = logits[slot * R] if K else logits[slot]
+                finished += self._emit(req, int(np.argmax(row)))
+                decoded += 1
+                continue
+            r0 = slot * R
+            greedy = [int(np.argmax(logits[r0 + j]))
+                      for j in range(len(d) + 1)]
+            m = accept_length(d, greedy)
+            fin = 0
+            for j in range(m + 1):
+                decoded += 1
+                fin = self._emit(req, greedy[j])
+                if fin:
+                    break   # EOS/max_new: _finish already freed the seq
+            finished += fin
+            if not fin:
+                # reject the tail: pure length rollback — stale KV past
+                # the new length is never readable (kv_lengths caps the
+                # attention window) and is overwritten by later tokens
+                self.allocator.shrink(req.request_id, len(d) - m)
+            record_verify(len(d), m)
+            _TRACE.stamp(req.request_id, "verify_accept",
+                         drafted=len(d), accepted=m)
+        if _obs.enabled() and decoded:
+            _TOKENS.labels(phase="decode").inc(decoded)
         _TRACE.set_host_span(None)
-        return n, len(active), finished
+        return n, decoded, finished
 
     def _emit(self, req: Request, tok: int) -> int:
         """Record one sampled token; finish on EOS/max-tokens (pages
@@ -557,9 +759,14 @@ class ServingEngine:
                      cfg.head_dim)
         eps = cfg.rms_norm_eps
         moe_static = self._p.get("moe_static")
-        B, C = self.max_slots, self.prefill_chunk
-        T = B + C
-        seq_start = jnp.arange(B + 1, dtype=jnp.int32)
+        B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
+        R = 1 + K
+        T = B * R + C
+        # decode slot s owns rows [s*R, (s+1)*R); the prefill chunk owns
+        # rows [B*R, B*R+C). R == 1 reduces to arange(B + 1).
+        seq_start = jnp.concatenate(
+            [jnp.arange(B, dtype=jnp.int32) * R,
+             jnp.asarray([B * R], jnp.int32)])
 
         def step(w, tok, pools, positions, num_tokens, kv_lengths,
                  tables, tok_page, tok_off):
@@ -586,8 +793,14 @@ class ServingEngine:
                 x = x + _ffn_apply(L, h2, st)
             x = fused_rms_norm(x, w["norm"], eps)
             # each sequence's logits come from its LAST flat row; idle
-            # slots (num_tokens 0) index garbage the host ignores
-            last = x[0, jnp.clip(seq_start + num_tokens - 1, 0, T - 1)]
+            # slots (num_tokens 0) index garbage the host ignores. With
+            # spec decoding every row's logits come back — each drafted
+            # position is a verify point.
+            if K:
+                last = x[0]
+            else:
+                last = x[0, jnp.clip(seq_start + num_tokens - 1,
+                                     0, T - 1)]
             if "head_q" in w or "head_q4" in w:
                 logits = _mm_w(last, w, "head")
             else:
@@ -601,9 +814,14 @@ class ServingEngine:
         cfg = self._p["cfg"]
         nh, hd = cfg.num_attention_heads, cfg.head_dim
         eps = cfg.layer_norm_eps
-        B, C = self.max_slots, self.prefill_chunk
-        T = B + C
-        seq_start = jnp.arange(B + 1, dtype=jnp.int32)
+        B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
+        R = 1 + K
+        T = B * R + C
+        # decode slot s owns rows [s*R, (s+1)*R); the prefill chunk owns
+        # rows [B*R, B*R+C). R == 1 reduces to arange(B + 1).
+        seq_start = jnp.concatenate(
+            [jnp.arange(B, dtype=jnp.int32) * R,
+             jnp.asarray([B * R], jnp.int32)])
 
         def step(w, tok, pools, positions, num_tokens, kv_lengths,
                  tables, tok_page, tok_off):
@@ -631,7 +849,11 @@ class ServingEngine:
                                      approximate=True) @ L["wf"]
                          + L["bf"])
             x = fused_layer_norm(x, w["normw"], w["normb"], eps)
-            last = x[0, jnp.clip(seq_start + num_tokens - 1, 0, T - 1)]
+            if K:
+                last = x[0]
+            else:
+                last = x[0, jnp.clip(seq_start + num_tokens - 1,
+                                     0, T - 1)]
             logits = last @ (w["head"] if w["head"] is not None
                              else w["embed"].T)
             return logits, new_pools
@@ -647,9 +869,14 @@ class ServingEngine:
         eps = cfg.rms_norm_eps
         scale = 1.0 / float(math.sqrt(dn + dr))
         moe_static = self._p.get("moe_static")
-        B, C = self.max_slots, self.prefill_chunk
-        T = B + C
-        seq_start = jnp.arange(B + 1, dtype=jnp.int32)
+        B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
+        R = 1 + K
+        T = B * R + C
+        # decode slot s owns rows [s*R, (s+1)*R); the prefill chunk owns
+        # rows [B*R, B*R+C). R == 1 reduces to arange(B + 1).
+        seq_start = jnp.concatenate(
+            [jnp.arange(B, dtype=jnp.int32) * R,
+             jnp.asarray([B * R], jnp.int32)])
 
         def step(w, tok, pools, positions, num_tokens, kv_lengths,
                  tables, tok_page, tok_off):
@@ -698,7 +925,11 @@ class ServingEngine:
                 h2 = fused_rms_norm(x, L["ln2"], eps)
                 x = x + _ffn_apply(L, h2, st)
             x = fused_rms_norm(x, w["norm"], eps)
-            last = x[0, jnp.clip(seq_start + num_tokens - 1, 0, T - 1)]
+            if K:
+                last = x[0]
+            else:
+                last = x[0, jnp.clip(seq_start + num_tokens - 1,
+                                     0, T - 1)]
             if "head_q" in w or "head_q4" in w:
                 logits = _mm_w(last, w, "head")
             else:

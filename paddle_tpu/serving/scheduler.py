@@ -15,8 +15,18 @@ work and drives this scheduler once per `step()`:
   - per-request deadlines (`inference.Config.set_deadline` or
     `Request(deadline_s=...)`) produce falsy `resilience.TimeoutResult`
     partial results, never hangs;
-  - head-of-line order is never bypassed (no skip-ahead admission), so
-    a seeded request trace schedules deterministically.
+  - priority / fair-share classes: `Request(priority=..., tenant=...)`
+    plus per-tenant in-flight token budgets (`tenant_budgets`) on the
+    admission gate. Admission picks the highest-priority, oldest
+    budget-eligible request; over-budget tenants are skipped (their
+    requests wait, others flow). With the defaults — every request at
+    priority 0, no budgets — this reduces exactly to the original FCFS
+    head-of-line order, so seeded traces stay deterministic;
+  - preemption: a DECODE-state victim of strictly lower priority can be
+    re-queued (`preempt()`) to make room for a higher-priority arrival.
+    The victim keeps its allocator sequence — pages and reservation
+    intact — and is re-admitted straight into DECODE without any
+    re-prefill, so engine output is unchanged, only its latency.
 """
 
 from __future__ import annotations
@@ -56,7 +66,9 @@ class Request:
                  eos_token_id: Optional[int] = None,
                  pad_token_id: int = 0,
                  deadline_s: Optional[float] = None,
-                 request_id=None):
+                 request_id=None,
+                 priority: int = 0,
+                 tenant: Optional[str] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError("empty prompt")
@@ -75,6 +87,12 @@ class Request:
         self.pending: Optional[int] = None   # last sampled, not yet fed
         self.prefill_pos = 0                 # prompt tokens in cache
         self.shared_tokens = 0               # prefix tokens riding a donor
+        self.priority = int(priority)        # higher = more urgent
+        self.tenant = tenant                 # fair-share accounting key
+        self.preempted = False               # mid-decode, pages intact
+        self._seq: int = 0                   # submit order (set by submit)
+        self._share_source = None            # "cache" | "donor" | None
+        self._share_meta: dict = {}
         self._deadline: Optional[_res.Deadline] = None
         self._enqueued_at: Optional[float] = None
 
@@ -109,10 +127,13 @@ class Request:
 
 
 class Scheduler:
-    """FCFS continuous-batching scheduler over `max_slots` decode slots."""
+    """Continuous-batching scheduler over `max_slots` decode slots:
+    FCFS within a priority class, per-tenant token budgets across
+    classes, optional preemption of lower-priority decodes."""
 
     def __init__(self, max_slots: int, max_inflight: Optional[int] = None,
-                 queue_timeout_s: float = 0.0):
+                 queue_timeout_s: float = 0.0,
+                 tenant_budgets: Optional[dict] = None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.max_slots = int(max_slots)
@@ -120,9 +141,15 @@ class Scheduler:
             if max_inflight else self.max_slots
         self.backpressure = max_inflight is not None
         self.queue_timeout_s = float(queue_timeout_s)
+        # tenant -> max in-flight total_tokens. A tenant at zero usage
+        # always gets one request through even if it alone exceeds the
+        # budget (progress guarantee — budgets shape, never starve).
+        self.tenant_budgets = dict(tenant_budgets or {})
+        self._tenant_tokens: dict = {}
         self.waiting: deque = deque()
         self.slots: List[Optional[Request]] = [None] * self.max_slots
         self.finished: List[Request] = []
+        self._submit_seq = itertools.count()
 
     # ------------------------------------------------------------- queries
     @property
@@ -155,11 +182,17 @@ class Scheduler:
             raise _res.Overloaded(
                 f"admission gate full ({self.max_inflight} inflight)")
         req.state = WAITING
+        req._seq = next(self._submit_seq)
         req._enqueued_at = time.monotonic()
         req.start_deadline()
         self.waiting.append(req)
+        meta = {}
+        if req.priority:
+            meta["priority"] = req.priority
+        if req.tenant is not None:
+            meta["tenant"] = req.tenant
         _TRACE.begin(req.request_id, prompt_len=int(req.prompt.size),
-                     max_new_tokens=req.max_new_tokens)
+                     max_new_tokens=req.max_new_tokens, **meta)
         _TRACE.stamp(req.request_id, "enqueue")
         return req
 
@@ -171,7 +204,11 @@ class Scheduler:
         keep = deque()
         now = time.monotonic()
         for req in self.waiting:
-            timed_out = (self.backpressure and self.queue_timeout_s > 0
+            # preempted requests were already admitted once: the
+            # admission-queue timeout no longer applies (their deadline
+            # still does, producing a partial TimeoutResult)
+            timed_out = (not req.preempted
+                         and self.backpressure and self.queue_timeout_s > 0
                          and now - req._enqueued_at > self.queue_timeout_s)
             if timed_out:
                 req.state = FINISHED
@@ -193,26 +230,85 @@ class Scheduler:
         self.finished.extend(expired)
         return expired
 
+    def _budget_ok(self, req: Request) -> bool:
+        budget = self.tenant_budgets.get(req.tenant)
+        if budget is None:
+            return True
+        used = self._tenant_tokens.get(req.tenant, 0)
+        return used == 0 or used + req.total_tokens <= budget
+
+    def next_candidate(self) -> Optional[Request]:
+        """Highest-priority, oldest budget-eligible waiting request —
+        ignoring slot availability (the preemption path asks this)."""
+        best = None
+        for req in self.waiting:
+            if not self._budget_ok(req):
+                continue
+            if best is None or (req.priority, -req._seq) \
+                    > (best.priority, -best._seq):
+                best = req
+        return best
+
     def next_admittable(self) -> Optional[Request]:
-        """Head-of-line request if a slot and an inflight credit are
-        free; None otherwise. FCFS: nothing behind the head ever jumps
-        it (deterministic under a seeded trace)."""
+        """The request `admit()` would take if a slot and an inflight
+        credit are free; None otherwise. With all-default priorities
+        and no budgets this is exactly the old FCFS head of line —
+        nothing behind the head ever jumps it (deterministic under a
+        seeded trace)."""
         if not self.waiting or self.inflight >= self.max_inflight \
                 or all(r is not None for r in self.slots):
             return None
-        return self.waiting[0]
+        return self.next_candidate()
 
     def admit(self, req: Request) -> int:
-        """Bind the head-of-line request to the lowest free slot."""
-        assert self.waiting and self.waiting[0] is req, \
-            "admit() must take the head of the FCFS queue"
+        """Bind the chosen waiting request to the lowest free slot. A
+        preempted request resumes straight into DECODE — its KV pages
+        never left the allocator, so there is nothing to re-prefill."""
+        self.waiting.remove(req)
         slot = next(i for i, r in enumerate(self.slots) if r is None)
-        self.waiting.popleft()
-        req.state = PREFILL
+        req.state = DECODE if req.preempted else PREFILL
         req.slot = slot
         self.slots[slot] = req
-        _TRACE.stamp(req.request_id, "admit", slot=slot)
+        if req.tenant is not None:
+            self._tenant_tokens[req.tenant] = \
+                self._tenant_tokens.get(req.tenant, 0) + req.total_tokens
+        if req.preempted:
+            req.preempted = False
+            _TRACE.stamp(req.request_id, "resumed", slot=slot,
+                         decoded=len(req.tokens))
+        else:
+            _TRACE.stamp(req.request_id, "admit", slot=slot)
         return slot
+
+    def pick_victim(self, priority: int) -> Optional[Request]:
+        """Lowest-priority DECODE-state request strictly below
+        `priority` (youngest on ties) — the page-intact preemption
+        victim. PREFILL requests are never preempted (their chunk
+        bookkeeping is mid-flight)."""
+        victim = None
+        for _, req in self.active(DECODE):
+            if req.priority >= priority:
+                continue
+            if victim is None or (req.priority, -req._seq) \
+                    < (victim.priority, -victim._seq):
+                victim = req
+        return victim
+
+    def preempt(self, req: Request) -> None:
+        """Re-queue a running decode with its allocator sequence —
+        pages, length, reservation — intact. Only the slot is given
+        up; `admit()` later resumes it without re-prefill."""
+        assert req.slot is not None and req.state == DECODE
+        self.slots[req.slot] = None
+        req.slot = None
+        req.state = WAITING
+        req.preempted = True
+        if req.tenant is not None:
+            self._tenant_tokens[req.tenant] = \
+                self._tenant_tokens.get(req.tenant, 0) - req.total_tokens
+        self.waiting.append(req)
+        _TRACE.stamp(req.request_id, "preempted",
+                     decoded=len(req.tokens))
 
     def release(self, req: Request) -> None:
         """Free the slot the instant a request finishes — the next
@@ -220,6 +316,10 @@ class Scheduler:
         if req.slot is not None:
             self.slots[req.slot] = None
             req.slot = None
+            if req.tenant is not None:
+                self._tenant_tokens[req.tenant] = \
+                    self._tenant_tokens.get(req.tenant, 0) \
+                    - req.total_tokens
         req.state = FINISHED
         self.finished.append(req)
 

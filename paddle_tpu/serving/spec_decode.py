@@ -1,0 +1,94 @@
+"""N-gram self-drafting speculative decoding for the serving engine.
+
+Prompt-lookup drafting (no draft model): the longest suffix n-gram of a
+sequence's context that occurred earlier in that same context proposes
+the k tokens that followed its most recent occurrence. The engine
+verifies all k drafts in ONE ragged unified step — a decode slot simply
+contributes `1 + k` rows instead of 1 to the flat token buffer, and the
+ragged kernel's per-row causality (`row t attends KV positions
+0 .. kv_len - num_tokens + t`) already gives each draft position
+exactly the prefix it would see in plain decode.
+
+Greedy accept/rollback keeps engine output EXACTLY equal to plain
+decode: with greedy sampling, position j's argmax depends only on the
+accepted prefix, so accepting drafts while they match the verifier's
+argmax chain and rolling the KV length back past the first mismatch
+(`allocator.shrink`; rejected KV rows are never readable and are
+rewritten later) reproduces the token-at-a-time output bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .. import observability as _obs
+
+__all__ = ["ngram_draft", "accept_length", "record_verify"]
+
+_DRAFTED = _obs.registry().counter(
+    "serving.spec_decode.draft_tokens", "tokens proposed by the drafter")
+_ACCEPTED = _obs.registry().counter(
+    "serving.spec_decode.accepted_tokens",
+    "drafted tokens accepted by batched greedy verification")
+_REJECTED = _obs.registry().counter(
+    "serving.spec_decode.rejected_tokens",
+    "drafted tokens rolled back after verification")
+_STEPS = _obs.registry().counter(
+    "serving.spec_decode.verify_steps",
+    "engine steps that verified >= 1 drafted token")
+
+
+def ngram_draft(context: Sequence[int], k: int, max_ngram: int = 3,
+                min_ngram: int = 1) -> List[int]:
+    """Draft up to `k` next tokens for `context` (prompt + generated so
+    far) by prompt lookup: for n from `max_ngram` down to `min_ngram`,
+    find the most recent earlier occurrence of the length-n context
+    suffix and propose the tokens that followed it. Returns [] when no
+    n-gram recurs — the engine then runs a plain 1-token row.
+
+    The copy is self-referential (LZ77 style): when the match sits close
+    to the end of the context, drafted tokens feed back into the copy
+    source, so a periodic tail (e.g. a constant run) drafts the full k
+    tokens instead of truncating at the context boundary."""
+    ctx = np.asarray(context, dtype=np.int64).ravel()
+    size = int(ctx.size)
+    if k <= 0 or size < min_ngram + 1:
+        return []
+    for n in range(min(max_ngram, size - 1), min_ngram - 1, -1):
+        tail = ctx[size - n:]
+        for i in range(size - n - 1, -1, -1):
+            if np.array_equal(ctx[i:i + n], tail):
+                seq = [int(t) for t in ctx]
+                out: List[int] = []
+                pos = i + n
+                for _ in range(k):
+                    nxt = seq[pos]
+                    out.append(nxt)
+                    seq.append(nxt)
+                    pos += 1
+                return out
+    return []
+
+
+def accept_length(drafts: Sequence[int], greedy: Sequence[int]) -> int:
+    """Length of the accepted prefix: drafted token j survives iff it
+    equals the verifier's greedy argmax at position j (which was
+    computed with drafts[:j] in context)."""
+    m = 0
+    for d, g in zip(drafts, greedy):
+        if int(d) != int(g):
+            break
+        m += 1
+    return m
+
+
+def record_verify(drafted: int, accepted: int) -> None:
+    """Publish one verify step's draft/accept counts."""
+    if not _obs.enabled() or drafted <= 0:
+        return
+    _DRAFTED.inc(drafted)
+    _ACCEPTED.inc(accepted)
+    _REJECTED.inc(drafted - accepted)
+    _STEPS.inc()

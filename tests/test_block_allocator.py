@@ -13,7 +13,7 @@ from paddle_tpu.serving import PageBlockAllocator
 def _check_invariants(a: PageBlockAllocator):
     """Global conservation: every usable page is on the free list xor
     referenced; refcounts equal the number of sequences holding the
-    page; reservations never exceed the free list."""
+    page plus its pin count; reservations never exceed the free list."""
     free = set(a._free)
     assert len(free) == len(a._free), "free list has duplicates"
     assert 0 not in free, "trash page leaked to the free list"
@@ -25,9 +25,10 @@ def _check_invariants(a: PageBlockAllocator):
     for pg in range(1, a.num_pages):
         if pg in free:
             assert a.refcount(pg) == 0, pg
+            assert a.pinned(pg) == 0, pg
             assert pg not in held, pg
         else:
-            assert a.refcount(pg) == held.get(pg, 0) > 0, pg
+            assert a.refcount(pg) == held.get(pg, 0) + a.pinned(pg) > 0, pg
     assert a.refcount(0) >= 1
     assert 0 <= a._reserved_total <= len(a._free)
     assert a._reserved_total == sum(s.reserved for s in a._seqs.values())
@@ -216,6 +217,147 @@ class TestPrefixShareCOW:
         a.fork("p", "c", share_tokens=0, total_tokens=4)
         a.extend("c", 4)
         assert a.refcount(a.seq_pages("c")[0]) == 1
+        _check_invariants(a)
+
+
+class TestPinning:
+    """pin/unpin refcount API (the prefix-cache trie's page hold) and
+    the page-aligned adopt/shrink admission paths built on it."""
+
+    def test_pin_survives_request_free(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("s", 8)
+        a.extend("s", 8)
+        pages = a.seq_pages("s")
+        for pg in pages:
+            a.pin(pg)
+        _check_invariants(a)
+        a.free("s")
+        for pg in pages:
+            assert a.refcount(pg) == 1 and a.pinned(pg) == 1
+            assert pg not in a._free
+        _check_invariants(a)
+        # eviction (unpin of the last holder) returns pages to the pool
+        freed = [a.unpin(pg) for pg in pages]
+        assert all(freed)
+        assert a.free_pages == 8
+        _check_invariants(a)
+
+    def test_unpin_with_live_sequence_keeps_page(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("s", 4)
+        a.extend("s", 4)
+        pg = a.seq_pages("s")[0]
+        a.pin(pg)
+        assert a.unpin(pg) is False      # sequence still holds it
+        assert a.refcount(pg) == 1
+        a.free("s")
+        assert a.free_pages == 8
+
+    def test_pin_errors(self):
+        a = PageBlockAllocator(num_pages=5, page_size=4, pages_per_seq=4)
+        with pytest.raises(ValueError):
+            a.pin(0)                     # trash page
+        with pytest.raises(ValueError):
+            a.pin(1)                     # free page
+        with pytest.raises(ValueError):
+            a.unpin(1)                   # not pinned
+        a.allocate("s", 4)
+        a.extend("s", 4)
+        pg = a.seq_pages("s")[0]
+        a.pin(pg)
+        a.unpin(pg)
+        with pytest.raises(ValueError):
+            a.unpin(pg)                  # double unpin
+
+    def test_adopt_shares_pinned_pages(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("donor", 8)
+        a.extend("donor", 8)
+        pages = a.seq_pages("donor")
+        for pg in pages:
+            a.pin(pg)
+        a.free("donor")                  # trie pins keep the pages
+        a.adopt("child", pages, share_tokens=8, total_tokens=12)
+        assert a.seq_pages("child") == pages
+        assert a.seq_length("child") == 8
+        assert all(a.refcount(pg) == 2 for pg in pages)
+        _check_invariants(a)
+        # adopter's first write lands on a fresh page: no COW copies
+        assert a.extend("child", 4) == []
+        a.free("child")
+        assert all(a.refcount(pg) == 1 for pg in pages)
+        _check_invariants(a)
+
+    def test_adopt_oom_and_bad_args_pre_mutation(self):
+        a = PageBlockAllocator(num_pages=5, page_size=4, pages_per_seq=4)
+        a.allocate("d", 8)
+        a.extend("d", 8)
+        pages = a.seq_pages("d")
+        for pg in pages:
+            a.pin(pg)
+        a.allocate("x", 8)               # pool fully committed
+        before = (a.free_pages, a.available_pages, a._reserved_total,
+                  a.refcount(pages[0]))
+        with pytest.raises(res.Overloaded):
+            a.adopt("c", pages, share_tokens=8, total_tokens=16)
+        with pytest.raises(ValueError):
+            a.adopt("c", pages, share_tokens=7, total_tokens=16)
+        with pytest.raises(ValueError):
+            a.adopt("c", [4], share_tokens=4, total_tokens=8)
+        assert (a.free_pages, a.available_pages, a._reserved_total,
+                a.refcount(pages[0])) == before
+        assert not a.has_seq("c")
+        _check_invariants(a)
+
+    def test_shrink_rolls_back_length_only(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("s", 12)
+        a.extend("s", 10)
+        pages = list(a.seq_pages("s"))
+        a.shrink("s", 3)
+        assert a.seq_length("s") == 7
+        assert a.seq_pages("s") == pages   # pages stay attached
+        a.extend("s", 5)                   # rewrite + grow to 12
+        assert a.seq_length("s") == 12
+        with pytest.raises(ValueError):
+            a.shrink("s", 13)
+        with pytest.raises(ValueError):
+            a.shrink("s", -1)
+        _check_invariants(a)
+
+    def test_churn_with_pins_never_corrupts(self):
+        rng = np.random.RandomState(1)
+        a = PageBlockAllocator(num_pages=17, page_size=4,
+                               pages_per_seq=6)
+        live, pinned = {}, []
+        for step in range(200):
+            sid = f"s{step}"
+            total = int(rng.randint(1, 24))
+            if a.can_admit(total):
+                a.allocate(sid, total)
+                live[sid] = total
+            for s, tot in list(live.items()):
+                if a.seq_length(s) < tot:
+                    a.extend(s, 1)
+                if rng.rand() < 0.1:     # trie-style pin on a full page
+                    full = [pg for i, pg in enumerate(a.seq_pages(s))
+                            if a.seq_length(s) >= (i + 1) * a.page_size]
+                    if full:
+                        pg = full[int(rng.randint(len(full)))]
+                        a.pin(pg)
+                        pinned.append(pg)
+                if rng.rand() < 0.2 or a.seq_length(s) >= tot:
+                    a.free(s)
+                    del live[s]
+            while pinned and rng.rand() < 0.3:
+                a.unpin(pinned.pop(int(rng.randint(len(pinned)))))
+            _check_invariants(a)
+        for pg in pinned:
+            a.unpin(pg)
+        for s in live:
+            a.free(s)
+        assert a.free_pages == 16
         _check_invariants(a)
 
 

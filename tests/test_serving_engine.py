@@ -255,6 +255,239 @@ class TestEngineSemantics:
         assert outcomes.get("completed", 0) >= 1
 
 
+def _full_trace(V, n, seed):
+    """Seeded multi-tenant trace: (prompt, max_new, submit_at, priority,
+    tenant). Even requests share a base prefix (exercises the prefix
+    cache); the last request gets top priority (exercises preemption
+    when slots are busy at its submit step)."""
+    rng = np.random.RandomState(seed)
+    base = rng.randint(0, V, 8).astype(np.int32)
+    out = []
+    for i in range(n):
+        if i % 2 == 0:
+            tail = rng.randint(0, V, rng.randint(2, 5)).astype(np.int32)
+            prompt = np.concatenate([base, tail])
+        else:
+            prompt = rng.randint(0, V, rng.randint(4, 11)).astype(np.int32)
+        prio = 5 if i == n - 1 else int(rng.randint(0, 2))
+        out.append((prompt, int(rng.randint(3, 7)),
+                    int(rng.randint(0, 4)), prio,
+                    f"tenant{int(rng.randint(0, 2))}"))
+    return out
+
+
+def _run_full_trace(model, V, n, seed, **engine_kw):
+    """Drive a seeded join/leave/preempt trace with prefix cache,
+    priority scheduling and speculative decoding ALL enabled."""
+    trace = _full_trace(V, n, seed)
+    eng = ServingEngine(model, spec_decode=2, **engine_kw)
+    ref, pending, results, step = {}, list(enumerate(trace)), {}, 0
+    while pending or eng.has_work():
+        still = []
+        for i, (prompt, max_new, at, prio, tenant) in pending:
+            if at <= step:
+                eng.add_request(prompt, max_new_tokens=max_new,
+                                request_id=i, priority=prio,
+                                tenant=tenant)
+                ref[i] = _solo(model, prompt, max_new)
+            else:
+                still.append((i, (prompt, max_new, at, prio, tenant)))
+        pending = still
+        eng.step()
+        results.update(eng.collect())
+        step += 1
+    return results, ref, eng
+
+
+class TestAllFeaturesExact:
+    """ISSUE 10 acceptance: with prefix cache + priority scheduling +
+    speculative decoding ALL enabled, greedy engine output exact-matches
+    solo generate_cached for every model family under seeded
+    multi-tenant join/leave/preempt traces."""
+
+    def _check(self, model, V, n, seed):
+        results, ref, eng = _run_full_trace(
+            model, V, n, seed, max_slots=2, page_size=4, prefill_chunk=4)
+        assert set(results) == set(ref)
+        for rid in ref:
+            np.testing.assert_array_equal(results[rid], ref[rid])
+        assert all(v == 1 for v in eng.program_cache_sizes().values())
+        # fair-share bookkeeping drains to zero with the pool
+        assert all(v == 0 for v in eng.scheduler._tenant_tokens.values())
+        eng.prefix_cache.flush()
+        assert eng.allocator.free_pages == eng.allocator.num_pages - 1
+
+    def test_llama_all_features(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        c = llama_tiny_config(num_hidden_layers=2)
+        m = LlamaForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 5, seed=31)
+
+    def test_gpt_all_features(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+        paddle.seed(0)
+        c = gpt_tiny_config(max_position_embeddings=64)
+        m = GPTForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 4, seed=32)
+
+    def test_mla_all_features(self):
+        from paddle_tpu.models.deepseek import (DeepSeekV2ForCausalLM,
+                                                deepseek_v2_tiny_config)
+        paddle.seed(0)
+        c = deepseek_v2_tiny_config(moe_dropless=True, num_hidden_layers=2)
+        m = DeepSeekV2ForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 4, seed=33)
+
+    def test_moe_all_features(self):
+        from paddle_tpu.models.moe_llm import (MoEForCausalLM,
+                                               qwen2_moe_tiny_config)
+        paddle.seed(0)
+        c = qwen2_moe_tiny_config(moe_dropless=True,
+                                  first_k_dense_replace=1,
+                                  max_position_embeddings=64)
+        m = MoEForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 4, seed=34)
+
+
+class TestPriorityScheduling:
+    """Scheduler-level priority / fair-share semantics (no model) and
+    the engine's page-intact preemption path."""
+
+    def test_priority_order_fcfs_within_class(self):
+        from paddle_tpu.serving.scheduler import Scheduler, Request
+        s = Scheduler(max_slots=1)
+        lo1 = s.submit(Request([1], 4, priority=0))
+        hi = s.submit(Request([1], 4, priority=2))
+        lo2 = s.submit(Request([1], 4, priority=0))
+        assert s.next_admittable() is hi
+        s.admit(hi)
+        s.release(hi)
+        assert s.next_admittable() is lo1      # FCFS within class
+        s.admit(lo1)
+        s.release(lo1)
+        assert s.next_admittable() is lo2
+
+    def test_defaults_reduce_to_fcfs(self):
+        from paddle_tpu.serving.scheduler import Scheduler, Request
+        s = Scheduler(max_slots=2)
+        reqs = [s.submit(Request([1], 4)) for _ in range(4)]
+        order = []
+        while s.has_work():
+            r = s.next_admittable()
+            if r is None:
+                for _, a in s.active():
+                    s.release(a)
+                    order.append(a)
+                continue
+            s.admit(r)
+        for _, a in s.active():
+            s.release(a)
+            order.append(a)
+        assert order == reqs
+
+    def test_tenant_budget_shapes_not_starves(self):
+        from paddle_tpu.serving.scheduler import Scheduler, Request
+        s = Scheduler(max_slots=4, tenant_budgets={"a": 10})
+        a1 = s.submit(Request([1, 2], 4, tenant="a"))   # 6 tokens
+        a2 = s.submit(Request([1, 2], 4, tenant="a"))   # would be 12 > 10
+        b1 = s.submit(Request([1, 2], 4, tenant="b"))   # no budget: free
+        assert s.next_admittable() is a1
+        s.admit(a1)
+        assert s.next_admittable() is b1       # a2 over budget, b flows
+        s.admit(b1)
+        assert s.next_admittable() is None
+        s.release(a1)                          # budget drains with usage
+        assert s.next_admittable() is a2
+        s.admit(a2)
+        # progress guarantee: a zero-usage tenant admits even a request
+        # bigger than its whole budget
+        s2 = Scheduler(max_slots=1, tenant_budgets={"c": 2})
+        c1 = s2.submit(Request([1, 2, 3], 8, tenant="c"))
+        assert s2.next_admittable() is c1
+
+    def test_pick_victim_strictly_lower_youngest(self):
+        from paddle_tpu.serving.scheduler import (Scheduler, Request,
+                                                  DECODE)
+        s = Scheduler(max_slots=3)
+        r0 = s.submit(Request([1], 4, priority=0))
+        r1 = s.submit(Request([1], 4, priority=0))
+        r2 = s.submit(Request([1], 4, priority=1))
+        for r in (r0, r1, r2):
+            s.admit(r)
+            r.state = DECODE
+        assert s.pick_victim(2) is r1          # lowest class, youngest
+        assert s.pick_victim(1) is r1
+        assert s.pick_victim(0) is None        # nothing strictly lower
+        r1.state = "prefill"
+        assert s.pick_victim(2) is r0          # PREFILL never preempted
+
+    def test_engine_preemption_no_reprefill(self, ):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        from paddle_tpu.serving.scheduler import DECODE
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=1))
+        m.eval()
+        V = m.config.vocab_size
+        rng = np.random.RandomState(17)
+        p1 = rng.randint(0, V, 6).astype(np.int32)
+        p2 = rng.randint(0, V, 5).astype(np.int32)
+        # sharing off so prefill-token accounting is exact
+        eng = ServingEngine(m, max_slots=1, page_size=4, prefill_chunk=4,
+                            prefix_sharing=False,
+                            enable_prefix_cache=False)
+        r1 = eng.add_request(p1, max_new_tokens=10, priority=0)
+        prefill = 0
+        while r1.state != DECODE or len(r1.tokens) < 2:
+            prefill += eng.step()["prefill_tokens"]
+        r2 = eng.add_request(p2, max_new_tokens=3, priority=1)
+        results = {}
+        while eng.has_work():
+            prefill += eng.step()["prefill_tokens"]
+            results.update(eng.collect())
+        # the high-priority arrival preempted r1 and finished first...
+        assert r1.preempted is False and r1.state == "finished"
+        np.testing.assert_array_equal(results[r2.request_id],
+                                      _solo(m, p2, 3))
+        # ...and r1 resumed with pages intact: its output is exact and
+        # NO prompt token was ever prefilled twice
+        np.testing.assert_array_equal(results[r1.request_id],
+                                      _solo(m, p1, 10))
+        assert prefill == p1.size + p2.size
+        from paddle_tpu import serving as srv
+        fam = srv.metrics().get("serving.engine.preemptions")
+        assert fam and fam["series"][0]["value"] >= 1
+
+    def test_preemption_off_knob(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        from paddle_tpu.serving.scheduler import DECODE
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=1))
+        m.eval()
+        V = m.config.vocab_size
+        rng = np.random.RandomState(18)
+        p1 = rng.randint(0, V, 5).astype(np.int32)
+        p2 = rng.randint(0, V, 5).astype(np.int32)
+        eng = ServingEngine(m, max_slots=1, page_size=4, prefill_chunk=4,
+                            preemption=False)
+        r1 = eng.add_request(p1, max_new_tokens=6, priority=0)
+        while r1.state != DECODE or len(r1.tokens) < 1:
+            eng.step()
+        r2 = eng.add_request(p2, max_new_tokens=3, priority=9)
+        finish_order = []
+        while eng.has_work():
+            eng.step()
+            finish_order.extend(eng.collect().keys())
+        assert finish_order == [r1.request_id, r2.request_id]
+
+
 class TestRaggedPath:
     """The unified ragged dispatch path (PR 7): split-path parity,
     strictly fewer launches, and int4-MLA exactness."""

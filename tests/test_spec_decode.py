@@ -1,0 +1,163 @@
+"""N-gram speculative decoding (serving.spec_decode): drafter and
+accept-rule units, engine exactness with batched one-launch
+verification, >1 mean accepted tokens per verify step on a repetitive
+trace (ISSUE 10 acceptance), and compile-once under spec rows."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving as srv
+from paddle_tpu.generation import generate_cached
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.spec_decode import accept_length, ngram_draft
+
+
+def _metric(name):
+    fam = srv.metrics().get(name)
+    if not fam or not fam["series"]:
+        return 0.0
+    return fam["series"][0]["value"]
+
+
+def _solo(model, prompt, max_new):
+    out, _ = generate_cached(model, paddle.to_tensor(prompt[None]),
+                             max_new_tokens=max_new,
+                             decode_strategy="greedy_search")
+    return [int(t) for t in out.numpy()[0]]
+
+
+class TestDrafter:
+    def test_recurring_ngram_proposes_followers(self):
+        #          [5 6 7] ... [5 6 7] -> propose what followed: 8 9
+        ctx = [5, 6, 7, 8, 9, 1, 2, 5, 6, 7]
+        assert ngram_draft(ctx, 2) == [8, 9]
+
+    def test_most_recent_occurrence_wins(self):
+        # [1 2] occurs twice; the LATER one (followed by 4) is used
+        ctx = [1, 2, 3, 0, 1, 2, 4, 9, 1, 2]
+        assert ngram_draft(ctx, 1) == [4]
+
+    def test_longest_ngram_tried_first(self):
+        # the 1-gram [2] would propose 7, but the 3-gram [9 1 2]
+        # (followed by 5) matches and takes precedence
+        ctx = [9, 1, 2, 5, 0, 2, 7, 3, 9, 1, 2]
+        assert ngram_draft(ctx, 1) == [5]
+
+    def test_self_referential_copy_extends_runs(self):
+        # constant tail: the copy source overlaps the drafted tokens
+        # (LZ77 style), so a period-1 run drafts all k tokens
+        ctx = [3, 1, 4, 7, 7, 7]
+        assert ngram_draft(ctx, 4) == [7, 7, 7, 7]
+        # period-2 cycle continues the alternation
+        ctx2 = [9, 5, 8, 5, 8, 5, 8]
+        assert ngram_draft(ctx2, 4) == [5, 8, 5, 8]
+
+    def test_no_match_or_degenerate_returns_empty(self):
+        assert ngram_draft([1, 2, 3, 4], 3) == []    # nothing recurs
+        assert ngram_draft([1, 2, 3], 0) == []       # k = 0
+        assert ngram_draft([], 3) == []
+        assert ngram_draft([4], 3) == []
+
+    def test_accept_length_prefix_rule(self):
+        assert accept_length([7, 8, 9], [7, 8, 9]) == 3
+        assert accept_length([7, 8, 9], [7, 8, 1]) == 2
+        assert accept_length([7, 8, 9], [1, 8, 9]) == 0
+        assert accept_length([], [5]) == 0
+
+
+class TestEngineSpecDecode:
+    @pytest.fixture(scope="class")
+    def model(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=1))
+        m.eval()
+        return m
+
+    def _repetitive_prompt(self, model):
+        """A prompt whose greedy continuation is repetitive: extend a
+        seed prompt with its own greedy output up into the cyclic tail
+        tiny greedy models converge to."""
+        base = np.asarray([251, 195, 359, 9, 211], np.int32)
+        cont = _solo(model, base, 16)
+        return np.concatenate([base, np.asarray(cont[:10], np.int32)])
+
+    def test_spec_decode_exact_and_accepts_over_one(self, model):
+        # acceptance: > 1 mean accepted tokens per verify step on a
+        # repetitive-text trace, output exactly equal to solo greedy
+        prompt = self._repetitive_prompt(model)
+        ref = _solo(model, prompt, 12)
+        base = {k: _metric(f"serving.spec_decode.{k}")
+                for k in ("draft_tokens", "accepted_tokens",
+                          "verify_steps")}
+        eng = ServingEngine(model, max_slots=1, page_size=4,
+                            prefill_chunk=4, spec_decode=4)
+        r = eng.add_request(prompt, max_new_tokens=12)
+        steps = 0
+        while eng.has_work():
+            eng.step()
+            steps += 1
+        out = eng.collect()[r.request_id]
+        assert [int(t) for t in out] == ref
+        drafted = _metric("serving.spec_decode.draft_tokens") \
+            - base["draft_tokens"]
+        accepted = _metric("serving.spec_decode.accepted_tokens") \
+            - base["accepted_tokens"]
+        vsteps = _metric("serving.spec_decode.verify_steps") \
+            - base["verify_steps"]
+        assert vsteps >= 1 and drafted >= accepted
+        assert accepted / vsteps > 1.0
+        # accepted drafts emit multiple tokens per launch: fewer engine
+        # steps than a token-at-a-time decode would need
+        assert steps < len(prompt) // 4 + 12
+        assert all(v == 1 for v in eng.program_cache_sizes().values())
+
+    def test_spec_decode_exact_on_mixed_batch(self, model):
+        # spec rows coexist with plain decode + chunked prefill in the
+        # same ragged launch; every stream stays exact
+        V = model.config.vocab_size
+        rng = np.random.RandomState(21)
+        prompts = [self._repetitive_prompt(model)] + \
+            [rng.randint(0, V, rng.randint(4, 9)).astype(np.int32)
+             for _ in range(3)]
+        eng = ServingEngine(model, max_slots=2, page_size=4,
+                            prefill_chunk=4, spec_decode=3)
+        reqs = [eng.add_request(p, max_new_tokens=5) for p in prompts]
+        out = eng.run_to_completion()
+        for p, r in zip(prompts, reqs):
+            assert [int(t) for t in out[r.request_id]] \
+                == _solo(model, p, 5)
+        assert all(v == 1 for v in eng.program_cache_sizes().values())
+
+    def test_rollback_rewrites_rejected_kv(self, model):
+        # force drafts that mostly get rejected (cyclic prompt, but the
+        # model breaks the cycle): rolled-back KV slots are rewritten
+        # and the output still exact-matches
+        V = model.config.vocab_size
+        rng = np.random.RandomState(33)
+        for _ in range(3):
+            p = rng.randint(0, V, 6).astype(np.int32)
+            prompt = np.concatenate([p, p])       # repetitive PROMPT
+            eng = ServingEngine(model, max_slots=1, page_size=4,
+                                prefill_chunk=4, spec_decode=4)
+            r = eng.add_request(prompt, max_new_tokens=8)
+            out = eng.run_to_completion()[r.request_id]
+            assert [int(t) for t in out] == _solo(model, prompt, 8)
+
+    def test_spec_zero_is_plain_decode(self, model):
+        V = model.config.vocab_size
+        rng = np.random.RandomState(44)
+        prompt = rng.randint(0, V, 7).astype(np.int32)
+        base_drafted = _metric("serving.spec_decode.draft_tokens")
+        eng = ServingEngine(model, max_slots=1, page_size=4,
+                            prefill_chunk=4, spec_decode=0)
+        r = eng.add_request(prompt, max_new_tokens=4)
+        out = eng.run_to_completion()[r.request_id]
+        assert [int(t) for t in out] == _solo(model, prompt, 4)
+        assert _metric("serving.spec_decode.draft_tokens") == base_drafted
+
+    def test_negative_spec_rejected(self, model):
+        with pytest.raises(ValueError):
+            ServingEngine(model, max_slots=1, spec_decode=-1)

@@ -328,6 +328,101 @@ class TestEngineTracing:
             tr.set_enabled(True)
 
 
+class TestServingStampRoundTrip:
+    """PR-10 stamps (prefix_hit, preempted/resumed, draft/verify_accept)
+    recorded on a RequestTrace survive the chrome-trace export."""
+
+    def test_recorder_level_roundtrip(self, tmp_path):
+        rec = tr.recorder()
+        rec.begin("r", prompt_len=12, max_new_tokens=4, priority=2,
+                  tenant="acme")
+        rec.stamp("r", "enqueue")
+        rec.stamp("r", "admit", slot=0)
+        rec.stamp("r", "prefix_hit", tokens=8, pages=2)
+        rec.stamp("r", "token")
+        rec.stamp("r", "preempted", decoded=1)
+        rec.stamp("r", "resumed", slot=1, decoded=1)
+        rec.stamp("r", "draft", tokens=3)
+        rec.stamp("r", "verify_accept", drafted=3, accepted=2)
+        rec.stamp("r", "token")
+        rec.finish("r", "finish")
+        t = rec.trace("r")
+        names = [e.name for e in t.timeline()]
+        for name in ("prefix_hit", "preempted", "resumed", "draft",
+                     "verify_accept"):
+            assert name in names
+        assert names.index("preempted") < names.index("resumed")
+        assert t.first("prefix_hit").meta["tokens"] == 8
+        assert t.first("verify_accept").meta == {"drafted": 3,
+                                                 "accepted": 2}
+        path = str(tmp_path / "trace.json")
+        n = rec.export_chrome_trace(path)
+        events = load_profiler_result(path)
+        assert len(events) == n
+        by_name = {e["name"]: e for e in events
+                   if e["name"] in ("prefix_hit", "preempted", "resumed",
+                                    "draft", "verify_accept")}
+        assert set(by_name) == {"prefix_hit", "preempted", "resumed",
+                                "draft", "verify_accept"}
+        assert by_name["prefix_hit"]["args"]["tokens"] == 8
+        assert by_name["verify_accept"]["args"]["accepted"] == 2
+
+
+@pytest.mark.slow
+class TestEngineServingStamps:
+    def test_prefix_hit_and_spec_stamps(self, tmp_path):
+        eng, cfg = _tiny_engine(spec_decode=3, prefix_sharing=False)
+        rng = np.random.RandomState(7)
+        prompt = rng.randint(0, cfg.vocab_size, 12).astype(np.int32)
+        eng.add_request(prompt, max_new_tokens=3, request_id="warm")
+        eng.run_to_completion()
+        eng.add_request(prompt.copy(), max_new_tokens=3, request_id="hit",
+                        tenant="acme")
+        eng.run_to_completion()
+        t = tr.recorder().trace("hit")
+        hit = t.first("prefix_hit")
+        assert hit is not None and hit.meta["tokens"] >= 8
+        assert t.meta.get("tenant") == "acme"
+        # spec decode on a repetitive prompt stamps draft/verify_accept
+        rep = np.asarray([5, 9, 5, 9, 5, 9, 5, 9], np.int32)
+        eng.add_request(rep, max_new_tokens=6, request_id="spec")
+        eng.run_to_completion()
+        ts = tr.recorder().trace("spec")
+        if ts.first("draft") is not None:       # model-dependent drafts
+            assert ts.first("draft").meta["tokens"] >= 1
+        # chrome export round-trips every stamped event
+        path = str(tmp_path / "t.json")
+        n = tr.recorder().export_chrome_trace(path)
+        events = load_profiler_result(path)
+        assert len(events) == n > 0
+        assert any(e["name"] == "prefix_hit" for e in events)
+
+    def test_preempt_resume_stamps(self):
+        from paddle_tpu.serving.scheduler import DECODE
+        eng, cfg = _tiny_engine(max_slots=1)
+        rng = np.random.RandomState(9)
+        p1 = rng.randint(0, cfg.vocab_size, 5).astype(np.int32)
+        p2 = rng.randint(0, cfg.vocab_size, 5).astype(np.int32)
+        r1 = eng.add_request(p1, max_new_tokens=8, request_id="low",
+                             priority=0)
+        while r1.state != DECODE or len(r1.tokens) < 1:
+            eng.step()
+        eng.add_request(p2, max_new_tokens=2, request_id="high",
+                        priority=3)
+        eng.run_to_completion()
+        t = tr.recorder().trace("low")
+        names = [e.name for e in t.timeline()]
+        assert "preempted" in names and "resumed" in names
+        assert names.index("preempted") < names.index("resumed")
+        assert t.first("preempted").meta["decoded"] >= 1
+        # no re-prefill on resume: every prefill_chunk stamp precedes
+        # the preemption
+        pre = names.index("preempted")
+        assert all(i < pre for i, nm in enumerate(names)
+                   if nm == "prefill_chunk")
+        assert tr.recorder().trace("high").meta.get("priority") == 3
+
+
 # ---------------------------------------------------------- trainer phases
 
 @pytest.mark.slow

@@ -13,7 +13,9 @@ Two artifact families are gated, both higher-is-better throughputs:
     measured 1.03% spread). The LATEST round's value must not fall below
     the band floor.
   - **serving** — docs/SERVING_BENCH.json rows (decode*/prefill*/moe*/
-    mla* throughput fields). No repeat artifacts exist per row, so each
+    mla*/serving-engine throughput fields plus the prefix-cache and
+    speculative-decode quality stats). No repeat artifacts exist per
+    row, so each
     committed value is its own reference with a --noise band around it
     (default 15%, the upper edge of the file's own measurement-protocol
     "10-15% run-to-run variation" note).
@@ -44,9 +46,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# SERVING_BENCH fields gated per row (all higher-is-better throughputs)
+# SERVING_BENCH fields gated per row (all higher-is-better: throughputs
+# plus the prefix-cache hit-rate / TTFT-speedup and speculative-decode
+# accepted-tokens-per-verify-step quality stats, which regress the same
+# way a throughput does when the radix trie or the drafter breaks)
 SERVING_FIELDS = ("decode_tokens_per_s_per_chip", "prefill_tokens_per_s",
-                  "inflight_tokens_per_s", "ragged_tokens_per_s")
+                  "inflight_tokens_per_s", "ragged_tokens_per_s",
+                  "cache_on_tokens_per_s", "prefix_hit_rate",
+                  "spec_tokens_per_s", "accepted_tokens_per_verify_step")
 
 
 def _load(path: str) -> Optional[Dict[str, Any]]:
