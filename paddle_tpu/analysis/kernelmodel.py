@@ -139,6 +139,7 @@ class KernelCallSite:
     fi: Optional[FunctionInfo]              # enclosing function (innermost)
     call: ast.Call                          # the pl.pallas_call(...) node
     grid_len: Optional[int] = None
+    grid_elts: Optional[List[ast.AST]] = None       # grid component exprs
     n_prefetch: int = 0
     in_specs: Optional[List[BlockSpecModel]] = None
     out_specs: Optional[List[BlockSpecModel]] = None
@@ -350,6 +351,7 @@ def _parse_site(mi: ModuleInfo, fi: Optional[FunctionInfo], call: ast.Call,
 
     grid_elts = _seq_elts(grid_expr) if grid_expr is not None else None
     site.grid_len = len(grid_elts) if grid_elts is not None else None
+    site.grid_elts = list(grid_elts) if grid_elts is not None else None
 
     site.in_specs = _spec_list(in_specs_expr, mi, fi, env)
     site.out_specs = _spec_list(out_specs_expr, mi, fi, env)
@@ -481,3 +483,162 @@ def shape_dtype_struct(expr: ast.AST) -> Optional[Tuple[ast.AST, ast.AST]]:
             and len(expr.args) >= 2:
         return expr.args[0], expr.args[1]
     return None
+
+
+# ---------------------------------------------------------------------------
+# numeric transfer evaluation (ISSUE 11: the cost-model cross-check)
+# ---------------------------------------------------------------------------
+#
+# The cost registry (`observability.costmodel`) states each kernel's HBM
+# bytes in closed form; these helpers derive the same quantity from the
+# committed BlockSpecs so the two can never drift apart silently.  The
+# model is Pallas's fetch rule: a block is (re)copied at every grid step
+# whose block index differs from the previous step's.  For an index_map
+# that references grid dims S (directly or through body locals), over a
+# lexicographic grid sweep the index changes whenever any dim at or
+# outside max(S) ticks, so
+#
+#     fetch_runs = prod(grid[0 .. max(S)])        (1 when S is empty)
+#
+# and the spec's transfer is fetch_runs * block elements * dtype bytes.
+# Specs with memory_space=ANY (manual-DMA operands) evaluate to None.
+
+def eval_int_expr(node: Optional[ast.AST],
+                  bindings: Dict[str, int]) -> Optional[int]:
+    """Evaluate an integer shape expression under `bindings` (Name ->
+    int). Supports the arithmetic the committed call sites use
+    (+ - * // % **, unary -, min/max calls); None when anything else
+    appears."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        v = node.value
+        return v if isinstance(v, int) and not isinstance(v, bool) else None
+    if isinstance(node, ast.Name):
+        return bindings.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = eval_int_expr(node.operand, bindings)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        a = eval_int_expr(node.left, bindings)
+        b = eval_int_expr(node.right, bindings)
+        if a is None or b is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return a + b
+        if isinstance(node.op, ast.Sub):
+            return a - b
+        if isinstance(node.op, ast.Mult):
+            return a * b
+        if isinstance(node.op, ast.FloorDiv):
+            return a // b if b else None
+        if isinstance(node.op, ast.Mod):
+            return a % b if b else None
+        if isinstance(node.op, ast.Pow):
+            return a ** b
+        return None
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("min", "max") and not node.keywords:
+        vals = [eval_int_expr(a, bindings) for a in node.args]
+        if any(v is None for v in vals) or not vals:
+            return None
+        return min(vals) if node.func.id == "min" else max(vals)
+    return None
+
+
+def grid_values(site: KernelCallSite,
+                bindings: Dict[str, int]) -> Optional[List[int]]:
+    """The concrete grid under `bindings`, or None when any component
+    doesn't evaluate."""
+    if site.grid_elts is None:
+        return None
+    out = []
+    for e in site.grid_elts:
+        v = eval_int_expr(e, bindings)
+        if v is None:
+            return None
+        out.append(v)
+    return out
+
+
+def index_map_grid_refs(imap: IndexMapModel, grid_len: int) -> Set[int]:
+    """Grid-dim positions the index map's return value depends on, with
+    body locals expanded (the page maps return a clamped local `phys`
+    computed from the grid id)."""
+    local_defs: Dict[str, ast.AST] = {}
+    for stmt in imap.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            local_defs[stmt.targets[0].id] = stmt.value
+
+    names: Set[str] = set()
+    pending = [c for comps in imap.returns for c in comps]
+    seen_exprs = 0
+    while pending and seen_exprs < 64:
+        expr = pending.pop()
+        seen_exprs += 1
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id not in names:
+                names.add(n.id)
+                if n.id in local_defs:
+                    pending.append(local_defs[n.id])
+    grid_params = imap.params[:grid_len]
+    return {i for i, p in enumerate(grid_params) if p in names}
+
+
+def spec_transfer_elems(spec: BlockSpecModel, grid: List[int],
+                        grid_len: int,
+                        bindings: Dict[str, int]) -> Optional[int]:
+    """fetch_runs x block elements for one spec, or None when the spec
+    stays in HBM (ANY), lacks a literal block shape, or an expression
+    doesn't evaluate under `bindings`."""
+    if spec.memory_space == "ANY" or spec.block_shape is None:
+        return None
+    elems = 1
+    for e in spec.block_shape:
+        v = eval_int_expr(e, bindings)
+        if v is None:
+            return None
+        elems *= v
+    if spec.index_map is None:
+        return None
+    refs = index_map_grid_refs(spec.index_map, grid_len)
+    runs = 1
+    if refs:
+        last = max(refs)
+        if last >= len(grid):
+            return None
+        for g in grid[: last + 1]:
+            runs *= g
+    return runs * elems
+
+
+def transfer_bytes(site: KernelCallSite, bindings: Dict[str, int],
+                   in_dtype_bytes: List[Optional[int]],
+                   out_dtype_bytes: List[Optional[int]]
+                   ) -> Optional[Dict[str, List[Optional[int]]]]:
+    """{'in': [...], 'out': [...]} per-spec transfer bytes for a call
+    site under concrete shape `bindings`; entries are None for specs
+    that opt out (ANY space / unresolved), the dict is None when the
+    grid itself doesn't evaluate.  Dtype bytes are supplied per spec
+    (an entry of None skips that spec)."""
+    if site.grid_len is None:
+        return None
+    grid = grid_values(site, bindings)
+    if grid is None:
+        return None
+
+    def _side(specs, dtypes):
+        out: List[Optional[int]] = []
+        for i, spec in enumerate(specs or []):
+            eb = dtypes[i] if i < len(dtypes) else None
+            if eb is None:
+                out.append(None)
+                continue
+            elems = spec_transfer_elems(spec, grid, site.grid_len,
+                                        bindings)
+            out.append(elems * eb if elems is not None else None)
+        return out
+
+    return {"in": _side(site.in_specs, in_dtype_bytes),
+            "out": _side(site.out_specs, out_dtype_bytes)}

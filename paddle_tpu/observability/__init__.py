@@ -513,3 +513,11 @@ from .tracing import (RequestTrace, TraceRecorder,       # noqa: E402,F401
 
 __all__ += ["tracing", "RequestTrace", "TraceRecorder", "percentile",
             "percentiles", "slo_summary"]
+
+# the roofline observatory (ISSUE 11): the analytical per-kernel cost
+# registry and the measured-vs-model attribution joins built on it
+from . import attribution, costmodel                     # noqa: E402
+from .costmodel import CostEstimate                      # noqa: E402,F401
+
+__all__ += ["attribution", "costmodel", "CostEstimate"]
+

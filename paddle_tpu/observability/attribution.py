@@ -1,0 +1,198 @@
+"""paddle_tpu.observability.attribution — measured time x analytical
+cost (ISSUE 11).
+
+Joins `profiler.statistic.summarize` per-op tables with the
+`costmodel` registry to answer "where do the bytes go": per-kernel
+achieved GB/s and FLOP/s against the chip roofline, %-of-roofline, and
+%-of-step-time.  Two consumers:
+
+  - `tools/observatory.py` renders `attribute()` as the human roofline
+    table and ships it in docs/OBSERVATORY.json (perf-gate banded);
+  - the FLAGSHIP residual step-breakdown table is
+    `train_step_attribution()` + `render_flagship_table()` over a traced
+    train run — generated, not hand math.
+
+Matching is by kernel name: a summarize() row whose base name equals or
+contains the kernel name (device XPlane rows carry the real Mosaic
+kernel names, e.g. ``ragged_paged_attention_kernel.1``) provides the
+measured side.  On CPU tier-1 there are no device rows, so kernels
+attribute model-only — launches from `pt_kernel_launch_total` style
+counts, measured fields None — and the step-level phases still
+attribute exactly.  Rows are plain dicts so they JSON-serialize into
+the observatory artifact unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, \
+    Union
+
+from . import costmodel
+
+__all__ = ["attribute", "render_roofline_table",
+           "train_step_attribution", "render_flagship_table"]
+
+_TRAIN_PHASES = ("data", "fwd", "bwd", "opt")
+
+#: FLAGSHIP.md row labels (the generated table keeps the committed prose)
+_PHASE_LABELS = {
+    "data": "data (loader + host staging)",
+    "fwd": "fwd (incl. loss sync — see OBSERVABILITY.md timing caveat)",
+    "bwd": "bwd",
+    "opt": "opt (AdamW update)",
+}
+
+
+def _stat_parts(stat: Any) -> Tuple[List[Dict[str, Any]],
+                                    List[Dict[str, Any]], float]:
+    """Normalize a StatisticResult / its to_dict() / a bare ops list to
+    (ops, steps, total_us)."""
+    if hasattr(stat, "ops"):
+        return list(stat.ops), list(stat.steps), float(stat.total_us)
+    if isinstance(stat, Mapping):
+        return (list(stat.get("ops", [])), list(stat.get("steps", [])),
+                float(stat.get("total_us", 0.0)))
+    ops = list(stat or [])
+    return ops, [], float(sum(r.get("total_us", 0.0) for r in ops))
+
+
+def _match_row(ops: Sequence[Mapping[str, Any]],
+               kernel: str) -> Optional[Mapping[str, Any]]:
+    for r in ops:
+        if r.get("name") == kernel:
+            return r
+    for r in ops:
+        if kernel in str(r.get("name", "")):
+            return r
+    return None
+
+
+def attribute(stat: Any,
+              kernel_costs: Mapping[str, Union[costmodel.CostEstimate,
+                                               Tuple[int,
+                                                     costmodel.CostEstimate]]],
+              *, hbm_bw: float = costmodel.HBM_BW["v5e"],
+              peak_flops: Optional[float] = None,
+              step_time_us: Optional[float] = None,
+              launches: Optional[Mapping[str, int]] = None
+              ) -> List[Dict[str, Any]]:
+    """Per-kernel attribution rows, sorted by model HBM bytes descending.
+
+    ``kernel_costs`` maps kernel name -> CostEstimate for ONE launch (or
+    ``(launches, CostEstimate)`` as `decode_layer_kernels` emits).
+    ``launches`` overrides the launch count per kernel (the measured
+    `pt_kernel_launch_total` values); a matching summarize() row's call
+    count wins over both.  ``step_time_us`` is the denominator for
+    %-of-step-time (defaults to the profile's total)."""
+    ops, _, total_us = _stat_parts(stat)
+    denom = step_time_us if step_time_us else total_us
+    rows: List[Dict[str, Any]] = []
+    for kernel, entry in kernel_costs.items():
+        n, est = entry if isinstance(entry, tuple) else (1, entry)
+        if launches and kernel in launches:
+            n = int(launches[kernel])
+        row = _match_row(ops, kernel)
+        measured_us = float(row["total_us"]) if row else None
+        if row:
+            n = int(row.get("calls", n))
+        bytes_total = est.hbm_bytes * n
+        flops_total = est.flops * n
+        theo_us = est.theoretical_us(hbm_bw, peak_flops) * n
+        out: Dict[str, Any] = {
+            "kernel": kernel, "launches": n,
+            "bytes": bytes_total, "bytes_per_launch": est.hbm_bytes,
+            "flops": flops_total,
+            "arithmetic_intensity": est.arithmetic_intensity,
+            "theoretical_us": theo_us,
+            "measured_us": measured_us,
+            "achieved_gbps": None, "achieved_tflops": None,
+            "pct_roofline": None, "pct_step_time": None,
+        }
+        if measured_us and measured_us > 0:
+            out["achieved_gbps"] = bytes_total / measured_us / 1e3
+            out["achieved_tflops"] = flops_total / measured_us / 1e6
+            out["pct_roofline"] = 100.0 * theo_us / measured_us
+            if denom:
+                out["pct_step_time"] = 100.0 * measured_us / denom
+        rows.append(out)
+    rows.sort(key=lambda r: -r["bytes"])
+    return rows
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"                      # pragma: no cover
+
+
+def render_roofline_table(rows: Sequence[Mapping[str, Any]],
+                          hbm_bw: float = costmodel.HBM_BW["v5e"]
+                          ) -> str:
+    """The human observatory table: kernel · launches · bytes ·
+    achieved/theoretical · % step time."""
+    head = (f"{'kernel':<28}{'launches':>9}{'bytes':>12}"
+            f"{'GB/s ach':>10}{'GB/s roof':>10}{'%roof':>7}{'%step':>7}")
+    out = [head, "-" * len(head)]
+    for r in rows:
+        ach = r.get("achieved_gbps")
+        roof = hbm_bw / 1e9
+        pct = r.get("pct_roofline")
+        pstep = r.get("pct_step_time")
+        out.append(
+            f"{r['kernel'][:27]:<28}{r['launches']:>9}"
+            f"{_fmt_bytes(r['bytes']):>12}"
+            f"{(f'{ach:.1f}' if ach is not None else '—'):>10}"
+            f"{roof:>10.0f}"
+            f"{(f'{pct:.0f}%' if pct is not None else '—'):>7}"
+            f"{(f'{pstep:.1f}%' if pstep is not None else '—'):>7}")
+    return "\n".join(out)
+
+
+def train_step_attribution(stat: Any) -> Dict[str, Any]:
+    """The residual step breakdown FLAGSHIP.md commits: per-phase
+    ms/step and % of wall from the traced train-step spans
+    (`kind="train"` lifetime events + data/fwd/bwd/opt phase events in
+    the chrome export), with the residual reported as *unattributed*
+    instead of silently absorbed."""
+    ops, steps, total_us = _stat_parts(stat)
+    life = [r for r in ops if str(r.get("name", "")).startswith("train:")]
+    n_steps = sum(int(r.get("calls", 0)) for r in life)
+    wall_us = sum(float(r.get("total_us", 0.0)) for r in life)
+    if not n_steps:                  # no lifetime spans: fall back to
+        n_steps = max(int(next((s["calls"] for s in steps
+                                if s["phase"] == "opt"), 1)), 1)
+        wall_us = total_us
+    phases = []
+    attributed = 0.0
+    for name in _TRAIN_PHASES:
+        s = next((s for s in steps if s["phase"] == name), None)
+        t = float(s["total_us"]) if s else 0.0
+        attributed += t
+        phases.append({
+            "phase": name,
+            "ms_per_step": t / n_steps / 1e3,
+            "pct": 100.0 * t / wall_us if wall_us else 0.0})
+    resid = max(wall_us - attributed, 0.0)
+    return {"steps": n_steps,
+            "wall_ms_per_step": wall_us / n_steps / 1e3,
+            "phases": phases,
+            "unattributed_ms_per_step": resid / n_steps / 1e3,
+            "unattributed_pct": 100.0 * resid / wall_us if wall_us
+            else 0.0}
+
+
+def render_flagship_table(d: Mapping[str, Any]) -> str:
+    """Markdown table in the committed FLAGSHIP.md §5 layout."""
+    out = ["| Phase | ms/step | % of wall |", "|---|---:|---:|"]
+    for p in d["phases"]:
+        label = _PHASE_LABELS.get(p["phase"], p["phase"])
+        out.append(f"| {label} | {p['ms_per_step']:.1f} "
+                   f"| {p['pct']:.1f}% |")
+    out.append(f"| unattributed (logging, bookkeeping) "
+               f"| {d['unattributed_ms_per_step']:.1f} "
+               f"| {d['unattributed_pct']:.1f}% |")
+    out.append(f"| **wall per step** | **{d['wall_ms_per_step']:.1f}** "
+               f"| 100% |")
+    return "\n".join(out)

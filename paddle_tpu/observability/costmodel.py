@@ -1,0 +1,583 @@
+"""paddle_tpu.observability.costmodel — analytical per-kernel cost
+registry (ISSUE 11 tentpole).
+
+One entry per authored kernel in ``ops/oracles.py`` (all 17): HBM bytes
+read / written and FLOPs as closed-form functions of the launch shapes
+and dtypes.  The byte formulas for the attention families mirror the
+Pallas BlockSpec accounting exactly — fetch *runs* x block bytes, where
+a block is re-fetched at every grid step whose index differs from the
+previous step's (so flash K/V pay once per q-block, paged K/V once per
+page per batch row) — and `tests/test_costmodel.py` asserts they equal
+the sizes `analysis/kernelmodel.py` derives from the committed
+grids/BlockSpecs.  Scalar-prefetch operands (lengths, page tables) are
+EXCLUDED everywhere: they are KBs against MBs and live in SMEM.
+
+On top of the registry sit the composite budgets the rest of the repo
+consumes so train and serve share one cost vocabulary:
+
+  - `decode_step_budget` — the serving HBM roofline (weights + KV read
+    per engine step, int4/int8 aware via ``weight_bytes`` /
+    ``kv_dtype_bytes``; ``page_size=None`` reproduces the naive
+    row-granular roofline SERVING_BENCH committed, an int gives the
+    page-granular figure the engine actually transfers);
+  - `decode_layer_kernels` — the per-kernel decomposition of one decode
+    layer body (which `tools/observatory.py` renders as the roofline
+    table and `tools/perf_gate.py` bands per kernel);
+  - `pretrain_step_budget` / `train_mfu` — the 6N FLOPs ledger the
+    trainer's MFU gauge is derived from (`trainer.py` falls back to
+    `flops_per_sample(...)` when TrainingArguments doesn't pin one).
+
+Pure python + math: importable from tools and tests without jax.
+`tree_bytes` (the one helper that touches arrays) duck-types leaves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Mapping, Optional
+
+__all__ = [
+    "CostEstimate", "KernelCost", "register_cost", "costs", "cost",
+    "decode_step_budget", "decode_layer_kernels", "pretrain_step_budget",
+    "flops_per_sample", "train_mfu", "roofline_tokens_per_s",
+    "tree_bytes", "HBM_BW", "PEAK_FLOPS",
+]
+
+#: per-chip HBM bandwidth (bytes/s) — same table serving_bench publishes
+HBM_BW: Dict[str, float] = {"v5e": 819e9, "v5p": 2765e9, "v4": 1228e9,
+                            "v6e": 1640e9}
+
+#: per-chip bf16 peak (FLOP/s) for MFU / roofline-knee math
+PEAK_FLOPS: Dict[str, float] = {"v5e": 197e12, "v5p": 459e12,
+                                "v4": 275e12, "v6e": 918e12}
+
+
+@dataclasses.dataclass(frozen=True)
+class CostEstimate:
+    """Analytical cost of ONE launch: HBM bytes each way, FLOPs, and an
+    optional named byte breakdown (weights / kv / activations / ...)."""
+
+    bytes_read: int
+    bytes_written: int
+    flops: int
+    breakdown: Optional[Mapping[str, int]] = None
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.bytes_read + self.bytes_written
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        """FLOP per HBM byte — which side of the roofline knee."""
+        return self.flops / max(self.hbm_bytes, 1)
+
+    def theoretical_us(self, hbm_bw: float,
+                       peak_flops: Optional[float] = None) -> float:
+        """Roofline-optimal launch time: max of the bandwidth and the
+        compute bound (compute bound skipped when peak_flops is None)."""
+        t = self.hbm_bytes / hbm_bw
+        if peak_flops:
+            t = max(t, self.flops / peak_flops)
+        return t * 1e6
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCost:
+    name: str
+    fn: Callable[..., CostEstimate]
+    doc: str = ""
+
+
+_COSTS: Dict[str, KernelCost] = {}
+
+
+def register_cost(name: str, fn: Optional[Callable[..., CostEstimate]]
+                  = None, doc: str = ""):
+    """Register the cost function for kernel `name` (the ops/oracles.py
+    name). Usable as a decorator; re-registration replaces (mirrors
+    register_oracle)."""
+    def _reg(f: Callable[..., CostEstimate]) -> Callable[..., CostEstimate]:
+        _COSTS[name] = KernelCost(name=name, fn=f,
+                                  doc=doc or (f.__doc__ or "").strip())
+        return f
+    return _reg(fn) if fn is not None else _reg
+
+
+def costs() -> Mapping[str, KernelCost]:
+    """Read-only view of the registry (name -> KernelCost)."""
+    return dict(_COSTS)
+
+
+def cost(name: str, **shapes: Any) -> CostEstimate:
+    """Evaluate the registered cost of `name` at the given shapes."""
+    try:
+        entry = _COSTS[name]
+    except KeyError:
+        raise KeyError(
+            f"no cost registered for kernel {name!r}; "
+            f"known: {sorted(_COSTS)}") from None
+    return entry.fn(**shapes)
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
+
+
+# ---------------------------------------------------------------------------
+# elementwise / fused-op kernels (ops/fused.py)
+# ---------------------------------------------------------------------------
+
+@register_cost("fused_rms_norm")
+def _c_fused_rms_norm(*, T: int, H: int, dtype_bytes: int = 2
+                      ) -> CostEstimate:
+    """x [T, H] + weight [H] -> [T, H]; square/mean/rsqrt/scale."""
+    return CostEstimate(bytes_read=(T * H + H) * dtype_bytes,
+                        bytes_written=T * H * dtype_bytes,
+                        flops=4 * T * H,
+                        breakdown={"activations": 2 * T * H * dtype_bytes,
+                                   "weights": H * dtype_bytes})
+
+
+@register_cost("fused_layer_norm")
+def _c_fused_layer_norm(*, T: int, H: int, dtype_bytes: int = 2
+                        ) -> CostEstimate:
+    """x [T, H] + weight/bias [H] -> [T, H]; mean/var/normalize/affine."""
+    return CostEstimate(bytes_read=(T * H + 2 * H) * dtype_bytes,
+                        bytes_written=T * H * dtype_bytes,
+                        flops=6 * T * H,
+                        breakdown={"activations": 2 * T * H * dtype_bytes,
+                                   "weights": 2 * H * dtype_bytes})
+
+
+@register_cost("fused_bias_residual_layer_norm")
+def _c_fused_brln(*, T: int, H: int, dtype_bytes: int = 2) -> CostEstimate:
+    """x + residual [T, H] + bias/weight/ln-bias [H] -> [T, H]."""
+    return CostEstimate(bytes_read=(2 * T * H + 3 * H) * dtype_bytes,
+                        bytes_written=T * H * dtype_bytes,
+                        flops=8 * T * H,
+                        breakdown={"activations": 3 * T * H * dtype_bytes,
+                                   "weights": 3 * H * dtype_bytes})
+
+
+@register_cost("fused_moe_dispatch_combine")
+def _c_fused_moe_dc(*, T: int, K: int, E: int, C: int,
+                    dtype_bytes: int = 4) -> CostEstimate:
+    """keep [T,K,E] + oh_loc [T,K,C] + gv [T,K] -> two [T,E,C] scatter
+    planes (dispatch one-hot and gate-weighted combine)."""
+    read = T * (K * E + K * C + K) * dtype_bytes
+    return CostEstimate(bytes_read=read,
+                        bytes_written=2 * T * E * C * dtype_bytes,
+                        flops=2 * T * K * C,
+                        breakdown={"activations": read})
+
+
+@register_cost("fused_rope")
+def _c_fused_rope(*, B: int, S: int, H: int, D: int, Hk: int = 0,
+                  dtype_bytes: int = 2) -> CostEstimate:
+    """Rotary embedding over q [B,S,H,D] (+ optionally k with Hk heads);
+    cos/sin ride once per position ([B,S,1,D/2] each)."""
+    heads = H + Hk
+    act = B * S * heads * D * dtype_bytes
+    trig = B * S * D * dtype_bytes          # cos + sin, D/2 each
+    return CostEstimate(bytes_read=act + trig, bytes_written=act,
+                        flops=3 * B * S * heads * D,
+                        breakdown={"activations": 2 * act + trig})
+
+
+@register_cost("fused_rope_append")
+def _c_fused_rope_append(*, T: int, Hq: int, KV: int, D: int,
+                         page_size: int, dtype_bytes: int = 2
+                         ) -> CostEstimate:
+    """Rope(q,k) + paged K/V row scatter in one launch, grid (T,): q/k/v
+    token rows + cos/sin, plus the aliased page blocks — each token
+    read-modify-writes one (KV, page_size, D) block per cache plane."""
+    rows = T * (Hq + 2 * KV) * D * dtype_bytes
+    trig = T * D * dtype_bytes
+    pages = 2 * T * KV * page_size * D * dtype_bytes   # k_pages + v_pages
+    return CostEstimate(
+        bytes_read=rows + trig + pages,
+        bytes_written=(T * Hq * D * dtype_bytes) + pages,
+        flops=3 * T * (Hq + KV) * D,
+        breakdown={"activations": rows + trig, "kv": 2 * pages})
+
+
+@register_cost("fused_append_rows")
+def _c_fused_append_rows(*, T: int, KV: int, D: int, page_size: int,
+                         dtype_bytes: int = 2) -> CostEstimate:
+    """Scatter T rows [KV, D] into paged cache: each token
+    read-modify-writes one (KV, page_size, D) block (aliased in+out)."""
+    pages = T * KV * page_size * D * dtype_bytes
+    return CostEstimate(bytes_read=(T * KV * D * dtype_bytes) + pages,
+                        bytes_written=pages, flops=0,
+                        breakdown={"kv": 2 * pages,
+                                   "activations": T * KV * D * dtype_bytes})
+
+
+@register_cost("swiglu")
+def _c_swiglu(*, T: int, H: int, dtype_bytes: int = 2) -> CostEstimate:
+    """gate/up [T, H] -> silu(gate) * up [T, H]."""
+    return CostEstimate(bytes_read=2 * T * H * dtype_bytes,
+                        bytes_written=T * H * dtype_bytes,
+                        flops=6 * T * H,
+                        breakdown={"activations": 3 * T * H * dtype_bytes})
+
+
+# ---------------------------------------------------------------------------
+# attention kernels — byte formulas mirror the BlockSpec fetch accounting
+# ---------------------------------------------------------------------------
+
+def _flash_blocks(Sq: int, Sk: int, block_q: int, block_k: int):
+    bq, bk = min(block_q, Sq), min(block_k, Sk)
+    return bq, bk, Sq // bq, Sk // bk
+
+
+def _flash_bytes(B: int, H: int, Sq: int, Sk: int, D: int, bq: int,
+                 bk: int, nq: int, nk: int, dtype_bytes: int,
+                 seg_bytes: int):
+    # fetch runs (see module docstring): q once; k/v once per q-block;
+    # the int32 segment-id rows ride the same grids
+    q = B * H * nq * bq * D * dtype_bytes
+    kv = 2 * B * H * nq * nk * bk * D * dtype_bytes
+    seg = (B * H * nq * bq + B * H * nq * nk * bk) * seg_bytes
+    out = B * H * Sq * D * dtype_bytes
+    lse = B * H * Sq * 4                      # f32 row stats
+    return q, kv, seg, out, lse
+
+
+@register_cost("flash_sdpa")
+def _c_flash_sdpa(*, B: int, H: int, Sq: int, Sk: int, D: int,
+                  block_q: int = 512, block_k: int = 512,
+                  causal: bool = False, dtype_bytes: int = 2,
+                  seg_bytes: int = 4) -> CostEstimate:
+    """Tiled online-softmax attention, fwd grid (B, H, nq, nk): q read
+    once, K/V re-fetched per q-block (the flash HBM contract)."""
+    bq, bk, nq, nk = _flash_blocks(Sq, Sk, block_q, block_k)
+    q, kv, seg, out, lse = _flash_bytes(B, H, Sq, Sk, D, bq, bk, nq, nk,
+                                        dtype_bytes, seg_bytes)
+    flops = 4 * B * H * Sq * Sk * D
+    if causal:
+        flops //= 2
+    return CostEstimate(bytes_read=q + kv + seg, bytes_written=out + lse,
+                        flops=flops + 6 * B * H * Sq * Sk,
+                        breakdown={"activations": q + kv + out,
+                                   "stats": seg + lse})
+
+
+@register_cost("flashmask_sdpa")
+def _c_flashmask_sdpa(*, B: int, H: int, Sq: int, Sk: int, D: int,
+                      block_q: int = 512, block_k: int = 512,
+                      causal: bool = False, dtype_bytes: int = 2,
+                      seg_bytes: int = 4,
+                      mask_rows: int = 2) -> CostEstimate:
+    """flash_sdpa + the column-sparse startend row-index mask
+    (`mask_rows` int32 rows of length Sk, re-fetched per q-block)."""
+    base = _c_flash_sdpa(B=B, H=H, Sq=Sq, Sk=Sk, D=D, block_q=block_q,
+                         block_k=block_k, causal=causal,
+                         dtype_bytes=dtype_bytes, seg_bytes=seg_bytes)
+    bq, bk, nq, nk = _flash_blocks(Sq, Sk, block_q, block_k)
+    mask = B * mask_rows * nq * nk * bk * 4
+    bd = dict(base.breakdown or {})
+    bd["stats"] = bd.get("stats", 0) + mask
+    return CostEstimate(bytes_read=base.bytes_read + mask,
+                        bytes_written=base.bytes_written,
+                        flops=base.flops, breakdown=bd)
+
+
+def _paged_bytes(B: int, H: int, KV: int, D: int, pages: int,
+                 page_size: int, dtype_bytes: int):
+    rep = H // KV
+    q = B * KV * rep * D * dtype_bytes       # one (1,1,rep,D) block per (b,h)
+    kv = 2 * B * KV * pages * page_size * D * dtype_bytes
+    out = B * KV * rep * D * dtype_bytes
+    return q, kv, out
+
+
+def _paged_cost(B: int, H: int, KV: int, D: int, context: int,
+                page_size: int, pages_per_seq: Optional[int],
+                dtype_bytes: int) -> CostEstimate:
+    pages = (pages_per_seq if pages_per_seq is not None
+             else _ceil_div(context, page_size))
+    q, kv, out = _paged_bytes(B, H, KV, D, pages, page_size, dtype_bytes)
+    return CostEstimate(bytes_read=q + kv, bytes_written=out,
+                        flops=4 * B * H * context * D
+                        + 6 * B * H * context,
+                        breakdown={"kv": kv, "activations": q + out})
+
+
+@register_cost("paged_decode_attention")
+def _c_paged_v1(*, B: int, H: int, KV: int, D: int, context: int,
+                page_size: int, pages_per_seq: Optional[int] = None,
+                dtype_bytes: int = 2) -> CostEstimate:
+    """Paged decode, grid (B, KV, pages): the K/V page blocks are
+    fetched once per (batch row, kv head, page) — the whole allocated
+    table unless pages_per_seq narrows it."""
+    return _paged_cost(B, H, KV, D, context, page_size, pages_per_seq,
+                       dtype_bytes)
+
+
+@register_cost("paged_decode_attention_v2")
+def _c_paged_v2(*, B: int, H: int, KV: int, D: int, context: int,
+                page_size: int, pages_per_seq: Optional[int] = None,
+                dtype_bytes: int = 2) -> CostEstimate:
+    """v2 keeps K/V in HBM and double-buffers page groups by manual DMA;
+    the per-launch HBM traffic model is the same as v1 (every live page
+    crosses once per (b, kv head))."""
+    return _paged_cost(B, H, KV, D, context, page_size, pages_per_seq,
+                       dtype_bytes)
+
+
+@register_cost("ragged_paged_attention")
+def _c_ragged(*, T: int, H: int, KV: int, D: int, S: int,
+              pages_per_seq: int, page_size: int,
+              dtype_bytes: int = 2) -> CostEstimate:
+    """Ragged mixed prefill+decode, grid (KV, S, pages): the whole
+    [T*rep, D] query group of one KV head stays VMEM-resident across the
+    head's page sweep (read once per head), K/V pages fetched once per
+    (kv head, sequence, page)."""
+    rep = H // KV
+    q = KV * T * rep * D * dtype_bytes
+    kv = 2 * KV * S * pages_per_seq * page_size * D * dtype_bytes
+    out = KV * T * rep * D * dtype_bytes
+    ctx = pages_per_seq * page_size
+    return CostEstimate(bytes_read=q + kv, bytes_written=out,
+                        flops=4 * T * H * ctx * D + 6 * T * H * ctx,
+                        breakdown={"kv": kv, "activations": q + out})
+
+
+@register_cost("mla_decode_attention")
+def _c_mla(*, B: int, nh: int, r: int, dr: int, context: int,
+           block_t: int = 128, dtype_bytes: int = 2) -> CostEstimate:
+    """Absorbed latent-KV decode, grid (B, nj): q_eff [1,nh,r] + q_pe
+    [1,nh,dr] resident, latent/rope cache tiles [block_t, r|dr] swept;
+    output is the [1,nh,r] latent-space read-out. The single latent
+    cache read IS the point — kv bytes = context*(r+dr), not 2*ctx*KV*D."""
+    nj = _ceil_div(context, block_t)
+    q = B * nh * (r + dr) * dtype_bytes
+    kv = B * nj * block_t * (r + dr) * dtype_bytes
+    out = B * nh * r * dtype_bytes
+    return CostEstimate(bytes_read=q + kv, bytes_written=out,
+                        flops=2 * B * nh * context * (r + dr)
+                        + 2 * B * nh * context * r + 6 * B * nh * context,
+                        breakdown={"kv": kv, "activations": q + out})
+
+
+# ---------------------------------------------------------------------------
+# matmul-family kernels
+# ---------------------------------------------------------------------------
+
+@register_cost("gmm")
+def _c_gmm(*, M: int, K: int, N: int, G: int, block_m: int = 128,
+           block_n: int = 128, dtype_bytes: int = 2) -> CostEstimate:
+    """Grouped GEMM lhs [M,K] x rhs [G,K,N]: useful traffic — every
+    expert's weight slab crosses once per n-block sweep, lhs rows once
+    per n-block (pl.when elides the non-overlapping group blocks, so
+    this is the dense-equivalent lower bound, not grid x block)."""
+    nn = max(N // min(block_n, N), 1)
+    lhs = M * K * nn * dtype_bytes
+    rhs = G * K * N * dtype_bytes
+    out = M * N * dtype_bytes
+    return CostEstimate(bytes_read=lhs + rhs, bytes_written=out,
+                        flops=2 * M * K * N,
+                        breakdown={"weights": rhs,
+                                   "activations": lhs + out})
+
+
+@register_cost("int4_dequantize")
+def _c_int4_dequantize(*, K: int, N: int) -> CostEstimate:
+    """Packed int4 [K/2, N] + scale [N] -> f32 [K, N] in VMEM."""
+    read = (K // 2) * N + N * 4
+    return CostEstimate(bytes_read=read, bytes_written=K * N * 4,
+                        flops=2 * K * N,
+                        breakdown={"weights": read})
+
+
+@register_cost("weight_only_linear")
+def _c_weight_only_linear(*, M: int, K: int, N: int,
+                          algo: str = "weight_only_int8",
+                          dtype_bytes: int = 2) -> CostEstimate:
+    """x [M,K] @ dequant(qw) [K,N]: the weight read stays quantized
+    (int8: K*N bytes, int4: K*N/2) — the bandwidth win of the family."""
+    if algo == "weight_only_int8":
+        w = K * N
+    elif algo == "weight_only_int4":
+        w = (K // 2) * N
+    else:
+        raise ValueError(f"unknown algo: {algo}")
+    w += N * 4                                 # per-channel f32 scales
+    x = M * K * dtype_bytes
+    out = M * N * dtype_bytes
+    return CostEstimate(bytes_read=x + w, bytes_written=out,
+                        flops=2 * M * K * N + 2 * K * N,
+                        breakdown={"weights": w, "activations": x + out})
+
+
+# ---------------------------------------------------------------------------
+# composite budgets — the shared cost vocabulary
+# ---------------------------------------------------------------------------
+
+def kv_bytes_per_token_layer(family: str, *, kv_heads: int = 0,
+                             head_dim: int = 0, kv_latent_dim: int = 0,
+                             kv_dtype_bytes: int = 2) -> int:
+    """HBM bytes of cache READ per context token per layer at decode:
+    K+V rows for the attention families, the single [latent|rope] row
+    for mla (read once — the absorbed decode's whole advantage)."""
+    if family == "mla":
+        if not kv_latent_dim:
+            raise ValueError("mla needs kv_latent_dim "
+                             "(kv_lora_rank + qk_rope_head_dim)")
+        return kv_latent_dim * kv_dtype_bytes
+    if not (kv_heads and head_dim):
+        raise ValueError(f"{family} needs kv_heads and head_dim")
+    return 2 * kv_heads * head_dim * kv_dtype_bytes
+
+
+def decode_step_budget(family: str = "llama", *, batch: int,
+                       context: float, layers: int, weight_bytes: int,
+                       kv_heads: int = 0, head_dim: int = 0,
+                       kv_latent_dim: int = 0, kv_dtype_bytes: int = 2,
+                       page_size: Optional[int] = None,
+                       spec_rows: int = 1) -> Dict[str, Any]:
+    """HBM budget of ONE decode step (every weight byte + every live
+    cache byte crosses once): the serving roofline's denominator.
+
+    ``page_size=None`` counts cache rows exactly (the naive roofline
+    SERVING_BENCH committed); an int rounds each sequence up to whole
+    pages (what the paged kernels actually transfer).  ``spec_rows`` > 1
+    scales the attention read for speculative-decode verify rows.
+    """
+    per_tok = kv_bytes_per_token_layer(
+        family, kv_heads=kv_heads, head_dim=head_dim,
+        kv_latent_dim=kv_latent_dim, kv_dtype_bytes=kv_dtype_bytes)
+    if page_size is None:
+        kv_seq = per_tok * float(context) * layers
+    else:
+        kv_seq = per_tok * _ceil_div(int(math.ceil(context)),
+                                     page_size) * page_size * layers
+    kv_step = batch * kv_seq * max(spec_rows, 1)
+    total = weight_bytes + kv_step
+    return {"family": family, "batch": batch, "context": float(context),
+            "weight_bytes": int(weight_bytes),
+            "kv_bytes_per_seq": kv_seq,
+            "kv_bytes": kv_step,
+            "bytes_per_step": total,
+            "bytes_per_token": total / max(batch, 1),
+            "kv_bytes_per_token_layer": per_tok}
+
+
+def roofline_tokens_per_s(budget: Mapping[str, Any],
+                          hbm_bw: float = HBM_BW["v5e"]) -> float:
+    """Bandwidth-bound decode throughput for a `decode_step_budget`:
+    batch tokens emerge per step, one step moves bytes_per_step."""
+    return budget["batch"] * hbm_bw / budget["bytes_per_step"]
+
+
+def decode_layer_kernels(family: str = "llama", *, batch: int,
+                         context: int, hidden: int, heads: int,
+                         kv_heads: int, head_dim: int,
+                         intermediate: int, page_size: int,
+                         kv_dtype_bytes: int = 2,
+                         weight_bytes_per_layer: int = 0,
+                         quant_algo: Optional[str] = None
+                         ) -> Dict[str, Any]:
+    """Per-kernel decomposition of one decode layer body (the ~6-kernel
+    chain ROADMAP item 1 fuses against): {kernel: (launches_per_layer,
+    CostEstimate at this shape)}.
+
+    The projection matmuls (qkv / o-proj / ffn) route through
+    `weight_only_linear` when ``quant_algo`` is set; in bf16 they are
+    XLA dots, reported under the pseudo-kernel ``xla_projections`` so
+    the layer's weight traffic still lands in the ledger (pass
+    ``weight_bytes_per_layer`` from the real weight tree).
+    """
+    B, D, KV, Hq = batch, head_dim, kv_heads, heads
+    kernels: Dict[str, Any] = {
+        "fused_rms_norm": (2, cost("fused_rms_norm", T=B, H=hidden)),
+        "fused_rope_append": (1, cost(
+            "fused_rope_append", T=B, Hq=Hq, KV=KV, D=D,
+            page_size=page_size, dtype_bytes=kv_dtype_bytes)),
+        "ragged_paged_attention": (1, cost(
+            "ragged_paged_attention", T=B, H=Hq, KV=KV, D=D, S=B,
+            pages_per_seq=_ceil_div(context, page_size),
+            page_size=page_size, dtype_bytes=kv_dtype_bytes)),
+        "swiglu": (1, cost("swiglu", T=B, H=intermediate)),
+    }
+    # projection traffic: every weight byte of the layer crosses once
+    # per step plus the token activations each way
+    proj_flops = 2 * B * hidden * (Hq * D + 2 * KV * D + hidden
+                                   + 3 * intermediate)
+    act = B * hidden * 2 * 6                  # in/out rows of ~6 matmuls
+    proj = CostEstimate(
+        bytes_read=int(weight_bytes_per_layer) + act,
+        bytes_written=act, flops=proj_flops,
+        breakdown={"weights": int(weight_bytes_per_layer),
+                   "activations": 2 * act})
+    if quant_algo is not None:
+        kernels["weight_only_linear"] = (6, proj)
+    else:
+        kernels["xla_projections"] = (6, proj)
+    return {"family": family, "kernels": kernels,
+            "launches_per_layer": sum(n for n, _ in kernels.values())}
+
+
+def pretrain_step_budget(*, n_params: int, tokens: int,
+                         layers: int = 0, hidden: int = 0,
+                         seq_len: int = 0, dtype_bytes: int = 2,
+                         opt_state_bytes_per_param: int = 12
+                         ) -> Dict[str, Any]:
+    """6N FLOPs ledger + coarse HBM decomposition of one train step:
+    weights cross ~3x (fwd read, bwd read, grad write), the AdamW state
+    (f32 master + 2 moments = 12 B/param) crosses twice, activations ~
+    2 * tokens * hidden * layers * dtype each way when the shape is
+    given.  The FLOPs side is the MFU contract: 6 * n_params per token
+    (+ the 12*L*s*H attention term when layers/seq/hidden are known)."""
+    flops_tok = 6 * n_params
+    if layers and hidden and seq_len:
+        flops_tok += 12 * layers * seq_len * hidden
+    weights = 3 * n_params * dtype_bytes
+    opt = 2 * n_params * opt_state_bytes_per_param
+    acts = (4 * tokens * hidden * layers * dtype_bytes
+            if layers and hidden else 0)
+    return {"flops_per_token": flops_tok,
+            "flops_per_step": flops_tok * tokens,
+            "weights_bytes": weights, "optimizer_bytes": opt,
+            "activation_bytes": acts,
+            "bytes_per_step": weights + opt + acts,
+            "tokens": tokens}
+
+
+def flops_per_sample(*, n_params: int, tokens_per_sample: int,
+                     layers: int = 0, hidden: int = 0) -> float:
+    """The trainer's MFU numerator when TrainingArguments doesn't pin
+    flops_per_sample: 6N (+ attention term) per token, fwd+bwd."""
+    b = pretrain_step_budget(n_params=n_params, tokens=tokens_per_sample,
+                             layers=layers, hidden=hidden,
+                             seq_len=tokens_per_sample)
+    return float(b["flops_per_step"])
+
+
+def train_mfu(*, tokens_per_s: float, n_params: int,
+              peak_flops: float = PEAK_FLOPS["v5e"],
+              flops_per_token: Optional[float] = None) -> float:
+    """Model FLOPs utilization from the same 6N registry the serving
+    roofline uses — train and serve share one cost vocabulary."""
+    f = flops_per_token if flops_per_token is not None else 6 * n_params
+    return tokens_per_s * f / peak_flops
+
+
+# ---------------------------------------------------------------------------
+# array-tree accounting
+# ---------------------------------------------------------------------------
+
+def tree_bytes(tree: Any) -> int:
+    """Total storage bytes of every array leaf (duck-typed: anything
+    with .size and .dtype.itemsize counts; config/str leaves don't)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = getattr(leaf, "size", None)
+        dt = getattr(leaf, "dtype", None)
+        if size is not None and dt is not None:
+            total += int(size) * int(getattr(dt, "itemsize", 0)
+                                     or dt.itemsize)
+    return total

@@ -217,6 +217,8 @@ class TraceRecorder:
         self._lock = threading.Lock()
         self._live: Dict[Any, RequestTrace] = {}
         self._done: deque = deque(maxlen=int(capacity))
+        self._capacity = int(capacity)
+        self._counters: Dict[str, deque] = {}
         self._host_span: Optional[str] = None
         self._export_f = None
         self._export_thread: Optional[threading.Thread] = None
@@ -289,7 +291,45 @@ class TraceRecorder:
         with self._lock:
             self._host_span = span_id
 
+    def counter(self, name: str, value, t_us: Optional[int] = None) -> None:
+        """Record one sample on a named counter track — a (t, value)
+        point rendered as a chrome-trace ``ph:"C"`` counter series on
+        the same timeline as the request spans (the live HBM accounting
+        view ISSUE 11 adds: weights / page pool / draft state /
+        utilization). Bounded per series by the ring capacity."""
+        if not _FLAG.value:
+            return
+        t = _now_us() if t_us is None else int(t_us)
+        with self._lock:
+            series = self._counters.get(name)
+            if series is None:
+                series = self._counters[name] = deque(
+                    maxlen=self._capacity)
+            series.append((t, float(value)))
+
+    def sample_gauges(self, names: Sequence[str], reg=None) -> int:
+        """Sample current registry gauge values onto counter tracks (one
+        `counter()` point per gauge that exists). The engine calls this
+        at the end of every step, so the exporter's counter tracks move
+        in lockstep with the span timeline. Returns the sampled count."""
+        if not _FLAG.value:
+            return 0
+        reg = reg or registry()
+        n = 0
+        for name in names:
+            m = reg._metrics.get(name)
+            if m is None or m.kind != "gauge":
+                continue
+            self.counter(name, m.value)
+            n += 1
+        return n
+
     # -------------------------------------------------------------- queries
+    def counters(self) -> Dict[str, List[Tuple[int, float]]]:
+        """Snapshot of every counter track: {name: [(t_us, value), ...]}."""
+        with self._lock:
+            return {k: list(v) for k, v in self._counters.items()}
+
     def trace(self, request_id) -> Optional[RequestTrace]:
         """Most recent trace for `request_id`: live first, then the
         newest matching finished one."""
@@ -315,6 +355,7 @@ class TraceRecorder:
         with self._lock:
             self._live.clear()
             self._done.clear()
+            self._counters.clear()
             self._pending_export.clear()
             self._host_span = None
 
@@ -325,12 +366,16 @@ class TraceRecorder:
         request/step, an enclosing lifetime span named
         ``<kind>:<id>[span=<span_id>]`` (the observability.span naming
         convention, so ids join against host-profiler exports), phase
-        spans (queue / prefill / decode or the trainer phases), and an
-        instant per point event. Returns the event count; the file
+        spans (queue / prefill / decode or the trainer phases), an
+        instant per point event, and one ``ph:"C"`` counter event per
+        counter-track sample (gauge series — page-pool utilization,
+        HBM accounting — rendered by Perfetto as value-over-time tracks
+        on the same clock). Returns the event count; the file
         round-trips through `profiler.load_profiler_result`."""
         with self._lock:
             traces = list(self._done) + \
                 (list(self._live.values()) if include_live else [])
+            counters = {k: list(v) for k, v in self._counters.items()}
         pid = os.getpid()
         events: List[Dict[str, Any]] = []
         for tid, tr in enumerate(traces, start=1):
@@ -355,6 +400,11 @@ class TraceRecorder:
                         rec.update(ph="X", dur=int(dur),
                                    ts=e.t_us - int(dur), cat="phase")
                 events.append(rec)
+        for name, series in sorted(counters.items()):
+            for t, v in series:
+                events.append({"name": name, "ph": "C", "pid": pid,
+                               "ts": t, "cat": "counter",
+                               "args": {"value": v}})
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         with open(path, "w", encoding="utf-8") as f:

@@ -44,6 +44,7 @@ import numpy as np
 
 from .. import observability as _obs
 from .. import resilience as _res
+from ..observability import costmodel as _costmodel
 from ..observability import tracing as _tracing
 from ..generation import (_decode_params, _dq, _ffn_apply, _llama_weights,
                           _mm_w)
@@ -77,7 +78,39 @@ _PREEMPTIONS = _obs.registry().counter(
     "serving.engine.preemptions",
     "low-priority decodes re-queued (pages intact) for a higher-"
     "priority arrival")
+_G_HBM_WEIGHTS = _obs.registry().gauge(
+    "serving.engine.hbm_weights_bytes",
+    "resident decode weight-tree bytes (costmodel.tree_bytes)")
+_G_HBM_POOL = _obs.registry().gauge(
+    "serving.engine.hbm_page_pool_bytes",
+    "resident KV page-pool bytes: layers x planes x kv_heads x "
+    "num_pages x page_size x head_dim x itemsize")
+_G_HBM_DRAFT = _obs.registry().gauge(
+    "serving.engine.hbm_draft_bytes",
+    "spec-decode draft state staged this step: draft + verify token "
+    "ids for every extra row of the unified launch")
+_G_BPT_MODEL = _obs.registry().gauge(
+    "serving.engine.bytes_per_token_model",
+    "cumulative costmodel.decode_step_budget bytes (evaluated at each "
+    "step's batch and mean live context) / tokens processed")
+_G_BPT_MEASURED = _obs.registry().gauge(
+    "serving.engine.bytes_per_token_measured",
+    "cumulative launch ledger / tokens processed: weight tree once "
+    "per device launch + page-granular cache reads at actual lengths")
 _TRACE = _tracing.recorder()
+
+#: gauges sampled onto the chrome-trace counter tracks after each step
+_COUNTER_GAUGES = (
+    "serving.engine.active_slots", "serving.engine.waiting",
+    "serving.engine.pages_used", "serving.engine.pages_free",
+    "serving.engine.page_utilization",
+    "serving.engine.page_fragmentation",
+    "serving.engine.hbm_weights_bytes",
+    "serving.engine.hbm_page_pool_bytes",
+    "serving.engine.hbm_draft_bytes",
+    "serving.engine.bytes_per_token_model",
+    "serving.engine.bytes_per_token_measured",
+)
 
 
 def _lcp(a: np.ndarray, b: np.ndarray) -> int:
@@ -205,6 +238,26 @@ class ServingEngine:
         self.spec_k = int(spec_decode) if self.ragged else 0
         self.launches = 0      # device program launches by THIS engine
 
+        # live HBM accounting (ISSUE 11): static residency is published
+        # once; a cumulative analytical ledger turns each launch into
+        # measured bytes, divided by tokens processed for the
+        # bytes-per-token gauge the observatory checks against the
+        # costmodel budget
+        self._kv_geom = (kv, d)
+        self._kv_itemsize = int(jnp.dtype(dt).itemsize)
+        planes = 1 if self._family == "mla" else 2
+        self._hbm_weights_bytes = _costmodel.tree_bytes(self._w)
+        self._hbm_pool_bytes = (n_layers * planes * kv * self.num_pages
+                                * self.page_size * d * self._kv_itemsize)
+        self._ledger_bytes = 0.0
+        self._ledger_model_bytes = 0.0
+        self._ledger_tokens = 0
+        self._ledger_launches = 0   # self.launches at the last account
+        if _obs.enabled():
+            _G_HBM_WEIGHTS.set(self._hbm_weights_bytes)
+            _G_HBM_POOL.set(self._hbm_pool_bytes)
+            _G_HBM_DRAFT.set(0)
+
         # the fixed-shape programs: built ONCE here, never in the step
         # loop (paddlelint PT002)
         if self.ragged:
@@ -290,8 +343,83 @@ class ServingEngine:
         if _obs.enabled():
             _ACTIVE.set(self.scheduler.inflight)
             _WAITING.set(len(self.scheduler.waiting))
+            self._account_step(out)
         self.allocator.publish_gauges()
+        if _obs.enabled():
+            # counter tracks move in lockstep with the step spans
+            _TRACE.sample_gauges(_COUNTER_GAUGES)
         return out
+
+    # ------------------------------------------------- HBM accounting
+    def _account_step(self, out: Dict[str, int]) -> None:
+        """Fold this step's launches into the measured bytes-per-token
+        ledger and refresh the costmodel budget gauge (ISSUE 11).
+
+        Measured = analytical bytes at the step's ACTUAL geometry: the
+        weight tree once per device launch plus page-granular cache
+        reads at each live slot's current length (what the paged/ragged
+        kernels really transfer), cumulative over the engine's life.
+        Model = `decode_step_budget` at the same batch and the MEAN
+        context.  The two agree up to page rounding and prefill chunks
+        riding the unified launch — the slack the observatory's 25%
+        gate allows."""
+        kv, d = self._kv_geom
+        n_layers = len(self._p["layers"])
+        per_tok = _costmodel.kv_bytes_per_token_layer(
+            self._family, kv_heads=kv, head_dim=d,
+            kv_latent_dim=(d if self._family == "mla" else 0),
+            kv_dtype_bytes=self._kv_itemsize)
+        lens = [self.allocator.seq_length(req.request_id)
+                for _, req in self.scheduler.active()
+                if self.allocator.has_seq(req.request_id)]
+        spec_rows = 1 + self.spec_k
+        dl = self.launches - self._ledger_launches
+        self._ledger_launches = self.launches
+        self._ledger_tokens += (int(out["decoded"])
+                                + int(out["prefill_tokens"]))
+        if dl:
+            pages = sum(-(-ln // self.page_size) for ln in lens)
+            self._ledger_bytes += (
+                dl * self._hbm_weights_bytes
+                + dl * pages * self.page_size * per_tok * n_layers
+                * spec_rows)
+            if lens:
+                # the budget's view of the SAME step: one weight pass +
+                # every live cache byte at the mean context
+                budget = _costmodel.decode_step_budget(
+                    self._family, batch=len(lens),
+                    context=sum(lens) / len(lens), layers=n_layers,
+                    weight_bytes=self._hbm_weights_bytes,
+                    kv_heads=kv, head_dim=d,
+                    kv_latent_dim=(d if self._family == "mla" else 0),
+                    kv_dtype_bytes=self._kv_itemsize,
+                    page_size=self.page_size, spec_rows=spec_rows)
+                self._ledger_model_bytes += budget["bytes_per_step"]
+        if self._ledger_tokens:
+            _G_BPT_MEASURED.set(self._ledger_bytes
+                                / self._ledger_tokens)
+            _G_BPT_MODEL.set(self._ledger_model_bytes
+                             / self._ledger_tokens)
+        _G_HBM_DRAFT.set(len(lens) * self.spec_k * 2 * 4)
+
+    def hbm_accounting(self) -> Dict[str, float]:
+        """Live HBM/bandwidth ledger snapshot for the observatory:
+        static residency (weights, page pool, draft state) plus the
+        measured and model bytes-per-token the 25% acceptance check
+        compares."""
+        return {
+            "weights_bytes": float(self._hbm_weights_bytes),
+            "page_pool_bytes": float(self._hbm_pool_bytes),
+            "draft_bytes": float(_G_HBM_DRAFT.value),
+            "ledger_bytes": float(self._ledger_bytes),
+            "ledger_tokens": int(self._ledger_tokens),
+            "bytes_per_token_measured": (
+                self._ledger_bytes / self._ledger_tokens
+                if self._ledger_tokens else 0.0),
+            "bytes_per_token_model": (
+                self._ledger_model_bytes / self._ledger_tokens
+                if self._ledger_tokens else 0.0),
+        }
 
     def program_cache_sizes(self) -> Dict[str, int]:
         """{program name: compiled-variant count} for this engine's

@@ -134,6 +134,7 @@ class Trainer:
         self._last_good: Optional[Dict[str, Any]] = None
         self._preempted = False
         self._step_trace = None   # live train-step trace id (tracing)
+        self._n_params: Optional[int] = None  # costmodel MFU fallback
         paddle.seed(self.args.seed)
 
     # -- construction helpers ------------------------------------------------
@@ -254,6 +255,28 @@ class Trainer:
             return float(jnp.sqrt(sq)) if seen else None
         except Exception:
             return None
+
+    def _flops_per_sample(self, tokens_per_sample: int) -> float:
+        """MFU numerator: TrainingArguments.flops_per_sample when pinned,
+        else the 6N/token ledger from `observability.costmodel` — the
+        same registry the serving roofline reads, so train and serve
+        report from one cost vocabulary."""
+        if self.args.flops_per_sample:
+            return self.args.flops_per_sample
+        if self._n_params is None:
+            n = 0
+            try:
+                for p in self.model.parameters():
+                    a = p._data if hasattr(p, "_data") else p
+                    n += int(getattr(a, "size", 0) or 0)
+            except Exception:
+                n = 0
+            self._n_params = n
+        if not self._n_params or tokens_per_sample <= 0:
+            return 0.0
+        from ..observability import costmodel
+        return costmodel.flops_per_sample(
+            n_params=self._n_params, tokens_per_sample=tokens_per_sample)
 
     def _count_tokens(self, batch) -> int:
         """Tokens in a micro-batch for the throughput gauge: the size of
@@ -404,10 +427,12 @@ class Trainer:
                     if mx:
                         _G_SAMPPS.set(entry["samples_per_sec"])
                         _G_TOKPS.set(tokens / max(dt, 1e-9))
-                        if args.flops_per_sample and args.hardware_peak_flops:
-                            _G_MFU.set(samples * args.flops_per_sample
-                                       / max(dt, 1e-9)
-                                       / args.hardware_peak_flops)
+                        if args.hardware_peak_flops:
+                            fps = self._flops_per_sample(
+                                max(1, round(tokens / max(samples, 1))))
+                            if fps:
+                                _G_MFU.set(samples * fps / max(dt, 1e-9)
+                                           / args.hardware_peak_flops)
                 if self._preempted:
                     # log the marker BEFORE serializing so the emergency
                     # checkpoint's trainer_state.json records the preemption

@@ -369,3 +369,46 @@ class TestOverhead:
         assert min(ti) < min(tp) * 1.05, (
             f"disabled-metrics loop {min(ti):.4f}s vs plain {min(tp):.4f}s "
             f"(+{(min(ti) / min(tp) - 1) * 100:.1f}%)")
+
+    def test_disabled_counter_tracks_under_5pct(self):
+        # ISSUE 11: the per-step attribution stamps the engine adds —
+        # counter-track points and gauge sampling — must also vanish
+        # under the metrics-off gate
+        from paddle_tpu.observability import tracing as tr
+        r = Registry()
+        g = r.gauge("ov_gauge")
+        g.set(1.0)
+        rec = tr.TraceRecorder(capacity=8)
+        a = np.random.RandomState(0).randn(160, 160).astype(np.float32)
+        n = 600
+
+        def plain():
+            t0 = time.perf_counter()
+            for _ in range(n):
+                a.dot(a)
+            return time.perf_counter() - t0
+
+        def instrumented():
+            t0 = time.perf_counter()
+            for i in range(n):
+                a.dot(a)
+                rec.counter("ov.track", float(i))
+                rec.sample_gauges(("ov_gauge",), reg=r)
+            return time.perf_counter() - t0
+
+        obs.set_enabled(False)
+        tr.set_enabled(False)
+        try:
+            plain()
+            instrumented()
+            tp, ti = [], []
+            for _ in range(7):
+                tp.append(plain())
+                ti.append(instrumented())
+        finally:
+            obs.set_enabled(True)
+            tr.set_enabled(True)
+        assert rec.counters() == {}  # the flag really gated sampling
+        assert min(ti) < min(tp) * 1.05, (
+            f"disabled counter-track loop {min(ti):.4f}s vs plain "
+            f"{min(tp):.4f}s (+{(min(ti) / min(tp) - 1) * 100:.1f}%)")

@@ -1,0 +1,93 @@
+"""tools/observatory.py: the FLAGSHIP residual step-breakdown table is
+GENERATED from `attribution.train_step_attribution` over the recorded
+stats (byte-identical to the committed markdown — the hand-math era is
+over), the in-place splice is idempotent, and the seeded serving
+observatory reproduces the committed docs/OBSERVATORY.json artifact and
+its 25% measured-vs-model acceptance gate."""
+
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import observatory  # noqa: E402
+
+STATS = os.path.join(REPO, "docs", "FLAGSHIP_trace_stats.json")
+FLAGSHIP = os.path.join(REPO, "docs", "FLAGSHIP.md")
+
+
+class TestTrainMode:
+    def test_recorded_stats_regenerate_committed_table(self):
+        d, table = observatory.run_train(STATS)
+        assert d["steps"] == 8
+        assert d["wall_ms_per_step"] == pytest.approx(135.1)
+        assert d["unattributed_ms_per_step"] == pytest.approx(2.5)
+        # the regenerated markdown block is byte-identical to what
+        # FLAGSHIP.md commits — the table is generated output
+        with open(FLAGSHIP, encoding="utf-8") as f:
+            assert table in f.read()
+
+    def test_splice_is_idempotent_and_updates(self, tmp_path):
+        md = str(tmp_path / "FLAGSHIP.md")
+        shutil.copy(FLAGSHIP, md)
+        _, table = observatory.run_train(STATS)
+        assert observatory.splice_flagship_table(table, path=md) is False
+        doctored = table.replace("**135.1**", "**999.9**")
+        assert observatory.splice_flagship_table(doctored, path=md) is True
+        with open(md, encoding="utf-8") as f:
+            text = f.read()
+        assert "**999.9**" in text and "**135.1**" not in text
+        # and back again
+        assert observatory.splice_flagship_table(table, path=md) is True
+
+    def test_splice_without_table_raises(self, tmp_path):
+        md = str(tmp_path / "no_table.md")
+        with open(md, "w", encoding="utf-8") as f:
+            f.write("# nothing here\n")
+        _, table = observatory.run_train(STATS)
+        with pytest.raises(SystemExit):
+            observatory.splice_flagship_table(table, path=md)
+
+
+@pytest.mark.slow
+class TestServingMode:
+    def test_seeded_run_reproduces_committed_artifact(self, tmp_path):
+        out = str(tmp_path / "OBSERVATORY.json")
+        assert observatory.main(["--out", out]) == 0
+        with open(out, encoding="utf-8") as f:
+            art = json.load(f)
+        s = art["serving"]
+        # the acceptance gate: measured bytes/token within 25% of the
+        # costmodel budget on CPU interpret mode
+        assert 0.75 <= s["measured_over_model"] <= 1.25
+        # deterministic seed -> the analytical rows match the committed
+        # artifact exactly (this is what perf_gate bands)
+        with open(os.path.join(REPO, "docs", "OBSERVATORY.json"),
+                  encoding="utf-8") as f:
+            committed = json.load(f)
+        mine = {(k["kernel"], k["launches"], k["bytes"])
+                for k in art["kernels"]}
+        theirs = {(k["kernel"], k["launches"], k["bytes"])
+                  for k in committed["kernels"]}
+        assert mine == theirs
+        assert s["hbm_weights_bytes"] \
+            == committed["serving"]["hbm_weights_bytes"]
+        # and the fresh artifact round-trips through the perf gate
+        import perf_gate
+        assert perf_gate.main(["--repo", REPO, "--check", out]) == 0
+
+    def test_train_mode_fresh_trace(self):
+        # a fresh seeded 2-step tiny train loop attributes cleanly: all
+        # four phases present, residual non-negative, wall > 0
+        d, table = observatory.run_train(None, steps=2)
+        assert d["steps"] >= 1
+        assert d["wall_ms_per_step"] > 0
+        assert [p["phase"] for p in d["phases"]] == ["data", "fwd",
+                                                     "bwd", "opt"]
+        assert d["unattributed_ms_per_step"] >= 0
+        assert "| Phase | ms/step | % of wall |" in table

@@ -37,6 +37,26 @@ def mini_repo(tmp_path):
     return str(tmp_path)
 
 
+OBSERVATORY = {
+    "kernels": [
+        {"kernel": "ragged_paged_attention", "launches": 70,
+         "bytes": 1.8e6},
+        {"kernel": "fused_rms_norm", "launches": 140, "bytes": 3.2e5},
+    ],
+    "serving": {"bytes_per_token_model": 4e5,
+                "bytes_per_token_measured": 4.1e5,
+                "measured_over_model": 1.025},
+}
+
+
+@pytest.fixture()
+def obs_repo(mini_repo):
+    with open(os.path.join(mini_repo, "docs", "OBSERVATORY.json"),
+              "w") as f:
+        json.dump(OBSERVATORY, f)
+    return mini_repo
+
+
 class TestBands:
     def test_pretrain_band_is_union_of_runs_and_bands(self, mini_repo):
         rows = perf_gate.pretrain_rows(mini_repo, margin=0.0)
@@ -95,6 +115,84 @@ class TestCheck:
         out = perf_gate.check_candidate({"pretrain.typo_tps": 1.0}, rows)
         assert not out[0]["ok"]
         assert out[0]["why"] == "unknown metric key"
+
+
+class TestObservatoryRows:
+    """ISSUE 11: per-kernel bytes-and-launches bands over
+    docs/OBSERVATORY.json, two-sided (more traffic AND broken
+    accounting both fail)."""
+
+    def test_rows_derived_two_sided(self, obs_repo):
+        rows = perf_gate.observatory_rows(obs_repo, noise=0.10)
+        by_key = {r["key"]: r for r in rows}
+        r = by_key["observatory.kernel.ragged_paged_attention.bytes"]
+        assert r["direction"] == "both"
+        assert r["band"] == [pytest.approx(1.62e6), pytest.approx(1.98e6)]
+        assert set(by_key) >= {
+            "observatory.kernel.fused_rms_norm.launches",
+            "observatory.serving.bytes_per_token_model",
+            "observatory.serving.bytes_per_token_measured",
+            "observatory.serving.measured_over_model"}
+        # the ratio row carries the absolute 25% acceptance band
+        assert by_key["observatory.serving.measured_over_model"]["band"] \
+            == list(perf_gate.OBSERVATORY_RATIO_BAND)
+        assert all(r["ok"] for r in rows)
+
+    def test_self_check_fails_when_ratio_out_of_band(self, obs_repo):
+        art = dict(OBSERVATORY,
+                   serving=dict(OBSERVATORY["serving"],
+                                measured_over_model=1.4))
+        with open(os.path.join(obs_repo, "docs", "OBSERVATORY.json"),
+                  "w") as f:
+            json.dump(art, f)
+        assert perf_gate.main(["--repo", obs_repo]) == 1
+
+    def test_bytes_growth_fails_both_directions(self, obs_repo):
+        rows = perf_gate.gate_rows(obs_repo, noise=0.10)
+        key = "observatory.kernel.ragged_paged_attention.bytes"
+        grown = perf_gate.check_candidate({key: 1.8e6 * 1.5}, rows)
+        shrunk = perf_gate.check_candidate({key: 1.8e6 * 0.5}, rows)
+        inband = perf_gate.check_candidate({key: 1.8e6 * 1.05}, rows)
+        assert not grown[0]["ok"] and not shrunk[0]["ok"]
+        assert inband[0]["ok"]
+
+    def test_unknown_kernel_exits_one(self, obs_repo, tmp_path):
+        cand = tmp_path / "cand.json"
+        art = {"kernels": [{"kernel": "mystery", "launches": 1,
+                            "bytes": 10.0}], "serving": {}}
+        with open(cand, "w") as f:
+            json.dump(art, f)
+        assert perf_gate.main(["--repo", obs_repo,
+                               "--check", str(cand)]) == 1
+
+    def test_missing_field_exits_one(self, obs_repo, tmp_path):
+        cand = tmp_path / "cand.json"
+        art = {"kernels": [{"kernel": "ragged_paged_attention",
+                            "launches": 70}],   # bytes omitted
+               "serving": dict(OBSERVATORY["serving"])}
+        with open(cand, "w") as f:
+            json.dump(art, f)
+        assert perf_gate.main(["--repo", obs_repo,
+                               "--check", str(cand)]) == 1
+
+    def test_observatory_candidate_in_band_passes(self, obs_repo,
+                                                  tmp_path):
+        cand = tmp_path / "cand.json"
+        with open(cand, "w") as f:
+            json.dump(OBSERVATORY, f)
+        assert perf_gate.main(["--repo", obs_repo,
+                               "--check", str(cand)]) == 0
+
+    def test_committed_artifact_roundtrips(self):
+        # the real docs/OBSERVATORY.json must gate green against its
+        # own bands (the acceptance criterion)
+        path = os.path.join(REPO, "docs", "OBSERVATORY.json")
+        assert os.path.exists(path)
+        assert perf_gate.main(["--repo", REPO, "--check", path]) == 0
+
+    def test_no_observatory_artifact_is_fine(self, mini_repo):
+        assert perf_gate.observatory_rows(mini_repo) == []
+        assert perf_gate.main(["--repo", mini_repo]) == 0
 
 
 class TestCli:

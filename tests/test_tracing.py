@@ -87,6 +87,41 @@ class TestPercentile:
         assert set(row) == {"count", "mean", "p50", "p90", "p99"}
 
 
+class TestSloEdgeCases:
+    """ISSUE 11 satellite: slo_summary on degenerate histograms —
+    empty, single-bucket, and everything-in-+Inf."""
+
+    def test_empty_histogram_reports_count_zero_none_quantiles(self):
+        reg = Registry()
+        reg.histogram("slo.empty", buckets=(1.0, 2.0))
+        s = tr.slo_summary(("slo.empty",), reg=reg)
+        assert s["slo.empty"] == {"count": 0, "mean": None, "p50": None,
+                                  "p90": None, "p99": None}
+
+    def test_single_bucket_interpolates_from_zero(self):
+        h = Histogram(buckets=(2.0,))
+        for _ in range(4):
+            h.observe(1.0)
+        # one finite bucket: quantiles interpolate linearly from the
+        # implicit 0 lower edge to the single bound
+        assert tr.percentile(h, 25) == pytest.approx(0.5)
+        assert tr.percentile(h, 50) == pytest.approx(1.0)
+        assert tr.percentile(h, 100) == pytest.approx(2.0)
+
+    def test_all_observations_in_inf_bucket_clamp(self):
+        reg = Registry()
+        h = reg.histogram("slo.inf", buckets=(0.1, 1.0))
+        for _ in range(3):
+            h.observe(9.9)
+        s = tr.slo_summary(("slo.inf",), reg=reg)["slo.inf"]
+        assert s["count"] == 3
+        assert s["mean"] == pytest.approx(9.9)
+        # every quantile clamps to the largest finite bound (the
+        # Prometheus histogram_quantile convention) — the mean is the
+        # only signal the buckets were mis-sized
+        assert s["p50"] == s["p90"] == s["p99"] == pytest.approx(1.0)
+
+
 # ------------------------------------------------------------------ recorder
 
 class TestRecorder:
@@ -366,6 +401,105 @@ class TestServingStampRoundTrip:
                                 "draft", "verify_accept"}
         assert by_name["prefix_hit"]["args"]["tokens"] == 8
         assert by_name["verify_accept"]["args"]["accepted"] == 2
+
+
+class TestCounterTracks:
+    """ISSUE 11 satellite: gauge samples become ph:"C" counter events in
+    the chrome export (the PR-6 exporter dropped gauges entirely)."""
+
+    def test_counter_roundtrip(self, tmp_path):
+        rec = tr.recorder()
+        rec.counter("pool.util", 0.25, t_us=100)
+        rec.counter("pool.util", 0.75, t_us=200)
+        rec.counter("hbm.bytes", 4096, t_us=150)
+        assert rec.counters()["pool.util"] == [(100, 0.25), (200, 0.75)]
+        path = str(tmp_path / "t.json")
+        n = rec.export_chrome_trace(path)
+        events = load_profiler_result(path)
+        assert len(events) == n == 3
+        cs = [e for e in events if e["ph"] == "C"]
+        assert {(e["name"], e["ts"], e["args"]["value"]) for e in cs} \
+            == {("pool.util", 100, 0.25), ("pool.util", 200, 0.75),
+                ("hbm.bytes", 150, 4096.0)}
+        assert all(e["cat"] == "counter" for e in cs)
+
+    def test_sample_gauges_reads_registry(self):
+        reg = Registry()
+        reg.gauge("g.a", "a").set(3.5)
+        reg.gauge("g.b", "b").set(7)
+        reg.counter("g.c", "not a gauge").inc()
+        rec = tr.recorder()
+        # missing names and non-gauges are skipped, not errors
+        assert rec.sample_gauges(("g.a", "g.b", "g.c", "g.nope"),
+                                 reg=reg) == 2
+        got = rec.counters()
+        assert [v for _, v in got["g.a"]] == [3.5]
+        assert [v for _, v in got["g.b"]] == [7.0]
+        assert "g.c" not in got and "g.nope" not in got
+
+    def test_counter_disabled_is_noop(self):
+        tr.set_enabled(False)
+        try:
+            rec = tr.recorder()
+            rec.counter("x", 1.0)
+            assert rec.sample_gauges(("x",)) == 0
+            assert rec.counters() == {}
+        finally:
+            tr.set_enabled(True)
+
+    def test_counter_track_bounded_by_capacity(self):
+        rec = tr.TraceRecorder(capacity=4)
+        for i in range(10):
+            rec.counter("x", float(i), t_us=i)
+        assert [v for _, v in rec.counters()["x"]] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_clear_drops_counters(self):
+        rec = tr.recorder()
+        rec.counter("x", 1.0)
+        rec.clear()
+        assert rec.counters() == {}
+
+
+@pytest.mark.slow
+class TestEngineCounterTracks:
+    def test_engine_step_exports_hbm_counter_tracks(self, tmp_path):
+        eng, cfg = _tiny_engine()
+        eng.add_request(np.arange(5, dtype=np.int32) % cfg.vocab_size,
+                        max_new_tokens=4, request_id="c")
+        eng.run_to_completion()
+        acct = eng.hbm_accounting()
+        assert acct["weights_bytes"] > 0
+        assert acct["page_pool_bytes"] > 0
+        assert acct["ledger_tokens"] > 0
+        # the live ledger and the analytical budget agree well inside
+        # the observatory's 25% acceptance band on this seeded trace
+        ratio = (acct["bytes_per_token_measured"]
+                 / acct["bytes_per_token_model"])
+        assert 0.75 < ratio < 1.25
+        path = str(tmp_path / "t.json")
+        tr.recorder().export_chrome_trace(path)
+        events = load_profiler_result(path)
+        series = {}
+        for e in events:
+            if e["ph"] == "C":
+                series.setdefault(e["name"], []).append(
+                    e["args"]["value"])
+        for name in ("serving.engine.pages_used",
+                     "serving.engine.page_utilization",
+                     "serving.engine.page_fragmentation",
+                     "serving.engine.hbm_weights_bytes",
+                     "serving.engine.hbm_page_pool_bytes",
+                     "serving.engine.bytes_per_token_measured"):
+            assert name in series, name
+        # one sample per engine step, constant residency throughout
+        assert set(series["serving.engine.hbm_weights_bytes"]) \
+            == {acct["weights_bytes"]}
+        assert set(series["serving.engine.hbm_page_pool_bytes"]) \
+            == {acct["page_pool_bytes"]}
+        # utilization rises from empty, then drains at finish down to
+        # the pages the prefix cache retains for future prompt hits
+        util = series["serving.engine.page_utilization"]
+        assert max(util) > 0 and util[-1] < max(util)
 
 
 @pytest.mark.slow

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Roofline observatory (ISSUE 11): one seeded run -> where the bytes go.
+
+Serving mode (default): drives a deterministic tiny-llama serving trace
+through `ServingEngine` with request tracing on, then joins three
+ledgers that all derive from the SAME `observability.costmodel`
+registry:
+
+  - the engine's live HBM accounting (weights / page pool / draft state
+    gauges + the cumulative measured bytes-per-token ledger),
+  - the per-kernel analytical decomposition of the decode layer body
+    (`costmodel.decode_layer_kernels` x layers x device launches),
+  - the host-trace timing from `profiler.statistic.summarize` over the
+    chrome export (counter tracks ride the same file).
+
+Output: the human roofline table (kernel . launches . bytes .
+achieved/theoretical . % step time) on stdout and the machine artifact
+``docs/OBSERVATORY.json`` whose per-kernel bytes/launches rows
+`tools/perf_gate.py --check` bands. Exit 1 if the measured
+bytes-per-token disagrees with the costmodel budget by more than 25%
+(the acceptance gate this tool exists to hold).
+
+Train mode (``--train``): the FLAGSHIP residual step-breakdown table is
+*generated* from `attribution.train_step_attribution`, not hand math —
+``--stats docs/FLAGSHIP_trace_stats.json`` replays the recorded
+flagship phase stats (regenerating the committed FLAGSHIP.md table
+verbatim; ``--write-docs`` splices it in place), while without
+``--stats`` a fresh seeded tiny train loop is traced and attributed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FLAGSHIP_MD = os.path.join(REPO, "docs", "FLAGSHIP.md")
+
+
+# ---------------------------------------------------------------------------
+# serving observatory
+# ---------------------------------------------------------------------------
+
+def run_serving(requests: int = 4, prompt_len: int = 8,
+                new_tokens: int = 32, max_slots: int = 4,
+                page_size: int = 4, layers: int = 2):
+    """Seeded decode-heavy trace on the tiny llama; returns the
+    observatory artifact dict."""
+    import paddle_tpu as paddle
+    from paddle_tpu import serving as srv
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    from paddle_tpu.observability import attribution, costmodel
+    from paddle_tpu.observability import tracing as tr
+    from paddle_tpu.profiler import statistic
+
+    tr.set_enabled(True)
+    tr.recorder().clear()
+    cfg = llama_tiny_config(num_hidden_layers=layers)
+    paddle.seed(0)
+    eng = srv.ServingEngine(LlamaForCausalLM(cfg), max_slots=max_slots,
+                            page_size=page_size, prefill_chunk=prompt_len)
+    rng = np.random.RandomState(0)
+    for i in range(requests):
+        eng.add_request(
+            rng.randint(0, cfg.vocab_size, prompt_len).astype(np.int32),
+            max_new_tokens=new_tokens, request_id=i)
+    eng.run_to_completion()
+    acct = eng.hbm_accounting()
+    steps = eng.launches
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "trace.json")
+        tr.recorder().export_chrome_trace(path)
+        stat = statistic.summarize(path)
+
+    # per-kernel decomposition from the SAME registry the engine ledger
+    # uses: one decode layer body x layers x device launches
+    context = prompt_len + new_tokens / 2          # mean over the trace
+    layer = costmodel.decode_layer_kernels(
+        "llama", batch=max_slots, context=int(context),
+        hidden=cfg.hidden_size, heads=cfg.num_attention_heads,
+        kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
+        intermediate=cfg.intermediate_size, page_size=page_size,
+        weight_bytes_per_layer=int(acct["weights_bytes"] // layers))
+    launches = {name: n * layers * steps
+                for name, (n, _) in layer["kernels"].items()}
+    rows = attribution.attribute(stat, layer["kernels"],
+                                 launches=launches)
+    table = attribution.render_roofline_table(rows)
+
+    measured, model = (acct["bytes_per_token_measured"],
+                       acct["bytes_per_token_model"])
+    ratio = measured / model if model else 0.0
+    return {
+        "generated_by": "tools/observatory.py",
+        "scenario": {
+            "model": f"llama_tiny x{layers}L (h{cfg.hidden_size}, "
+                     f"{cfg.num_attention_heads}q/"
+                     f"{cfg.num_key_value_heads}kv d{cfg.head_dim})",
+            "requests": requests, "prompt_len": prompt_len,
+            "new_tokens": new_tokens, "max_slots": max_slots,
+            "page_size": page_size, "device_steps": steps,
+        },
+        "serving": {
+            "bytes_per_token_model": model,
+            "bytes_per_token_measured": measured,
+            "measured_over_model": ratio,
+            "ledger_tokens": acct["ledger_tokens"],
+            "hbm_weights_bytes": acct["weights_bytes"],
+            "hbm_page_pool_bytes": acct["page_pool_bytes"],
+            "hbm_draft_bytes": acct["draft_bytes"],
+        },
+        "kernels": rows,
+        "table": table,
+    }
+
+
+# ---------------------------------------------------------------------------
+# train observatory (the FLAGSHIP residual table, generated)
+# ---------------------------------------------------------------------------
+
+def run_train(stats_path=None, steps: int = 4):
+    """train_step_attribution over recorded stats (``--stats``) or a
+    fresh seeded tiny train trace; returns (attribution dict, table)."""
+    from paddle_tpu.observability import attribution
+
+    if stats_path:
+        with open(stats_path, encoding="utf-8") as f:
+            stat = json.load(f)
+    else:
+        import tempfile as _tf
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        from paddle_tpu.observability import tracing as tr
+        from paddle_tpu.profiler import statistic
+        from paddle_tpu.trainer.trainer import Trainer, TrainingArguments
+
+        tr.set_enabled(True)
+        tr.recorder().clear()
+        cfg = llama_tiny_config(num_hidden_layers=1)
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        batch, seq = 2, 16
+        # per-SAMPLE dicts: the loader stacks `batch` of them per step
+        # and `labels` makes the model forward return (loss, logits)
+        data = [{"input_ids": (ids := rng.randint(
+                     0, cfg.vocab_size, seq).astype(np.int32)),
+                 "labels": ids.copy()}
+                for _ in range(batch * steps)]
+        with _tf.TemporaryDirectory() as d:
+            args = TrainingArguments(
+                output_dir=d, per_device_train_batch_size=batch,
+                max_steps=steps, logging_steps=0)
+            Trainer(model=LlamaForCausalLM(cfg), args=args,
+                    train_dataset=data).train()
+            path = os.path.join(d, "trace.json")
+            tr.recorder().export_chrome_trace(path)
+            stat = statistic.summarize(path)
+    d = attribution.train_step_attribution(stat)
+    return d, attribution.render_flagship_table(d)
+
+
+def splice_flagship_table(table: str, path: str = FLAGSHIP_MD) -> bool:
+    """Replace the residual-breakdown markdown table in FLAGSHIP.md with
+    the regenerated one. Returns True when the file changed."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    pat = re.compile(r"\| Phase \| ms/step \| % of wall \|\n"
+                     r"(?:\|[^\n]*\|\n)+")
+    new, n = pat.subn(table + "\n", text, count=1)
+    if not n:
+        raise SystemExit(f"observatory: no residual table in {path}")
+    if new == text:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "docs",
+                                                  "OBSERVATORY.json"))
+    ap.add_argument("--train", action="store_true",
+                    help="attribute a train step instead of serving")
+    ap.add_argument("--stats", metavar="STATS.json",
+                    help="train mode: replay recorded summarize() stats "
+                         "instead of running a fresh trace")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="train mode: splice the regenerated table into "
+                         "docs/FLAGSHIP.md")
+    ap.add_argument("--requests", type=int, default=4)
+    ap.add_argument("--new-tokens", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=4,
+                    help="train-mode warm steps")
+    args = ap.parse_args(argv)
+
+    if args.train:
+        d, table = run_train(args.stats, steps=args.steps)
+        print(table)
+        print(f"\nobservatory: {d['steps']} steps, "
+              f"{d['wall_ms_per_step']:.1f} ms/step, "
+              f"{d['unattributed_pct']:.1f}% unattributed")
+        if args.write_docs:
+            changed = splice_flagship_table(table)
+            print(f"observatory: docs/FLAGSHIP.md "
+                  f"{'updated' if changed else 'already current'}")
+        return 0
+
+    art = run_serving(requests=args.requests, new_tokens=args.new_tokens)
+    print(art["table"])
+    s = art["serving"]
+    print(f"\nbytes/token: model {s['bytes_per_token_model']:.0f}  "
+          f"measured {s['bytes_per_token_measured']:.0f}  "
+          f"(x{s['measured_over_model']:.3f})")
+    print(f"HBM residency: weights {s['hbm_weights_bytes']:.0f}B, "
+          f"page pool {s['hbm_page_pool_bytes']:.0f}B, "
+          f"draft {s['hbm_draft_bytes']:.0f}B")
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(art, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"observatory: wrote {os.path.relpath(args.out, REPO)}")
+    if not 0.75 <= s["measured_over_model"] <= 1.25:
+        print("observatory: FAIL measured bytes/token outside 25% of "
+              "the costmodel budget", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

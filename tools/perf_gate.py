@@ -55,6 +55,16 @@ SERVING_FIELDS = ("decode_tokens_per_s_per_chip", "prefill_tokens_per_s",
                   "cache_on_tokens_per_s", "prefix_hit_rate",
                   "spec_tokens_per_s", "accepted_tokens_per_verify_step")
 
+# OBSERVATORY.json per-kernel fields gated per row (ISSUE 11). These are
+# two-sided: bytes or launches GROWING past the band means new HBM
+# traffic / extra dispatches snuck into the decode step, while falling
+# below it means the cost accounting itself broke — both are findings.
+OBSERVATORY_KERNEL_FIELDS = ("bytes", "launches")
+OBSERVATORY_SERVING_FIELDS = ("bytes_per_token_model",
+                              "bytes_per_token_measured")
+#: absolute acceptance band for measured/model bytes-per-token agreement
+OBSERVATORY_RATIO_BAND = (0.75, 1.25)
+
 
 def _load(path: str) -> Optional[Dict[str, Any]]:
     try:
@@ -138,9 +148,60 @@ def serving_rows(repo: str = REPO, noise: float = 0.15
     return out
 
 
+def _judge(value: float, band: List[float], direction: str) -> bool:
+    if direction == "both":
+        return band[0] <= value <= band[1]
+    if direction == "lower":
+        return value <= band[1]
+    return value >= band[0]
+
+
+def observatory_rows(repo: str = REPO, noise: float = 0.15
+                     ) -> List[Dict[str, Any]]:
+    """Per-kernel bytes-and-launches bands from docs/OBSERVATORY.json
+    (ISSUE 11) plus the bytes-per-token pair and the measured/model
+    agreement ratio (absolute band — the committed artifact must itself
+    satisfy the 25% acceptance gate, so self-check can fail here)."""
+    art = _load(os.path.join(repo, "docs", "OBSERVATORY.json"))
+    if not art:
+        return []
+    src = "docs/OBSERVATORY.json"
+    out = []
+    for k in art.get("kernels", []):
+        if not isinstance(k, dict) or not k.get("kernel"):
+            continue
+        for field in OBSERVATORY_KERNEL_FIELDS:
+            v = k.get(field)
+            if not isinstance(v, (int, float)) or v <= 0:
+                continue
+            v = float(v)
+            out.append({"key": f"observatory.kernel.{k['kernel']}.{field}",
+                        "value": v, "direction": "both",
+                        "band": [v * (1.0 - noise), v * (1.0 + noise)],
+                        "source": src, "ok": True})
+    srv = art.get("serving") or {}
+    for field in OBSERVATORY_SERVING_FIELDS:
+        v = srv.get(field)
+        if isinstance(v, (int, float)) and v > 0:
+            v = float(v)
+            out.append({"key": f"observatory.serving.{field}", "value": v,
+                        "direction": "both",
+                        "band": [v * (1.0 - noise), v * (1.0 + noise)],
+                        "source": src, "ok": True})
+    ratio = srv.get("measured_over_model")
+    if isinstance(ratio, (int, float)):
+        band = list(OBSERVATORY_RATIO_BAND)
+        out.append({"key": "observatory.serving.measured_over_model",
+                    "value": float(ratio), "direction": "both",
+                    "band": band, "source": src,
+                    "ok": _judge(float(ratio), band, "both")})
+    return out
+
+
 def gate_rows(repo: str = REPO, margin: float = 0.01,
               noise: float = 0.15) -> List[Dict[str, Any]]:
-    return pretrain_rows(repo, margin) + serving_rows(repo, noise)
+    return (pretrain_rows(repo, margin) + serving_rows(repo, noise)
+            + observatory_rows(repo, noise))
 
 
 def check_candidate(candidate: Dict[str, float],
@@ -160,14 +221,47 @@ def check_candidate(candidate: Dict[str, float],
                         "source": "candidate", "ok": False,
                         "why": "unknown metric key"})
             continue
+        direction = base.get("direction", "higher")
         r = dict(base, value=float(val))
-        r["ok"] = float(val) >= r["band"][0]
+        r["ok"] = _judge(float(val), r["band"], direction)
         if not r["ok"]:
-            r["why"] = (f"regressed below band floor "
-                        f"{r['band'][0]:.1f} (committed "
-                        f"{base['value']:.1f})")
+            word = ("outside band" if direction == "both"
+                    else "regressed below band floor")
+            r["why"] = (f"{word} [{r['band'][0]:.3g}, "
+                        f"{r['band'][1]:.3g}] (committed "
+                        f"{base['value']:.3g})")
         out.append(r)
     return out
+
+
+def flatten_observatory(art: Dict[str, Any]
+                        ) -> Tuple[Dict[str, float],
+                                   List[Dict[str, Any]]]:
+    """Turn an OBSERVATORY.json-shaped candidate into {metric_key:
+    value} plus pre-failed rows for every kernel entry missing a gated
+    field (a candidate that stops reporting bytes must not pass by
+    omission)."""
+    flat: Dict[str, float] = {}
+    bad: List[Dict[str, Any]] = []
+    for k in art.get("kernels", []):
+        name = (k.get("kernel") if isinstance(k, dict) else None) \
+            or "<unnamed>"
+        for field in OBSERVATORY_KERNEL_FIELDS:
+            v = k.get(field) if isinstance(k, dict) else None
+            if isinstance(v, (int, float)):
+                flat[f"observatory.kernel.{name}.{field}"] = float(v)
+            else:
+                bad.append({"key": f"observatory.kernel.{name}.{field}",
+                            "value": None, "band": None,
+                            "source": "candidate", "ok": False,
+                            "why": f"candidate kernel row missing "
+                                   f"'{field}'"})
+    srv = art.get("serving") or {}
+    for field in OBSERVATORY_SERVING_FIELDS + ("measured_over_model",):
+        v = srv.get(field)
+        if isinstance(v, (int, float)):
+            flat[f"observatory.serving.{field}"] = float(v)
+    return flat, bad
 
 
 # ---------------------------------------------------------------------------
@@ -201,9 +295,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"perf_gate: cannot read candidate {args.check}",
                   file=sys.stderr)
             return 2
-        rows = check_candidate(
-            {k: v for k, v in cand.items()
-             if isinstance(v, (int, float))}, rows)
+        if isinstance(cand.get("kernels"), list):
+            # an OBSERVATORY.json-shaped candidate: flatten to metric
+            # keys; missing gated fields become pre-failed rows
+            flat, bad = flatten_observatory(cand)
+            rows = check_candidate(flat, rows) + bad
+        else:
+            rows = check_candidate(
+                {k: v for k, v in cand.items()
+                 if isinstance(v, (int, float))}, rows)
         if not rows:
             print("perf_gate: candidate contains no gated metrics (ok)")
             return 0
@@ -215,8 +315,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             band = (f"[{r['band'][0]:.1f}, {r['band'][1]:.1f}]"
                     if r.get("band") else "-")
             mark = "ok  " if r["ok"] else "FAIL"
-            line = (f"{mark} {r['key']:<58} {r['value']:>12.1f}  "
-                    f"band {band}")
+            val = (f"{r['value']:>12.1f}" if r["value"] is not None
+                   else f"{'-':>12}")
+            line = f"{mark} {r['key']:<58} {val}  band {band}"
             if r.get("why"):
                 line += f"  ({r['why']})"
             print(line)

@@ -51,6 +51,30 @@ def _tree_bytes(p) -> int:
                if hasattr(v, "size"))
 
 
+def _roofline(family, *, B, S0, new, n_layers, w_bytes, decode_tok_s,
+              kv_heads=0, head_dim=0, kv_latent_dim=0):
+    """Roofline fields for one bench row, derived from the shared
+    `observability.costmodel` registry (ISSUE 11: every roofline in this
+    report comes from `decode_step_budget`, never hand-inlined byte
+    math). The average KV length over the decode phase is ~S0 + new/2.
+    ``bytes_per_token_measured`` is the HBM traffic per token the
+    achieved rate implies at full bandwidth (= model / roofline
+    fraction) — the instrumented-HBM counterpart lives in the serving
+    engine's `hbm_accounting()` ledger."""
+    from paddle_tpu.observability import costmodel
+    budget = costmodel.decode_step_budget(
+        family, batch=B, context=S0 + new / 2, layers=n_layers,
+        weight_bytes=w_bytes, kv_heads=kv_heads, head_dim=head_dim,
+        kv_latent_dim=kv_latent_dim)
+    bw = _bw()
+    bound_tok_s = costmodel.roofline_tokens_per_s(budget, bw)
+    return dict(
+        roofline_tokens_per_s=round(bound_tok_s, 1),
+        roofline_fraction=round(decode_tok_s / bound_tok_s, 3),
+        bytes_per_token_model=round(budget["bytes_per_token"], 1),
+        bytes_per_token_measured=round(bw / decode_tok_s, 1))
+
+
 def _log(msg):
     print(f"[serving_bench +{time.time() - _T0:.0f}s] {msg}", file=sys.stderr,
           flush=True)
@@ -140,10 +164,9 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16",
     per_token_ms = t_decode / new * 1e3
     prefill_tok_s = B * S0 / max(t_prefill, 1e-9)
 
-    # roofline: average KV length over the decode phase ~ S0 + new/2
-    avg_len = S0 + new / 2
-    kv_read = 2 * avg_len * KV * D * 2 * len(p["layers"])
-    bound_tok_s = B * _bw() / (w_bytes + B * kv_read)
+    roof = _roofline("llama", B=B, S0=S0, new=new,
+                     n_layers=len(p["layers"]), w_bytes=w_bytes,
+                     decode_tok_s=decode_tok_s, kv_heads=KV, head_dim=D)
     wo_tag = ("int4" if weight_only_quant == "int4"
               else "int8" if (weight_only_int8 or weight_only_quant)
               else None)
@@ -169,8 +192,7 @@ def bench_decode(B=8, S0=1024, new=512, dtype="bfloat16",
         prefill_tokens_per_s=round(prefill_tok_s),
         decode_tokens_per_s_per_chip=round(decode_tok_s, 1),
         decode_ms_per_token_per_seq=round(per_token_ms, 3),
-        roofline_tokens_per_s=round(bound_tok_s, 1),
-        roofline_fraction=round(decode_tok_s / bound_tok_s, 3))
+        **roof)
 
 
 def bench_moe_decode(B=8, S0=512, new=256, dtype="bfloat16",
@@ -236,9 +258,9 @@ def bench_moe_decode(B=8, S0=512, new=256, dtype="bfloat16",
     # ~2/8 of routed expert weight bytes are LIVE per token, but a whole
     # decode step at small B still reads every routed expert touched by
     # ANY token — report the conservative all-weights bound
-    avg_len = S0 + new / 2
-    kv_read = 2 * avg_len * KV * D * 2 * len(p["layers"])
-    bound_tok_s = B * _bw() / (w_bytes + B * kv_read)
+    roof = _roofline("moe", B=B, S0=S0, new=new,
+                     n_layers=len(p["layers"]), w_bytes=w_bytes,
+                     decode_tok_s=decode_tok_s, kv_heads=KV, head_dim=D)
     return dict(
         config="moe_shard 8L h2048 E8 top2 mi1408 shared1408 (dropless "
                + ("[weight-only int8] " if weight_only_int8 else "")
@@ -249,8 +271,7 @@ def bench_moe_decode(B=8, S0=512, new=256, dtype="bfloat16",
         compile_plus_first_s=round(compile_and_first, 2),
         decode_tokens_per_s_per_chip=round(decode_tok_s, 1),
         decode_ms_per_token_per_seq=round(t_decode / new * 1e3, 3),
-        roofline_tokens_per_s=round(bound_tok_s, 1),
-        roofline_fraction=round(decode_tok_s / bound_tok_s, 3))
+        **roof)
 
 
 def _mla_bench_model(total, dtype="bfloat16", weight_only_int8=False):
@@ -341,11 +362,11 @@ def bench_mla_decode(B=8, S0=512, new=256, dtype="bfloat16",
     # this lane-aligned rank) — never a silent best-of-both (review r5)
     t_decode = max(sum(t_fused) / reps - t_prefill, 1e-9)
     decode_tok_s = B * new / t_decode
-    avg_len = S0 + new / 2
     # latent cache: (r + dr) bf16 per token per layer — the MLA win
-    kv_read = avg_len * (cfg.kv_lora_rank + cfg.qk_rope_head_dim) * 2 \
-        * len(p["layers"])
-    bound_tok_s = B * _bw() / (w_bytes + B * kv_read)
+    roof = _roofline(
+        "mla", B=B, S0=S0, new=new, n_layers=len(p["layers"]),
+        w_bytes=w_bytes, decode_tok_s=decode_tok_s,
+        kv_latent_dim=cfg.kv_lora_rank + cfg.qk_rope_head_dim)
     return dict(
         config="mla_shard 8L h2048 16h q768/kv512 nope128 rope64 v128 "
                + ("E8 top2 [weight-only int8] (absorbed latent-KV decode)"
@@ -360,8 +381,7 @@ def bench_mla_decode(B=8, S0=512, new=256, dtype="bfloat16",
         headline_impl="fused (the auto route at kv_lora_rank=512)",
         decode_tokens_per_s_per_chip=round(decode_tok_s, 1),
         decode_ms_per_token_per_seq=round(t_decode / new * 1e3, 3),
-        roofline_tokens_per_s=round(bound_tok_s, 1),
-        roofline_fraction=round(decode_tok_s / bound_tok_s, 3),
+        **roof,
         impl_ab=dict(
             note="same-run interleaved whole-loop rounds (prefill "
                  "included in both, subtracted from the headline); "
