@@ -2,19 +2,24 @@
 
 Pure-stdlib (``ast`` only): importing this package never imports jax, so
 ``tools/paddlelint.py`` can run in any environment, including CI hosts
-with no accelerator stack. Rules PT001-PT006 are documented in
-docs/ANALYSIS.md; the CLI lives in :mod:`paddle_tpu.analysis.cli`.
+with no accelerator stack. The rule families (PT/PK/PC/PS/PF) are
+documented in docs/ANALYSIS.md; the CLI lives in
+:mod:`paddle_tpu.analysis.cli`, and the static kernel-memory model
+behind the PF family in :mod:`paddle_tpu.analysis.vmemmodel`.
 """
 
 from .baseline import load as load_baseline
 from .baseline import save as save_baseline
 from .baseline import split as split_baseline
 from .callgraph import PackageIndex
-from .model import RULES, Config, Finding
+from .model import FAMILIES, RULE_MODULES, RULES, Config, Finding
 from .runner import analyze_paths, analyze_source
+from .vmemmodel import COST_DRIFT_RTOL, VMEM_BYTES_PER_CORE
 
 __all__ = [
-    "PackageIndex", "RULES", "Config", "Finding",
+    "PackageIndex", "RULES", "FAMILIES", "RULE_MODULES",
+    "Config", "Finding",
     "analyze_paths", "analyze_source",
     "load_baseline", "save_baseline", "split_baseline",
+    "COST_DRIFT_RTOL", "VMEM_BYTES_PER_CORE",
 ]

@@ -19,8 +19,13 @@ from collections import Counter
 from typing import List, Optional, Set
 
 from . import baseline as baseline_mod
-from .model import RULE_SEVERITIES, RULES, Config, rule_family
-from .runner import analyze_files, analyze_paths, discover
+from .model import (FAMILIES, RULE_MODULES, RULE_SEVERITIES, RULES, Config,
+                    rule_family)
+from .runner import (analyze_files, analyze_paths, discover,
+                     expand_changed_with_factories)
+
+#: bumped whenever the JSON layout changes shape (CI parsers key on it)
+SCHEMA_VERSION = 1
 
 #: sentinel for a bare ``--rules`` (no ids): print the rule table
 _LIST = "__list__"
@@ -30,7 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="paddlelint",
         description="TPU/JAX-aware static analysis for paddle_tpu "
-                    "(rule families PT/PK/PC/PS; see docs/ANALYSIS.md)")
+                    "(rule families PT/PK/PC/PS/PF; see docs/ANALYSIS.md)")
     p.add_argument("paths", nargs="*", default=["paddle_tpu"],
                    help="package dirs or files to analyze "
                         "(default: paddle_tpu)")
@@ -80,9 +85,23 @@ def _git_changed(ref: str) -> Optional[Set[str]]:
 
 
 def _print_rule_table() -> None:
+    """Rules grouped by family; a trailing ``<- module`` marker calls out
+    rules that live outside their family's default module (e.g. PC201)."""
+    by_fam = {}
     for rid in sorted(RULES):
-        sev = RULE_SEVERITIES.get(rid, "warning")
-        print(f"{rid}  {sev:<8}  {RULES[rid]}")
+        by_fam.setdefault(rule_family(rid), []).append(rid)
+    for fam in sorted(by_fam):
+        desc = FAMILIES.get(fam, "")
+        print(f"-- {fam}: {desc}" if desc else f"-- {fam}")
+        mods = {RULE_MODULES.get(r, "") for r in by_fam[fam]}
+        default_mod = max(mods, key=lambda m: sum(
+            1 for r in by_fam[fam] if RULE_MODULES.get(r, "") == m))
+        for rid in by_fam[fam]:
+            sev = RULE_SEVERITIES.get(rid, "warning")
+            mod = RULE_MODULES.get(rid, "")
+            note = (f"  <- {mod.rsplit('.', 1)[-1]}"
+                    if mod and mod != default_mod else "")
+            print(f"{rid}  {sev:<8}  {RULES[rid]}{note}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -111,8 +130,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "analyzing all paths", file=sys.stderr)
             findings = analyze_paths(paths, cfg)
         else:
-            files = [t for p_ in paths for t in discover(p_)
-                     if os.path.abspath(t[1]) in changed]
+            allfiles = [t for p_ in paths for t in discover(p_)]
+            files = expand_changed_with_factories(allfiles, changed)
             changed_rels = sorted(t[2] for t in files)
             findings = analyze_files(files, cfg)
     else:
@@ -175,12 +194,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             if not j.strip() or j.strip().lower().startswith("todo"))
         for k in unjustified:
             fam_of(k.split("|", 1)[0])["unjustified"].append(k)
+        # deterministic order: (rule, path, qualname) — stable across
+        # dict-ordering and pass-ordering changes so CI diffs are clean
+        fresh_sorted = sorted(fresh,
+                              key=lambda f: (f.rule, f.path, f.qualname))
         out = {
-            "findings": [f.to_dict() for f in fresh],
+            "schema_version": SCHEMA_VERSION,
+            "findings": [f.to_dict() for f in fresh_sorted],
             "baselined": len(findings) - len(fresh),
             "stale_baseline_keys": stale,
             "rules": {rid: {"description": RULES[rid],
-                            "severity": RULE_SEVERITIES.get(rid, "warning")}
+                            "severity": RULE_SEVERITIES.get(rid, "warning"),
+                            "module": RULE_MODULES.get(rid, "")}
                       for rid in sorted(RULES)},
             "families": families,
             "baseline": {"total": len(base), "stale": stale,

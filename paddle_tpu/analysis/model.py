@@ -29,18 +29,37 @@ RULES: Dict[str, str] = {}
 #: rule id -> nominal severity (a rule may still emit individual findings
 #: at a lower severity, e.g. PK102's lane-alignment advisories)
 RULE_SEVERITIES: Dict[str, str] = {}
+#: rule id -> implementing module. The family prefix groups rules
+#: conceptually; the registry records where each one actually lives, so
+#: cross-filed rules (PC201 is numbered in the collective family but
+#: guards a kernel-adjacent hazard and lives in rules_collective.py) are
+#: documented here instead of by filename convention.
+RULE_MODULES: Dict[str, str] = {}
+
+#: family prefix -> one-line description (``--list-rules`` group headers)
+FAMILIES: Dict[str, str] = {
+    "PT": "python-tracing hygiene (host leaks, retrace churn, RNG/thread "
+          "discipline)",
+    "PK": "pallas kernel structure (grids, BlockSpecs, refs, aliases, "
+          "accumulators)",
+    "PC": "collectives (axis names, branch-guarded issue order)",
+    "PS": "sharding/mesh (PartitionSpec vs mesh axes, donation, "
+          "resharding)",
+    "PF": "kernel memory lane (VMEM budgets, donation dataflow, dtype "
+          "chains, fusion advisories, cost-model drift)",
+}
 
 
 def register_rule(rule_id: str, description: str,
-                  severity: str = "warning") -> None:
+                  severity: str = "warning", module: str = "") -> None:
     RULES[rule_id] = description
     RULE_SEVERITIES[rule_id] = severity
+    RULE_MODULES[rule_id] = module
 
 
 def rule_family(rule_id: str) -> str:
     """'PK101' -> 'PK': the alphabetic prefix groups rules into families
-    (PT python-tracing hygiene, PK pallas-kernel, PC collective,
-    PS sharding/mesh)."""
+    (see :data:`FAMILIES`)."""
     return rule_id.rstrip("0123456789") or rule_id
 
 

@@ -26,7 +26,7 @@ from .model import Config, Finding, register_rule
 
 register_rule("PC201", "collective issued under a branch inside a "
                        "shard_map region (cross-rank deadlock shape)",
-              severity="error")
+              severity="error", module=__name__)
 
 #: communicating primitives — axis_index etc. are local and excluded
 COLLECTIVES = {"psum", "pmax", "pmin", "pmean", "all_gather",

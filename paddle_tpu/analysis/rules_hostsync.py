@@ -23,7 +23,7 @@ from .callgraph import PackageIndex, _dotted, _last_name, walk_shallow
 from .model import Config, Finding, register_rule
 
 register_rule("PT003", "host sync (block_until_ready/device_get/.item/"
-                       ".numpy) in a hot path", severity="warning")
+                       ".numpy) in a hot path", severity="warning", module=__name__)
 
 _SYNC_METHODS = {"block_until_ready", "item", "numpy", "tolist",
                  "copy_to_host_async"}

@@ -50,18 +50,18 @@ from .model import Config, Finding, register_rule
 
 register_rule("PK101", "index_map block index out of bounds: unclamped "
                        "scalar-prefetch table read or negative literal",
-              severity="error")
+              severity="error", module=__name__)
 register_rule("PK102", "BlockSpec/kernel mismatch: map arity, block rank "
                        "vs map result, ref count, lane alignment",
-              severity="error")
+              severity="error", module=__name__)
 register_rule("PK103", "input_output_aliases hazard: index/shape/dtype/"
                        "spec mismatch or unguarded aliased-input read",
-              severity="error")
+              severity="error", module=__name__)
 register_rule("PK104", "sub-f32 accumulator in a matmul/softmax kernel",
-              severity="warning")
+              severity="warning", module=__name__)
 register_rule("PK105", "pallas kernel without a registered XLA reference "
                        "oracle (register_oracle certification contract)",
-              severity="warning")
+              severity="warning", module=__name__)
 
 _MATMUL_SOFTMAX_FUNCS = {"dot", "dot_general", "matmul", "exp", "exp2",
                          "softmax", "logsumexp", "einsum"}

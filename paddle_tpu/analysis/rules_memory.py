@@ -1,0 +1,338 @@
+"""PF401-PF406: the kernel memory lane (docs/ANALYSIS.md).
+
+Static verification of what interpret-mode runtime checks miss — VMEM
+budgets, buffer donation, dtype chains — plus the fusion-opportunity
+advisory that turns the decode-layer producer/consumer tilings into the
+machine-checked worklist for ROADMAP item 1 (mega-kernel decode).  All
+byte math comes from :mod:`vmemmodel` (the kernelmodel grid x BlockSpec
+evaluator under the published canonical family shapes); this module only
+turns it into findings.  Degrade to unknown, never guess: a shape that
+does not evaluate is skipped, not reported.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from . import kernelmodel as km
+from . import vmemmodel as vm
+from .callgraph import PackageIndex
+from .kernelmodel import SUB_F32_DTYPES, KernelCallSite
+from .model import Config, Finding, register_rule
+
+register_rule("PF401",
+              "pallas_call VMEM footprint exceeds the per-core budget "
+              "under its canonical decode shapes", "error",
+              module=__name__)
+register_rule("PF402",
+              "donated input buffer (input_output_aliases) is read "
+              "after the pallas_call launch", "error", module=__name__)
+register_rule("PF403",
+              "kernel dtype-chain break: f32 scratch accumulator stored "
+              "at reduced precision, or packed-int4 lane not "
+              "128-aligned", "error", module=__name__)
+register_rule("PF404",
+              "adjacent decode-chain kernels with compatible token "
+              "tilings — an HBM round-trip a fused kernel would elide "
+              "(ROADMAP item 1 worklist)", "info", module=__name__)
+register_rule("PF405",
+              "grid component does not divide evenly under the real "
+              "family shapes (llama/gpt/moe/mla)", "error",
+              module=__name__)
+register_rule("PF406",
+              "registered CostEstimate bytes drift from the "
+              "BlockSpec-derived bytes beyond tolerance", "warning",
+              module=__name__)
+
+_MIB = 1024 * 1024
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    """Base variable of ``x`` / ``x.attr`` / ``x[i]`` / ``x.astype(...)``
+    chains (the buffer a call argument ultimately names)."""
+    while True:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, (ast.Attribute, ast.Subscript)):
+            expr = expr.value
+        elif isinstance(expr, ast.Call):
+            expr = expr.func
+        else:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# PF401 — VMEM budget
+# ---------------------------------------------------------------------------
+
+def _pf401(canon: Dict[str, KernelCallSite]) -> List[Finding]:
+    out = []
+    for qn, site in canon.items():
+        entry = vm.CANONICAL[qn]
+        fp = vm.site_footprint(site, entry)
+        if fp["bytes"] <= vm.VMEM_BYTES_PER_CORE:
+            continue
+        lb = (" (lower bound: %d block(s) did not evaluate)"
+              % fp["unresolved"] if fp["unresolved"] else "")
+        out.append(Finding(
+            "PF401", "error", site.mi.rel, site.line,
+            site.call.col_offset, site.qualname,
+            f"static VMEM footprint {fp['bytes'] / _MIB:.1f} MiB exceeds "
+            f"the {vm.VMEM_BYTES_PER_CORE // _MIB} MiB per-core budget "
+            f"under the canonical {entry['kernel']} shapes{lb}",
+            hint="shrink the block/scratch shapes or retile: Mosaic "
+                 "will refuse the allocation at compile time on real "
+                 "hardware",
+            detail=f"vmem:{qn}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PF402 — read-after-donate
+# ---------------------------------------------------------------------------
+
+def _pf402(sites: List[KernelCallSite]) -> List[Finding]:
+    out = []
+    for site in sites:
+        if not site.aliases or not site.arg_exprs or site.fi is None:
+            continue
+        boundary = site.call.end_lineno or site.call.lineno
+        for a in site.arg_exprs:
+            boundary = max(boundary, getattr(a, "end_lineno", 0) or 0)
+        seen: Set[str] = set()
+        for k in sorted(site.aliases):
+            if k >= len(site.arg_exprs):
+                continue
+            root = _root_name(site.arg_exprs[k])
+            if root is None or root in seen:
+                continue
+            seen.add(root)
+            hit = next(
+                (n for n in ast.walk(site.fi.node)
+                 if isinstance(n, ast.Name) and n.id == root
+                 and isinstance(n.ctx, ast.Load)
+                 and n.lineno > boundary), None)
+            if hit is None:
+                continue
+            out.append(Finding(
+                "PF402", "error", site.mi.rel, hit.lineno,
+                hit.col_offset, site.qualname,
+                f"`{root}` is donated to output "
+                f"{site.aliases[k]} via input_output_aliases but read "
+                f"again after the launch — on TPU the buffer has been "
+                f"overwritten in place",
+                hint="capture the kernel's returned output instead of "
+                     "re-reading the donated operand",
+                detail=f"alias:{root}->out{site.aliases[k]}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PF403 — dtype-chain breaks
+# ---------------------------------------------------------------------------
+
+def _scratch_param_names(site: KernelCallSite) -> List[Optional[str]]:
+    """Kernel param name per scratch entry (positionally the LAST
+    ``len(scratch)`` params), or Nones when unresolvable."""
+    n = len(site.scratch or [])
+    params = site.kernel_positional_params()
+    if not n or params is None or len(params) < n:
+        return [None] * n
+    return list(params[-n:])
+
+
+def _astype_sub_f32(value: ast.AST) -> bool:
+    """Top-level ``<expr>.astype(<reduced dtype literal>)``."""
+    return (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "astype"
+            and bool(value.args)
+            and km._last_name(value.args[0]) in SUB_F32_DTYPES)
+
+
+def _kernel_is_int4(site: KernelCallSite) -> bool:
+    if site.kernel_fi is None:
+        return False
+    for n in ast.walk(site.kernel_fi.node):
+        if isinstance(n, ast.BinOp):
+            if isinstance(n.op, ast.BitAnd) and 0xF in (
+                    km._int_const(n.left), km._int_const(n.right)):
+                return True
+            if isinstance(n.op, ast.RShift) \
+                    and km._int_const(n.right) == 4:
+                return True
+    return False
+
+
+def _pf403(sites: List[KernelCallSite]) -> List[Finding]:
+    out = []
+    for site in sites:
+        # (a) f32 scratch accumulator stored at reduced precision
+        if site.kernel_fi is not None and site.scratch:
+            names = _scratch_param_names(site)
+            f32_params = {
+                nm for nm, expr in zip(names, site.scratch)
+                if nm is not None
+                and km.scratch_dtype_name(expr) == "float32"}
+            if f32_params:
+                for node in ast.walk(site.kernel_fi.node):
+                    if not (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1
+                            and isinstance(node.targets[0], ast.Subscript)):
+                        continue
+                    root = km._subscript_root(node.targets[0])
+                    if root in f32_params and _astype_sub_f32(node.value):
+                        dt = km._last_name(node.value.args[0])
+                        out.append(Finding(
+                            "PF403", "error", site.mi.rel, node.lineno,
+                            node.col_offset, site.qualname,
+                            f"f32 scratch accumulator `{root}` in "
+                            f"`{site.kernel_fi.qualname}` is stored as "
+                            f"{dt} — the online accumulation chain "
+                            f"loses precision across grid steps",
+                            hint="keep scratch accumulators f32; cast "
+                                 "only the final output ref store",
+                            detail=f"accum:{root}"))
+        # (b) packed-int4 lane alignment
+        if not _kernel_is_int4(site):
+            continue
+        entry = vm.CANONICAL.get(site.qualname)
+        bindings = vm.site_bindings(entry) if entry else {}
+        env = km.Env(site.mi, site.fi)
+        reported: Set[str] = set()
+        for specs in (site.in_specs, site.out_specs):
+            for spec in specs or []:
+                if not spec.block_shape or len(spec.block_shape) < 2:
+                    continue
+                lane = spec.block_shape[-1]
+                v = vm.resolved_value(lane, env, bindings)
+                if v is None or v == 1 or v % 128 == 0:
+                    continue
+                text = km.unparse(lane)
+                if text in reported:
+                    continue
+                reported.add(text)
+                out.append(Finding(
+                    "PF403", "error", site.mi.rel, site.line,
+                    site.call.col_offset, site.qualname,
+                    f"packed-int4 kernel lane `{text}` = {v} is neither "
+                    f"1 nor a multiple of 128 — nibble unpack breaks "
+                    f"the (8, 128) tiling layout invariant",
+                    hint="pick a lane block from the 128-multiple "
+                         "ladder (the padded-N divisor chain)",
+                    detail=f"int4lane:{text}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PF404 — fusion opportunities (info; surfaces under --strict)
+# ---------------------------------------------------------------------------
+
+def _pf404(index: PackageIndex) -> List[Finding]:
+    out = []
+    for cand in vm.fusion_candidates(index):
+        site = cand["site"]
+        how = ("identical token tiling — fusable as-is"
+               if cand["class"] == "aligned"
+               else "both token-swept at different granularity (retile)")
+        out.append(Finding(
+            "PF404", "info", site.mi.rel, site.line,
+            site.call.col_offset, site.qualname,
+            f"decode chain {cand['producer']} -> {cand['consumer']}: "
+            f"{how}; the intermediate HBM round-trip is a mega-kernel "
+            f"fusion candidate (ROADMAP item 1)",
+            hint="see docs/ANALYSIS.md 'PF404 as a fusion worklist'",
+            detail=cand["detail"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PF405 — grid divisibility under family shapes
+# ---------------------------------------------------------------------------
+
+def _pf405(canon: Dict[str, KernelCallSite]) -> List[Finding]:
+    out = []
+    for qn, site in canon.items():
+        entry = vm.CANONICAL[qn]
+        env = km.Env(site.mi, site.fi)
+        fams: Dict[str, Dict[str, int]] = {"canonical": {}}
+        fams.update(entry.get("families", {}))
+        reported: Set[str] = set()
+        for fam, over in fams.items():
+            b = vm.site_bindings(entry)
+            b.update(over)
+            for e in site.grid_elts or []:
+                if not (isinstance(e, ast.BinOp)
+                        and isinstance(e.op, ast.FloorDiv)):
+                    continue
+                num = vm.resolved_value(e.left, env, b)
+                den = vm.resolved_value(e.right, env, b)
+                if num is None or not den:
+                    continue
+                if num % den == 0:
+                    continue
+                text = km.unparse(e)
+                if text in reported:
+                    continue
+                reported.add(text)
+                out.append(Finding(
+                    "PF405", "error", site.mi.rel, site.line,
+                    site.call.col_offset, site.qualname,
+                    f"grid component `{text}` = {num} // {den} drops "
+                    f"{num % den} row(s) under the {fam} shapes "
+                    f"({entry['kernel']}) — the launch silently skips "
+                    f"the ragged tail",
+                    hint="pad to the block size or derive the block "
+                         "from the runtime shape (`_row_block`-style "
+                         "divisor ladder)",
+                    detail=f"grid:{text}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PF406 — cost-model drift
+# ---------------------------------------------------------------------------
+
+def _pf406(index: PackageIndex) -> List[Finding]:
+    out = []
+    for rec in vm.derive_cost_bytes(index):
+        if rec["status"] != "drift":
+            continue
+        out.append(Finding(
+            "PF406", "warning", rec["path"], rec["line"], 0,
+            rec["qualname"],
+            f"cost registry states {rec['expected']} HBM bytes for "
+            f"{rec['kernel']} but the committed BlockSpecs transfer "
+            f"{rec['derived']} (rel err {rec['rel_err']:.3f} > "
+            f"{vm.COST_DRIFT_RTOL}) — the roofline observatory is "
+            f"reporting a kernel that no longer exists",
+            hint="update observability/costmodel.py (or the canonical "
+                 "bindings in analysis/vmemmodel.py) to match the "
+                 "edited kernel",
+            detail=f"drift:{rec['kernel']}"))
+    return out
+
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    wanted = [r for r in ("PF401", "PF402", "PF403", "PF404", "PF405",
+                          "PF406") if cfg.wants(r)]
+    if not wanted:
+        return findings
+    sites = km.collect_kernel_calls(index)
+    canon = {s.qualname: s for s in sites
+             if s.qualname in vm.CANONICAL}
+    if cfg.wants("PF401"):
+        findings.extend(_pf401(canon))
+    if cfg.wants("PF402"):
+        findings.extend(_pf402(sites))
+    if cfg.wants("PF403"):
+        findings.extend(_pf403(sites))
+    if cfg.wants("PF404"):
+        findings.extend(_pf404(index))
+    if cfg.wants("PF405"):
+        findings.extend(_pf405(canon))
+    if cfg.wants("PF406"):
+        findings.extend(_pf406(index))
+    return findings

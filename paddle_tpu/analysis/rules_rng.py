@@ -21,7 +21,7 @@ from .callgraph import PackageIndex, FunctionInfo, _dotted, _last_name
 from .model import Config, Finding, register_rule
 
 register_rule("PT004", "PRNG hygiene: key reuse without split, host RNG "
-                       "in traced code", severity="error")
+                       "in traced code", severity="error", module=__name__)
 
 _KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "clone"}
 # jax.random samplers that consume a key as their first argument

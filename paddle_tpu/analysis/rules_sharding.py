@@ -23,22 +23,22 @@ from .model import Config, Finding, register_rule
 
 register_rule("PS301", "collective axis name not bound by an enclosing "
                        "mesh/shard_map axis environment",
-              severity="error")
+              severity="error", module=__name__)
 register_rule("PS302", "in_specs/out_specs arity mismatch vs the wrapped "
                        "function's signature or call arguments",
-              severity="error")
+              severity="error", module=__name__)
 register_rule("PS303", "PartitionSpec rank exceeds the sharded array's "
                        "rank, or the same mesh axis appears twice",
-              severity="error")
+              severity="error", module=__name__)
 register_rule("PS304", "statically-known dimension not divisible by the "
                        "product of the mesh axis sizes sharding it",
-              severity="warning")
+              severity="warning", module=__name__)
 register_rule("PS305", "axis-name shadowing across nested shard_map/"
                        "vmap(axis_name=) scopes",
-              severity="warning")
+              severity="warning", module=__name__)
 register_rule("PS306", "unsanitized layer-declared spec reaches "
                        "NamedSharding under a configurable mesh",
-              severity="warning")
+              severity="warning", module=__name__)
 
 
 def _spec_dup_axes(spec: SpecModel) -> List[str]:

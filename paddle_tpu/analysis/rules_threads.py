@@ -25,7 +25,7 @@ from .model import Config, Finding, register_rule
 
 register_rule("PT006", "module-level mutable state written from a "
                        "background thread without the owning lock",
-              severity="warning")
+              severity="warning", module=__name__)
 
 _MUTATORS = {"append", "add", "pop", "update", "setdefault", "extend",
              "remove", "clear", "insert", "discard", "popleft",

@@ -29,13 +29,13 @@ from .callgraph import (JIT_CONSTRUCTORS, PackageIndex, FunctionInfo,
 from .model import Config, Finding, register_rule
 
 register_rule("PT001", "tracer leak: host conversion or Python control "
-                       "flow on a traced value", severity="error")
+                       "flow on a traced value", severity="error", module=__name__)
 register_rule("PT002", "retrace hazard: jit construction in a loop, "
                        "unhashable static args, shape-dependent branch",
-              severity="warning")
+              severity="warning", module=__name__)
 register_rule("PT005", "FLAGS mutation at trace time (set_flags/"
                        "flags_guard/define_flag inside a traced body)",
-              severity="error")
+              severity="error", module=__name__)
 
 # attribute reads that yield concrete (non-tracer) values at trace time
 _BREAKER_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "device",

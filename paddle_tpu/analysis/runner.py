@@ -7,13 +7,14 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Tuple
 
-from . import (rules_collective, rules_hostsync, rules_kernel, rules_rng,
-               rules_sharding, rules_threads, rules_trace)
+from . import (rules_collective, rules_hostsync, rules_kernel,
+               rules_memory, rules_rng, rules_sharding, rules_threads,
+               rules_trace)
 from .callgraph import PackageIndex
 from .model import Config, Finding, is_suppressed
 
 _PASSES = (rules_trace, rules_hostsync, rules_rng, rules_threads,
-           rules_kernel, rules_collective, rules_sharding)
+           rules_kernel, rules_collective, rules_sharding, rules_memory)
 
 
 def discover(root: str) -> List[Tuple[str, str, str]]:
@@ -41,6 +42,43 @@ def discover(root: str) -> List[Tuple[str, str, str]]:
             modname = base if mod == "__init__" else f"{base}.{mod}"
             out.append((modname, path, rel.replace(os.sep, "/")))
     return out
+
+
+def expand_changed_with_factories(
+        files: List[Tuple[str, str, str]],
+        changed_abs: set) -> List[Tuple[str, str, str]]:
+    """Grow a ``--changed-only`` file selection with kernel *call-site*
+    files whose factory module changed.
+
+    A pallas kernel is often built in one module (the factory) and
+    launched from another; editing only the factory leaves the call-site
+    file out of the changed set, so the kernel-structure passes — which
+    anchor findings at the ``pallas_call`` site — silently skip the
+    launch that the edit just broke.  Index the full selection once,
+    and for every kernel call whose *kernel function* is defined in a
+    changed module, pull the call-site file back in."""
+    picked = [t for t in files if os.path.abspath(t[1]) in changed_abs]
+    if not picked or len(picked) == len(files):
+        return picked
+    from . import kernelmodel as km
+    index = PackageIndex.from_files(files)
+    have = {os.path.abspath(t[1]) for t in picked}
+    extras = []
+    for site in km.collect_kernel_calls(index):
+        if site.kernel_fi is None:
+            continue
+        factory_mi = index.modules.get(site.kernel_fi.modname)
+        if factory_mi is None:
+            continue
+        if os.path.abspath(factory_mi.path) not in changed_abs:
+            continue
+        site_abs = os.path.abspath(site.mi.path)
+        if site_abs in have:
+            continue
+        have.add(site_abs)
+        extras.extend(t for t in files
+                      if os.path.abspath(t[1]) == site_abs)
+    return picked + extras
 
 
 def _filter(findings: List[Finding], index: PackageIndex,

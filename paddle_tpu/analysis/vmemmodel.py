@@ -1,0 +1,651 @@
+"""Static per-kernel VMEM / HBM memory model (ISSUE 13 tentpole).
+
+Built on the :mod:`kernelmodel` grid x BlockSpec evaluator: for every
+registered oracle kernel this module publishes CANONICAL decode-shaped
+bindings (the llama/gpt/moe/mla family shapes the engine actually
+launches) and derives, purely from the committed AST,
+
+  - the per-core VMEM footprint of one launch: resolvable block bytes
+    (doubled when the index_map references a grid dim — Pallas keeps a
+    revolving double buffer for re-fetched operands) plus
+    ``scratch_shapes`` accumulators.  Unresolvable parts are COUNTED,
+    not guessed, so every footprint is an explicit lower bound;
+  - the HBM transfer bytes of one launch (``fetch runs x block bytes``,
+    the same accounting `observability/costmodel.py` states in closed
+    form), which PF406 cross-checks against the registered
+    ``CostEstimate`` within :data:`COST_DRIFT_RTOL`;
+  - producer/consumer tiling signatures across the decode-layer kernel
+    chain, which PF404 turns into the fusion-opportunity worklist for
+    ROADMAP item 1 (mega-kernel decode).
+
+The flash/flashmask in_specs ride through the tuple-unpacked ``_specs``
+helpers, invisible to the flow-insensitive ``Env``; they are rebuilt by
+recording the ``order == 'qk'`` branch over the helper's scope (the same
+technique `tests/test_costmodel.py` committed for the flash pin).
+
+Pure stdlib (`ast` only): the cost registry is loaded from
+``observability/costmodel.py`` BY FILE PATH, so nothing here ever
+imports jax.  Degrade to unknown, never guess.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import kernelmodel as km
+from .callgraph import PackageIndex
+from .kernelmodel import KernelCallSite
+
+__all__ = [
+    "VMEM_BYTES_PER_CORE", "COST_DRIFT_RTOL", "DTYPE_WIDTHS",
+    "CANONICAL", "FAMILY_SHAPES", "DECODE_CHAIN",
+    "load_costmodel", "canonical_sites", "site_bindings", "grid_ok",
+    "site_footprint", "derive_transfer", "derive_cost_bytes",
+    "fusion_candidates", "rebuild_helper_specs", "resolved_value",
+]
+
+#: Pallas VMEM budget per TensorCore (v4/v5 generations: ~16 MiB).
+VMEM_BYTES_PER_CORE = 16 * 1024 * 1024
+
+#: PF406 / perf_gate shared tolerance: vmemmodel-derived bytes and the
+#: registered CostEstimate must agree within this relative error.  ONE
+#: constant — tools/perf_gate.py imports it, so the two gates cannot
+#: drift apart.
+COST_DRIFT_RTOL = 0.05
+
+DTYPE_WIDTHS: Dict[str, int] = {
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "float8_e4m3fn": 1, "float8_e5m2": 1, "int8": 1, "uint8": 1,
+    "bool_": 1,
+}
+
+_COSTMODEL_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "observability", "costmodel.py")
+
+
+def load_costmodel():
+    """The cost registry, loaded by file path (pure python + math; going
+    through the package would drag in jax). None when unavailable."""
+    name = "_paddlelint_costmodel"
+    if name in sys.modules:
+        return sys.modules[name]
+    try:
+        spec = importlib.util.spec_from_file_location(
+            name, _COSTMODEL_PATH)
+        if spec is None or spec.loader is None:
+            return None
+        mod = importlib.util.module_from_spec(spec)
+        # dataclasses resolves cls.__module__ through sys.modules at
+        # class-creation time; register before exec
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        sys.modules.pop(name, None)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# family shapes + canonical per-site bindings
+# ---------------------------------------------------------------------------
+#
+# The real model configs the engine serves (models/llama.py,
+# models/gpt.py, models/moe_llm.py, models/deepseek.py).  PF405 sweeps
+# every canonical site's grid divisibility under its applicable
+# families, not just the canonical symbols.
+
+FAMILY_SHAPES: Dict[str, Dict[str, int]] = {
+    "llama": dict(hidden=4096, intermediate=14336, heads=32, kv_heads=8,
+                  head_dim=128),
+    "gpt": dict(hidden=4096, intermediate=16384, heads=32, kv_heads=32,
+                head_dim=128),
+    "moe": dict(hidden=4096, intermediate=14336, heads=32, kv_heads=8,
+                head_dim=128, experts=8, top_k=2),
+    "mla": dict(hidden=5120, heads=16, lora_rank=512, rope_dim=64),
+}
+
+# One entry per registered oracle kernel, keyed by the qualname of the
+# function that owns its pallas_call.  Fields:
+#   kernel       cost-registry name (ops/oracles.py name)
+#   bindings     Name -> int for the site's block/grid symbols, decode-
+#                shaped (T = decode batch rows; page_size 32; D 128)
+#   in_widths /  dtype bytes per in/out spec, in source order (prefetch
+#   out_widths   operands are excluded from in_specs, matching Pallas)
+#   cost_kwargs  shapes handed to cost(kernel, ...) for PF406
+#   mode         "exact": compare hbm read+write; "activations": the
+#                site's resolvable specs cover only the activation side
+#                (paged v2 keeps K/V behind memory_space=ANY manual
+#                DMA), so compare against breakdown["activations"]
+#   any_inputs   in-spec indices EXPECTED to evaluate to None (ANY)
+#   rebuild      in_specs live behind the module's `_specs` helper;
+#                rebuild them with the order='qk' branch recorded
+#   token_tiled  the launch sweeps the token axis (PF404 chain signat.)
+#   families     PF405 family sweep: family name -> binding overrides
+CANONICAL: Dict[str, Dict[str, Any]] = {
+    # -- ops/fused.py ------------------------------------------------------
+    "_rms_forward": dict(
+        kernel="fused_rms_norm",
+        bindings=dict(T=8, bt=8, H=4096),
+        in_widths=[2, 2], out_widths=[2],
+        cost_kwargs=dict(T=8, H=4096),
+        token_tiled=True,
+        families={"llama": dict(H=4096), "gpt": dict(H=4096)},
+    ),
+    "fused_layer_norm": dict(
+        kernel="fused_layer_norm",
+        bindings=dict(T=8, bt=8, H=4096),
+        in_widths=[2, 2, 2], out_widths=[2],
+        cost_kwargs=dict(T=8, H=4096),
+        token_tiled=True,
+        families={"gpt": dict(H=4096)},
+    ),
+    "_brln_forward": dict(
+        kernel="fused_bias_residual_layer_norm",
+        bindings=dict(T=8, bt=8, H=4096),
+        in_widths=[2, 2, 2, 2, 2], out_widths=[2],
+        cost_kwargs=dict(T=8, H=4096),
+        token_tiled=True,
+        families={"gpt": dict(H=4096)},
+    ),
+    "_moe_dc_forward": dict(
+        kernel="fused_moe_dispatch_combine",
+        bindings=dict(T=8, bt=8, K=2, E=8, C=64),
+        in_widths=[4, 4, 4], out_widths=[4, 4],
+        cost_kwargs=dict(T=8, K=2, E=8, C=64),
+        token_tiled=True,
+        families={"moe": dict(E=8, K=2)},
+    ),
+    # fused_rope launches _rope_forward once for q and once for k; the
+    # cost entry covers the PAIR, so the canonical binding folds both
+    # head counts into one conceptual launch (H = Hq + Hk = 40) — the
+    # cos/sin fetch then matches the single trig read the cost states.
+    "_rope_forward": dict(
+        kernel="fused_rope",
+        bindings=dict(B=4, S=256, bs=256, H=40, D=128),
+        in_widths=[2, 2, 2], out_widths=[2],
+        cost_kwargs=dict(B=4, S=256, H=32, Hk=8, D=128),
+        token_tiled=False,
+        families={"llama": dict(H=40, D=128)},
+    ),
+    "fused_rope_append": dict(
+        kernel="fused_rope_append",
+        bindings=dict(T=8, Hq=32, KV=8, D=128, psz=32, d2=64),
+        in_widths=[2, 2, 2, 2, 2, 2, 2], out_widths=[2, 2, 2],
+        cost_kwargs=dict(T=8, Hq=32, KV=8, D=128, page_size=32),
+        token_tiled=True,
+        families={"llama": dict(Hq=32, KV=8, D=128),
+                  "gpt": dict(Hq=32, KV=32, D=128)},
+    ),
+    "fused_append_rows": dict(
+        kernel="fused_append_rows",
+        bindings=dict(T=8, KV=8, D=128, psz=32),
+        in_widths=[2, 2], out_widths=[2],
+        cost_kwargs=dict(T=8, KV=8, D=128, page_size=32),
+        token_tiled=True,
+        families={"mla": dict(KV=1, D=576)},
+    ),
+    "_swiglu_forward": dict(
+        kernel="swiglu",
+        bindings=dict(T=8, bt=8, H=14336),
+        in_widths=[2, 2], out_widths=[2],
+        cost_kwargs=dict(T=8, H=14336),
+        token_tiled=True,
+        families={"llama": dict(H=14336), "gpt": dict(H=16384)},
+    ),
+    # -- ops/pallas_flash.py / pallas_flashmask.py -------------------------
+    "_flash_fwd_impl": dict(
+        kernel="flash_sdpa",
+        bindings=dict(B=1, H=8, Sq=1024, Sk=1024, D=128,
+                      bq=512, bk=512, nq=2, nk=2),
+        in_widths=[4, 4, 2, 2, 2], out_widths=[2, 4],
+        cost_kwargs=dict(B=1, H=8, Sq=1024, Sk=1024, D=128),
+        rebuild=True,
+        token_tiled=False,
+    ),
+    # the startend row-index mask rows and the SMEM skip map are not in
+    # the closed-form cost (which carries flash's seg term instead);
+    # both are stats-sized against the K/V stream, so the site lands
+    # inside COST_DRIFT_RTOL rather than exactly on the formula.
+    "_flashmask_fwd_impl": dict(
+        kernel="flashmask_sdpa",
+        bindings=dict(B=1, H=8, Sq=1024, Sk=1024, D=128,
+                      bq=512, bk=512, nq=2, nk=2),
+        in_widths=[4, 4, 4, 4, 4, 2, 2, 2], out_widths=[2, 4],
+        cost_kwargs=dict(B=1, H=8, Sq=1024, Sk=1024, D=128),
+        rebuild=True,
+        token_tiled=False,
+    ),
+    # -- ops/pallas_paged.py / pallas_ragged.py / pallas_mla.py ------------
+    "paged_decode_attention": dict(
+        kernel="paged_decode_attention",
+        bindings=dict(B=8, KV=8, rep=4, D=128, nj=8, page_size=32),
+        in_widths=[2, 2, 2], out_widths=[2],
+        cost_kwargs=dict(B=8, H=32, KV=8, D=128, context=256,
+                         page_size=32, pages_per_seq=8),
+        token_tiled=False,
+        families={"llama": dict(KV=8, rep=4, D=128)},
+    ),
+    "paged_decode_attention_v2": dict(
+        kernel="paged_decode_attention_v2",
+        bindings=dict(B=8, KV=8, rep=4, D=128, G=2, psz=32),
+        in_widths=[2, 2, 2], out_widths=[2],
+        cost_kwargs=dict(B=8, H=32, KV=8, D=128, context=256,
+                         page_size=32, pages_per_seq=8),
+        mode="activations",
+        any_inputs=(1, 2),
+        token_tiled=False,
+    ),
+    "ragged_paged_attention": dict(
+        kernel="ragged_paged_attention",
+        bindings=dict(T=8, rep=4, D=128, KV=8, S=8, nj=8, psz=32),
+        in_widths=[2, 2, 2], out_widths=[2],
+        cost_kwargs=dict(T=8, H=32, KV=8, D=128, S=8, pages_per_seq=8,
+                         page_size=32),
+        token_tiled=False,
+        families={"llama": dict(KV=8, rep=4, D=128)},
+    ),
+    "mla_decode_attention": dict(
+        kernel="mla_decode_attention",
+        bindings=dict(B=8, nh=16, r=512, dr=64, block_t=128, nj=4),
+        in_widths=[2, 2, 2, 2], out_widths=[2],
+        cost_kwargs=dict(B=8, nh=16, r=512, dr=64, context=512,
+                         block_t=128),
+        token_tiled=False,
+        families={"mla": dict(nh=16, r=512, dr=64)},
+    ),
+    # -- ops/pallas_gmm.py / quant.py --------------------------------------
+    # gmm: one m-block, one n-block (the cost's nn factor is then 1 and
+    # the pl.when group-elision lower bound coincides with grid x block)
+    "_gmm_fwd_impl": dict(
+        kernel="gmm",
+        bindings=dict(nm=1, nn=1, G=8, bm=128, bn=128, K=4096, Mp=128),
+        in_widths=[2, 2], out_widths=[2],
+        cost_kwargs=dict(M=128, K=4096, N=128, G=8,
+                         block_m=128, block_n=128),
+        token_tiled=False,
+        families={"moe": dict(G=8, K=4096)},
+    ),
+    # int4_dequantize: tensor-parallel shard shapes; K=1024 keeps the
+    # whole-column f32 out block (K x bn x 4B, doubled) inside VMEM
+    "int4_dequantize": dict(
+        kernel="int4_dequantize",
+        bindings=dict(K2=512, Np=1024, bn=1024),
+        in_widths=[1, 4], out_widths=[4],
+        cost_kwargs=dict(K=1024, N=1024),
+        token_tiled=False,
+        families={"llama": dict(K2=512, Np=1024)},
+    ),
+    # weight_only_linear (int8 path): N=1792 is the 8-way tensor-
+    # parallel shard of llama's 14336 — the whole [K, N] int8 slab is
+    # VMEM-resident (index_map refs no grid dim: fetched once)
+    "_wol_int8_fwd_impl": dict(
+        kernel="weight_only_linear",
+        bindings=dict(M=128, bm=128, K=4096, N=1792),
+        in_widths=[2, 1, 4], out_widths=[2],
+        cost_kwargs=dict(M=128, K=4096, N=1792,
+                         algo="weight_only_int8"),
+        token_tiled=False,
+        families={"llama": dict(K=4096, N=1792)},
+    ),
+}
+
+#: The decode-layer kernel chain in launch order (PF404 walks adjacent
+#: pairs; names repeat where the layer re-enters a kernel).  The XLA
+#: projections between launches are exactly the HBM round-trips a
+#: mega-kernel would elide — ROADMAP item 1's back half is the final
+#: norm -> swiglu pair.
+DECODE_CHAIN: List[str] = [
+    "fused_rms_norm", "fused_rope_append", "ragged_paged_attention",
+    "fused_rms_norm", "swiglu",
+]
+
+_CHAIN_SITE: Dict[str, str] = {
+    "fused_rms_norm": "_rms_forward",
+    "fused_rope_append": "fused_rope_append",
+    "ragged_paged_attention": "ragged_paged_attention",
+    "swiglu": "_swiglu_forward",
+}
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def site_bindings(entry: Dict[str, Any],
+                  family: Optional[str] = None) -> Dict[str, int]:
+    b = dict(entry["bindings"])
+    if family is not None:
+        b.update(entry.get("families", {}).get(family, {}))
+    return b
+
+
+def resolved_value(expr: ast.AST, env: km.Env,
+                   bindings: Dict[str, int]) -> Optional[int]:
+    """Evaluate `expr` with the site's own assignments taking precedence
+    over the canonical bindings: a literal ``bn = 64`` in the file beats
+    the published shape (that is the defect PF403/PF405 exist to catch);
+    an unresolvable chain (``bn = next(...)``) falls back to bindings."""
+    v = km.eval_int_expr(env.resolve(expr), bindings)
+    if v is None:
+        v = km.eval_int_expr(expr, bindings)
+    return v
+
+
+def canonical_sites(index: PackageIndex) -> Dict[str, KernelCallSite]:
+    """qualname -> call site for every CANONICAL kernel present in the
+    analyzed set (each owning function holds exactly one pallas_call)."""
+    out: Dict[str, KernelCallSite] = {}
+    for site in km.collect_kernel_calls(index):
+        qn = site.qualname
+        if qn in CANONICAL and qn not in out:
+            out[qn] = site
+    return out
+
+
+def grid_ok(site: KernelCallSite, bindings: Dict[str, int]) -> bool:
+    """The grid evaluates and every ``a // b`` component divides exactly
+    (a mis-gridded launch makes byte accounting meaningless — PF405 owns
+    that finding; PF401/PF406 skip)."""
+    if km.grid_values(site, bindings) is None:
+        return False
+    for e in site.grid_elts or []:
+        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.FloorDiv):
+            a = km.eval_int_expr(e.left, bindings)
+            d = km.eval_int_expr(e.right, bindings)
+            if a is None or not d or a % d:
+                return False
+    return True
+
+
+def _flatten_spec_list(expr: Optional[ast.AST],
+                       env: km.Env) -> Optional[List[ast.AST]]:
+    """Evaluate a ``[a] + [b] * 4 + [...]`` spec-list expression to its
+    element ASTs (the flashmask `_specs` return shape)."""
+    expr = env.resolve(expr)
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        return list(expr.elts)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _flatten_spec_list(expr.left, env)
+        right = _flatten_spec_list(expr.right, env)
+        if left is None or right is None:
+            return None
+        return left + right
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mult):
+        base = _flatten_spec_list(expr.left, env)
+        n = km.eval_int_expr(expr.right, {})
+        if base is None or n is None or n < 0:
+            return None
+        return base * n
+    return None
+
+
+def rebuild_helper_specs(site: KernelCallSite, helper: str = "_specs"
+                         ) -> Tuple[Optional[List[km.BlockSpecModel]],
+                                    Optional[List[km.BlockSpecModel]]]:
+    """Rebuild (in_specs, out_specs) for sites whose specs ride through
+    the module's tuple-unpacked `_specs` helper.  Records the
+    ``order == 'qk'`` branch body over the helper's env (Env is
+    flow-insensitive; without this the else-branch maps would win) and
+    flattens the returned list expression."""
+    mi = site.mi
+    fi = mi.functions.get(helper)
+    if fi is None:
+        return None, None
+    env = km.Env(mi, fi)
+    branch = next((n for n in ast.walk(fi.node) if isinstance(n, ast.If)),
+                  None)
+    if branch is not None:
+        for stmt in branch.body:
+            env._record(stmt)
+    ret = next((n for n in ast.walk(fi.node)
+                if isinstance(n, ast.Return)), None)
+    if ret is None or not isinstance(ret.value, ast.Tuple) \
+            or not ret.value.elts:
+        return None, None
+    elts = _flatten_spec_list(ret.value.elts[0], env)
+    if elts is None:
+        return None, None
+    in_specs = []
+    for e in elts:
+        spec = km.build_block_spec(e, mi, fi, env)
+        if spec is None:
+            return None, None
+        in_specs.append(spec)
+    out_specs = None
+    if site.out_specs is not None:
+        out_specs = [km.build_block_spec(s.node, mi, fi, env) or s
+                     for s in site.out_specs]
+    return in_specs, out_specs
+
+
+def _site_specs(site: KernelCallSite, entry: Dict[str, Any]
+                ) -> Tuple[Optional[List[km.BlockSpecModel]],
+                           Optional[List[km.BlockSpecModel]]]:
+    if entry.get("rebuild"):
+        return rebuild_helper_specs(site)
+    return site.in_specs, site.out_specs
+
+
+# ---------------------------------------------------------------------------
+# VMEM footprint
+# ---------------------------------------------------------------------------
+
+def _scratch_bytes(site: KernelCallSite,
+                   bindings: Dict[str, int]) -> Tuple[int, int]:
+    """(bytes, unresolved entries) for the VMEM/SMEM scratch shapes.
+    Semaphores and ANY-space scratch carry no VMEM block."""
+    total = 0
+    unresolved = 0
+    for expr in site.scratch or []:
+        if not (isinstance(expr, ast.Call)
+                and km._last_name(expr.func) in ("VMEM", "SMEM")
+                and expr.args):
+            continue
+        width = DTYPE_WIDTHS.get(km.scratch_dtype_name(expr) or "")
+        shape = km._seq_elts(expr.args[0])
+        if width is None or shape is None:
+            unresolved += 1
+            continue
+        elems = 1
+        for e in shape:
+            v = km.eval_int_expr(e, bindings)
+            if v is None:
+                elems = None
+                break
+            elems *= v
+        if elems is None:
+            unresolved += 1
+        else:
+            total += elems * width
+    return total, unresolved
+
+
+def site_footprint(site: KernelCallSite, entry: Dict[str, Any],
+                   bindings: Optional[Dict[str, int]] = None
+                   ) -> Dict[str, int]:
+    """Per-core VMEM bytes of one launch under the canonical bindings:
+    each resolvable non-ANY block (x2 when its index_map references a
+    grid dim — the revolving fetch buffer), SMEM blocks excluded, plus
+    scratch accumulators.  ``unresolved`` counts the parts that did not
+    evaluate — the footprint is a documented lower bound."""
+    b = dict(bindings) if bindings is not None else site_bindings(entry)
+    in_specs, out_specs = _site_specs(site, entry)
+    total = 0
+    unresolved = 0
+    grid_len = site.grid_len or 0
+    for specs, widths in ((in_specs, entry.get("in_widths", [])),
+                          (out_specs, entry.get("out_widths", []))):
+        for i, spec in enumerate(specs or []):
+            if spec.memory_space in ("ANY", "SMEM"):
+                continue
+            width = widths[i] if i < len(widths) else None
+            if width is None or spec.block_shape is None:
+                unresolved += 1
+                continue
+            elems = 1
+            for e in spec.block_shape:
+                v = km.eval_int_expr(e, b)
+                if v is None:
+                    elems = None
+                    break
+                elems *= v
+            if elems is None:
+                unresolved += 1
+                continue
+            mult = 1
+            if spec.index_map is not None and \
+                    km.index_map_grid_refs(spec.index_map, grid_len):
+                mult = 2
+            total += elems * width * mult
+    sb, su = _scratch_bytes(site, b)
+    return {"bytes": total + sb, "unresolved": unresolved + su}
+
+
+# ---------------------------------------------------------------------------
+# HBM transfer derivation + cost cross-check (PF406)
+# ---------------------------------------------------------------------------
+
+def derive_transfer(site: KernelCallSite, entry: Dict[str, Any],
+                    bindings: Optional[Dict[str, int]] = None
+                    ) -> Optional[Dict[str, int]]:
+    """{'read': bytes, 'write': bytes, 'unresolved': n} for one launch
+    under the canonical bindings, or None when the grid itself does not
+    evaluate.  In-spec indices listed in ``any_inputs`` are expected to
+    opt out (manual-DMA operands) and are not counted unresolved."""
+    b = dict(bindings) if bindings is not None else site_bindings(entry)
+    grid = km.grid_values(site, b)
+    if grid is None or site.grid_len is None:
+        return None
+    in_specs, out_specs = _site_specs(site, entry)
+    skip_in = set(entry.get("any_inputs", ()))
+    res = {"read": 0, "write": 0, "unresolved": 0}
+    for specs, widths, key, skip in (
+            (in_specs, entry.get("in_widths", []), "read", skip_in),
+            (out_specs, entry.get("out_widths", []), "write", set())):
+        for i, spec in enumerate(specs or []):
+            width = widths[i] if i < len(widths) else None
+            elems = km.spec_transfer_elems(spec, grid, site.grid_len, b)
+            if elems is None or width is None:
+                if i not in skip:
+                    res["unresolved"] += 1
+                continue
+            res[key] += elems * width
+    return res
+
+
+def derive_cost_bytes(index: PackageIndex,
+                      cost_module=None) -> List[Dict[str, Any]]:
+    """One record per CANONICAL kernel present in `index`: the
+    AST-derived HBM bytes vs the registered CostEstimate.  status is
+    'ok' / 'drift', or 'skipped:<why>' when the comparison is not
+    meaningful (absent site, failed grid divisibility — PF405 owns that
+    — or an unresolvable spec)."""
+    cm = cost_module if cost_module is not None else load_costmodel()
+    sites = canonical_sites(index)
+    records: List[Dict[str, Any]] = []
+    for qn, entry in CANONICAL.items():
+        site = sites.get(qn)
+        if site is None:
+            continue
+        rec: Dict[str, Any] = {
+            "kernel": entry["kernel"], "qualname": qn,
+            "path": site.mi.rel, "line": site.line,
+        }
+        b = site_bindings(entry)
+        if not grid_ok(site, b):
+            rec["status"] = "skipped:grid"
+            records.append(rec)
+            continue
+        t = derive_transfer(site, entry, b)
+        if t is None or t["unresolved"]:
+            rec["status"] = "skipped:unresolved"
+            records.append(rec)
+            continue
+        derived = t["read"] + t["write"]
+        rec["derived"] = derived
+        if cm is None:
+            rec["status"] = "skipped:costmodel"
+            records.append(rec)
+            continue
+        try:
+            est = cm.cost(entry["kernel"], **entry["cost_kwargs"])
+        except Exception:
+            rec["status"] = "skipped:cost-error"
+            records.append(rec)
+            continue
+        if entry.get("mode") == "activations":
+            expected = (est.breakdown or {}).get("activations")
+        else:
+            expected = est.bytes_read + est.bytes_written
+        if not expected:
+            rec["status"] = "skipped:cost-empty"
+            records.append(rec)
+            continue
+        rel = abs(derived - expected) / expected
+        rec.update(expected=expected, rel_err=rel,
+                   status="ok" if rel <= COST_DRIFT_RTOL else "drift")
+        records.append(rec)
+    return records
+
+
+# ---------------------------------------------------------------------------
+# fusion opportunities (PF404)
+# ---------------------------------------------------------------------------
+
+def _leading_sweep(spec: Optional[km.BlockSpecModel],
+                   grid_len: Optional[int]) -> Optional[ast.AST]:
+    """The block's leading extent when the spec is a leading-axis sweep:
+    index_map returns ``(g, 0, ..., 0)`` with g referencing a grid dim.
+    None otherwise."""
+    if spec is None or spec.block_shape is None or spec.index_map is None:
+        return None
+    rets = spec.index_map.returns
+    if not rets:
+        return None
+    comps = rets[0]
+    if len(comps) != len(spec.block_shape):
+        return None
+    for c in comps[1:]:
+        if km._int_const(c) != 0:
+            return None
+    if not km.index_map_grid_refs(spec.index_map, grid_len or 0):
+        return None
+    return spec.block_shape[0]
+
+
+def fusion_candidates(index: PackageIndex) -> List[Dict[str, Any]]:
+    """Adjacent DECODE_CHAIN pairs whose producer out-tiling and
+    consumer in-tiling are both token-axis sweeps — each one is an HBM
+    round-trip a fused kernel would elide.  class 'aligned' (identical
+    leading block extents: fusable as-is) or 'retile' (both token-swept
+    but at different granularity)."""
+    sites = canonical_sites(index)
+    out: List[Dict[str, Any]] = []
+    for prod, cons in zip(DECODE_CHAIN, DECODE_CHAIN[1:]):
+        pq, cq = _CHAIN_SITE[prod], _CHAIN_SITE[cons]
+        pe, ce = CANONICAL[pq], CANONICAL[cq]
+        ps, cs = sites.get(pq), sites.get(cq)
+        if ps is None or cs is None:
+            continue
+        if not (pe.get("token_tiled") and ce.get("token_tiled")):
+            continue
+        p_spec = (ps.out_specs or [None])[0]
+        c_spec = (cs.in_specs or [None])[0]
+        p_lead = _leading_sweep(p_spec, ps.grid_len)
+        c_lead = _leading_sweep(c_spec, cs.grid_len)
+        if p_lead is None or c_lead is None:
+            continue
+        pv = km.eval_int_expr(p_lead, site_bindings(pe))
+        cv = km.eval_int_expr(c_lead, site_bindings(ce))
+        klass = "aligned" if (pv is not None and pv == cv) else "retile"
+        out.append({
+            "producer": prod, "consumer": cons, "class": klass,
+            "site": ps, "detail": f"fuse:{prod}->{cons}",
+        })
+    return out

@@ -11,6 +11,13 @@ page per batch row) — and `tests/test_costmodel.py` asserts they equal
 the sizes `analysis/kernelmodel.py` derives from the committed
 grids/BlockSpecs.  Scalar-prefetch operands (lengths, page tables) are
 EXCLUDED everywhere: they are KBs against MBs and live in SMEM.
+Drift between this registry and the committed kernels is machine-
+checked from both sides: paddlelint's PF406 (via
+``analysis/vmemmodel.py``) re-derives every kernel's bytes from the
+BlockSpecs and fails CI past ``COST_DRIFT_RTOL``, and
+``tools/perf_gate.py --check`` applies the same tolerance to
+observatory candidates — edit a kernel's tiling and the cost formula
+here must move with it.
 
 On top of the registry sit the composite budgets the rest of the repo
 consumes so train and serve share one cost vocabulary:

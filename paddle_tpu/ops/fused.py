@@ -403,7 +403,9 @@ def fused_rope_append(q, k, v, cos, sin, k_pages, v_pages,
     [KV, total_pages, page_size, D]; page_idx/page_off [T] int32 name
     where token t's K/V row lands. Returns (q_roped, k_pages, v_pages)
     with the page pools donated through input_output_aliases (the HBM
-    buffers update in place on TPU).
+    buffers update in place on TPU — callers must use the RETURNED
+    pools, never re-read the donated arguments; paddlelint's PF402
+    checks that statically).
 
     Contract: tokens that share a page are ADJACENT in t (the engine's
     prefill chunk); non-adjacent revisits only happen on the trash page

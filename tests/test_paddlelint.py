@@ -1214,7 +1214,8 @@ class TestRepoJsonGate:
                         "--json"])
         data = json.loads(capsys.readouterr().out)
         assert rc == 0
-        assert set(data["families"]) == {"PT", "PK", "PC", "PS"}
+        assert data["schema_version"] == 1
+        assert set(data["families"]) == {"PT", "PK", "PC", "PS", "PF"}
         for fam, info in sorted(data["families"].items()):
             assert info["fresh"] == 0, (fam, data["findings"])
             assert info["rules"], fam
@@ -1232,6 +1233,14 @@ class TestRepoJsonGate:
         assert ps["baselined"] == 0
         assert all(c == {"fresh": 0, "baselined": 0}
                    for c in ps["per_rule"].values())
+        # the memory lane gates at zero debt too: all six rules active,
+        # nothing fresh, nothing baselined, nothing unjustified
+        pf = data["families"]["PF"]
+        assert pf["rules"] == ["PF401", "PF402", "PF403", "PF404",
+                               "PF405", "PF406"]
+        assert pf["baselined"] == 0
+        assert all(c == {"fresh": 0, "baselined": 0}
+                   for c in pf["per_rule"].values())
 
 
 # -------------------------------------- seeded kernel/collective defects
@@ -1920,3 +1929,285 @@ class TestChangedOnly:
         assert rc == 1
         assert "git unavailable" in cap.err
         assert "PT001" in cap.out
+
+
+# ------------------------------- changed-only factory-module expansion
+
+class TestChangedOnlyFactoryExpansion:
+    """ISSUE PR13 small fix: a kernel built in one module (the factory)
+    and launched from another anchors its findings at the pallas_call
+    site — so when only the factory file changes, `--changed-only` must
+    pull the call-site file back into the analyzed set or the defect the
+    edit introduced is silently skipped."""
+
+    FACTORY = """
+        def make_kernel(eps):
+            def _kern(x_ref, y_ref, o_ref):
+                o_ref[:] = x_ref[:] + eps
+            return _kern
+    """
+    CALLSITE = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        from pkg.factory import make_kernel
+
+        def run(x):
+            kern = make_kernel(0.5)
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+    """
+
+    def _pkg(self, tmp_path):
+        from paddle_tpu.analysis.runner import discover
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "factory.py").write_text(textwrap.dedent(self.FACTORY))
+        (pkg / "callsite.py").write_text(textwrap.dedent(self.CALLSITE))
+        return pkg, discover(str(pkg))
+
+    def test_factory_change_pulls_in_call_site(self, tmp_path):
+        from paddle_tpu.analysis.runner import (
+            analyze_files, expand_changed_with_factories)
+        pkg, files = self._pkg(tmp_path)
+        changed = {os.path.abspath(str(pkg / "factory.py"))}
+        sel = expand_changed_with_factories(files, changed)
+        assert sorted(t[2] for t in sel) == ["pkg/callsite.py",
+                                             "pkg/factory.py"]
+        fs = analyze_files(sel, Config(rules={"PK102"}))
+        assert [(f.rule, f.path, f.detail) for f in fs] \
+            == [("PK102", "pkg/callsite.py", "refs:3!=2")]
+
+    def test_naive_selection_misses_the_defect(self, tmp_path):
+        # the regression this guards: filtering by changed paths alone
+        # analyzes only the factory file, where no pallas_call site
+        # exists, and the ref-count mismatch goes unreported
+        from paddle_tpu.analysis.runner import analyze_files
+        pkg, files = self._pkg(tmp_path)
+        changed = {os.path.abspath(str(pkg / "factory.py"))}
+        naive = [t for t in files
+                 if os.path.abspath(t[1]) in changed]
+        assert analyze_files(naive, Config(rules={"PK102"})) == []
+
+    def test_call_site_change_is_not_duplicated(self, tmp_path):
+        from paddle_tpu.analysis.runner import (
+            expand_changed_with_factories)
+        pkg, files = self._pkg(tmp_path)
+        changed = {os.path.abspath(str(pkg / "factory.py")),
+                   os.path.abspath(str(pkg / "callsite.py"))}
+        sel = expand_changed_with_factories(files, changed)
+        assert sorted(t[2] for t in sel) == ["pkg/callsite.py",
+                                             "pkg/factory.py"]
+
+    def test_no_changes_selects_nothing(self, tmp_path):
+        from paddle_tpu.analysis.runner import (
+            expand_changed_with_factories)
+        _, files = self._pkg(tmp_path)
+        assert expand_changed_with_factories(files, set()) == []
+
+
+# ------------------------------------ JSON schema version + ordering
+
+class TestJsonSchemaAndOrdering:
+    def test_schema_version_present(self, tmp_path, capsys):
+        p = tmp_path / "mod.py"
+        p.write_text("x = 1\n")
+        assert lint_main(["--json", str(p)]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["schema_version"] == 1
+
+    def test_findings_sorted_rule_path_qualname(self, tmp_path, capsys):
+        # two files, two rules each — emitted order must be
+        # (rule, path, qualname), not discovery or pass order
+        for name in ("b_mod.py", "a_mod.py"):
+            (tmp_path / name).write_text(textwrap.dedent("""
+                import jax
+
+                @jax.jit
+                def f(x):
+                    if x > 0:          # PT001 branch on traced value
+                        x = float(x)   # PT001 host conversion
+                    return x
+
+                def loop():
+                    for _ in range(3):
+                        g = jax.jit(lambda y: y)   # PT002
+                    return g
+            """))
+        assert lint_main(["--json", str(tmp_path / "b_mod.py"),
+                          str(tmp_path / "a_mod.py")]) == 1
+        data = json.loads(capsys.readouterr().out)
+        keys = [(f["rule"], f["path"], f["qualname"])
+                for f in data["findings"]]
+        assert keys == sorted(keys)
+        assert len({f["rule"] for f in data["findings"]}) > 1
+        assert len({f["path"] for f in data["findings"]}) > 1
+
+    def test_rules_carry_module(self, tmp_path, capsys):
+        p = tmp_path / "mod.py"
+        p.write_text("x = 1\n")
+        assert lint_main(["--json", str(p)]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["rules"]["PC201"]["module"].endswith(
+            "rules_collective")
+        assert data["rules"]["PF401"]["module"].endswith("rules_memory")
+
+
+# ---------------------------------------------- rule-family registry
+
+class TestRuleFamilyRegistry:
+    def test_every_rule_has_a_module_and_family(self):
+        from paddle_tpu.analysis.model import (FAMILIES, RULE_MODULES,
+                                               RULES, rule_family)
+        for rid in RULES:
+            assert RULE_MODULES.get(rid), rid
+            assert rule_family(rid) in FAMILIES, rid
+
+    def test_pc201_mapping_documented_in_registry(self):
+        # PC201 lives in rules_collective.py by design; the registry —
+        # not the filename convention — records that
+        from paddle_tpu.analysis.model import RULE_MODULES
+        assert RULE_MODULES["PC201"].endswith(".rules_collective")
+
+    def test_list_rules_grouped_by_family(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        headers = [ln for ln in out.splitlines() if ln.startswith("-- ")]
+        assert [h.split()[1].rstrip(":") for h in headers] \
+            == ["PC", "PF", "PK", "PS", "PT"]
+        # rules listed under their family header
+        lines = out.splitlines()
+        pf_at = lines.index(next(h for h in headers if "PF" in h))
+        pk_at = lines.index(next(h for h in headers if "PK" in h))
+        pf401_at = next(i for i, ln in enumerate(lines)
+                        if ln.startswith("PF401"))
+        assert pf_at < pf401_at < pk_at
+        # cross-filed rules carry their module marker
+        pt003 = next(ln for ln in lines if ln.startswith("PT003"))
+        assert "rules_hostsync" in pt003
+
+
+# ------------------------------------------ seeded memory-lane defects
+
+class TestSeededMemoryDefects:
+    """ISSUE PR13 acceptance: each PF rule catches exactly its seeded
+    defect in a scratch copy of the real kernel modules, and the
+    pristine copies stay PF-quiet. Copies are analyzed statically —
+    never imported — so mutations are plain text edits."""
+
+    RAGGED = "paddle_tpu/ops/pallas_ragged.py"
+    FUSED = "paddle_tpu/ops/fused.py"
+    QUANT = "paddle_tpu/ops/quant.py"
+
+    def _analyze(self, tmp_path, rel, tag, old="", new="", append="",
+                 strict=False):
+        src = open(os.path.join(REPO, rel)).read()
+        if old:
+            assert old in src, f"seed anchor vanished from {rel}: {old!r}"
+            src = src.replace(old, new, 1)
+        d = tmp_path / tag
+        d.mkdir(exist_ok=True)
+        p = d / os.path.basename(rel)
+        p.write_text(src + textwrap.dedent(append))
+        return analyze_paths([str(p)], Config(strict=strict))
+
+    def _seed(self, tmp_path, rel, strict=False, **kw):
+        clean = self._analyze(tmp_path, rel, "clean", strict=strict)
+        seeded = self._analyze(tmp_path, rel, "seeded", strict=strict,
+                               **kw)
+        new_keys = ({f.baseline_key for f in seeded}
+                    - {f.baseline_key for f in clean})
+        return [f for f in seeded if f.baseline_key in new_keys]
+
+    def test_pristine_copies_are_pf_quiet(self, tmp_path):
+        for rel in (self.RAGGED, self.FUSED, self.QUANT):
+            fs = self._analyze(tmp_path, rel, "clean")
+            assert [f for f in fs if f.rule.startswith("PF")] == [], rel
+
+    def test_pf401_catches_vmem_overflow(self, tmp_path):
+        # 4096x the f32 accumulator scratch: ~64 MiB against the 16 MiB
+        # per-core budget
+        fresh = self._seed(
+            tmp_path, self.RAGGED,
+            old="pltpu.VMEM((T * rep, D), jnp.float32),",
+            new="pltpu.VMEM((T * rep * 4096, D), jnp.float32),")
+        assert fresh and {f.rule for f in fresh} == {"PF401"}
+        assert fresh[0].detail == "vmem:ragged_paged_attention"
+        assert "MiB" in fresh[0].message
+
+    def test_pf402_catches_read_after_donate(self, tmp_path):
+        # `pages` is donated to output 0 of fused_append_rows; reading
+        # it after the launch observes the in-place overwrite
+        fresh = self._seed(
+            tmp_path, self.FUSED,
+            old="      rows, pages)",
+            new="      rows, pages)\n    _ = pages.mean()")
+        assert fresh and {f.rule for f in fresh} == {"PF402"}
+        assert fresh[0].detail == "alias:pages->out0"
+        assert fresh[0].qualname == "fused_append_rows"
+
+    def test_pf403_catches_reduced_precision_accumulator_store(
+            self, tmp_path):
+        # scratch stays DECLARED f32 (PK104 quiet) but the store
+        # truncates — the break PK104's declaration-side check misses
+        fresh = self._seed(
+            tmp_path, self.RAGGED,
+            old="m_ref[:] = m_new",
+            new="m_ref[:] = m_new.astype(jnp.bfloat16)")
+        assert fresh and {f.rule for f in fresh} == {"PF403"}
+        assert fresh[0].detail == "accum:m_ref"
+
+    def test_pf403_catches_unaligned_int4_lane(self, tmp_path):
+        # a Name-bound lane block (not a literal, so PK102's constant
+        # lane check stays quiet) that breaks the nibble-packed 128
+        # alignment
+        fresh = self._seed(
+            tmp_path, self.QUANT,
+            old="bn = next((c for c in (2048, 1024, 512, 256, 128) "
+                "if Np % c == 0), Np)",
+            new="bn = 64")
+        assert fresh and {f.rule for f in fresh} == {"PF403"}
+        assert fresh[0].detail == "int4lane:bn"
+        assert fresh[0].qualname == "int4_dequantize"
+
+    def test_pf404_emits_decode_chain_fusion_worklist(self, tmp_path):
+        # advisory, info severity: the pristine repo chain itself is the
+        # fixture — the aligned rms->swiglu pair is ROADMAP item 1's
+        # back half
+        fs = self._analyze(tmp_path, self.FUSED, "clean", strict=True)
+        details = {f.detail for f in fs if f.rule == "PF404"}
+        assert "fuse:fused_rms_norm->swiglu" in details
+        assert "fuse:fused_rms_norm->fused_rope_append" in details
+        # ...and stays out of default (non-strict) runs
+        fs = self._analyze(tmp_path, self.FUSED, "plain")
+        assert [f for f in fs if f.rule == "PF404"] == []
+
+    def test_pf405_catches_indivisible_grid(self, tmp_path):
+        # 8 tokens // 192 == 0 under the canonical shapes: the launch
+        # silently skips every row
+        fresh = self._seed(
+            tmp_path, self.FUSED,
+            old="grid=(T // bt,),",
+            new="grid=(T // 192,),")
+        assert fresh and {f.rule for f in fresh} == {"PF405"}
+        assert fresh[0].detail == "grid:T // 192"
+        assert fresh[0].qualname == "_rms_forward"
+
+    def test_pf406_catches_cost_model_drift(self, tmp_path):
+        # grow the dequant output block ~25%: BlockSpec-derived bytes
+        # drift past COST_DRIFT_RTOL while VMEM stays in budget, so
+        # exactly the drift rule fires
+        fresh = self._seed(
+            tmp_path, self.QUANT,
+            old="out_specs=pl.BlockSpec((K2 * 2, bn), "
+                "lambda j: (0, j)),",
+            new="out_specs=pl.BlockSpec((K2 * 2 + 256, bn), "
+                "lambda j: (0, j)),")
+        assert fresh and {f.rule for f in fresh} == {"PF406"}
+        assert fresh[0].detail == "drift:int4_dequantize"

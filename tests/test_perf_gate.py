@@ -233,3 +233,55 @@ class TestCli:
         assert {r["key"] for r in rep["rows"]} == {
             "pretrain.pretrain_tps",
             "serving.decode.decode_tokens_per_s_per_chip"}
+
+
+class TestVmemDriftCheck:
+    """ISSUE PR13 CI satellite: observatory candidates are cross-checked
+    against a costmodel recompute at their own recorded scenario, judged
+    at the SAME tolerance as paddlelint's PF406 (one shared constant)."""
+
+    def _committed(self):
+        with open(os.path.join(REPO, "docs", "OBSERVATORY.json")) as f:
+            return json.load(f)
+
+    def test_tolerance_is_shared_with_the_analyzer(self):
+        from paddle_tpu.analysis import vmemmodel
+        assert perf_gate.COST_DRIFT_RTOL is vmemmodel.COST_DRIFT_RTOL
+
+    def test_committed_artifact_recomputes_exactly(self):
+        rows = perf_gate.vmem_drift_rows(self._committed())
+        assert len(rows) >= 5            # the full decode-layer chain
+        assert all(r["ok"] for r in rows)
+        assert all(r["value"] == r["band"][0] for r in rows)
+
+    def test_candidate_without_scenario_fields_is_skipped(self):
+        # artifacts predating the scenario extension stay green
+        assert perf_gate.vmem_drift_rows(OBSERVATORY) == []
+        art = self._committed()
+        del art["scenario"]["hidden"]
+        assert perf_gate.vmem_drift_rows(art) == []
+
+    def test_drift_inside_noise_band_is_still_rejected(self, tmp_path):
+        # +8% bytes: inside the 15% observatory noise band (the
+        # per-kernel row passes) but beyond the 5% static tolerance —
+        # exactly the stale-cost-table case the noise band cannot see
+        art = self._committed()
+        row = next(k for k in art["kernels"] if k["kernel"] == "swiglu")
+        row["bytes"] = int(row["bytes"] * 1.08)
+        rows = perf_gate.vmem_drift_rows(art)
+        bad = [r for r in rows if not r["ok"]]
+        assert [r["key"] for r in bad] \
+            == ["observatory.vmem.swiglu.bytes"]
+        assert "static memory model" in bad[0]["why"]
+        cand = tmp_path / "cand.json"
+        with open(cand, "w") as f:
+            json.dump(art, f)
+        assert perf_gate.main(["--repo", REPO,
+                               "--check", str(cand)]) == 1
+
+    def test_unmodeled_kernel_rows_are_ignored(self):
+        art = self._committed()
+        art["kernels"].append({"kernel": "not_in_registry",
+                               "bytes": 123, "launches": 1})
+        keys = {r["key"] for r in perf_gate.vmem_drift_rows(art)}
+        assert "observatory.vmem.not_in_registry.bytes" not in keys

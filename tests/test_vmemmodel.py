@@ -1,0 +1,188 @@
+"""vmemmodel (paddle_tpu.analysis.vmemmodel): the static per-kernel
+memory model behind the PF rule family.
+
+The ISSUE PR13 acceptance gate lives here: every one of the 17 kernels
+registered in observability/costmodel.py must have a canonical entry
+whose BlockSpec-derived HBM bytes agree with the registered CostEstimate
+within COST_DRIFT_RTOL, every canonical launch must fit the 16 MiB
+per-core VMEM budget, and the decode-chain fusion scan must surface the
+rms->swiglu pair that ROADMAP item 1 fuses by hand."""
+
+import os
+
+import pytest
+
+from paddle_tpu.analysis import kernelmodel as km
+from paddle_tpu.analysis import vmemmodel as vm
+from paddle_tpu.analysis.callgraph import PackageIndex
+from paddle_tpu.analysis.runner import discover
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def index():
+    return PackageIndex.from_files(
+        discover(os.path.join(REPO, "paddle_tpu")))
+
+
+@pytest.fixture(scope="module")
+def sites(index):
+    return vm.canonical_sites(index)
+
+
+class TestCanonicalCoverage:
+    def test_every_registered_cost_kernel_has_an_entry(self):
+        cm = vm.load_costmodel()
+        assert cm is not None
+        registered = set(cm.costs())
+        modeled = {e["kernel"] for e in vm.CANONICAL.values()}
+        assert modeled == registered
+        assert len(registered) == 17
+
+    def test_every_entry_resolves_to_one_repo_site(self, sites):
+        missing = sorted(set(vm.CANONICAL) - set(sites))
+        assert missing == []
+
+
+class TestCostAgreement:
+    """PF406's substance: the cost registry and the committed BlockSpecs
+    describe the same kernels."""
+
+    def test_all_17_kernels_within_tolerance(self, index):
+        recs = vm.derive_cost_bytes(index)
+        assert len(recs) == 17
+        bad = [(r["kernel"], r["status"], r.get("rel_err"))
+               for r in recs if r["status"] != "ok"]
+        assert bad == []
+
+    def test_most_kernels_are_byte_exact(self, index):
+        # only flashmask carries structural slack (its registered cost
+        # reuses flash's segment terms); everything else must be exact
+        recs = {r["kernel"]: r for r in vm.derive_cost_bytes(index)}
+        inexact = sorted(k for k, r in recs.items()
+                         if r["rel_err"] and r["rel_err"] > 1e-9)
+        assert inexact in ([], ["flashmask_sdpa"])
+        assert recs["flashmask_sdpa"]["rel_err"] < vm.COST_DRIFT_RTOL
+
+    def test_drift_detected_when_cost_registry_lies(self, index):
+        class _FakeCost:
+            def cost(self, name, **kw):
+                real = vm.load_costmodel().cost(name, **kw)
+                class _C:
+                    bytes_read = int(real.bytes_read * 2)
+                    bytes_written = int(real.bytes_written * 2)
+                    breakdown = {k: v * 2 for k, v in
+                                 (real.breakdown or {}).items()}
+                return _C()
+        recs = vm.derive_cost_bytes(index, cost_module=_FakeCost())
+        assert any(r["status"] == "drift" for r in recs)
+
+
+class TestFootprints:
+    def test_all_canonical_launches_fit_vmem(self, sites):
+        for qn, site in sites.items():
+            fp = vm.site_footprint(site, vm.CANONICAL[qn])
+            assert fp["bytes"] <= vm.VMEM_BYTES_PER_CORE, (
+                qn, fp["bytes"])
+
+    def test_footprints_are_nonzero(self, sites):
+        for qn, site in sites.items():
+            fp = vm.site_footprint(site, vm.CANONICAL[qn])
+            assert fp["bytes"] > 0, qn
+
+    def test_grid_swept_blocks_double_buffer(self, sites):
+        # _rms_forward: x in/out blocks sweep the grid (x2 double
+        # buffering), the weight block does not
+        site = sites["_rms_forward"]
+        entry = vm.CANONICAL["_rms_forward"]
+        b = vm.site_bindings(entry)
+        bt, h = b["bt"], b["H"]
+        expected = (bt * h * 2) * 2 * 2 + h * 2   # x, out dbl-buffered
+        fp = vm.site_footprint(site, entry)
+        assert fp["bytes"] == expected
+        assert fp["unresolved"] == 0
+
+    def test_unresolved_blocks_are_counted_not_guessed(self, sites):
+        # paged_decode_attention_v2 declares two data-dtype scratch
+        # buffers the static model cannot size; they must surface in
+        # `unresolved`, not silently inflate/deflate the byte total
+        site = sites["paged_decode_attention_v2"]
+        fp = vm.site_footprint(site, vm.CANONICAL[
+            "paged_decode_attention_v2"])
+        assert fp["unresolved"] == 2
+
+
+class TestGridOk:
+    def test_canonical_grids_divide(self, sites):
+        for qn, site in sites.items():
+            b = vm.site_bindings(vm.CANONICAL[qn])
+            assert vm.grid_ok(site, b), qn
+
+    def test_indivisible_grid_rejected(self, sites):
+        site = sites["_rms_forward"]
+        b = vm.site_bindings(vm.CANONICAL["_rms_forward"])
+        b["bt"] = 192                      # 8 % 192 != 0
+        assert not vm.grid_ok(site, b)
+
+
+class TestHelperRebuild:
+    """Flash/flashmask route their specs through a local `_specs` helper
+    the call-site Env cannot see; the model rebuilds them from the
+    helper body (the idiom test_costmodel.py pins for the cost suite)."""
+
+    def test_flash_specs_rebuilt(self, sites):
+        site = sites["_flash_fwd_impl"]
+        in_specs, out_specs = vm._site_specs(
+            site, vm.CANONICAL["_flash_fwd_impl"])
+        assert in_specs is not None and len(in_specs) == 5
+        assert all(s.block_shape for s in in_specs)
+
+    def test_flashmask_concat_specs_rebuilt(self, sites):
+        # the flashmask helper returns [kind] + [se]*4 + [q, k, v]:
+        # list-concat and list-repeat must both flatten
+        site = sites["_flashmask_fwd_impl"]
+        in_specs, _ = vm._site_specs(
+            site, vm.CANONICAL["_flashmask_fwd_impl"])
+        assert in_specs is not None and len(in_specs) == 8
+
+    def test_transfer_derivable_after_rebuild(self, sites):
+        site = sites["_flash_fwd_impl"]
+        t = vm.derive_transfer(site, vm.CANONICAL["_flash_fwd_impl"])
+        assert t is not None
+        assert t["read"] > 0 and t["write"] > 0
+        assert t["unresolved"] == 0
+
+
+class TestFusionCandidates:
+    def test_decode_chain_pairs_found(self, index):
+        cands = vm.fusion_candidates(index)
+        details = {c["detail"]: c for c in cands}
+        # ROADMAP item 1's back half: norm -> swiglu share the token
+        # tiling exactly
+        assert "fuse:fused_rms_norm->swiglu" in details
+        assert details["fuse:fused_rms_norm->swiglu"]["class"] \
+            == "aligned"
+
+    def test_candidates_carry_sites(self, index):
+        for c in vm.fusion_candidates(index):
+            assert c["site"].qualname in vm._CHAIN_SITE.values()
+            assert c["producer"] in vm.DECODE_CHAIN
+            assert c["consumer"] in vm.DECODE_CHAIN
+
+
+class TestSharedDriftConstant:
+    def test_perf_gate_imports_the_same_tolerance(self):
+        # one constant, no drift between paddlelint and perf_gate
+        import importlib.util
+        import sys
+        import types
+        path = os.path.join(REPO, "tools", "perf_gate.py")
+        spec = importlib.util.spec_from_file_location("_pg_test", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_pg_test"] = mod
+        try:
+            spec.loader.exec_module(mod)
+            assert mod.COST_DRIFT_RTOL == vm.COST_DRIFT_RTOL
+        finally:
+            sys.modules.pop("_pg_test", None)

@@ -108,6 +108,17 @@ def run_serving(requests: int = 4, prompt_len: int = 8,
             "requests": requests, "prompt_len": prompt_len,
             "new_tokens": new_tokens, "max_slots": max_slots,
             "page_size": page_size, "device_steps": steps,
+            # recompute inputs for tools/perf_gate.py's static
+            # cross-check (vmem_drift_rows): enough to re-derive every
+            # per-kernel bytes figure from the cost registry alone
+            "layers": layers, "hidden": cfg.hidden_size,
+            "heads": cfg.num_attention_heads,
+            "kv_heads": cfg.num_key_value_heads,
+            "head_dim": cfg.head_dim,
+            "intermediate": cfg.intermediate_size,
+            "context": int(context),
+            "weight_bytes_per_layer": int(acct["weights_bytes"]
+                                          // layers),
         },
         "serving": {
             "bytes_per_token_model": model,

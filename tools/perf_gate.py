@@ -42,9 +42,21 @@ import json
 import os
 import re
 import sys
+import types
 from typing import Any, Dict, List, Optional, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One tolerance shared with paddlelint's PF406 cost-drift rule: imported
+# from the analyzer (pure stdlib; the stub parent skips paddle_tpu's jax
+# imports, same trick as tools/paddlelint.py) so the two gates cannot
+# drift apart.
+if "paddle_tpu" not in sys.modules:
+    _stub = types.ModuleType("paddle_tpu")
+    _stub.__path__ = [os.path.join(REPO, "paddle_tpu")]
+    sys.modules["paddle_tpu"] = _stub
+from paddle_tpu.analysis.vmemmodel import (  # noqa: E402
+    COST_DRIFT_RTOL, load_costmodel)
 
 # SERVING_BENCH fields gated per row (all higher-is-better: throughputs
 # plus the prefix-cache hit-rate / TTFT-speedup and speculative-decode
@@ -264,6 +276,66 @@ def flatten_observatory(art: Dict[str, Any]
     return flat, bad
 
 
+#: scenario fields an observatory candidate must record for the static
+#: cross-check to recompute its per-kernel bytes
+_SCENARIO_KEYS = ("max_slots", "context", "hidden", "heads", "kv_heads",
+                  "head_dim", "intermediate", "page_size", "layers",
+                  "device_steps", "weight_bytes_per_layer")
+
+
+def vmem_drift_rows(candidate: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cross-check an observatory candidate's per-kernel bytes against a
+    fresh costmodel recompute at the candidate's own recorded scenario
+    shapes — the same registry paddlelint's PF406 holds byte-consistent
+    with the committed BlockSpecs, judged at the same COST_DRIFT_RTOL.
+    A candidate whose bytes disagree was produced by a stale or edited
+    cost table and must not rerate the bands. Candidates predating the
+    scenario extension (missing recompute fields) are skipped, not
+    failed, so old artifacts stay green."""
+    sc = candidate.get("scenario") or {}
+    if any(not isinstance(sc.get(k), (int, float))
+           for k in _SCENARIO_KEYS):
+        return []
+    cm = load_costmodel()
+    if cm is None:
+        return []
+    try:
+        layer = cm.decode_layer_kernels(
+            "llama", batch=int(sc["max_slots"]),
+            context=int(sc["context"]), hidden=int(sc["hidden"]),
+            heads=int(sc["heads"]), kv_heads=int(sc["kv_heads"]),
+            head_dim=int(sc["head_dim"]),
+            intermediate=int(sc["intermediate"]),
+            page_size=int(sc["page_size"]),
+            weight_bytes_per_layer=int(sc["weight_bytes_per_layer"]))
+    except Exception:
+        return []
+    mult = int(sc["layers"]) * int(sc["device_steps"])
+    out = []
+    for k in candidate.get("kernels", []):
+        if not isinstance(k, dict):
+            continue
+        name, v = k.get("kernel"), k.get("bytes")
+        ref = layer["kernels"].get(name)
+        if ref is None or not isinstance(v, (int, float)) or v <= 0:
+            continue
+        n, est = ref
+        expected = float(est.hbm_bytes * n * mult)
+        if expected <= 0:
+            continue
+        rel = abs(float(v) - expected) / expected
+        row = {"key": f"observatory.vmem.{name}.bytes",
+               "value": float(v), "band": [expected, expected],
+               "source": "costmodel@scenario",
+               "ok": rel <= COST_DRIFT_RTOL}
+        if not row["ok"]:
+            row["why"] = (f"disagrees with the static memory model by "
+                          f"{rel:.1%} (tolerance {COST_DRIFT_RTOL:.0%}:"
+                          f" model says {expected:.0f} bytes)")
+        out.append(row)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
@@ -299,7 +371,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             # an OBSERVATORY.json-shaped candidate: flatten to metric
             # keys; missing gated fields become pre-failed rows
             flat, bad = flatten_observatory(cand)
-            rows = check_candidate(flat, rows) + bad
+            rows = (check_candidate(flat, rows) + bad
+                    + vmem_drift_rows(cand))
         else:
             rows = check_candidate(
                 {k: v for k, v in cand.items()
