@@ -293,23 +293,70 @@ CANONICAL: Dict[str, Dict[str, Any]] = {
         token_tiled=False,
         families={"llama": dict(K=4096, N=1792)},
     ),
+    # -- ops/pallas_megadecode.py (ISSUE 14 mega-kernel back half) ---------
+    # 8-way tensor-parallel shard shapes, like _wol_int8_fwd_impl: H=512
+    # is llama's 4096/8, I=1792 its 14336/8 — the whole weight slab is
+    # VMEM-resident (constant index_maps: fetched once per launch).
+    "_oproj_norm_forward": dict(
+        kernel="fused_oproj_norm",
+        bindings=dict(T=8, bt=8, Ko=512, H=512),
+        in_widths=[2, 2, 2, 4, 2, 2, 2], out_widths=[2, 2],
+        cost_kwargs=dict(T=8, Ko=512, H=512),
+        token_tiled=True,
+        families={"llama": dict(Ko=512, H=512)},
+    ),
+    "_oproj_norm_int4": dict(
+        kernel="fused_oproj_norm",
+        bindings=dict(T=8, bt=8, Ko2=256, H=512),
+        in_widths=[2, 2, 2, 1, 4, 2, 2, 2], out_widths=[2, 2],
+        cost_kwargs=dict(T=8, Ko=512, H=512, algo="weight_only_int4"),
+        token_tiled=True,
+        families={"llama": dict(Ko2=256, H=512)},
+    ),
+    "_ffn_forward": dict(
+        kernel="fused_ffn",
+        bindings=dict(T=8, bt=8, H=512, I=1792, Ku=512),
+        in_widths=[2, 2, 2, 4, 2, 4, 2, 4, 2, 2], out_widths=[2],
+        cost_kwargs=dict(T=8, H=512, I=1792),
+        token_tiled=True,
+        families={"llama": dict(H=512, I=1792)},
+    ),
+    "_ffn_int4": dict(
+        kernel="fused_ffn",
+        bindings=dict(T=8, bt=8, H=512, H2=256, I=1792, I2=896),
+        in_widths=[2, 2, 2, 1, 4, 1, 4, 1, 4, 2, 2], out_widths=[2],
+        cost_kwargs=dict(T=8, H=512, I=1792, algo="weight_only_int4"),
+        token_tiled=True,
+        families={"llama": dict(H2=256, I2=896)},
+    ),
 }
 
 #: The decode-layer kernel chain in launch order (PF404 walks adjacent
-#: pairs; names repeat where the layer re-enters a kernel).  The XLA
-#: projections between launches are exactly the HBM round-trips a
-#: mega-kernel would elide — ROADMAP item 1's back half is the final
-#: norm -> swiglu pair.
+#: pairs).  ISSUE 14 collapsed the back half into the two megadecode
+#: launches — o-proj + residual + norm, then the whole FFN — so the old
+#: norm -> swiglu advisory is RESOLVED (the swiglu kernel stays
+#: registered for the standalone op).  The two advisories that remain
+#: standing are justified seams, not oversights:
+#:   - fused_rms_norm -> fused_rope_append 'retile': the qkv projection
+#:     matmuls sit between them, and their [T, H] x [H, (Hq+2KV)D]
+#:     weight slab plus the rope pair cannot co-reside in VMEM at the
+#:     family shapes;
+#:   - fused_oproj_norm -> fused_ffn 'aligned': the deliberate two-
+#:     kernel cut — the o-proj slab plus all three FFN slabs exceed the
+#:     16 MiB budget even 8-way sharded, so only the [T, H] residual +
+#:     normed pair crosses HBM between them (down from four
+#:     intermediates in the unfused chain).
 DECODE_CHAIN: List[str] = [
     "fused_rms_norm", "fused_rope_append", "ragged_paged_attention",
-    "fused_rms_norm", "swiglu",
+    "fused_oproj_norm", "fused_ffn",
 ]
 
 _CHAIN_SITE: Dict[str, str] = {
     "fused_rms_norm": "_rms_forward",
     "fused_rope_append": "fused_rope_append",
     "ragged_paged_attention": "ragged_paged_attention",
-    "swiglu": "_swiglu_forward",
+    "fused_oproj_norm": "_oproj_norm_forward",
+    "fused_ffn": "_ffn_forward",
 }
 
 

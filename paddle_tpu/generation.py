@@ -305,7 +305,8 @@ def _q8(d, key, enabled: bool = True, algo: str = "weight_only_int8"):
     d[key + "_s"] = sc.astype(jnp.float32)
 
 
-def _mlp_params(lyr, weight_only_int8: bool = False):
+def _mlp_params(lyr, weight_only_int8: bool = False,
+                algo: str = "weight_only_int8"):
     """Per-layer FFN weights: (weight dict, static routing knobs or None).
     Dense SwiGLU (llama layout) or routed MoE (dropless per-token routing —
     serving never drops tokens; the capacity factor is a training
@@ -313,10 +314,12 @@ def _mlp_params(lyr, weight_only_int8: bool = False):
     of the weight tree: it rides through jit as arguments.
 
     ``weight_only_int8`` quantizes the dense ffn, the per-expert stacks
-    (per-expert out-channel scales) and the shared expert; the ROUTER
-    gate stays fp — it is tiny and routing decisions are
-    precision-sensitive (a flipped top-k is a different program, not a
-    rounding error)."""
+    (per-expert out-channel scales) and the shared expert with ``algo``
+    ('weight_only_int8' or 'weight_only_int4' — the 3-D expert stacks
+    pack per expert via the vmapped weight_quantize and read back
+    through _dq's plane-interleave); the ROUTER gate stays fp — it is
+    tiny and routing decisions are precision-sensitive (a flipped top-k
+    is a different program, not a rounding error)."""
     m = lyr.mlp
     from .incubate.moe import MoELayer
     if isinstance(m, MoELayer):
@@ -336,27 +339,29 @@ def _mlp_params(lyr, weight_only_int8: bool = False):
                   wge=m.w_gate._data if m.w_gate is not None else None,
                   wup=m.w_up._data, wdn=m.w_down._data)
         for k in ("wge", "wup", "wdn"):
-            _q8(mo, k, weight_only_int8)
+            _q8(mo, k, weight_only_int8, algo)
         if m.shared_up is not None:
             sh = dict(sg=m.shared_gate.weight._data,
                       su=m.shared_up.weight._data,
                       sd=m.shared_down.weight._data)
             for k in ("sg", "su", "sd"):
-                _q8(sh, k, weight_only_int8)
+                _q8(sh, k, weight_only_int8, algo)
             mo["shared"] = sh
         return dict(moe=mo), dict(top_k=m.top_k, renorm=m.renormalize)
     d = dict(wg=m.gate_proj.weight._data, wu=m.up_proj.weight._data,
              wd=m.down_proj.weight._data)
     for k in ("wg", "wu", "wd"):
-        _q8(d, k, weight_only_int8)
+        _q8(d, k, weight_only_int8, algo)
     return d, None
 
 
-def _moe_decode_params(model, weight_only_int8: bool = False):
+def _moe_decode_params(model, weight_only_int8: bool = False,
+                       algo: str = "weight_only_int8"):
     """MoEForCausalLM (Qwen2-MoE/DeepSeekMoE pattern): llama attention
-    backbone, per-layer dense-or-routed FFN. ``weight_only_int8`` halves
-    the HBM weight reads (the expert stacks are the bulk of them) — see
-    _llama_decode_params."""
+    backbone, per-layer dense-or-routed FFN. ``weight_only_int8`` cuts
+    the HBM weight reads (the expert stacks are the bulk of them) with
+    ``algo`` — 'weight_only_int4' packs the 3-D expert stacks two
+    nibbles per byte for quarter-width reads — see _llama_decode_params."""
     inner = model.model
     cfg = model.config
     layers = []
@@ -369,8 +374,8 @@ def _moe_decode_params(model, weight_only_int8: bool = False):
             wv=a.v_proj.weight._data, wo=a.o_proj.weight._data,
             ln2=lyr.post_attention_layernorm.weight._data)
         for k in ("wq", "wk", "wv", "wo"):
-            _q8(d, k, weight_only_int8)
-        mlp_w, mlp_st = _mlp_params(lyr, weight_only_int8)
+            _q8(d, k, weight_only_int8, algo)
+        mlp_w, mlp_st = _mlp_params(lyr, weight_only_int8, algo)
         d.update(mlp_w)
         layers.append(d)
         moe_static.append(mlp_st)
@@ -381,7 +386,7 @@ def _moe_decode_params(model, weight_only_int8: bool = False):
              cos=inner.rope_cos._data, sin=inner.rope_sin._data,
              moe_static=tuple(moe_static))
     if weight_only_int8 and head is not None:
-        _q8(p, "head")
+        _q8(p, "head", True, algo)
         p["head"] = None
     return p
 
@@ -394,11 +399,12 @@ def _mla_decode_params(model, weight_only_int8: bool = False,
     the query/output projections (DeepSeek-V2 matrix absorption; ref
     capability: PaddleNLP deepseek_v2 fused MLA decode).
 
-    ``algo`` applies to the attention projections and the head:
-    'weight_only_int4' packs them (kv_b reads whole through
+    ``algo`` applies to every quantized leaf: 'weight_only_int4' packs
+    the attention projections (kv_b reads whole through
     ops.quant.int4_dequantize; the rest through _mm_w's split
-    contraction). The FFN/expert stacks always quantize int8 — their
-    3-D per-expert einsums consume weights whole."""
+    contraction) AND the FFN/expert stacks — 3-D packed stacks read
+    whole through _dq's plane-interleave dequant (density win: the
+    stored stack is quarter-width)."""
     inner = model.model
     cfg = model.config
     layers = []
@@ -421,7 +427,7 @@ def _mla_decode_params(model, weight_only_int8: bool = False,
         for k in ("wkva", "wkvb", "wo", "wqa", "wqb", "wq"):
             if k in d:
                 _q8(d, k, weight_only_int8, algo)
-        mlp_w, mlp_st = _mlp_params(lyr, weight_only_int8)
+        mlp_w, mlp_st = _mlp_params(lyr, weight_only_int8, algo)
         d.update(mlp_w)
         layers.append(d)
         moe_static.append(mlp_st)
@@ -440,11 +446,12 @@ def _mla_decode_params(model, weight_only_int8: bool = False,
 def _decode_params(model, weight_only_int8: bool = False,
                    weight_only_quant=None):
     """Family dispatch for the cached/compiled decode paths. int4 covers
-    the llama family and the MLA attention projections (kv_b reads whole
-    through the int4_dequantize kernel; experts/FFN stay int8) — the MoE
-    expert stacks are consumed whole by 3-D per-expert einsums whose
-    contraction the int4 split would have to thread through every call
-    site (int8 already halves them)."""
+    the llama, MoE and MLA families end-to-end: 2-D projections contract
+    through _mm_w's even/odd split (or read whole through
+    int4_dequantize — the MLA kv_b), and the 3-D MoE expert stacks pack
+    per expert and read back through _dq's plane-interleave. The GPT
+    family stays fp (its fused-qkv + bias layout is not wired through
+    the quant matmul helper)."""
     algo, enabled = _woq_algo(weight_only_int8, weight_only_quant)
     if getattr(model, "gpt", None) is not None:
         if enabled:
@@ -459,12 +466,8 @@ def _decode_params(model, weight_only_int8: bool = False,
         from .models.moe_llm import MoEModel
         if isinstance(inner, DeepSeekV2Model):
             return _mla_decode_params(model, enabled, algo)
-        if enabled and algo == "weight_only_int4":
-            raise NotImplementedError(
-                "weight_only_quant='int4' covers the llama and MLA "
-                "families; MoE runs 'int8', the GPT family is fp-only")
         if isinstance(inner, MoEModel):
-            return _moe_decode_params(model, enabled)
+            return _moe_decode_params(model, enabled, algo)
     return _llama_decode_params(model, weight_only_int8,
                                 weight_only_quant)
 
@@ -487,15 +490,21 @@ def _dq(d, key, dtype):
     into the consuming einsum. 3-D stacks carry per-(expert, out-channel)
     scales [E, N]. 2-D int4 (_q4) entries unpack through the
     ops.quant.int4_dequantize Pallas kernel (the HBM read stays packed;
-    the MLA absorbed kv_b rides this); 3-D expert stacks stay int8-only
-    — their per-expert einsum consumers would re-materialize the planes
-    anyway."""
+    the MLA absorbed kv_b rides this); 3-D packed stacks [E, K/2, N]
+    interleave their sign-extended nibble planes back to source-row
+    order (the same row order weight_dequantize writes) and scale per
+    (expert, out-channel) — int4's recorded win here is DENSITY (the
+    stored stack is quarter-width), not speed: the per-expert einsum
+    consumers materialize the planes either way."""
     if key + "_q4" in d:
         q4, s = d[key + "_q4"], d[key + "_s"]
         if q4.ndim == 3:
-            raise NotImplementedError(
-                f"{key}: 3-D packed-int4 expert stacks are not readable "
-                "whole; experts run 'int8'")
+            from .ops.quant import int4_planes
+            lo, hi = int4_planes(q4)                    # [E, K/2, N]
+            E, K2, N = q4.shape
+            w = jnp.stack([lo, hi], axis=2).reshape(E, K2 * 2, N)
+            return (w.astype(jnp.float32)
+                    * s[:, None, :].astype(jnp.float32)).astype(dtype)
         from .ops.quant import int4_dequantize
         return int4_dequantize(q4, s).astype(dtype)
     if key + "_q" in d:

@@ -419,6 +419,69 @@ def _c_weight_only_linear(*, M: int, K: int, N: int,
                         breakdown={"weights": w, "activations": x + out})
 
 
+def _quant_payload(K: int, N: int, algo: Optional[str],
+                   dtype_bytes: int) -> int:
+    """HBM bytes of one [K, N] weight slab in its deploy layout: fp
+    (dtype_bytes wide), int8 (1 byte) or packed int4 (half a byte —
+    two nibbles share each stored byte)."""
+    if algo is None:
+        return K * N * dtype_bytes
+    if algo == "weight_only_int8":
+        return K * N
+    if algo == "weight_only_int4":
+        return (K // 2) * N
+    raise ValueError(f"unknown algo: {algo}")
+
+
+@register_cost("fused_oproj_norm")
+def _c_fused_oproj_norm(*, T: int, Ko: int, H: int,
+                        algo: Optional[str] = None,
+                        dtype_bytes: int = 2) -> CostEstimate:
+    """Mega-kernel 1 (ops/pallas_megadecode.py): o-proj + bias +
+    residual add + rms/layer norm in one launch.  Reads the attention
+    output [T, Ko], the residual [T, H], the weight slab in its deploy
+    layout (+ f32 scale row) and the bias/norm rows; writes BOTH the
+    new residual stream and the normed FFN input — the four
+    intermediates of the unfused chain never cross HBM."""
+    db = dtype_bytes
+    w = _quant_payload(Ko, H, algo, db) + H * 4      # slab + f32 scale
+    x = T * Ko * db + T * H * db                     # o + residual in
+    rows = 3 * H * db                                # bias + nw + nb
+    out = 2 * T * H * db                             # x_new + h
+    return CostEstimate(
+        bytes_read=x + w + rows, bytes_written=out,
+        flops=2 * T * Ko * H + 8 * T * H,
+        breakdown={"weights": w, "activations": x + out,
+                   "rows": rows})
+
+
+@register_cost("fused_ffn")
+def _c_fused_ffn(*, T: int, H: int, I: int, algo: Optional[str] = None,
+                 act: str = "swiglu",
+                 dtype_bytes: int = 2) -> CostEstimate:
+    """Mega-kernel 2 (ops/pallas_megadecode.py): gate/up matmul +
+    activation (swiglu or gelu) + down-proj + residual add.  The
+    [T, I] activation lives only in f32 VMEM scratch; gelu rides a
+    sublane-minimal 8-row dummy up slab (launch arity stays fixed)."""
+    db = dtype_bytes
+    wg = _quant_payload(H, I, algo, db) + I * 4
+    if act == "swiglu":
+        wu = _quant_payload(H, I, algo, db) + I * 4
+    else:
+        wu = 8 * I * db + I * 4                      # the gelu dummy
+    wd = _quant_payload(I, H, algo, db) + H * 4
+    x = 2 * T * H * db                               # h + residual in
+    rows = I * db + H * db                           # b1 + b2
+    out = T * H * db
+    n_mats = 3 if act == "swiglu" else 2
+    return CostEstimate(
+        bytes_read=x + wg + wu + wd + rows, bytes_written=out,
+        flops=2 * T * H * I * (n_mats - 1) + 2 * T * I * H
+        + 6 * T * I,
+        breakdown={"weights": wg + wu + wd, "activations": x + out,
+                   "rows": rows})
+
+
 # ---------------------------------------------------------------------------
 # composite budgets — the shared cost vocabulary
 # ---------------------------------------------------------------------------
@@ -485,21 +548,29 @@ def decode_layer_kernels(family: str = "llama", *, batch: int,
                          intermediate: int, page_size: int,
                          kv_dtype_bytes: int = 2,
                          weight_bytes_per_layer: int = 0,
-                         quant_algo: Optional[str] = None
-                         ) -> Dict[str, Any]:
-    """Per-kernel decomposition of one decode layer body (the ~6-kernel
-    chain ROADMAP item 1 fuses against): {kernel: (launches_per_layer,
-    CostEstimate at this shape)}.
+                         quant_algo: Optional[str] = None,
+                         megadecode: bool = True) -> Dict[str, Any]:
+    """Per-kernel decomposition of one decode layer body:
+    {kernel: (launches_per_layer, CostEstimate at this shape)}.
 
-    The projection matmuls (qkv / o-proj / ffn) route through
-    `weight_only_linear` when ``quant_algo`` is set; in bf16 they are
-    XLA dots, reported under the pseudo-kernel ``xla_projections`` so
-    the layer's weight traffic still lands in the ledger (pass
-    ``weight_bytes_per_layer`` from the real weight tree).
+    ``megadecode=True`` (the engine default since ISSUE 14) models the
+    mega-kernel back half: after attention only ``fused_oproj_norm``
+    and ``fused_ffn`` launch (2 pallas_calls; their weight slabs are
+    carved out of ``weight_bytes_per_layer``, so only the qkv matmuls
+    remain under the projection pseudo-kernel).  ``megadecode=False``
+    models the pre-ISSUE-14 split chain (the ~6-kernel body ROADMAP
+    item 1 fused against: 2 norms + swiglu + 6 projection matmuls).
+
+    The projection matmuls route through `weight_only_linear` when
+    ``quant_algo`` is set; in bf16 they are XLA dots, reported under
+    the pseudo-kernel ``xla_projections`` so the layer's weight traffic
+    still lands in the ledger (pass ``weight_bytes_per_layer`` from the
+    real weight tree).
     """
     B, D, KV, Hq = batch, head_dim, kv_heads, heads
     kernels: Dict[str, Any] = {
-        "fused_rms_norm": (2, cost("fused_rms_norm", T=B, H=hidden)),
+        "fused_rms_norm": (1 if megadecode else 2,
+                           cost("fused_rms_norm", T=B, H=hidden)),
         "fused_rope_append": (1, cost(
             "fused_rope_append", T=B, Hq=Hq, KV=KV, D=D,
             page_size=page_size, dtype_bytes=kv_dtype_bytes)),
@@ -507,22 +578,40 @@ def decode_layer_kernels(family: str = "llama", *, batch: int,
             "ragged_paged_attention", T=B, H=Hq, KV=KV, D=D, S=B,
             pages_per_seq=_ceil_div(context, page_size),
             page_size=page_size, dtype_bytes=kv_dtype_bytes)),
-        "swiglu": (1, cost("swiglu", T=B, H=intermediate)),
     }
-    # projection traffic: every weight byte of the layer crosses once
-    # per step plus the token activations each way
-    proj_flops = 2 * B * hidden * (Hq * D + 2 * KV * D + hidden
-                                   + 3 * intermediate)
-    act = B * hidden * 2 * 6                  # in/out rows of ~6 matmuls
-    proj = CostEstimate(
-        bytes_read=int(weight_bytes_per_layer) + act,
-        bytes_written=act, flops=proj_flops,
-        breakdown={"weights": int(weight_bytes_per_layer),
-                   "activations": 2 * act})
-    if quant_algo is not None:
-        kernels["weight_only_linear"] = (6, proj)
+    if megadecode:
+        oproj = cost("fused_oproj_norm", T=B, Ko=Hq * D, H=hidden,
+                     algo=quant_algo)
+        ffn = cost("fused_ffn", T=B, H=hidden, I=intermediate,
+                   algo=quant_algo,
+                   act="gelu" if family == "gpt" else "swiglu")
+        kernels["fused_oproj_norm"] = (1, oproj)
+        kernels["fused_ffn"] = (1, ffn)
+        # only the qkv matmuls remain outside the fused kernels; their
+        # weight bytes are whatever the layer tree holds beyond the
+        # fused slabs (both ledgers carve from the SAME real total)
+        fused_w = (oproj.breakdown["weights"]
+                   + ffn.breakdown["weights"])
+        qkv_w = max(0, int(weight_bytes_per_layer) - fused_w)
+        n_mats, mat_flops = 3, Hq * D + 2 * KV * D
     else:
-        kernels["xla_projections"] = (6, proj)
+        kernels["swiglu"] = (1, cost("swiglu", T=B, H=intermediate))
+        qkv_w = int(weight_bytes_per_layer)
+        n_mats = 6
+        mat_flops = (Hq * D + 2 * KV * D + hidden + 3 * intermediate)
+    # per-LAUNCH projection traffic (consumers multiply by the launch
+    # count, so the n_mats dispatches still sum to the layer's full
+    # projection weight read — one crossing per step, never n_mats)
+    proj_flops = 2 * B * hidden * mat_flops // n_mats
+    act = B * hidden * 2                    # in/out rows of one matmul
+    proj = CostEstimate(
+        bytes_read=qkv_w // n_mats + act,
+        bytes_written=act, flops=proj_flops,
+        breakdown={"weights": qkv_w // n_mats, "activations": 2 * act})
+    if quant_algo is not None:
+        kernels["weight_only_linear"] = (n_mats, proj)
+    else:
+        kernels["xla_projections"] = (n_mats, proj)
     return {"family": family, "kernels": kernels,
             "launches_per_layer": sum(n for n, _ in kernels.values())}
 

@@ -17,7 +17,8 @@ __all__ = ["rms_norm_reference", "layer_norm_reference",
            "bias_residual_layer_norm_reference",
            "moe_dispatch_combine_reference", "rope_reference",
            "rope_append_reference", "append_rows_reference",
-           "swiglu_reference", "mla_decode_reference", "gmm_reference"]
+           "swiglu_reference", "mla_decode_reference", "gmm_reference",
+           "oproj_norm_reference", "megadecode_ffn_reference"]
 
 
 def rms_norm_reference(x, weight, eps: float = 1e-6):
@@ -96,6 +97,67 @@ def swiglu_reference(gate, up=None):
     gf = gate.astype(jnp.float32)
     return (gf * jax.lax.logistic(gf)
             * up.astype(jnp.float32)).astype(gate.dtype)
+
+
+def _dequant_ref(w, scale, algo):
+    """Whole-tensor dequant of a deploy-layout weight (fp passthrough)."""
+    if algo is None:
+        return w.astype(jnp.float32)
+    from .quant import weight_dequantize
+    return weight_dequantize(w, scale.reshape(-1).astype(jnp.float32),
+                             algo)
+
+
+def oproj_norm_reference(o, x, w, scale=None, bias=None, norm_weight=None,
+                         norm_bias=None, *, eps: float = 1e-6,
+                         norm: str = "rms", algo=None):
+    """fused_oproj_norm oracle: dense dequant + f32 matmul + residual +
+    rms/layer norm, returning (x_new, h)."""
+    shape = x.shape
+    H = shape[-1]
+    x2 = x.reshape(-1, H).astype(jnp.float32)
+    o2 = o.reshape(x2.shape[0], -1).astype(jnp.float32)
+    p = o2 @ _dequant_ref(w, scale, algo)
+    if bias is not None:
+        p = p + bias.reshape(1, H).astype(jnp.float32)
+    xn = x2 + p
+    if norm == "rms":
+        var = jnp.mean(xn * xn, axis=-1, keepdims=True)
+        y = xn * jax.lax.rsqrt(var + eps)
+    else:
+        mu = jnp.mean(xn, axis=-1, keepdims=True)
+        xc = xn - mu
+        var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+        y = xc * jax.lax.rsqrt(var + eps)
+    h = y * (jnp.ones((H,), jnp.float32) if norm_weight is None
+             else norm_weight.astype(jnp.float32))
+    if norm_bias is not None:
+        h = h + norm_bias.astype(jnp.float32)
+    return (xn.astype(x.dtype).reshape(shape),
+            h.astype(x.dtype).reshape(shape))
+
+
+def megadecode_ffn_reference(h, x, wg, sg=None, wu=None, su=None,
+                             wd=None, sd=None, b1=None, b2=None, *,
+                             act: str = "swiglu", algo=None):
+    """fused_ffn oracle: gate/up dots + activation + down-proj +
+    residual, all in f32."""
+    shape = x.shape
+    H = shape[-1]
+    x2 = x.reshape(-1, H).astype(jnp.float32)
+    h2 = h.reshape(-1, H).astype(jnp.float32)
+    g = h2 @ _dequant_ref(wg, sg, algo)
+    if b1 is not None:
+        g = g + b1.reshape(1, -1).astype(jnp.float32)
+    if act == "swiglu":
+        u = h2 @ _dequant_ref(wu, su, algo)
+        t = g * jax.lax.logistic(g) * u
+    else:
+        t = jax.nn.gelu(g, approximate=True)
+    d = t @ _dequant_ref(wd, sd, algo)
+    if b2 is not None:
+        d = d + b2.reshape(1, H).astype(jnp.float32)
+    return (x2 + d).astype(x.dtype).reshape(shape)
 
 
 def mla_decode_reference(q_eff, q_pe, c_lat, c_pe, lengths, *,

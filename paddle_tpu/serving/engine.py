@@ -14,10 +14,17 @@ Two dispatch paths:
   slot's token and the oldest prefill request's chunk ride a single
   flat token buffer through a fused per-layer body
   (fused_rms_norm → qkv → fused_rope_append → ragged_paged_attention →
-  o-proj), so a step that has both prefill and decode work issues ONE
-  device program instead of two (`serving.engine.launches` counts the
-  difference). Per-sequence row tables (seq_start / num_tokens /
-  kv_lengths / page table) make joins and leaves pure data changes.
+  fused_oproj_norm → fused_ffn), so a step that has both prefill and
+  decode work issues ONE device program instead of two
+  (`serving.engine.launches` counts the difference). Per-sequence row
+  tables (seq_start / num_tokens / kv_lengths / page table) make joins
+  and leaves pure data changes. The back half rides the ISSUE-14
+  mega-kernels — o-proj + residual + norm in one pallas_call, the
+  whole FFN in a second — when `megadecode_eligible` holds for the
+  family geometry (`megadecode=False` or an ineligible tiling falls
+  back to the split o-proj/norm/ffn chain; routed MoE layers always
+  keep the `_ffn_apply` combine — data-dependent routing can't fuse —
+  but still take the fused o-proj+norm kernel).
 - **split (legacy, `ragged=False`)**: the PR-5 alternating
   `_prefill_chunk` / `_decode` dispatches over
   `paged_attention`/`append_to_cache`. Kept as the reference path and
@@ -51,6 +58,8 @@ from ..generation import (_decode_params, _dq, _ffn_apply, _llama_weights,
 from ..ops.fused import (fused_append_rows, fused_layer_norm,
                          fused_rms_norm, fused_rope_append)
 from ..ops.paged_attention import append_to_cache, paged_attention
+from ..ops.pallas_megadecode import (fused_ffn, fused_oproj_norm,
+                                     megadecode_eligible)
 from ..ops.pallas_ragged import (ragged_kernel_eligible,
                                  ragged_paged_attention)
 from .block_allocator import PageBlockAllocator
@@ -113,6 +122,28 @@ _COUNTER_GAUGES = (
 )
 
 
+def _walgo(L, key):
+    """Static quant algo of a deploy-layout weight leaf. Kept separate
+    from _wq2 (string literal out, never a tracer) so the fused-kernel
+    dispatchers branch on a host value."""
+    if key + "_q4" in L:
+        return "weight_only_int4"
+    if key + "_q" in L:
+        return "weight_only_int8"
+    return None
+
+
+def _wq2(L, key):
+    """(payload, scale) of a deploy-layout weight leaf — the three
+    layouts fused_oproj_norm / fused_ffn read natively (fp, int8 + f32
+    scale, packed int4 + f32 scale)."""
+    if key + "_q4" in L:
+        return L[key + "_q4"], L[key + "_s"]
+    if key + "_q" in L:
+        return L[key + "_q"], L[key + "_s"]
+    return L[key], None
+
+
 def _lcp(a: np.ndarray, b: np.ndarray) -> int:
     n = min(a.size, b.size)
     if n == 0:
@@ -164,7 +195,8 @@ class ServingEngine:
                  enable_prefix_cache: Optional[bool] = None,
                  spec_decode: int = 0,
                  preemption: bool = True,
-                 tenant_budgets: Optional[dict] = None):
+                 tenant_budgets: Optional[dict] = None,
+                 megadecode: Optional[bool] = None):
         p = _decode_params(model, weight_only_int8, weight_only_quant)
         cfg = p["cfg"]
         self._p = p
@@ -236,6 +268,25 @@ class ServingEngine:
         # ragged launch). The split path has no multi-row slots, so
         # spec decoding rides the ragged path only.
         self.spec_k = int(spec_decode) if self.ragged else 0
+        # mega-kernel back half (ISSUE 14): o-proj -> residual -> norm
+        # and the whole FFN collapse to TWO pallas_calls per layer when
+        # the family geometry tiles; default on, per-family fallback to
+        # the split chain via the megadecode_eligible gate (routed MoE
+        # layers keep the _ffn_apply combine either way — routing is
+        # data-dependent — but still take the fused o-proj+norm kernel)
+        ow = (cfg.num_attention_heads * cfg.v_head_dim
+              if self._family == "mla"
+              else cfg.num_attention_heads * cfg.head_dim)
+        int4 = any(k.endswith("_q4") for L in p["layers"] for k in L)
+        self.megadecode = bool(
+            (True if megadecode is None else megadecode)
+            and self.ragged
+            and megadecode_eligible(cfg.hidden_size,
+                                    cfg.intermediate_size, ow,
+                                    int4=int4))
+        #: pallas launches after attention, per layer per decode step —
+        #: the bench A/B row reads this (2 fused vs the 6-stage chain)
+        self.back_half_launches = 2 if self.megadecode else 6
         self.launches = 0      # device program launches by THIS engine
 
         # live HBM accounting (ISSUE 11): static residency is published
@@ -878,7 +929,11 @@ class ServingEngine:
     # [0..B-1, B] (decode slot i owns row i; the prefill chunk owns rows
     # B..B+n-1). The per-layer body is the fused decode chain:
     # fused_rms_norm -> qkv -> fused_rope_append (K/V row scatter rides
-    # the rope kernel) -> ragged_paged_attention -> o-proj -> ffn.
+    # the rope kernel) -> ragged_paged_attention -> fused_oproj_norm ->
+    # fused_ffn (the ISSUE-14 mega-kernel back half: o-proj + residual
+    # + norm emit from one f32 VMEM accumulator, the whole FFN from a
+    # second — `self.megadecode` False falls back to the split
+    # o-proj/norm/ffn chain, same math, more HBM round-trips).
     # No flags_guard: nothing in the chain is flag-routed.
 
     def _llama_unified_body(self):
@@ -887,6 +942,7 @@ class ServingEngine:
                      cfg.head_dim)
         eps = cfg.rms_norm_eps
         moe_static = self._p.get("moe_static")
+        mega = self.megadecode
         B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
         R = 1 + K
         T = B * R + C
@@ -916,9 +972,23 @@ class ServingEngine:
                 o = ragged_paged_attention(q, kp, vp, seq_start,
                                            num_tokens, kv_lengths,
                                            tables, scale=D ** -0.5)
-                x = x + _mm_w(o.reshape(1, T, Hh * D), L, "wo")
-                h2 = fused_rms_norm(x, L["ln2"], eps)
-                x = x + _ffn_apply(L, h2, st)
+                if mega:
+                    wp, ws = _wq2(L, "wo")
+                    xn, h2 = fused_oproj_norm(
+                        o.reshape(T, Hh * D), x[0], wp, ws, None,
+                        L["ln2"], None, eps=eps, algo=_walgo(L, "wo"))
+                    if "moe" in L:
+                        x = xn[None] + _ffn_apply(L, h2[None], st)
+                    else:
+                        gp, gs = _wq2(L, "wg")
+                        up, us = _wq2(L, "wu")
+                        dp, ds = _wq2(L, "wd")
+                        x = fused_ffn(h2, xn, gp, gs, up, us, dp, ds,
+                                      algo=_walgo(L, "wg"))[None]
+                else:
+                    x = x + _mm_w(o.reshape(1, T, Hh * D), L, "wo")
+                    h2 = fused_rms_norm(x, L["ln2"], eps)
+                    x = x + _ffn_apply(L, h2, st)
             x = fused_rms_norm(x, w["norm"], eps)
             # each sequence's logits come from its LAST flat row; idle
             # slots (num_tokens 0) index garbage the host ignores. With
@@ -942,6 +1012,7 @@ class ServingEngine:
         cfg = self._p["cfg"]
         nh, hd = cfg.num_attention_heads, cfg.head_dim
         eps = cfg.layer_norm_eps
+        mega = self.megadecode
         B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
         R = 1 + K
         T = B * R + C
@@ -971,11 +1042,23 @@ class ServingEngine:
                 o = ragged_paged_attention(q, kp, vp, seq_start,
                                            num_tokens, kv_lengths,
                                            tables, scale=hd ** -0.5)
-                x = x + (o.reshape(1, T, nh * hd) @ L["wo"] + L["bo"])
-                h2 = fused_layer_norm(x, L["ln2w"], L["ln2b"], eps)
-                x = x + (jax.nn.gelu(h2 @ L["wi"] + L["bi"],
-                                     approximate=True) @ L["wf"]
-                         + L["bf"])
+                if mega:
+                    # GPT family is fp (no quantized leaves): biases and
+                    # the layer norm ride the same two mega-kernels
+                    xn, h2 = fused_oproj_norm(
+                        o.reshape(T, nh * hd), x[0], L["wo"], None,
+                        L["bo"], L["ln2w"], L["ln2b"], eps=eps,
+                        norm="layer")
+                    x = fused_ffn(h2, xn, L["wi"], None, None, None,
+                                  L["wf"], None, L["bi"], L["bf"],
+                                  act="gelu")[None]
+                else:
+                    x = x + (o.reshape(1, T, nh * hd) @ L["wo"]
+                             + L["bo"])
+                    h2 = fused_layer_norm(x, L["ln2w"], L["ln2b"], eps)
+                    x = x + (jax.nn.gelu(h2 @ L["wi"] + L["bi"],
+                                         approximate=True) @ L["wf"]
+                             + L["bf"])
             x = fused_layer_norm(x, w["normw"], w["normb"], eps)
             if K:
                 last = x[0]
@@ -997,6 +1080,7 @@ class ServingEngine:
         eps = cfg.rms_norm_eps
         scale = 1.0 / float(math.sqrt(dn + dr))
         moe_static = self._p.get("moe_static")
+        mega = self.megadecode
         B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
         R = 1 + K
         T = B * R + C
@@ -1049,9 +1133,23 @@ class ServingEngine:
                                                kv_lengths, tables,
                                                scale=scale)
                 o = jnp.einsum("tnr,rnv->tnv", o_cat[..., :r], w_v)
-                x = x + _mm_w(o.reshape(1, T, nh * dv), L, "wo")
-                h2 = fused_rms_norm(x, L["ln2"], eps)
-                x = x + _ffn_apply(L, h2, st)
+                if mega:
+                    wp, ws = _wq2(L, "wo")
+                    xn, h2 = fused_oproj_norm(
+                        o.reshape(T, nh * dv), x[0], wp, ws, None,
+                        L["ln2"], None, eps=eps, algo=_walgo(L, "wo"))
+                    if "moe" in L:
+                        x = xn[None] + _ffn_apply(L, h2[None], st)
+                    else:
+                        gp, gs = _wq2(L, "wg")
+                        up, us = _wq2(L, "wu")
+                        dp, ds = _wq2(L, "wd")
+                        x = fused_ffn(h2, xn, gp, gs, up, us, dp, ds,
+                                      algo=_walgo(L, "wg"))[None]
+                else:
+                    x = x + _mm_w(o.reshape(1, T, nh * dv), L, "wo")
+                    h2 = fused_rms_norm(x, L["ln2"], eps)
+                    x = x + _ffn_apply(L, h2, st)
             x = fused_rms_norm(x, w["norm"], eps)
             if K:
                 last = x[0]

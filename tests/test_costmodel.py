@@ -55,6 +55,8 @@ SHAPES = {
     "gmm": dict(M=64, K=128, N=256, G=4),
     "int4_dequantize": dict(K=128, N=256),
     "weight_only_linear": dict(M=8, K=256, N=512),
+    "fused_oproj_norm": dict(T=8, Ko=512, H=512),
+    "fused_ffn": dict(T=8, H=512, I=1792),
 }
 
 
@@ -62,7 +64,8 @@ class TestRegistryCoverage:
     def test_all_oracle_kernels_have_costs(self):
         # registration side effects                          # noqa: F401
         from paddle_tpu.ops import (fused, pallas_flash, pallas_flashmask,
-                                    pallas_gmm, pallas_mla, pallas_paged,
+                                    pallas_gmm, pallas_megadecode,
+                                    pallas_mla, pallas_paged,
                                     pallas_ragged, quant)
         from paddle_tpu.ops.oracles import oracles
         names = set(oracles())

@@ -16,8 +16,8 @@ import numpy as np
 
 # registration side effects                                  # noqa: F401
 from paddle_tpu.ops import (fused, pallas_flash, pallas_flashmask,
-                            pallas_gmm, pallas_mla, pallas_paged,
-                            pallas_ragged, quant)
+                            pallas_gmm, pallas_megadecode, pallas_mla,
+                            pallas_paged, pallas_ragged, quant)
 from paddle_tpu.ops.oracles import oracles, resolve_reference
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -29,7 +29,7 @@ EXPECTED = {
     "mla_decode_attention", "gmm", "int4_dequantize",
     "weight_only_linear", "flash_sdpa", "flashmask_sdpa",
     "paged_decode_attention", "paged_decode_attention_v2",
-    "ragged_paged_attention",
+    "ragged_paged_attention", "fused_oproj_norm", "fused_ffn",
 }
 
 

@@ -266,12 +266,13 @@ class TestVmemDriftCheck:
         # per-kernel row passes) but beyond the 5% static tolerance —
         # exactly the stale-cost-table case the noise band cannot see
         art = self._committed()
-        row = next(k for k in art["kernels"] if k["kernel"] == "swiglu")
+        row = next(k for k in art["kernels"]
+                   if k["kernel"] == "fused_ffn")
         row["bytes"] = int(row["bytes"] * 1.08)
         rows = perf_gate.vmem_drift_rows(art)
         bad = [r for r in rows if not r["ok"]]
         assert [r["key"] for r in bad] \
-            == ["observatory.vmem.swiglu.bytes"]
+            == ["observatory.vmem.fused_ffn.bytes"]
         assert "static memory model" in bad[0]["why"]
         cand = tmp_path / "cand.json"
         with open(cand, "w") as f:

@@ -65,7 +65,13 @@ from paddle_tpu.analysis.vmemmodel import (  # noqa: E402
 SERVING_FIELDS = ("decode_tokens_per_s_per_chip", "prefill_tokens_per_s",
                   "inflight_tokens_per_s", "ragged_tokens_per_s",
                   "cache_on_tokens_per_s", "prefix_hit_rate",
-                  "spec_tokens_per_s", "accepted_tokens_per_verify_step")
+                  "spec_tokens_per_s", "accepted_tokens_per_verify_step",
+                  "mega_tokens_per_s", "split_tokens_per_s")
+
+# ISSUE 14 launch-accounting pins on the megadecode A/B row: exact and
+# two-sided — more launches means the fusion regressed, fewer means the
+# ledger itself broke. Each holds a {mode: count} dict in the artifact.
+SERVING_LAUNCH_FIELDS = ("launches_per_layer", "back_half_launches")
 
 # OBSERVATORY.json per-kernel fields gated per row (ISSUE 11). These are
 # two-sided: bytes or launches GROWING past the band means new HBM
@@ -157,6 +163,19 @@ def serving_rows(repo: str = REPO, noise: float = 0.15
                         "band": [v * (1.0 - noise), v * (1.0 + noise)],
                         "source": "docs/SERVING_BENCH.json",
                         "ok": True})
+        for field in SERVING_LAUNCH_FIELDS:
+            d = row.get(field)
+            if not isinstance(d, dict):
+                continue
+            for mode, v in sorted(d.items()):
+                if not isinstance(v, (int, float)) or v <= 0:
+                    continue
+                v = float(v)
+                out.append({"key": f"serving.{name}.{field}.{mode}",
+                            "value": v, "direction": "both",
+                            "band": [v, v],
+                            "source": "docs/SERVING_BENCH.json",
+                            "ok": True})
     return out
 
 

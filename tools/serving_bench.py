@@ -769,6 +769,112 @@ def bench_serving_engine_ragged(n=16, max_slots=8, page_size=16, rounds=3,
              "the record — the host-side step loop dominates tiny steps")
 
 
+def bench_megadecode(n=12, max_slots=8, page_size=16, rounds=3,
+                     smin=64, smax=257, mmin=32, mmax=129, seed=0,
+                     dtype="bfloat16", hbm_gb=16):
+    """Mega-kernel fused back half (ISSUE 14) vs the split chain on the
+    SAME ragged trace and engine geometry: megadecode=True runs o-proj
+    + residual + norm + FFN in TWO pallas_calls per layer after
+    attention (fused_oproj_norm -> fused_ffn, 8 launches/layer total);
+    megadecode=False keeps the six-dispatch split body (11/layer).
+    Also records the int4 density pairing: slots-per-chip at the shard
+    shapes, because int4's recorded win is capacity, not tok/s (see
+    int4_note on the decode_int4 row)."""
+    from bench_util import ratio_band
+    from paddle_tpu.observability import costmodel as cm
+    from paddle_tpu.serving import ServingEngine
+
+    total = 1024
+    _log(f"megadecode: init model n={n} slots={max_slots}")
+    cfg, model = _llama_bench_raw_model(total, dtype)
+    rng = np.random.RandomState(seed)
+    reqs = [(rng.randint(0, cfg.vocab_size,
+                         int(rng.randint(smin, smax))).astype(np.int32),
+             int(rng.randint(mmin, mmax)))
+            for _ in range(n)]
+    engines = {"mega": ServingEngine(model, max_slots=max_slots,
+                                     page_size=page_size, ragged=True),
+               "split_back_half": ServingEngine(
+                   model, max_slots=max_slots, page_size=page_size,
+                   ragged=True, megadecode=False)}
+    assert engines["mega"].megadecode
+    assert not engines["split_back_half"].megadecode
+
+    def run(eng):
+        for p, m in reqs:
+            eng.add_request(p, max_new_tokens=m)
+        eng.run_to_completion()
+
+    useful = sum(m for _, m in reqs)
+    for name, eng in engines.items():
+        _log(f"megadecode: warm {name}")
+        run(eng)                       # compiles the path's programs
+    ts = {name: [] for name in engines}
+    for _ in range(rounds):            # same-run interleaved A/B
+        for name, eng in engines.items():
+            t0 = time.time()
+            run(eng)
+            ts[name].append(time.time() - t0)
+    acct = engines["mega"].hbm_accounting()
+
+    # model-side launch/byte ledger at the engine's own geometry
+    n_layers = cfg.num_hidden_layers
+    w_layer = acct["weights_bytes"] / n_layers
+    kw = dict(batch=max_slots, context=total // 2,
+              hidden=cfg.hidden_size, heads=cfg.num_attention_heads,
+              kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
+              intermediate=cfg.intermediate_size, page_size=page_size,
+              weight_bytes_per_layer=int(w_layer))
+    mega_m = cm.decode_layer_kernels(**kw)
+    split_m = cm.decode_layer_kernels(megadecode=False, **kw)
+
+    def _layer_bytes(d):
+        return sum(c.hbm_bytes * k for k, c in d["kernels"].values())
+
+    # density pairing: KV slots that fit beside the weights on one chip
+    kv_slot = (2 * total * cfg.num_key_value_heads * cfg.head_dim
+               * 2 * n_layers)
+    wb = acct["weights_bytes"]
+    hbm = hbm_gb * 1024 ** 3
+    _, p4 = _llama_bench_model(total, dtype, weight_only_quant="int4")
+    wb4 = _tree_bytes(p4)
+    return dict(
+        requests=len(reqs), max_slots=max_slots, page_size=page_size,
+        useful_new_tokens=int(useful),
+        mega_tokens_per_s=round(useful * rounds / sum(ts["mega"]), 1),
+        split_tokens_per_s=round(
+            useful * rounds / sum(ts["split_back_half"]), 1),
+        # per-round split_time/mega_time: >1 means the fusion wins
+        mega_vs_split=ratio_band(ts["split_back_half"], ts["mega"]),
+        launches_per_layer={"mega": mega_m["launches_per_layer"],
+                            "split": split_m["launches_per_layer"]},
+        back_half_launches={
+            name: eng.back_half_launches
+            for name, eng in engines.items()},
+        model_layer_hbm_bytes={"mega": int(_layer_bytes(mega_m)),
+                               "split": int(_layer_bytes(split_m))},
+        bytes_per_token_measured=round(
+            acct["bytes_per_token_measured"]),
+        bytes_per_token_model=round(acct["bytes_per_token_model"]),
+        int4_slots_per_chip={
+            "weight_bytes_bf16": int(wb),
+            "weight_bytes_int4": int(wb4),
+            "kv_bytes_per_slot": int(kv_slot),
+            "slots_bf16": int(max(0, hbm - wb) // kv_slot),
+            "slots_int4": int(max(0, hbm - wb4) // kv_slot),
+            "note": f"KV slots at {total}-token context beside the "
+                    f"resident weights on a {hbm_gb} GiB chip — int4's "
+                    "win is this density column, not the tok/s column"},
+        note="same trace, same model, same slots both ways; "
+             "launches_per_layer is the costmodel ledger at the "
+             "engine's geometry (8 fused vs 11 split), "
+             "back_half_launches the engine's own count of "
+             "pallas_calls after attention (2 vs 6). CPU-host tok/s "
+             "is not the record — the host step loop dominates tiny "
+             "steps; the committed record pairs this row with the "
+             "measured roofline fractions")
+
+
 def bench_serving_engine(n=16, max_slots=8, page_size=16, rounds=3,
                          smin=64, smax=513, mmin=32, mmax=257, seed=0,
                          dtype="bfloat16"):
@@ -986,6 +1092,7 @@ ROWS = {
     "prefill_8k_mla": lambda: bench_prefill_long("mla"),
     "serving_engine": lambda: bench_serving_engine(),
     "serving_engine_ragged": lambda: bench_serving_engine_ragged(),
+    "megadecode": lambda: bench_megadecode(),
     "prefix_cache_multitenant": lambda: bench_prefix_cache_multitenant(),
     "spec_decode_b1": lambda: bench_spec_decode_b1(),
     "_paged": _paged_sweep_row,
