@@ -16,7 +16,7 @@ environments of the shard_map sites that reach it.
 
 Axis environments come from the constructions the distributed layer
 actually uses: ``ProcessMesh(ids, dim_names=[...])`` (sizes from a
-literal id array), ``build_hybrid_mesh(*_degree=...)`` (the fixed 6-axis
+literal id array), ``build_hybrid_mesh(*_degree=...)`` (the fixed 8-axis
 hybrid order, sizes from literal degree kwargs, absent degrees = 1),
 ``Mesh(devs, ("a", "b"))`` (including names routed through module
 constants like ``AXIS_ORDER`` and partially-symbolic tuples), and a
@@ -38,8 +38,10 @@ from .callgraph import (FunctionInfo, ModuleInfo, PackageIndex, _last_name,
                         partial_inner, walk_shallow)
 from .kernelmodel import Env, _int_const, _kw, _lookup_def, unparse
 
-#: the fixed axis order ``build_hybrid_mesh`` constructs (mesh.py)
-HYBRID_AXES = ("pp", "dp", "sharding", "sep", "ep", "mp")
+#: the fixed axis order ``build_hybrid_mesh`` constructs (mesh.py) —
+#: the dcn_* axes are the multi-slice DCN tier (outermost in the mesh)
+HYBRID_AXES = ("dcn_pp", "dcn_dp", "pp", "dp", "sharding", "sep", "ep",
+               "mp")
 
 #: call names that return the ambient / runtime-configured mesh
 AMBIENT_MESH_FUNCS = {"get_mesh", "_mesh_of", "current_mesh"}
@@ -239,8 +241,10 @@ def mesh_env(index: PackageIndex, mi: ModuleInfo, env: Env,
                     sizes[axis] = _int_const(
                         _resolve(index, mi, env, kw.value))
         if expr.args:
-            # positional signature: dp, mp, pp, sharding, sep, ep
-            order = ("dp", "mp", "pp", "sharding", "sep", "ep")
+            # positional signature: dp, mp, pp, sharding, sep, ep,
+            # dcn_dp, dcn_pp
+            order = ("dp", "mp", "pp", "sharding", "sep", "ep",
+                     "dcn_dp", "dcn_pp")
             for i, arg in enumerate(expr.args[: len(order)]):
                 sizes[order[i]] = _int_const(_resolve(index, mi, env, arg))
         return AxisEnv(axes=HYBRID_AXES, sizes=sizes, complete=complete,

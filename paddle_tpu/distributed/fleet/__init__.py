@@ -35,6 +35,7 @@ class DistributedStrategy:
         self.hybrid_configs = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
             "sharding_degree": 1, "sep_degree": 1,
+            "dcn_dp_degree": 1, "dcn_pp_degree": 1,
         }
         self.amp = False
         self.amp_configs = {}
@@ -60,7 +61,9 @@ def init(role_maker=None, is_collective: bool = True,
         dp_degree=hc.get("dp_degree", 1), mp_degree=hc.get("mp_degree", 1),
         pp_degree=hc.get("pp_degree", 1),
         sharding_degree=hc.get("sharding_degree", 1),
-        sep_degree=hc.get("sep_degree", 1))
+        sep_degree=hc.get("sep_degree", 1),
+        dcn_dp_degree=hc.get("dcn_dp_degree", 1),
+        dcn_pp_degree=hc.get("dcn_pp_degree", 1))
     set_mesh(mesh)
     _fleet_state["topology"] = HybridTopology(mesh)
     _fleet_state["strategy"] = strategy

@@ -19,11 +19,19 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ProcessMesh", "HybridTopology", "get_mesh", "set_mesh",
-           "mesh_context", "build_hybrid_mesh", "AXIS_ORDER",
+           "mesh_context", "build_hybrid_mesh", "AXIS_ORDER", "DCN_AXES",
            "global_device_put"]
 
-# outermost → innermost (DCN-most → ICI-most)
-AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+# outermost → innermost (DCN-most → ICI-most). The dcn_* axes are the
+# explicit data-center-network tier of a multi-slice job: traffic on
+# them crosses the process/slice boundary (slow, high-latency DCN),
+# everything to their right stays on intra-slice ICI. Only dp and pp
+# style parallelism may ride DCN — mp/sep/sharding collectives are
+# latency-bound and must stay within a slice.
+AXIS_ORDER = ("dcn_pp", "dcn_dp", "pp", "dp", "sharding", "sep", "mp")
+
+# axes whose collectives may legally cross the slice boundary
+DCN_AXES = ("dcn_pp", "dcn_dp")
 
 _current_mesh: Optional[Mesh] = None
 
@@ -113,13 +121,24 @@ class ProcessMesh:
 
 def build_hybrid_mesh(dp_degree=1, mp_degree=1, pp_degree=1,
                       sharding_degree=1, sep_degree=1, ep_degree=1,
+                      dcn_dp_degree=1, dcn_pp_degree=1,
                       devices=None) -> Mesh:
-    """Build the 6-axis hybrid mesh (ref: HybridCommunicateGroup's cartesian
+    """Build the 8-axis hybrid mesh (ref: HybridCommunicateGroup's cartesian
     topology, order [M] knob; ep is the expert-parallel degree PaddleNLP MoE
     derives inside the hybrid topology). Degrees of 1 keep the axis present
-    (size 1) so sharding specs are stable across configurations."""
+    (size 1) so sharding specs are stable across configurations.
+
+    `dcn_dp_degree` / `dcn_pp_degree` are the explicit multi-slice (DCN)
+    tier: they sit OUTERMOST so each contiguous device block along them
+    is one ICI-connected slice — data/pipeline parallelism crosses the
+    process boundary, mp/sep/sharding stay within a slice. When any DCN
+    degree exceeds 1 and the devices expose `slice_index`, the blocking
+    is validated: every DCN-tier block must live on exactly one slice
+    (mixing slices inside a block would silently route mp collectives
+    over DCN)."""
     devices = list(devices if devices is not None else jax.devices())
     sizes = collections.OrderedDict(
+        dcn_pp=dcn_pp_degree, dcn_dp=dcn_dp_degree,
         pp=pp_degree, dp=dp_degree, sharding=sharding_degree, sep=sep_degree,
         ep=ep_degree, mp=mp_degree)
     total = int(np.prod(list(sizes.values())))
@@ -127,6 +146,18 @@ def build_hybrid_mesh(dp_degree=1, mp_degree=1, pp_degree=1,
         raise ValueError(
             f"product of degrees {dict(sizes)} = {total} != device count "
             f"{len(devices)}")
+    n_dcn = int(dcn_pp_degree) * int(dcn_dp_degree)
+    if n_dcn > 1 and all(
+            getattr(d, "slice_index", None) is not None for d in devices):
+        per_slice = len(devices) // n_dcn
+        for blk in range(n_dcn):
+            block = devices[blk * per_slice:(blk + 1) * per_slice]
+            slices = {d.slice_index for d in block}
+            if len(slices) != 1:
+                raise ValueError(
+                    f"DCN-tier block {blk} spans slices {sorted(slices)}: "
+                    "each dcn_dp/dcn_pp block must be one ICI-connected "
+                    "slice (reorder `devices` by slice_index)")
     dev_arr = np.asarray(devices, dtype=object).reshape(
         tuple(sizes.values()))
     return Mesh(dev_arr, tuple(sizes.keys()))
@@ -174,6 +205,18 @@ class HybridTopology:
 
     def get_sharding_parallel_world_size(self) -> int:
         return self.mesh.shape.get("sharding", 1)
+
+    def get_dcn_data_parallel_world_size(self) -> int:
+        return self.mesh.shape.get("dcn_dp", 1)
+
+    def get_dcn_pipe_parallel_world_size(self) -> int:
+        return self.mesh.shape.get("dcn_pp", 1)
+
+    def slice_count(self) -> int:
+        """Number of ICI-connected slices the mesh spans (the DCN-tier
+        block count; 1 on a single-slice job)."""
+        return self.get_dcn_data_parallel_world_size() \
+            * self.get_dcn_pipe_parallel_world_size()
 
     def axis_size(self, name: str) -> int:
         return self.mesh.shape.get(name, 1)

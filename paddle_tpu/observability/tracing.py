@@ -346,6 +346,10 @@ class TraceRecorder:
         with self._lock:
             return list(self._live.values())
 
+    def is_live(self, request_id) -> bool:
+        with self._lock:
+            return request_id in self._live
+
     def finished(self, kind: Optional[str] = None) -> List[RequestTrace]:
         with self._lock:
             done = list(self._done)

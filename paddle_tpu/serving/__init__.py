@@ -18,9 +18,17 @@ Modules over the Pallas paged-decode kernel
     in the engine's single ragged launch per step;
   - `engine`: `ServingEngine.add_request/step/collect`, a fixed-shape
     jitted decode step (one compile per model/slot-count) plus chunked
-    prefill, for the llama/moe, gpt and mla families.
+    prefill, for the llama/moe, gpt and mla families — each engine runs
+    as a `prefill`, `decode`, or `colocated` (default) replica;
+  - `handoff`: `KVPageHandoff`, the pin → export → import → unpin
+    KV-page transfer between a prefill replica and a decode replica
+    (bit-identical resume, no re-prefill);
+  - `router`: `FleetRouter` spreading requests over N replicas by
+    radix-trie prefix overlap vs queue depth, brokering handoffs, and
+    draining/re-admitting replicas on `CollectiveTimeout` faults.
 
-See docs/SERVING.md ("Continuous batching") for sizing and usage.
+See docs/SERVING.md ("Continuous batching", "Disaggregated serving")
+for sizing and usage.
 """
 
 from typing import Any, Dict
@@ -29,11 +37,14 @@ from .. import observability as _obs
 from ..observability import tracing as _tracing
 from .block_allocator import PageBlockAllocator
 from .engine import ServingEngine
+from .handoff import KVPageHandoff
 from .prefix_cache import PrefixCache
+from .router import FleetRouter
 from .scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "Request", "Scheduler", "PageBlockAllocator",
-           "PrefixCache", "metrics", "slo"]
+           "PrefixCache", "KVPageHandoff", "FleetRouter", "metrics",
+           "slo"]
 
 
 def metrics() -> Dict[str, Any]:

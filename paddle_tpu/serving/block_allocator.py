@@ -280,6 +280,61 @@ class PageBlockAllocator:
                 f"cannot shrink {seq.length}-token sequence by {n_tokens}")
         seq.length -= n_tokens
 
+    # ------------------------------------------------------------- handoff
+    def export_seq(self, seq_id) -> Dict[str, object]:
+        """Snapshot `seq_id` for a cross-replica KV-page handoff: its
+        page list (position order), logical length, and remaining
+        reservation, with ONE pin taken on every page. The pins keep the
+        payload readable for the whole pin → export → import → unpin
+        window even if the sequence is freed in between (a preemption or
+        queue expiry landing mid-handoff must leave both replicas
+        consistent), and they stack on top of trie pins, so shared-
+        prefix pages come back with their refcounts intact when
+        `release_export` drops them.
+
+        Only pages covering the LOGICAL length are exported: after a
+        speculative-decode `shrink` a sequence may keep a trailing page
+        whose KV beyond `length` is stale-but-unobservable, and the
+        importer materializes exactly `ceil(length / page_size)` pages."""
+        seq = self._seqs[seq_id]
+        n_pages = -(-seq.length // self.page_size)
+        pages = list(seq.pages[:n_pages])
+        for pg in pages:
+            self.pin(pg)
+        return {"pages": pages, "length": seq.length,
+                "reserved": seq.reserved}
+
+    def release_export(self, export: Dict[str, object]) -> int:
+        """Drop an export's pins once the importer holds its own copy.
+        Returns how many pages went back to the free list — pages whose
+        owning sequence was freed mid-handoff and that nothing else
+        (another sequence, the trie) still shares."""
+        freed = 0
+        for pg in export["pages"]:
+            if self.unpin(pg):
+                freed += 1
+        return freed
+
+    def import_seq(self, seq_id, length: int,
+                   total_tokens: int) -> List[int]:
+        """Admit `seq_id` with `length` tokens already materialized on
+        another replica (the receive side of a KV-page handoff):
+        reserves the full `total_tokens` worst case like `allocate`,
+        then claims fresh pages for the first `length` tokens. Returns
+        the destination page list in position order — the engine copies
+        the handoff payload into exactly these pages. Raises
+        `resilience.Overloaded` pre-mutation when the pool cannot cover
+        the sequence."""
+        if length < 1 or length > total_tokens:
+            raise ValueError(
+                f"import length {length} outside [1, {total_tokens}]")
+        self.allocate(seq_id, total_tokens)
+        # fresh pages only — nothing is shared yet, so extend can never
+        # produce COW copies here
+        copies = self.extend(seq_id, length)
+        assert not copies
+        return self.seq_pages(seq_id)
+
     def free(self, seq_id) -> None:
         """Release a finished sequence: derefs its pages (returning
         refcount-0 pages to the free list) and drops its remaining

@@ -63,6 +63,8 @@ from ..ops.pallas_megadecode import (fused_ffn, fused_oproj_norm,
 from ..ops.pallas_ragged import (ragged_kernel_eligible,
                                  ragged_paged_attention)
 from .block_allocator import PageBlockAllocator
+from .handoff import (HANDOFF_BYTES, HANDOFF_PAGES, HANDOFFS,
+                      KVPageHandoff)
 from .prefix_cache import PrefixCache
 from .scheduler import DECODE, PREFILL, Request, Scheduler
 from .spec_decode import accept_length, ngram_draft, record_verify
@@ -196,7 +198,22 @@ class ServingEngine:
                  spec_decode: int = 0,
                  preemption: bool = True,
                  tenant_budgets: Optional[dict] = None,
-                 megadecode: Optional[bool] = None):
+                 megadecode: Optional[bool] = None,
+                 role: str = "colocated",
+                 replica: Optional[str] = None):
+        if role not in ("prefill", "decode", "colocated"):
+            raise ValueError(
+                f"role must be prefill/decode/colocated, got {role!r}")
+        # disaggregated serving (ROADMAP item 2): a prefill replica runs
+        # chunked prefill into its own pool, then stages the request on
+        # `handoff_ready` for export (KVPageHandoff) instead of decoding
+        # it; a decode replica refuses add_request — `import_request` is
+        # its intake — and resumes imported requests straight into
+        # DECODE via the PR-10 preemption/resume path. colocated keeps
+        # the single-replica behavior and can play either side.
+        self.role = role
+        self.replica = replica
+        self.handoff_ready: List[Request] = []
         p = _decode_params(model, weight_only_int8, weight_only_quant)
         cfg = p["cfg"]
         self._p = p
@@ -231,7 +248,7 @@ class ServingEngine:
         # inference.Config knob (set_prefix_cache), default on
         if enable_prefix_cache is None:
             enable_prefix_cache = getattr(config, "_prefix_cache", None)
-        self.prefix_cache = PrefixCache(self.allocator) \
+        self.prefix_cache = PrefixCache(self.allocator, replica=replica) \
             if enable_prefix_cache in (None, True) else None
         self.preemption = bool(preemption)
 
@@ -331,6 +348,11 @@ class ServingEngine:
         """Enqueue a request (FCFS within its priority class). Raises
         resilience.Overloaded when admission backpressure refuses it at
         the door."""
+        if self.role == "decode":
+            raise ValueError(
+                "decode-role replica does not prefill: route fresh "
+                "requests to a prefill/colocated replica "
+                "(import_request is this engine's intake)")
         req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
                       pad_token_id=pad_token_id,
                       deadline_s=(deadline_s if deadline_s is not None
@@ -496,6 +518,142 @@ class ServingEngine:
             results.update(self.collect())
         results.update(self.collect())
         return results
+
+    # ------------------------------------------------------------ handoff
+    def set_replica(self, name: str) -> None:
+        """Name this replica for routing/metrics (the FleetRouter calls
+        this for replicas constructed without `replica=`)."""
+        self.replica = name
+        if self.prefix_cache is not None:
+            self.prefix_cache.set_replica(name)
+
+    def _stage_handoff(self, req: Request) -> None:
+        """Prefill-role completion: give up the slot and queue the
+        request for export — a decode replica resumes it without
+        re-prefill. Called right after the first token was emitted, so
+        the KV-length invariant (length == prompt.size, pending ==
+        tokens[-1]) holds."""
+        self.scheduler.detach(req)
+        self.handoff_ready.append(req)
+        _TRACE.stamp(req.request_id, "handoff_ready",
+                     kv_tokens=self.allocator.seq_length(req.request_id))
+
+    def export_request(self, req: Request) -> KVPageHandoff:
+        """Export an in-flight request as a `KVPageHandoff`: pin its
+        pages, snapshot (page table, block payload, sampler state),
+        and remove it from this replica. Works for staged prefill
+        completions, running decodes, and preempted-waiting requests —
+        any request whose prefill is complete (the drain path exports
+        mid-stream decodes pages-intact). The export pins keep the
+        pages readable until the importer's `release()`, and trie pins
+        keep shared prompt pages warm on this replica regardless."""
+        rid = req.request_id
+        if req.pending is None or req.prefill_pos < int(req.prompt.size):
+            raise ValueError(
+                f"request {rid} is not exportable mid-prefill "
+                f"({req.prefill_pos}/{int(req.prompt.size)} tokens)")
+        if req in self.handoff_ready:
+            self.handoff_ready.remove(req)
+        else:
+            self.scheduler.detach(req)
+        exp = self.allocator.export_seq(rid)
+        pages = np.asarray(exp["pages"], np.int32)
+        if self._family == "mla":
+            blocks = [np.asarray(pool[:, pages]) for pool in self._pools]
+        else:
+            blocks = [(np.asarray(kp[:, pages]), np.asarray(vp[:, pages]))
+                      for kp, vp in self._pools]
+        # remaining deadline travels with the request (the importer's
+        # submit() restarts the clock)
+        dl = req.deadline_s
+        if req._deadline is not None:
+            dl = max(1e-6, req._deadline.budget_s
+                     - req._deadline.elapsed_s)
+        # the sequence leaves this replica the moment the payload is
+        # snapshotted; the export pins (dropped by release()) keep the
+        # protocol window consistent even so
+        self.allocator.free(rid)
+        alloc = self.allocator
+        handoff = KVPageHandoff(
+            request_id=rid, prompt=req.prompt,
+            max_new_tokens=req.max_new_tokens,
+            eos_token_id=req.eos_token_id, pad_token_id=req.pad_token_id,
+            priority=req.priority, tenant=req.tenant, deadline_s=dl,
+            tokens=list(req.tokens), pending=int(req.pending),
+            shared_tokens=req.shared_tokens,
+            kv_length=int(exp["length"]), blocks=blocks,
+            page_size=self.page_size, family=self._family,
+            source=self.replica or "", _release=lambda:
+            alloc.release_export(exp))
+        if _obs.enabled():
+            HANDOFFS.labels(direction="export").inc()
+            HANDOFF_PAGES.inc(len(exp["pages"]))
+            HANDOFF_BYTES.inc(handoff.payload_bytes)
+        _TRACE.stamp(rid, "handoff_export", pages=len(exp["pages"]),
+                     kv_tokens=handoff.kv_length)
+        return handoff
+
+    def import_request(self, handoff: KVPageHandoff) -> Request:
+        """Receive side of the handoff: allocate destination pages,
+        copy the block payload into this replica's pools, and submit
+        the rebuilt request with `preempted=True` so the scheduler
+        resumes it straight into DECODE (the PR-10 resume path) — no
+        re-prefill. Raises `resilience.Overloaded` (allocator or
+        admission gate) with this replica unchanged, so the router can
+        retry the same handoff elsewhere."""
+        if self.role == "prefill":
+            raise ValueError("prefill-role replica cannot decode an "
+                             "imported request")
+        if handoff.family != self._family:
+            raise ValueError(
+                f"family mismatch: handoff {handoff.family} vs engine "
+                f"{self._family}")
+        if handoff.page_size != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: handoff {handoff.page_size} vs "
+                f"engine {self.page_size}")
+        req = Request(handoff.prompt, handoff.max_new_tokens,
+                      eos_token_id=handoff.eos_token_id,
+                      pad_token_id=handoff.pad_token_id,
+                      deadline_s=handoff.deadline_s,
+                      request_id=handoff.request_id,
+                      priority=handoff.priority, tenant=handoff.tenant)
+        pages = self.allocator.import_seq(
+            req.request_id, handoff.kv_length, req.total_tokens)
+        dst = np.asarray(pages, np.int32)
+        if self._family == "mla":
+            self._pools = [pool.at[:, dst].set(jnp.asarray(blk))
+                           for pool, blk in zip(self._pools,
+                                                handoff.blocks)]
+        else:
+            self._pools = [(kp.at[:, dst].set(jnp.asarray(kb)),
+                            vp.at[:, dst].set(jnp.asarray(vb)))
+                           for (kp, vp), (kb, vb)
+                           in zip(self._pools, handoff.blocks)]
+        req.tokens = list(handoff.tokens)
+        req.pending = handoff.pending
+        req.prefill_pos = int(req.prompt.size)
+        req.shared_tokens = handoff.shared_tokens
+        req.preempted = True
+        try:
+            self.scheduler.submit(req)
+        except _res.Overloaded:
+            self.allocator.free(req.request_id)
+            raise
+        # warm THIS replica's trie with the prompt pages so the router's
+        # locality score sends the tenant's next request here. The
+        # inserted full prompt pages are never rewritten: decode writes
+        # land at positions >= kv_length >= prompt.size, past them.
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, pages)
+        if _obs.enabled():
+            HANDOFFS.labels(direction="import").inc()
+            _REQS.labels(outcome="imported").inc()
+        _TRACE.stamp(req.request_id, "handoff_import",
+                     source=handoff.source, replica=self.replica or "",
+                     pages=len(pages))
+        handoff.release()
+        return req
 
     # ---------------------------------------------------------- admission
     def _admit(self) -> int:
@@ -667,7 +825,10 @@ class ServingEngine:
                 self.prefix_cache.insert(
                     req.prompt, self.allocator.seq_pages(req.request_id))
             tok = int(np.argmax(np.asarray(logits[0])))
-            finished += self._emit(req, tok)
+            fin = self._emit(req, tok)
+            finished += fin
+            if not fin and self.role == "prefill":
+                self._stage_handoff(req)
         _TRACE.set_host_span(None)
         return n, finished
 
@@ -827,7 +988,10 @@ class ServingEngine:
                         preq.prompt,
                         self.allocator.seq_pages(preq.request_id))
                 row = logits[base + n - 1] if K else logits[S - 1]
-                finished += self._emit(preq, int(np.argmax(row)))
+                fin = self._emit(preq, int(np.argmax(row)))
+                finished += fin
+                if not fin and self.role == "prefill":
+                    self._stage_handoff(preq)
         decoded = 0
         for slot, req in active:
             d = drafts[slot]

@@ -1,0 +1,91 @@
+"""Cross-replica KV-page handoff for disaggregated prefill/decode.
+
+`KVPageHandoff` is the wire format between a prefill-role replica and a
+decode-role replica (ROADMAP item 2, arXiv 2604.15464): everything a
+decode replica needs to resume a request WITHOUT re-prefill —
+
+  - request identity and sampling state (prompt, max_new, eos/pad,
+    priority/tenant/deadline, emitted `tokens`, the `pending` token);
+  - the KV payload: per-layer host copies of exactly the sequence's
+    pages, gathered from the exporter's device pools in page-table
+    order. n-gram spec-decode needs no extra state — its drafts are
+    derived from prompt+tokens, which travel here;
+  - a `release()` callback that drops the exporter's allocator pins.
+
+The protocol is pin → export → import → unpin: `export_seq` pins every
+page before the payload is read, so a preemption, queue expiry, or even
+`free()` landing mid-handoff cannot recycle a page under the copy, and
+trie-pinned shared-prefix pages keep their refcounts across the window.
+The payload itself is physical-page-id agnostic: the importer writes it
+to whatever pages its own allocator hands out and only the page TABLE
+differs, so greedy decode on the importer is bit-identical to decode on
+the exporter (the disaggregated exactness contract).
+
+In-process (tier-1 / CPU) the "transfer" is a host array copy; on a real
+fleet the same payload rides the DCN tier `build_hybrid_mesh` now
+models (`dcn_dp`/`dcn_pp`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .. import observability as _obs
+
+__all__ = ["KVPageHandoff"]
+
+HANDOFFS = _obs.registry().counter(
+    "serving.handoff.requests",
+    "KV-page handoffs by direction", labels=("direction",))
+HANDOFF_PAGES = _obs.registry().counter(
+    "serving.handoff.pages", "KV pages moved by handoffs")
+HANDOFF_BYTES = _obs.registry().counter(
+    "serving.handoff.bytes", "KV block payload bytes moved by handoffs")
+
+
+@dataclass
+class KVPageHandoff:
+    """One request's portable decode state (see module docstring)."""
+
+    request_id: object
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    pad_token_id: int
+    priority: int
+    tenant: Optional[str]
+    deadline_s: Optional[float]
+    tokens: List[int]            # emitted so far; pending == tokens[-1]
+    pending: int                 # staged for the next decode step
+    shared_tokens: int           # prefill skipped at original admission
+    kv_length: int               # tokens materialized in `blocks`
+    blocks: list                 # per-layer page payloads (np arrays)
+    page_size: int
+    family: str
+    source: str                  # exporting replica name
+    _release: Optional[Callable[[], int]] = field(default=None,
+                                                  repr=False)
+    _released: bool = field(default=False, repr=False)
+
+    @property
+    def n_pages(self) -> int:
+        return -(-self.kv_length // self.page_size)
+
+    @property
+    def payload_bytes(self) -> int:
+        total = 0
+        for blk in self.blocks:
+            for a in (blk if isinstance(blk, tuple) else (blk,)):
+                total += a.nbytes
+        return total
+
+    def release(self) -> int:
+        """Drop the exporter's page pins (idempotent). Call once the
+        payload has been imported — or when abandoning the handoff."""
+        if self._released or self._release is None:
+            return 0
+        self._released = True
+        return self._release()

@@ -52,6 +52,20 @@ _SHARED = _obs.registry().counter(
     "prompt tokens whose prefill was skipped via the prefix cache")
 _PAGES = _obs.registry().gauge(
     "serving.prefix_cache.pages", "pages currently pinned by the trie")
+# per-replica families (ROADMAP item 2): the fleet router's locality
+# score is computed from the SAME counters operators see — a replica's
+# trie labels its hit/pin/eviction traffic with its name
+_R_HIT_TOK = _obs.registry().counter(
+    "serving.prefix_cache.replica_hit_tokens",
+    "prompt tokens matched in the trie at lookup, by replica",
+    labels=("replica",))
+_R_PINNED = _obs.registry().gauge(
+    "serving.prefix_cache.replica_pinned_pages",
+    "pages currently pinned by the replica's trie", labels=("replica",))
+_R_EVICTED = _obs.registry().counter(
+    "serving.prefix_cache.replica_evicted_pages",
+    "trie pages evicted under pool pressure, by replica",
+    labels=("replica",))
 
 
 class _Node:
@@ -90,7 +104,8 @@ class PrefixMatch:
 class PrefixCache:
     """Radix trie of pinned KV pages shared across requests/tenants."""
 
-    def __init__(self, allocator: PageBlockAllocator):
+    def __init__(self, allocator: PageBlockAllocator,
+                 replica: Optional[str] = None):
         self._alloc = allocator
         self._ps = allocator.page_size
         self._root = _Node(None, None, None)
@@ -98,6 +113,14 @@ class PrefixCache:
         # deterministic LRU clock (no wall time: seeded traces replay)
         self._clock = itertools.count(1)
         self._pages = 0
+        self._replica = replica
+
+    def set_replica(self, name: str) -> None:
+        """Adopt a replica name for the labeled metric families (the
+        FleetRouter names engines it was handed anonymously)."""
+        self._replica = name
+        if _obs.enabled():
+            _R_PINNED.labels(replica=name).set(self._pages)
 
     # ---------------------------------------------------------------- keys
     def _chunk(self, prompt, i: int) -> Tuple[int, ...]:
@@ -128,6 +151,9 @@ class PrefixCache:
                 self._alloc.pin(pg)
             if _obs.enabled():
                 (_HITS if pages else _MISSES).inc()
+                if pages and self._replica is not None:
+                    _R_HIT_TOK.labels(replica=self._replica).inc(
+                        len(pages) * self._ps)
         return PrefixMatch(self, pages, len(pages) * self._ps)
 
     def match_length(self, prompt) -> int:
@@ -178,6 +204,9 @@ class PrefixCache:
                 node = child
             if _obs.enabled():
                 _PAGES.set(self._pages)
+                if self._replica is not None:
+                    _R_PINNED.labels(
+                        replica=self._replica).set(self._pages)
         return added
 
     # ------------------------------------------------------------ eviction
@@ -218,8 +247,13 @@ class PrefixCache:
                     freed += 1
                 if _obs.enabled():
                     _EVICTED.inc()
+                    if self._replica is not None:
+                        _R_EVICTED.labels(replica=self._replica).inc()
             if _obs.enabled():
                 _PAGES.set(self._pages)
+                if self._replica is not None:
+                    _R_PINNED.labels(
+                        replica=self._replica).set(self._pages)
         return freed
 
     def flush(self) -> int:

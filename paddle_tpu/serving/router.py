@@ -1,0 +1,359 @@
+"""Prefix-cache-locality fleet router over N serving replicas.
+
+`FleetRouter` spreads requests across engine replicas (ROADMAP item 2)
+using the radix-trie prefix overlap as the placement signal: each
+prefill-capable replica is scored by
+
+    locality_weight * match_length(prompt)          (trie overlap, tokens)
+  - queue_cost_tokens * (inflight + waiting)        (queue depth penalty)
+
+with free pages then submission order as deterministic tiebreaks — a
+cold prompt degenerates to least-loaded placement. The same
+`PrefixCache.match_length` tokens feed the per-replica
+`serving.prefix_cache.replica_hit_tokens` counters, so the router's
+score is computed from the numbers operators already see.
+
+Disaggregation: prefill-role replicas stage completed prefills on
+`engine.handoff_ready`; after each fleet step the router exports them
+(`KVPageHandoff`) and imports into the least-loaded decode-capable
+replica. An import refused with `Overloaded` (pool or admission gate)
+parks the handoff on a pending queue and retries next step — the
+export pins keep the protocol window consistent however long that
+takes.
+
+Resilience: a replica whose `step()` raises
+`distributed.watchdog.CollectiveTimeout` (or any fault the caller
+reports via `drain()`) is taken out of rotation. Every in-flight
+request with complete KV — running decodes, staged handoffs,
+preempted waiters — is exported pages-intact and requeued on the
+survivors (no re-prefill, the PR-10 resume path); mid-prefill and
+still-waiting requests are resubmitted fresh (chunked prefill replays
+deterministically). `readmit()` puts a healed replica back, and
+`poll_elastic()` drives both transitions from an `ElasticManager`'s
+heartbeat view when one is attached.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import observability as _obs
+from .. import resilience as _res
+from ..distributed.watchdog import CollectiveTimeout
+from ..observability import tracing as _tracing
+from .engine import ServingEngine
+from .handoff import KVPageHandoff
+from .scheduler import DECODE, PREFILL, Request
+
+__all__ = ["FleetRouter"]
+
+_PLACED = _obs.registry().counter(
+    "serving.router.placements",
+    "requests placed, by replica and placement signal",
+    labels=("replica", "signal"))
+_ROUTED_HANDOFFS = _obs.registry().counter(
+    "serving.router.handoffs", "prefill→decode handoffs routed")
+_DRAINS = _obs.registry().counter(
+    "serving.router.drains", "replicas drained on fault",
+    labels=("replica",))
+_REQUEUED = _obs.registry().counter(
+    "serving.router.requeued",
+    "in-flight requests moved pages-intact off a drained replica")
+_RESUBMITTED = _obs.registry().counter(
+    "serving.router.resubmitted",
+    "waiting/mid-prefill requests restarted off a drained replica")
+_READMITS = _obs.registry().counter(
+    "serving.router.readmits", "healed replicas re-admitted",
+    labels=("replica",))
+_UP = _obs.registry().gauge(
+    "serving.router.replicas_up", "replicas in rotation")
+_TRACE = _tracing.recorder()
+
+
+class FleetRouter:
+    """Route requests across N `ServingEngine` replicas by prefix-cache
+    locality; drive their steps; broker prefill→decode handoffs; drain
+    and re-admit replicas on faults.
+
+    Typical loop::
+
+        router = FleetRouter({"pf0": prefill_eng, "dec0": decode_eng})
+        router.submit(prompt_ids, max_new_tokens=32)
+        results = router.run_to_completion()
+
+    Replicas may be any role mix: `prefill`/`colocated` replicas take
+    fresh prompts, `decode`/`colocated` replicas take handoffs. All
+    replicas must share model weights, family, and page_size for the
+    exactness contract to hold.
+    """
+
+    def __init__(self, replicas: Dict[str, ServingEngine],
+                 locality_weight: float = 1.0,
+                 queue_cost_tokens: float = 32.0,
+                 elastic=None,
+                 node_ranks: Optional[Dict[str, int]] = None):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = dict(replicas)
+        self.locality_weight = float(locality_weight)
+        self.queue_cost_tokens = float(queue_cost_tokens)
+        for name, eng in self.replicas.items():
+            if eng.replica is None:
+                eng.set_replica(name)
+        self._order = list(self.replicas)     # deterministic tiebreak
+        self._down: set = set()
+        self._pending: List[KVPageHandoff] = []
+        self._export_t: Dict[object, float] = {}
+        self._results: Dict[object, object] = {}
+        self.handoff_count = 0
+        self.handoff_seconds = 0.0
+        # optional ElasticManager heartbeat view: replica name -> node
+        # rank (defaults to listing order)
+        self._elastic = elastic
+        self._ranks = dict(node_ranks) if node_ranks else \
+            {name: i for i, name in enumerate(self._order)}
+        if _obs.enabled():
+            _UP.set(len(self._live()))
+
+    # ------------------------------------------------------------- queries
+    def _live(self) -> List[Tuple[str, ServingEngine]]:
+        return [(n, self.replicas[n]) for n in self._order
+                if n not in self._down]
+
+    def live_replicas(self) -> List[str]:
+        return [n for n, _ in self._live()]
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(
+            eng.has_work() or eng.handoff_ready for _, eng in self._live())
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "replicas": len(self.replicas),
+            "up": len(self._live()),
+            "down": sorted(self._down),
+            "pending_handoffs": len(self._pending),
+            "handoffs": self.handoff_count,
+            "handoff_latency_s": (self.handoff_seconds
+                                  / self.handoff_count
+                                  if self.handoff_count else 0.0),
+        }
+
+    # ----------------------------------------------------------- placement
+    def _score(self, eng: ServingEngine, prompt) -> Tuple[float, int]:
+        hit = eng.prefix_cache.match_length(prompt) \
+            if eng.prefix_cache is not None else 0
+        load = eng.scheduler.inflight + len(eng.scheduler.waiting)
+        return (self.locality_weight * hit
+                - self.queue_cost_tokens * load, hit)
+
+    def submit(self, prompt, max_new_tokens: int = 20, **kw) -> Request:
+        """Place one fresh request on the best prefill-capable replica:
+        highest locality-vs-load score, free pages then listing order as
+        tiebreaks, falling back down the ranking when a replica refuses
+        with `Overloaded`. Raises `Overloaded` only when every live
+        prefill-capable replica refused."""
+        targets = [(n, e) for n, e in self._live()
+                   if e.role in ("prefill", "colocated")]
+        if not targets:
+            raise _res.Overloaded("no prefill-capable replica in rotation")
+        ranked = []
+        for idx, (name, eng) in enumerate(targets):
+            score, hit = self._score(eng, prompt)
+            ranked.append((-score, -eng.allocator.available_pages, idx,
+                           name, eng, hit))
+        ranked.sort(key=lambda t: t[:3])
+        err: Optional[Exception] = None
+        for _, _, _, name, eng, hit in ranked:
+            try:
+                req = eng.add_request(prompt, max_new_tokens, **kw)
+            except _res.Overloaded as e:
+                err = e
+                continue
+            if _obs.enabled():
+                _PLACED.labels(replica=name,
+                               signal="prefix" if hit else "load").inc()
+            _TRACE.stamp(req.request_id, "routed", replica=name,
+                         hit_tokens=hit)
+            return req
+        raise err if err is not None else _res.Overloaded(
+            "all prefill-capable replicas refused")
+
+    def place_of(self, request_id) -> Optional[str]:
+        """Replica currently holding `request_id` (None if unknown/done)."""
+        for name, eng in self._live():
+            if any(r.request_id == request_id
+                   for r in eng.handoff_ready):
+                return name
+            if any(r.request_id == request_id
+                   for r in eng.scheduler.waiting):
+                return name
+            if any(r is not None and r.request_id == request_id
+                   for r in eng.scheduler.slots):
+                return name
+        return None
+
+    # ------------------------------------------------------------ stepping
+    def step(self) -> Dict[str, int]:
+        """One fleet iteration: step every live replica (a
+        `CollectiveTimeout` drains it instead of propagating), export
+        freshly completed prefills, then try to place pending handoffs
+        on decode-capable replicas."""
+        out = {"admitted": 0, "prefill_tokens": 0, "decoded": 0,
+               "finished": 0, "handoffs": 0}
+        for name in list(self._order):
+            if name in self._down:
+                continue
+            eng = self.replicas[name]
+            try:
+                st = eng.step()
+            except CollectiveTimeout as err:
+                self.drain(name, err)
+                continue
+            for k in ("admitted", "prefill_tokens", "decoded",
+                      "finished"):
+                out[k] += st.get(k, 0)
+            for req in list(eng.handoff_ready):
+                self._export(eng, req)
+            self._results.update(eng.collect())
+        pending, self._pending = self._pending, []
+        for handoff in pending:
+            out["handoffs"] += self._import(handoff)
+        return out
+
+    def collect(self) -> Dict[object, object]:
+        """Results finished anywhere in the fleet since last collect."""
+        for _, eng in self._live():
+            self._results.update(eng.collect())
+        done, self._results = self._results, {}
+        return done
+
+    def run_to_completion(self, max_steps: int = 100000) \
+            -> Dict[object, object]:
+        """Step until the fleet is idle; collect everything."""
+        results: Dict[object, object] = {}
+        steps = 0
+        while self.has_work():
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain in {max_steps} steps "
+                    f"({self.stats()})")
+            self.step()
+            results.update(self.collect())
+            steps += 1
+        results.update(self.collect())
+        return results
+
+    # ------------------------------------------------------------- handoff
+    def _export(self, eng: ServingEngine, req: Request) -> None:
+        self._export_t[req.request_id] = time.monotonic()
+        self._pending.append(eng.export_request(req))
+
+    def _import(self, handoff: KVPageHandoff) -> int:
+        """Place one handoff on the least-loaded decode-capable replica
+        (free pages, then listing order). Refused everywhere → back on
+        the pending queue for the next step."""
+        ranked = []
+        for idx, (name, eng) in enumerate(self._live()):
+            if eng.role not in ("decode", "colocated"):
+                continue
+            load = eng.scheduler.inflight + len(eng.scheduler.waiting)
+            ranked.append((load, -eng.allocator.available_pages, idx,
+                           name, eng))
+        ranked.sort(key=lambda t: t[:3])
+        for _, _, _, name, eng in ranked:
+            try:
+                eng.import_request(handoff)
+            except _res.Overloaded:
+                continue
+            t0 = self._export_t.pop(handoff.request_id, None)
+            if t0 is not None:
+                self.handoff_seconds += time.monotonic() - t0
+            self.handoff_count += 1
+            if _obs.enabled():
+                _ROUTED_HANDOFFS.inc()
+            return 1
+        self._pending.append(handoff)
+        return 0
+
+    # ---------------------------------------------------------- resilience
+    def drain(self, name: str, err: Optional[BaseException] = None) -> int:
+        """Take `name` out of rotation and move its work to survivors:
+        requests with complete KV (running decodes, staged handoffs,
+        preempted waiters) are exported pages-intact onto the pending
+        handoff queue — they resume elsewhere WITHOUT re-prefill;
+        waiting/mid-prefill requests are resubmitted fresh. Returns how
+        many requests were moved or resubmitted."""
+        if name in self._down:
+            return 0
+        eng = self.replicas[name]
+        self._down.add(name)
+        if _obs.enabled():
+            _DRAINS.labels(replica=name).inc()
+            _UP.set(len(self._live()))
+        # results finished before the fault survive the drain
+        self._results.update(eng.collect())
+        moved = resubmitted = 0
+        for req in list(eng.handoff_ready):
+            self._export(eng, req)
+            moved += 1
+        for _, req in list(eng.scheduler.active(DECODE)):
+            self._export(eng, req)
+            moved += 1
+        fresh: List[Request] = []
+        for _, req in list(eng.scheduler.active(PREFILL)):
+            # partial prefill is discarded: chunked prefill replays
+            # deterministically on the new replica
+            if req in eng._prefill_fifo:
+                eng._prefill_fifo.remove(req)
+            eng.scheduler.detach(req)
+            if eng.allocator.has_seq(req.request_id):
+                eng.allocator.free(req.request_id)
+            fresh.append(req)
+        for req in list(eng.scheduler.waiting):
+            if req.preempted and eng.allocator.has_seq(req.request_id):
+                self._export(eng, req)
+                moved += 1
+            else:
+                eng.scheduler.waiting.remove(req)
+                fresh.append(req)
+        for req in fresh:
+            self.submit(req.prompt, req.max_new_tokens,
+                        eos_token_id=req.eos_token_id,
+                        pad_token_id=req.pad_token_id,
+                        deadline_s=req.deadline_s,
+                        request_id=req.request_id,
+                        priority=req.priority, tenant=req.tenant)
+            resubmitted += 1
+        if _obs.enabled():
+            _REQUEUED.inc(moved)
+            _RESUBMITTED.inc(resubmitted)
+        _TRACE.stamp(f"drain:{name}", "drain", moved=moved,
+                     resubmitted=resubmitted,
+                     reason=type(err).__name__ if err else "manual")
+        return moved + resubmitted
+
+    def readmit(self, name: str) -> None:
+        """Put a healed replica back in rotation (its pool is empty —
+        drain exported or resubmitted everything)."""
+        if name not in self.replicas:
+            raise KeyError(name)
+        if name in self._down:
+            self._down.discard(name)
+            if _obs.enabled():
+                _READMITS.labels(replica=name).inc()
+                _UP.set(len(self._live()))
+
+    def poll_elastic(self) -> None:
+        """Reconcile rotation with an `ElasticManager` membership view:
+        replicas whose node stopped heartbeating are drained; nodes
+        alive again are re-admitted."""
+        if self._elastic is None:
+            return
+        alive = set(self._elastic.alive_nodes(len(self.replicas)))
+        for name, rank in self._ranks.items():
+            if rank in alive:
+                self.readmit(name)
+            elif name not in self._down:
+                self.drain(name)

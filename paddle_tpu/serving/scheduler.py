@@ -191,9 +191,16 @@ class Scheduler:
             meta["priority"] = req.priority
         if req.tenant is not None:
             meta["tenant"] = req.tenant
-        _TRACE.begin(req.request_id, prompt_len=int(req.prompt.size),
-                     max_new_tokens=req.max_new_tokens, **meta)
-        _TRACE.stamp(req.request_id, "enqueue")
+        if req.preempted and _TRACE.is_live(req.request_id):
+            # a handed-off / resumed request keeps its source timeline:
+            # the cross-replica story (routed → admit → prefill_chunk →
+            # handoff_export → handoff_import → resumed) stays ONE trace
+            # instead of the re-submit clobbering the earlier events
+            _TRACE.stamp(req.request_id, "enqueue", **meta)
+        else:
+            _TRACE.begin(req.request_id, prompt_len=int(req.prompt.size),
+                         max_new_tokens=req.max_new_tokens, **meta)
+            _TRACE.stamp(req.request_id, "enqueue")
         return req
 
     def expire_waiting(self) -> List[Request]:
@@ -308,6 +315,24 @@ class Scheduler:
                 self._tenant_tokens.get(req.tenant, 0) - req.total_tokens
         self.waiting.append(req)
         _TRACE.stamp(req.request_id, "preempted",
+                     decoded=len(req.tokens))
+
+    def detach(self, req: Request) -> None:
+        """Unbind an in-flight (or preempted-waiting) request from this
+        scheduler entirely — the cross-replica handoff path. Unlike
+        `preempt()` the request does NOT re-enter the waiting queue: it
+        continues on another replica's scheduler, so only the slot (or
+        queue position) and the tenant accounting are given up here."""
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+            if req.tenant is not None:
+                self._tenant_tokens[req.tenant] = \
+                    self._tenant_tokens.get(req.tenant, 0) \
+                    - req.total_tokens
+        elif req in self.waiting:
+            self.waiting.remove(req)
+        _TRACE.stamp(req.request_id, "detached",
                      decoded=len(req.tokens))
 
     def release(self, req: Request) -> None:

@@ -392,3 +392,121 @@ class TestStatsAndGauges:
         assert m["serving.engine.pages_used"]["series"][0]["value"] == 2
         assert m["serving.engine.page_utilization"]["series"][0]["value"] \
             == pytest.approx(2 / 8)
+
+
+class TestHandoff:
+    """export_seq / import_seq / release_export: the pin → export →
+    import → unpin window of a cross-replica KV-page handoff must keep
+    both allocators invariant-clean whatever lands in between."""
+
+    def test_export_pins_pages_release_unpins(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("s", 10)
+        a.extend("s", 10)               # 3 pages
+        exp = a.export_seq("s")
+        assert exp["length"] == 10
+        assert exp["pages"] == a.seq_pages("s")
+        for pg in exp["pages"]:
+            assert a.pinned(pg) == 1
+            assert a.refcount(pg) == 2   # seq hold + export pin
+        _check_invariants(a)
+        freed = a.release_export(exp)
+        assert freed == 0                # seq still holds the pages
+        for pg in exp["pages"]:
+            assert a.pinned(pg) == 0 and a.refcount(pg) == 1
+        _check_invariants(a)
+
+    def test_free_mid_handoff_keeps_payload_pages_alive(self):
+        # a preemption/expiry freeing the source sequence mid-window
+        # must not recycle the pages the payload copy reads
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("s", 12)
+        a.extend("s", 12)
+        exp = a.export_seq("s")
+        a.free("s")
+        _check_invariants(a)
+        for pg in exp["pages"]:
+            assert pg not in a._free
+            assert a.refcount(pg) == 1 and a.pinned(pg) == 1
+        freed = a.release_export(exp)
+        assert freed == len(exp["pages"])
+        assert a.free_pages == 8
+        _check_invariants(a)
+
+    def test_shared_prefix_trie_pins_survive_the_window(self):
+        # a trie-pinned shared-prefix page must come back with its trie
+        # refcount intact after export → free → release
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("s", 8)
+        a.extend("s", 8)
+        prefix_pg = a.seq_pages("s")[0]
+        a.pin(prefix_pg)                 # the trie's pin
+        exp = a.export_seq("s")
+        assert a.pinned(prefix_pg) == 2  # trie + export
+        a.free("s")
+        a.release_export(exp)
+        _check_invariants(a)
+        # trie pin intact; the non-prefix page went back to the pool
+        assert a.pinned(prefix_pg) == 1 and a.refcount(prefix_pg) == 1
+        assert prefix_pg not in a._free
+        assert a.unpin(prefix_pg)        # trie eviction frees it
+        _check_invariants(a)
+        assert a.free_pages == 8
+
+    def test_export_trims_pages_beyond_logical_length(self):
+        # after a speculative-decode shrink the seq may keep a trailing
+        # page past its length; the export must cover length only
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("s", 12)
+        a.extend("s", 9)                 # 3 pages, length 9
+        a.shrink("s", 2)                 # length 7: page 3 is overhang
+        assert len(a.seq_pages("s")) == 3
+        exp = a.export_seq("s")
+        assert exp["length"] == 7
+        assert len(exp["pages"]) == 2    # ceil(7/4)
+        _check_invariants(a)
+        a.release_export(exp)
+        _check_invariants(a)
+
+    def test_import_materializes_length_and_reserves_total(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        pages = a.import_seq("s", length=7, total_tokens=14)
+        assert len(pages) == 2 and a.seq_length("s") == 7
+        _check_invariants(a)
+        a.extend("s", 7)                 # reservation covers the rest
+        assert a.seq_length("s") == 14
+        _check_invariants(a)
+
+    def test_import_overloaded_premutation(self):
+        a = PageBlockAllocator(num_pages=5, page_size=4, pages_per_seq=4)
+        a.allocate("big", 12)            # reserves 3 of 4 usable pages
+        with pytest.raises(res.Overloaded):
+            a.import_seq("s", length=5, total_tokens=8)  # needs 2
+        assert not a.has_seq("s")
+        _check_invariants(a)
+
+    def test_import_rejects_bad_length(self):
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        with pytest.raises(ValueError):
+            a.import_seq("s", length=0, total_tokens=8)
+        with pytest.raises(ValueError):
+            a.import_seq("s", length=9, total_tokens=8)
+        _check_invariants(a)
+
+    def test_cross_allocator_round_trip(self):
+        # the real protocol: export from replica A, import into B,
+        # free A's seq, release the export — both pools invariant-clean
+        # and A's pages fully returned
+        a = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        b = PageBlockAllocator(num_pages=9, page_size=4, pages_per_seq=4)
+        a.allocate("r1", 10)
+        a.extend("r1", 10)
+        exp = a.export_seq("r1")
+        dst = b.import_seq("r1", exp["length"], 10)
+        assert len(dst) == len(exp["pages"])
+        a.free("r1")
+        a.release_export(exp)
+        _check_invariants(a)
+        _check_invariants(b)
+        assert a.free_pages == 8
+        assert b.seq_length("r1") == 10

@@ -52,8 +52,8 @@ def test_fleet_init_builds_hybrid_mesh():
     s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
                         "sharding_degree": 2, "sep_degree": 1}
     mesh = fleet.init(strategy=s)
-    assert dict(mesh.shape) == {"pp": 1, "dp": 2, "sharding": 2, "sep": 1, "ep": 1,
-                                "mp": 2}
+    assert dict(mesh.shape) == {"dcn_pp": 1, "dcn_dp": 1, "pp": 1, "dp": 2,
+                                "sharding": 2, "sep": 1, "ep": 1, "mp": 2}
     hcg = fleet.get_hybrid_communicate_group()
     assert hcg.get_model_parallel_world_size() == 2
     assert hcg.get_data_parallel_world_size() == 2

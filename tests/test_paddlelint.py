@@ -2223,3 +2223,80 @@ class TestSeededMemoryDefects:
                 "lambda j: (0, j)),")
         assert fresh and {f.rule for f in fresh} == {"PF406"}
         assert fresh[0].detail == "drift:int4_dequantize"
+
+
+# ------------------------------------------------------ DCN tier (PS3xx)
+
+class TestDCNTierAxes:
+    """ISSUE 15: build_hybrid_mesh grew an explicit multi-slice DCN tier
+    (dcn_dp/dcn_pp, outermost). The static mesh model must know the new
+    axes — both the keyword degrees and the extended positional order —
+    so the PS rules check DCN-tier layouts like any other axis."""
+
+    def test_dcn_dp_statically_indivisible_dim(self):
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def f():
+                mesh = build_hybrid_mesh(dcn_dp_degree=4)
+                x = jnp.zeros((6, 128))
+                return jax.device_put(
+                    x, NamedSharding(mesh, P("dcn_dp", None)))
+        """)
+        assert _rules(fs) == ["PS304"]
+        assert fs[0].detail == "indivisible:0:6:4"
+
+    def test_dcn_dp_divisible_dim_is_quiet(self):
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def f():
+                mesh = build_hybrid_mesh(dcn_dp_degree=4)
+                x = jnp.zeros((8, 128))
+                return jax.device_put(
+                    x, NamedSharding(mesh, P("dcn_dp", None)))
+        """)
+        assert _rules(fs) == []
+
+    def test_dcn_pp_positional_degree(self):
+        # positional signature tail: ..., ep, dcn_dp, dcn_pp
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def f():
+                mesh = build_hybrid_mesh(1, 1, 1, 1, 1, 1, 1, 4)
+                x = jnp.zeros((6, 128))
+                return jax.device_put(
+                    x, NamedSharding(mesh, P("dcn_pp", None)))
+        """)
+        assert _rules(fs) == ["PS304"]
+        assert fs[0].detail == "indivisible:0:6:4"
+
+    def test_psum_over_dcn_axis_is_bound(self):
+        # the hybrid mesh carries the dcn axes even at degree 1: a
+        # collective over them is bound, not a PS301 unbound-axis error
+        fs = _lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+            from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+            def f(x):
+                mesh = build_hybrid_mesh()
+
+                def body(v):
+                    return jax.lax.psum(v, "dcn_dp")
+
+                return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=P("dp"))(x)
+        """)
+        assert _rules(fs) == []

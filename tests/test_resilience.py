@@ -373,3 +373,90 @@ def test_chaos_pretrain_completes_and_resumes(tmp_path):
     assert _metric("resilience.steps_skipped") >= 1
     assert _metric("resilience.ckpt_retries") >= 1
     assert _metric("resilience.emergency_checkpoints") >= 1
+
+
+# ---------------------------------------------------------------------------
+# fleet drain / re-admit (ISSUE 15: disaggregated serving resilience)
+# ---------------------------------------------------------------------------
+def _fleet_model():
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    paddle.seed(0)
+    c = llama_tiny_config(num_hidden_layers=1)
+    m = LlamaForCausalLM(c)
+    m.eval()
+    return m, c.vocab_size
+
+
+def test_fleet_drain_on_collective_timeout_loses_nothing():
+    """Acceptance: killing a replica mid-stream loses zero requests —
+    running decodes move pages-intact (no re-prefill) to the survivor
+    and every output stays bit-identical to the healthy-fleet run."""
+    from paddle_tpu.distributed.watchdog import CollectiveTimeout
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    m, V = _fleet_model()
+    rng = np.random.RandomState(3)
+    reqs = [(rng.randint(0, V, rng.randint(3, 9)).astype(np.int32),
+             int(rng.randint(3, 7))) for _ in range(5)]
+    kw = dict(max_slots=2, page_size=4, prefill_chunk=4)
+
+    def run(inject):
+        a, b = ServingEngine(m, **kw), ServingEngine(m, **kw)
+        router = FleetRouter({"a": a, "b": b})
+        for i, (p, mn) in enumerate(reqs):
+            router.submit(p, mn, request_id=i)
+        if inject:
+            orig, calls = b.step, [0]
+
+            def flaky():
+                calls[0] += 1
+                if calls[0] == 3:   # mid-stream: b already holds work
+                    raise CollectiveTimeout("injected", op="all_reduce")
+                return orig()
+            b.step = flaky
+        return router.run_to_completion(), router
+
+    healthy, _ = run(inject=False)
+    faulted, router = run(inject=True)
+    assert set(faulted) == set(healthy) == set(range(len(reqs)))
+    for rid in healthy:
+        np.testing.assert_array_equal(faulted[rid], healthy[rid])
+    assert router.stats()["down"] == ["b"]
+
+
+def test_fleet_elastic_drain_and_readmit():
+    """The router's ElasticManager view: a replica whose node stops
+    heartbeating is drained; when the heartbeat returns it re-enters
+    rotation and serves again."""
+    import time
+    from paddle_tpu.native import TCPStore
+    from paddle_tpu.distributed.launch import ElasticManager
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    m, V = _fleet_model()
+    kw = dict(max_slots=2, page_size=4, prefill_chunk=4)
+    s = TCPStore(is_master=True, world_size=2)
+    try:
+        m0 = ElasticManager(s, node_rank=0, ttl=0.2)
+        m1 = ElasticManager(s, node_rank=1, ttl=0.2)
+        watcher = ElasticManager(s, node_rank=0, ttl=0.2)
+        router = FleetRouter(
+            {"a": ServingEngine(m, **kw), "b": ServingEngine(m, **kw)},
+            elastic=watcher, node_ranks={"a": 0, "b": 1})
+        m0.heartbeat()
+        m1.heartbeat()
+        router.poll_elastic()
+        assert router.live_replicas() == ["a", "b"]
+        time.sleep(0.3)
+        m0.heartbeat()           # node 1 went silent past its ttl
+        router.poll_elastic()
+        assert router.live_replicas() == ["a"]
+        # the healed node heartbeats again -> back in rotation
+        m1.heartbeat()
+        router.poll_elastic()
+        assert router.live_replicas() == ["a", "b"]
+        prompt = np.arange(1, 6, dtype=np.int32)
+        router.submit(prompt, 3, request_id="after")
+        out = router.run_to_completion()
+        assert list(out) == ["after"] and len(out["after"]) == 3
+    finally:
+        s.close()

@@ -568,3 +568,226 @@ class TestRaggedPath:
                                       decode_strategy="greedy_search",
                                       weight_only_quant="int4")
             np.testing.assert_array_equal(out[i], want.numpy()[0])
+
+
+def _run_fleet_trace(model, V, n, seed, roles, **engine_kw):
+    """The `_run_trace` join/leave trace driven through a FleetRouter
+    over role-split replicas; returns ({rid: result},
+    {rid: solo_reference}, router, {name: engine})."""
+    from paddle_tpu.serving import FleetRouter
+    trace = _trace(V, n, seed)
+    engines = {name: ServingEngine(model, role=role, **engine_kw)
+               for name, role in roles.items()}
+    router = FleetRouter(engines)
+    ref, pending = {}, list(enumerate(trace))
+    results, step = {}, 0
+    while pending or router.has_work():
+        still = []
+        for i, (prompt, max_new, at) in pending:
+            if at <= step:
+                router.submit(prompt, max_new_tokens=max_new,
+                              request_id=i)
+                ref[i] = _solo(model, prompt, max_new)
+            else:
+                still.append((i, (prompt, max_new, at)))
+        pending = still
+        router.step()
+        results.update(router.collect())
+        step += 1
+    return results, ref, router, engines
+
+
+class TestDisaggregated:
+    """Acceptance (ISSUE 15): a request prefilled on replica A and
+    decoded on replica B after a KV-page handoff produces BIT-IDENTICAL
+    greedy output to the colocated engine — across all four families,
+    and with speculative decoding and the prefix cache on."""
+
+    ROLES = {"pf0": "prefill", "dec0": "decode"}
+
+    def _check(self, model, V, n, seed, **kw):
+        results, ref, router, engines = _run_fleet_trace(
+            model, V, n, seed, self.ROLES, max_slots=2, page_size=4,
+            prefill_chunk=4, **kw)
+        assert set(results) == set(ref)
+        for rid in ref:
+            np.testing.assert_array_equal(results[rid], ref[rid])
+        # every request crossed the prefill→decode boundary exactly once
+        assert router.handoff_count == len(ref)
+        for eng in engines.values():
+            assert all(v == 1
+                       for v in eng.program_cache_sizes().values())
+
+    def test_llama_disaggregated_exact(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        c = llama_tiny_config(num_hidden_layers=2)
+        m = LlamaForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 5, seed=1)
+
+    def test_gpt_disaggregated_exact(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+        paddle.seed(0)
+        c = gpt_tiny_config(max_position_embeddings=64)
+        m = GPTForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 4, seed=2)
+
+    def test_mla_disaggregated_exact(self):
+        from paddle_tpu.models.deepseek import (DeepSeekV2ForCausalLM,
+                                                deepseek_v2_tiny_config)
+        paddle.seed(0)
+        c = deepseek_v2_tiny_config(moe_dropless=True,
+                                    num_hidden_layers=2)
+        m = DeepSeekV2ForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 4, seed=3)
+
+    def test_moe_disaggregated_exact(self):
+        from paddle_tpu.models.moe_llm import (MoEForCausalLM,
+                                               qwen2_moe_tiny_config)
+        paddle.seed(0)
+        c = qwen2_moe_tiny_config(moe_dropless=True,
+                                  first_k_dense_replace=1,
+                                  max_position_embeddings=64)
+        m = MoEForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 4, seed=4)
+
+    def test_llama_disaggregated_spec_decode_exact(self):
+        # handoff carries the sampler/spec-decode state: the n-gram
+        # drafter on the decode replica sees prompt+tokens exactly as
+        # the colocated engine would
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        c = llama_tiny_config(num_hidden_layers=2)
+        m = LlamaForCausalLM(c)
+        m.eval()
+        self._check(m, c.vocab_size, 5, seed=5, spec_decode=2)
+
+    def test_decode_role_refuses_fresh_requests(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny_config(num_hidden_layers=1))
+        m.eval()
+        eng = ServingEngine(m, max_slots=2, page_size=4, role="decode")
+        with pytest.raises(ValueError, match="decode-role"):
+            eng.add_request(np.arange(4, dtype=np.int32), 2)
+        with pytest.raises(ValueError):
+            ServingEngine(m, max_slots=2, page_size=4, role="bogus")
+
+    def test_export_shape_and_import_guards(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        c = llama_tiny_config(num_hidden_layers=1)
+        m = LlamaForCausalLM(c)
+        m.eval()
+        pf = ServingEngine(m, max_slots=2, page_size=4, role="prefill")
+        prompt = np.arange(1, 7, dtype=np.int32)
+        pf.add_request(prompt, max_new_tokens=4, request_id="r")
+        while not pf.handoff_ready:
+            pf.step()
+        req = pf.handoff_ready[0]
+        handoff = pf.export_request(req)
+        # KV-length invariant right after prefill: length == prompt
+        # tokens, one emitted token staged as pending
+        assert handoff.kv_length == prompt.size
+        assert handoff.tokens == [handoff.pending]
+        assert handoff.n_pages == 2 and handoff.page_size == 4
+        assert handoff.payload_bytes > 0
+        # a prefill-role replica refuses imports
+        with pytest.raises(ValueError, match="prefill"):
+            pf.import_request(handoff)
+        # geometry mismatch refused before any mutation
+        other = ServingEngine(m, max_slots=2, page_size=8)
+        with pytest.raises(ValueError, match="page_size"):
+            other.import_request(handoff)
+        assert not other.allocator.has_seq("r")
+        handoff.release()
+        assert pf.allocator.free_pages == pf.allocator.available_pages
+
+
+class TestFleetLocality:
+    """Acceptance (ISSUE 15): with 2+ replicas and a 16-tenant shared-
+    system-prompt trace, >= 90% of warm-tenant requests land on the
+    replica already holding their prefix, and the fleet-wide
+    prefill-skip rate stays within 2 points of a single replica's."""
+
+    def _warm_trace(self, V, n_tenants=16, sys_len=8, tail_len=4,
+                    ext_len=4):
+        rng = np.random.RandomState(7)
+        system = rng.randint(0, V, sys_len).astype(np.int32)
+        cold, warm = [], []
+        for _ in range(n_tenants):
+            tail = rng.randint(0, V, tail_len).astype(np.int32)
+            ext = rng.randint(0, V, ext_len).astype(np.int32)
+            cold.append(np.concatenate([system, tail]))
+            # the warm request extends the tenant's own prior prompt
+            # (multi-turn), so its full cold prompt is matchable
+            warm.append(np.concatenate([system, tail, ext]))
+        return cold, warm
+
+    def _drive(self, submit, run, cold, warm):
+        skipped = prompt_toks = 0
+        for t, p in enumerate(cold):
+            submit(p, f"cold{t}", f"t{t}")
+        run()
+        reqs = [submit(p, f"warm{t}", f"t{t}")
+                for t, p in enumerate(warm)]
+        run()
+        for p, r in zip(warm, reqs):
+            skipped += r.shared_tokens
+            prompt_toks += p.size
+        return skipped / prompt_toks
+
+    def test_warm_tenants_route_to_prefix_holder(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        from paddle_tpu.serving import FleetRouter
+        paddle.seed(0)
+        c = llama_tiny_config(num_hidden_layers=1)
+        m = LlamaForCausalLM(c)
+        m.eval()
+        V = c.vocab_size
+        cold, warm = self._warm_trace(V)
+        kw = dict(max_slots=2, page_size=4, prefill_chunk=4)
+        engines = {"a": ServingEngine(m, **kw),
+                   "b": ServingEngine(m, **kw)}
+        router = FleetRouter(engines)
+        for t, p in enumerate(cold):
+            router.submit(p, 3, request_id=f"cold{t}", tenant=f"t{t}")
+        router.run_to_completion()
+        # the cold round spread tenants over both replicas
+        homes = {}
+        for t, p in enumerate(warm):
+            hits = {n: e.prefix_cache.match_length(p)
+                    for n, e in engines.items()}
+            homes[t] = max(hits, key=lambda n: (hits[n], n))
+        assert len(set(homes.values())) == 2
+        on_home = 0
+        fleet_skip = prompt_toks = 0
+        for t, p in enumerate(warm):
+            r = router.submit(p, 3, request_id=f"warm{t}",
+                              tenant=f"t{t}")
+            if router.place_of(f"warm{t}") == homes[t]:
+                on_home += 1
+            router.run_to_completion()
+            fleet_skip += r.shared_tokens
+            prompt_toks += p.size
+        assert on_home >= 0.9 * len(warm), (on_home, homes)
+        fleet_rate = fleet_skip / prompt_toks
+
+        # same trace on ONE colocated replica
+        solo = ServingEngine(m, **kw)
+
+        def submit(p, rid, tenant):
+            return solo.add_request(p, 3, request_id=rid, tenant=tenant)
+        solo_rate = self._drive(submit, solo.run_to_completion, cold,
+                                warm)
+        assert abs(fleet_rate - solo_rate) <= 0.02, (fleet_rate,
+                                                     solo_rate)

@@ -1059,6 +1059,102 @@ def bench_spec_decode_b1(k=4, new=128, rounds=3, dtype="bfloat16"):
              "requested new tokens. CPU-host numbers are not the record")
 
 
+def bench_disaggregated(n_tenants=8, sys_len=128, tail_len=16, new=32,
+                        max_slots=4, page_size=16, dtype="bfloat16"):
+    """Disaggregated prefill/decode A/B (same model, same multitenant
+    trace both ways): a 1-prefill + 1-decode replica fleet behind the
+    FleetRouter — every request prefills on the prefill replica and
+    crosses a KV-page handoff before its first decode step — vs ONE
+    colocated engine. Tenants share a system prompt so the row also
+    measures whether the prefill replica's radix trie keeps its
+    prefill-skip rate under disaggregation. Records tokens/s, TTFT, and
+    prefill-skip both ways plus the handoff count and mean latency.
+    Output exactness across the handoff is the test-suite contract
+    (tests/test_serving_engine.py::TestDisaggregated)."""
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    from bench_util import band, ratio_band
+
+    total = 1024
+    _log(f"disaggregated: init model tenants={n_tenants}")
+    cfg, model = _llama_bench_raw_model(total, dtype)
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, cfg.vocab_size, sys_len).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rng.randint(0, cfg.vocab_size,
+                                           tail_len).astype(np.int32)])
+               for _ in range(n_tenants)]
+    warm = rng.randint(0, cfg.vocab_size,
+                       sys_len + tail_len).astype(np.int32)
+
+    def run(submit, step, drain, warmup):
+        warmup()                           # compile untimed
+        ttfts, shared, prompt_toks = [], 0, 0
+        t_all = time.time()
+        for t, prompt in enumerate(prompts):
+            r = submit(prompt, t)
+            t0 = time.time()
+            first = None
+            while first is None:
+                if step().get("decoded"):
+                    first = time.time() - t0   # first token emitted
+            drain()
+            ttfts.append(first)
+            shared += r.shared_tokens
+            prompt_toks += prompt.size
+        return ttfts, shared, prompt_toks, time.time() - t_all
+
+    _log("disaggregated: colocated trace")
+    eng = ServingEngine(model, max_slots=max_slots, page_size=page_size)
+
+    def _coloc_warm():
+        eng.add_request(warm, max_new_tokens=4)
+        eng.run_to_completion()
+    ttft_c, shared_c, ptoks, wall_c = run(
+        lambda p, t: eng.add_request(p, max_new_tokens=new,
+                                     tenant=f"tenant{t}"),
+        eng.step, eng.run_to_completion, _coloc_warm)
+
+    _log("disaggregated: prefill+decode fleet trace")
+    pf = ServingEngine(model, max_slots=max_slots, page_size=page_size,
+                       role="prefill")
+    dec = ServingEngine(model, max_slots=max_slots, page_size=page_size,
+                        role="decode")
+    router = FleetRouter({"prefill0": pf, "decode0": dec})
+
+    def _fleet_warm():
+        router.submit(warm, max_new_tokens=4)
+        router.run_to_completion()
+    ttft_d, shared_d, _, wall_d = run(
+        lambda p, t: router.submit(p, max_new_tokens=new,
+                                   tenant=f"tenant{t}"),
+        router.step, router.run_to_completion, _fleet_warm)
+
+    st = router.stats()
+    useful = n_tenants * new
+    return dict(
+        tenants=n_tenants, system_prompt_tokens=sys_len,
+        tail_tokens=tail_len, new_tokens_per_request=new,
+        max_slots=max_slots, page_size=page_size,
+        disagg_tokens_per_s=round(useful / wall_d, 1),
+        colocated_tokens_per_s=round(useful / wall_c, 1),
+        ttft_disagg=band(ttft_d),
+        ttft_colocated=band(ttft_c),
+        # per-request ttft_colocated/ttft_disagg: < 1 is the handoff tax
+        ttft_ratio=ratio_band(ttft_c, ttft_d),
+        prefill_skip_rate=round(shared_d / ptoks, 3),
+        colocated_prefill_skip_rate=round(shared_c / ptoks, 3),
+        handoffs=st["handoffs"],
+        handoff_latency_ms=round(st["handoff_latency_s"] * 1e3, 2),
+        programs_compiled={"prefill0": pf.program_cache_sizes(),
+                           "decode0": dec.program_cache_sizes()},
+        note="every fleet request pays one prefill→decode KV-page "
+             "handoff before its first token; sequential per-tenant "
+             "requests so TTFT isolates what each request actually "
+             "paid. handoff_latency is export→import wall time "
+             "(in-process host copy on CPU; DCN transfer on a real "
+             "fleet). CPU-host numbers are not the record")
+
+
 def _paged_sweep_row():
     # the old single-shot paged_attention_op row is gone: it duplicated
     # sweep[0] and its pre-q-scaling-fix "bundled" number contradicted
@@ -1095,6 +1191,7 @@ ROWS = {
     "megadecode": lambda: bench_megadecode(),
     "prefix_cache_multitenant": lambda: bench_prefix_cache_multitenant(),
     "spec_decode_b1": lambda: bench_spec_decode_b1(),
+    "disaggregated": lambda: bench_disaggregated(),
     "_paged": _paged_sweep_row,
 }
 
