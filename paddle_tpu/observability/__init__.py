@@ -521,3 +521,9 @@ from .costmodel import CostEstimate                      # noqa: E402,F401
 
 __all__ += ["attribution", "costmodel", "CostEstimate"]
 
+
+# the fleet observability plane (ISSUE 16): cross-replica trace
+# stitching, metric federation, and fleet-scope SLO histograms
+from . import fleet                                      # noqa: E402
+
+__all__ += ["fleet"]

@@ -1,0 +1,369 @@
+"""paddle_tpu.observability.fleet — the fleet observability plane
+(ISSUE 16): cross-replica trace stitching, metric federation, and the
+fleet-scope SLO histograms the `FleetRouter` measures.
+
+PR 15 made serving a fleet (prefill/decode roles, `KVPageHandoff`,
+`FleetRouter`); this module makes the fleet observable as ONE system:
+
+  - **Trace stitching** — `stitch_chrome_trace` joins per-replica
+    `TraceRecorder` rings into one chrome trace with one process lane
+    (pid) per replica. A request that travelled routed → prefill →
+    handoff export → import → decode renders as ONE logical timeline
+    whose lifetime spans sit in the lane of the replica that ran each
+    leg, tied together by a flow/arrow event (`ph:"s"`/`ph:"f"`) from
+    `handoff_export` to `handoff_import`. Lane attribution comes from
+    the ``replica=`` meta the recorder attaches to every stamp taken
+    under `TraceRecorder.set_replica_context` (the engine sets it at
+    the top of every stamping method).
+  - **Fleet SLO histograms** — ``serving.fleet.ttft_seconds`` /
+    ``e2e_seconds`` / ``handoff_latency_seconds`` observed by the
+    ROUTER (submit → first token / completion seen from outside the
+    replicas, the latency a client of the fleet actually experiences)
+    plus ``serving.fleet.phase_seconds{phase=router_queue|prefill|
+    handoff|decode}``, the per-phase attribution of each finished
+    request's e2e derived from its stitched trace.
+  - **Metric federation** — `federate` merges per-replica registry
+    snapshots (`ServingEngine.scrape()`) into one fleet rollup
+    registry: counters summed across replicas per label key, gauges
+    and histograms re-labeled with ``replica=<name>``. The rollup is a
+    plain `Registry`, so the existing exporters (`to_prometheus`,
+    `snapshot`) and `slo_summary` work on it unchanged —
+    `FleetRouter.scrape()` is the entry point.
+
+Overhead contract (same as the metrics/tracing layers): every observe_*
+entry point checks the cached ``FLAGS_metrics`` flag object FIRST, and
+the stitcher only reads recorder state that `FLAGS_request_tracing`
+gates at stamp time — gated at <5% disabled overhead alongside the
+other paths in tests/test_observability.py::TestOverhead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+from .. import flags as _flags
+from . import DEFAULT_BUCKETS, Registry, registry
+from .tracing import RequestTrace, TraceRecorder, slo_summary
+
+__all__ = ["FLEET_SLO_METRICS", "FLEET_PHASES", "observe_ttft",
+           "observe_e2e", "observe_handoff", "observe_phases",
+           "phase_attribution", "federate", "stitch_chrome_trace",
+           "fleet_slo_summary"]
+
+_MFLAG = _flags._registry["FLAGS_metrics"]
+
+#: router-measured fleet-scope SLO histograms (unlabeled; slo_summary
+#: renders the standard table over them)
+FLEET_SLO_METRICS: Tuple[str, ...] = (
+    "serving.fleet.ttft_seconds",
+    "serving.fleet.e2e_seconds",
+    "serving.fleet.handoff_latency_seconds",
+)
+#: per-phase attribution label values on serving.fleet.phase_seconds
+FLEET_PHASES: Tuple[str, ...] = ("router_queue", "prefill", "handoff",
+                                 "decode")
+
+_H_TTFT = registry().histogram(
+    "serving.fleet.ttft_seconds",
+    "router submit -> first token, fleet-wide (measured by the router, "
+    "drains and handoffs included)", buckets=DEFAULT_BUCKETS)
+_H_E2E = registry().histogram(
+    "serving.fleet.e2e_seconds",
+    "router submit -> completed result, fleet-wide", buckets=DEFAULT_BUCKETS)
+_H_HANDOFF = registry().histogram(
+    "serving.fleet.handoff_latency_seconds",
+    "KV-page handoff export -> successful import, router-measured",
+    buckets=DEFAULT_BUCKETS)
+_H_PHASE = registry().histogram(
+    "serving.fleet.phase_seconds",
+    "per-request e2e attribution by phase (router queue / prefill / "
+    "handoff / decode), derived from the stitched trace",
+    labels=("phase",), buckets=DEFAULT_BUCKETS)
+
+
+def observe_ttft(seconds: float) -> None:
+    if not _MFLAG.value:
+        return
+    _H_TTFT.observe(seconds)
+
+
+def observe_e2e(seconds: float) -> None:
+    if not _MFLAG.value:
+        return
+    _H_E2E.observe(seconds)
+
+
+def observe_handoff(seconds: float) -> None:
+    if not _MFLAG.value:
+        return
+    _H_HANDOFF.observe(seconds)
+
+
+def phase_attribution(tr: Optional[RequestTrace]) -> Dict[str, float]:
+    """Split one request's wall time into the four fleet phases from its
+    (stitched) timeline: router_queue = enqueue → admit, prefill =
+    admit → handoff_ready (or first token when colocated), handoff =
+    Σ(handoff_export → next handoff_import), decode = first token →
+    last event minus the handoff windows. Phases whose events are
+    missing are omitted (pure derivation — no flag, no mutation)."""
+    if tr is None:
+        return {}
+    evs = tr.timeline()
+    if not evs:
+        return {}
+    out: Dict[str, float] = {}
+    enq, adm = tr.first("enqueue"), tr.first("admit")
+    tok1 = tr.first("token")
+    if enq is not None and adm is not None and adm.t_us >= enq.t_us:
+        out["router_queue"] = (adm.t_us - enq.t_us) / 1e6
+    pf_end = tr.first("handoff_ready") or tok1
+    if adm is not None and pf_end is not None \
+            and pf_end.t_us >= adm.t_us:
+        out["prefill"] = (pf_end.t_us - adm.t_us) / 1e6
+    handoff = 0.0
+    t_exp: Optional[int] = None
+    for e in evs:
+        if e.name == "handoff_export":
+            t_exp = e.t_us
+        elif e.name == "handoff_import" and t_exp is not None:
+            handoff += (e.t_us - t_exp) / 1e6
+            t_exp = None
+    if handoff > 0.0:
+        out["handoff"] = handoff
+    if tok1 is not None and evs[-1].t_us >= tok1.t_us:
+        out["decode"] = max(
+            (evs[-1].t_us - tok1.t_us) / 1e6 - handoff, 0.0)
+    return out
+
+
+def observe_phases(tr: Optional[RequestTrace]) -> None:
+    """Observe a finished request's phase attribution into
+    ``serving.fleet.phase_seconds{phase=...}`` (router calls this when a
+    result is collected; no-op with metrics off or no trace)."""
+    if not _MFLAG.value:
+        return
+    for phase, seconds in phase_attribution(tr).items():
+        _H_PHASE.labels(phase=phase).observe(seconds)
+
+
+def fleet_slo_summary(reg=None, qs: Sequence[float] = (50, 90, 99)
+                      ) -> Dict[str, Any]:
+    """{metric: {count, mean, p50, p90, p99}} over the fleet SLO
+    histograms (default registry, or a `FleetRouter.scrape()` rollup)."""
+    return slo_summary(FLEET_SLO_METRICS, reg=reg, qs=qs)
+
+
+# ---------------------------------------------------------------------------
+# metric federation
+# ---------------------------------------------------------------------------
+
+def federate(snapshots: Mapping[str, Mapping[str, Any]]) -> Registry:
+    """Merge per-replica registry snapshots ({replica_name:
+    reg.snapshot()}) into one fleet rollup `Registry`:
+
+      - **counters** are summed across replicas per label key (the fleet
+        total — the per-replica split, when it matters, is already a
+        ``replica`` label on the source family);
+      - **gauges and histograms** gain a leading ``replica`` label, one
+        child per (replica, original labels) — summing a queue-depth
+        gauge or a latency histogram across replicas would destroy the
+        signal operators page on. Families that already carry a
+        ``replica`` label keep their label set (the value is overridden
+        with the scraping replica's name).
+
+    The result is a plain registry: `obs.to_prometheus(rollup)`,
+    `rollup.snapshot()` and `tracing.slo_summary(..., reg=rollup)` all
+    work unchanged. Pure transformation of its inputs — flag gating
+    lives at the scrape() entry points that produce them."""
+    reg = Registry()
+    for replica in sorted(snapshots):
+        snap = snapshots[replica]
+        for name in sorted(snap):
+            e = snap[name]
+            kind, labels = e["kind"], tuple(e["labels"])
+            if kind == "counter":
+                m = reg.counter(name, e.get("help", ""), labels)
+                for s in e["series"]:
+                    tgt = m.labels(**s["labels"]) if labels else m
+                    tgt._value += float(s["value"])
+                continue
+            relabel = "replica" not in labels
+            out_labels = (("replica",) + labels) if relabel else labels
+            if kind == "gauge":
+                m = reg.gauge(name, e.get("help", ""), out_labels)
+                for s in e["series"]:
+                    lbl = dict(s["labels"])
+                    lbl["replica"] = replica
+                    m.labels(**lbl)._value = float(s["value"])
+            elif kind == "histogram":
+                m = reg.histogram(name, e.get("help", ""), out_labels,
+                                  buckets=e["buckets"])
+                for s in e["series"]:
+                    lbl = dict(s["labels"])
+                    lbl["replica"] = replica
+                    tgt = m.labels(**lbl)
+                    counts = list(s["counts"])
+                    tgt._counts = [a + b for a, b
+                                   in zip(tgt._counts, counts)] \
+                        if tgt._count else counts
+                    tgt._sum += float(s["sum"])
+                    tgt._count += int(s["count"])
+            else:
+                raise ValueError(f"unknown metric kind {kind!r} "
+                                 f"for {name!r}")
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# cross-replica trace stitching
+# ---------------------------------------------------------------------------
+
+def _collect_traces(recorders, include_live: bool
+                    ) -> List[Tuple[str, RequestTrace]]:
+    if isinstance(recorders, TraceRecorder):
+        recorders = {"fleet": recorders}
+    seen: set = set()
+    out: List[Tuple[str, RequestTrace]] = []
+    for rec_name in recorders:
+        rec = recorders[rec_name]
+        traces = rec.finished() + (rec.live() if include_live else [])
+        for tr in traces:
+            if id(tr) in seen:     # one recorder shared by N replicas
+                continue
+            seen.add(id(tr))
+            out.append((rec_name, tr))
+    return out
+
+
+def _event_lanes(tr: RequestTrace, fallback: str) -> List[str]:
+    """Per-event lane names: the stamp's ``replica`` meta, carried
+    forward over untagged events; events before the first tagged one
+    back-fill from it (the enqueue raced the engine setting its
+    context). Fully untagged traces stay in the `fallback` lane."""
+    evs = tr.timeline()
+    lanes: List[Optional[str]] = []
+    cur: Optional[str] = None
+    for e in evs:
+        tag = (e.meta or {}).get("replica")
+        if tag:
+            cur = str(tag)
+        lanes.append(cur)
+    first = next((x for x in lanes if x is not None), None)
+    return [x if x is not None else (first or fallback) for x in lanes]
+
+
+def stitch_chrome_trace(path: str,
+                        recorders: Union[TraceRecorder,
+                                         Mapping[str, TraceRecorder],
+                                         None] = None,
+                        include_live: bool = True) -> int:
+    """Join per-replica `TraceRecorder` rings into ONE chrome trace with
+    one process lane per replica.
+
+    `recorders` maps replica/recorder name → `TraceRecorder`; an
+    in-process fleet (tier-1) passes the shared singleton (or nothing —
+    the default recorder is used) and lanes come entirely from the
+    per-stamp ``replica=`` meta. Each request renders as:
+
+      - one lifetime span (``<kind>:<id>[span=<span_id>]``) per
+        contiguous run of events on the same replica, in that replica's
+        pid lane, all sharing the request's span id;
+      - an instant event per stamp, in the lane the stamp was taken on;
+      - a flow event (``ph:"s"`` at ``handoff_export`` →
+        ``ph:"f"``/``bp:"e"`` at ``handoff_import``) drawing the
+        arrow across the two lanes for every handoff the request paid.
+
+    Counter tracks from every recorder land in a shared ``fleet`` lane
+    (pid 0). Returns the event count; the file opens in Perfetto."""
+    if recorders is None:
+        from .tracing import recorder as _default
+        recorders = _default()
+    pairs = _collect_traces(recorders, include_live)
+    # lane -> pid, assigned in first-appearance-then-sorted order so the
+    # output is deterministic for seeded runs
+    lane_events: Dict[str, List[Tuple[RequestTrace, List[int]]]] = {}
+    per_trace: List[Tuple[RequestTrace, List[str]]] = []
+    for rec_name, tr in pairs:
+        if not tr.timeline():
+            continue
+        lanes = _event_lanes(tr, rec_name)
+        per_trace.append((tr, lanes))
+    lane_names = sorted({ln for _, lanes in per_trace for ln in lanes})
+    pid_of = {ln: i + 1 for i, ln in enumerate(lane_names)}
+    events: List[Dict[str, Any]] = []
+    for ln in lane_names:
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": pid_of[ln],
+                       "args": {"name": f"replica:{ln}"}})
+    events.append({"ph": "M", "name": "process_name", "pid": 0,
+                   "args": {"name": "fleet"}})
+    # one tid per request within each lane, stable across lanes so the
+    # same request sits at the same row index in every replica's lane
+    tid_of: Dict[Any, int] = {}
+    for tr, _ in per_trace:
+        tid_of.setdefault(tr.request_id, len(tid_of) + 1)
+    n_flows = 0
+    for tr, lanes in per_trace:
+        evs = tr.timeline()
+        tid = tid_of[tr.request_id]
+        args = {"span_id": tr.span_id, "outcome": tr.outcome}
+        args.update(tr.meta)
+        # contiguous same-lane segments -> lifetime spans per lane
+        seg_start = 0
+        for i in range(1, len(evs) + 1):
+            if i < len(evs) and lanes[i] == lanes[seg_start]:
+                continue
+            seg = evs[seg_start:i]
+            pid = pid_of[lanes[seg_start]]
+            events.append({
+                "name": f"{tr.kind}:{tr.request_id}"
+                        f"[span={tr.span_id}]",
+                "ph": "X", "pid": pid, "tid": tid,
+                "ts": seg[0].t_us,
+                "dur": max(seg[-1].t_us - seg[0].t_us, 1),
+                "cat": tr.kind, "args": dict(args)})
+            seg_start = i
+        for e, ln in zip(evs, lanes):
+            rec = {"name": e.name, "ph": "i", "pid": pid_of[ln],
+                   "tid": tid, "ts": e.t_us, "s": "t", "cat": "event"}
+            if e.meta:
+                rec["args"] = dict(e.meta)
+            events.append(rec)
+        # handoff flow arrows: export on one lane -> import on the next
+        pending: Optional[Tuple[int, str]] = None
+        for e, ln in zip(evs, lanes):
+            if e.name == "handoff_export":
+                pending = (e.t_us, ln)
+            elif e.name == "handoff_import" and pending is not None:
+                n_flows += 1
+                fid = f"handoff:{tr.request_id}:{n_flows}"
+                t_exp, ln_exp = pending
+                events.append({"name": "kv_handoff", "ph": "s",
+                               "id": fid, "pid": pid_of[ln_exp],
+                               "tid": tid, "ts": t_exp,
+                               "cat": "handoff"})
+                events.append({"name": "kv_handoff", "ph": "f",
+                               "bp": "e", "id": fid, "pid": pid_of[ln],
+                               "tid": tid, "ts": e.t_us,
+                               "cat": "handoff"})
+                pending = None
+    if isinstance(recorders, TraceRecorder):
+        recorders = {"fleet": recorders}
+    seen_rec: set = set()
+    for rec_name in recorders:
+        rec = recorders[rec_name]
+        if id(rec) in seen_rec:
+            continue
+        seen_rec.add(id(rec))
+        for name, series in sorted(rec.counters().items()):
+            for t, v in series:
+                events.append({"name": name, "ph": "C", "pid": 0,
+                               "ts": t, "cat": "counter",
+                               "args": {"value": v}})
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(events)

@@ -220,6 +220,7 @@ class TraceRecorder:
         self._capacity = int(capacity)
         self._counters: Dict[str, deque] = {}
         self._host_span: Optional[str] = None
+        self._replica: Optional[str] = None
         self._export_f = None
         self._export_thread: Optional[threading.Thread] = None
         self._export_stop: Optional[threading.Event] = None
@@ -251,6 +252,9 @@ class TraceRecorder:
             hs = self._host_span
             if hs is not None and "host_span" not in meta:
                 meta["host_span"] = hs
+            rp = self._replica
+            if rp is not None and "replica" not in meta:
+                meta["replica"] = rp
             tr._events.append(TraceEvent(name, t, meta or None))
 
     def finish(self, request_id, outcome: str = "finish", **meta) -> None:
@@ -290,6 +294,60 @@ class TraceRecorder:
             return
         with self._lock:
             self._host_span = span_id
+
+    def set_replica_context(self, name: Optional[str]) -> None:
+        """Record which fleet replica is currently stamping; subsequent
+        stamps carry ``replica=<name>`` in their meta so the fleet
+        stitcher (`observability.fleet`) can split one cross-replica
+        timeline into per-replica chrome-trace lanes. The serving engine
+        sets this at the top of every method that stamps (and clears it
+        with None for solo engines)."""
+        if not _FLAG.value:
+            return
+        with self._lock:
+            self._replica = name
+
+    # ---------------------------------------------- cross-replica handoff
+    def export_context(self, request_id) -> Optional[Dict[str, Any]]:
+        """Portable trace context for a request leaving this process
+        with a `KVPageHandoff`: request id, span lineage, accumulated
+        events. `adopt()` on the importing replica's recorder continues
+        the SAME logical timeline. Returns None with tracing off or for
+        an unknown id."""
+        if not _FLAG.value:
+            return None
+        with self._lock:
+            tr = self._live.get(request_id)
+            if tr is None:
+                return None
+            return {
+                "request_id": tr.request_id, "kind": tr.kind,
+                "span_id": tr.span_id, "meta": dict(tr.meta),
+                "events": [{"name": e.name, "t_us": e.t_us,
+                            "meta": dict(e.meta) if e.meta else None}
+                           for e in tr._events],
+            }
+
+    def adopt(self, request_id, ctx: Optional[Dict[str, Any]]) -> None:
+        """Continue a timeline exported by another replica's recorder
+        (`export_context` travelling on the handoff). In-process fleets
+        share ONE recorder, so a request that is still live here keeps
+        its existing trace untouched; on a real fleet the importing
+        process rebuilds the carried events — same span id, same
+        lineage — and the scheduler's resume path appends to it."""
+        if not _FLAG.value or not ctx:
+            return
+        with self._lock:
+            if request_id in self._live:
+                return
+            tr = RequestTrace(request_id, kind=ctx.get("kind", "request"),
+                              meta=ctx.get("meta") or None)
+            if ctx.get("span_id"):
+                tr.span_id = ctx["span_id"]
+            for e in ctx.get("events", ()):
+                tr._events.append(TraceEvent(e["name"], e["t_us"],
+                                             e.get("meta") or None))
+            self._live[request_id] = tr
 
     def counter(self, name: str, value, t_us: Optional[int] = None) -> None:
         """Record one sample on a named counter track — a (t, value)
@@ -362,6 +420,7 @@ class TraceRecorder:
             self._counters.clear()
             self._pending_export.clear()
             self._host_span = None
+            self._replica = None
 
     # ------------------------------------------------------- chrome export
     def export_chrome_trace(self, path: str,

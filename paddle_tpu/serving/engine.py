@@ -214,6 +214,10 @@ class ServingEngine:
         self.role = role
         self.replica = replica
         self.handoff_ready: List[Request] = []
+        # engine-local handoff totals for scrape(): in-process fleets
+        # share ONE default registry, so per-replica truth must come
+        # from engine state, not the shared counters
+        self._handoff_counts = {"export": 0, "import": 0}
         p = _decode_params(model, weight_only_int8, weight_only_quant)
         cfg = p["cfg"]
         self._p = p
@@ -353,6 +357,7 @@ class ServingEngine:
                 "decode-role replica does not prefill: route fresh "
                 "requests to a prefill/colocated replica "
                 "(import_request is this engine's intake)")
+        _TRACE.set_replica_context(self.replica)
         req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
                       pad_token_id=pad_token_id,
                       deadline_s=(deadline_s if deadline_s is not None
@@ -385,6 +390,7 @@ class ServingEngine:
         for observability/benching."""
         out = {"admitted": 0, "prefill_tokens": 0, "decoded": 0,
                "finished": 0}
+        _TRACE.set_replica_context(self.replica)
         for req in self.scheduler.expire_waiting():
             # a PREEMPTED request expiring in the queue still owns its
             # allocator sequence (pages kept for the resume that never
@@ -510,6 +516,47 @@ class ServingEngine:
         return {r.request_id: r.result
                 for r in self.scheduler.drain_finished()}
 
+    def scrape(self) -> Dict[str, object]:
+        """This replica's registry snapshot for fleet federation
+        (`FleetRouter.scrape()` → `observability.fleet.federate`).
+
+        In-process fleets share ONE default registry, so the per-replica
+        families here (``serving.replica.*``) are built from engine-local
+        state — slots, queue, allocator, trie, launch and handoff totals
+        — into a fresh registry and returned in `Registry.snapshot()`
+        format. Returns {} with metrics disabled (the federation
+        mutation entry point honors `FLAGS_metrics`)."""
+        if not _obs.enabled():
+            return {}
+        reg = _obs.Registry()
+        reg.gauge("serving.replica.info",
+                  "replica role marker (value always 1)",
+                  labels=("role",)).labels(role=self.role).set(1)
+        reg.gauge("serving.replica.active_slots",
+                  "requests holding a slot").set(self.scheduler.inflight)
+        reg.gauge("serving.replica.waiting",
+                  "requests queued for admission").set(
+                      len(self.scheduler.waiting))
+        st = self.allocator.stats()
+        reg.gauge("serving.replica.kv_pages_used",
+                  "KV pool pages in use").set(st["pages_used"])
+        reg.gauge("serving.replica.kv_pages_free",
+                  "KV pool pages free").set(st["pages_free"])
+        reg.gauge("serving.replica.kv_utilization",
+                  "KV pool utilization [0,1]").set(st["utilization"])
+        if self.prefix_cache is not None:
+            reg.gauge("serving.replica.prefix_pages",
+                      "radix-trie pages pinned on this replica").set(
+                          self.prefix_cache.pages)
+        reg.counter("serving.replica.launches",
+                    "device program launches").inc(self.launches)
+        hc = reg.counter("serving.replica.handoffs",
+                         "KV-page handoffs by direction",
+                         labels=("direction",))
+        for direction, n in self._handoff_counts.items():
+            hc.labels(direction=direction).inc(n)
+        return reg.snapshot()
+
     def run_to_completion(self) -> Dict[object, object]:
         """Step until idle; collect everything."""
         results: Dict[object, object] = {}
@@ -548,6 +595,7 @@ class ServingEngine:
         pages readable until the importer's `release()`, and trie pins
         keep shared prompt pages warm on this replica regardless."""
         rid = req.request_id
+        _TRACE.set_replica_context(self.replica)
         if req.pending is None or req.prefill_pos < int(req.prompt.size):
             raise ValueError(
                 f"request {rid} is not exportable mid-prefill "
@@ -585,12 +633,16 @@ class ServingEngine:
             page_size=self.page_size, family=self._family,
             source=self.replica or "", _release=lambda:
             alloc.release_export(exp))
+        self._handoff_counts["export"] += 1
         if _obs.enabled():
             HANDOFFS.labels(direction="export").inc()
             HANDOFF_PAGES.inc(len(exp["pages"]))
             HANDOFF_BYTES.inc(handoff.payload_bytes)
         _TRACE.stamp(rid, "handoff_export", pages=len(exp["pages"]),
                      kv_tokens=handoff.kv_length)
+        # the trace context travels WITH the KV pages: the importer
+        # adopts it so the request keeps one timeline across replicas
+        handoff.trace = _TRACE.export_context(rid)
         return handoff
 
     def import_request(self, handoff: KVPageHandoff) -> Request:
@@ -612,6 +664,8 @@ class ServingEngine:
             raise ValueError(
                 f"page_size mismatch: handoff {handoff.page_size} vs "
                 f"engine {self.page_size}")
+        _TRACE.set_replica_context(self.replica)
+        _TRACE.adopt(handoff.request_id, handoff.trace)
         req = Request(handoff.prompt, handoff.max_new_tokens,
                       eos_token_id=handoff.eos_token_id,
                       pad_token_id=handoff.pad_token_id,
@@ -646,6 +700,7 @@ class ServingEngine:
         # land at positions >= kv_length >= prompt.size, past them.
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt, pages)
+        self._handoff_counts["import"] += 1
         if _obs.enabled():
             HANDOFFS.labels(direction="import").inc()
             _REQS.labels(outcome="imported").inc()

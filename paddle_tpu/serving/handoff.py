@@ -66,6 +66,10 @@ class KVPageHandoff:
     page_size: int
     family: str
     source: str                  # exporting replica name
+    #: portable trace context (request id, span lineage, events so far)
+    #: from TraceRecorder.export_context — the importer adopts it so the
+    #: request keeps ONE logical timeline across replicas
+    trace: Optional[dict] = None
     _release: Optional[Callable[[], int]] = field(default=None,
                                                   repr=False)
     _released: bool = field(default=False, repr=False)

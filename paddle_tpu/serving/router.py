@@ -38,9 +38,12 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .. import observability as _obs
 from .. import resilience as _res
 from ..distributed.watchdog import CollectiveTimeout
+from ..observability import fleet as _fleet
 from ..observability import tracing as _tracing
 from .engine import ServingEngine
 from .handoff import KVPageHandoff
@@ -108,6 +111,12 @@ class FleetRouter:
         self._results: Dict[object, object] = {}
         self.handoff_count = 0
         self.handoff_seconds = 0.0
+        # fleet-scope SLO tracking, router-measured: request_id ->
+        # [submit_t, first_token_seen, Request]. Populated only with
+        # metrics enabled; drain-resubmits keep the ORIGINAL submit
+        # time, so fleet TTFT/e2e include the retry cost a client of
+        # the fleet actually pays.
+        self._slo: Dict[object, list] = {}
         # optional ElasticManager heartbeat view: replica name -> node
         # rank (defaults to listing order)
         self._elastic = elastic
@@ -172,6 +181,12 @@ class FleetRouter:
                 err = e
                 continue
             if _obs.enabled():
+                ent = self._slo.get(req.request_id)
+                if ent is None:
+                    self._slo[req.request_id] = [time.monotonic(),
+                                                 False, req]
+                else:
+                    ent[2] = req     # drain-resubmit: keep original t0
                 _PLACED.labels(replica=name,
                                signal="prefix" if hit else "load").inc()
             _TRACE.stamp(req.request_id, "routed", replica=name,
@@ -214,9 +229,10 @@ class FleetRouter:
             for k in ("admitted", "prefill_tokens", "decoded",
                       "finished"):
                 out[k] += st.get(k, 0)
+            self._observe_first_tokens()
             for req in list(eng.handoff_ready):
                 self._export(eng, req)
-            self._results.update(eng.collect())
+            self._absorb(eng.collect())
         pending, self._pending = self._pending, []
         for handoff in pending:
             out["handoffs"] += self._import(handoff)
@@ -225,7 +241,7 @@ class FleetRouter:
     def collect(self) -> Dict[object, object]:
         """Results finished anywhere in the fleet since last collect."""
         for _, eng in self._live():
-            self._results.update(eng.collect())
+            self._absorb(eng.collect())
         done, self._results = self._results, {}
         return done
 
@@ -244,6 +260,68 @@ class FleetRouter:
             steps += 1
         results.update(self.collect())
         return results
+
+    # ------------------------------------------------------- fleet SLOs
+    def _observe_first_tokens(self) -> None:
+        """Fleet TTFT, measured from OUTSIDE the replicas: scanned right
+        after each engine step so the router sees a first token at the
+        earliest moment a fleet client could (within one step of the
+        trace's own token stamp)."""
+        if not self._slo:
+            return
+        now = time.monotonic()
+        for ent in self._slo.values():
+            if not ent[1] and ent[2] is not None and ent[2].tokens:
+                ent[1] = True
+                _fleet.observe_ttft(now - ent[0])
+
+    def _absorb(self, done: Dict[object, object]) -> None:
+        """Fold one engine's collected results into the fleet result
+        set, observing fleet e2e + per-phase attribution for every
+        request that completed with tokens."""
+        self._results.update(done)
+        if not self._slo or not done:
+            return
+        now = time.monotonic()
+        finished = None
+        for rid, res in done.items():
+            ent = self._slo.pop(rid, None)
+            if ent is None or not isinstance(res, np.ndarray):
+                continue
+            _fleet.observe_e2e(now - ent[0])
+            if finished is None:
+                finished = {t.request_id: t for t in _TRACE.finished()}
+            _fleet.observe_phases(finished.get(rid))
+
+    def scrape(self) -> _obs.Registry:
+        """Fleet metric federation: collect every live replica's
+        `ServingEngine.scrape()` snapshot into one rollup registry
+        (counters summed, gauges/histograms re-labeled with
+        ``replica=...``) plus the router-measured ``serving.fleet.*``
+        SLO histograms — ready for `obs.to_prometheus(rollup)` /
+        `rollup.snapshot()`. Returns an empty registry with metrics
+        disabled."""
+        snaps = {n: e.scrape() for n, e in self._live()}
+        rollup = _fleet.federate(
+            {n: s for n, s in snaps.items() if s})
+        snap = _obs.snapshot()
+        for name in sorted(snap):
+            if not name.startswith("serving.fleet."):
+                continue
+            e = snap[name]
+            m = rollup.histogram(name, e["help"], tuple(e["labels"]),
+                                 buckets=tuple(e["buckets"]))
+            for s in e["series"]:
+                tgt = m.labels(**s["labels"]) if e["labels"] else m
+                tgt._counts = list(s["counts"])
+                tgt._sum = float(s["sum"])
+                tgt._count = int(s["count"])
+        return rollup
+
+    def slo_summary(self, qs=(50, 90, 99)) -> Dict[str, object]:
+        """Fleet-scope SLO table ({metric: {count, mean, pXX}}) over the
+        router-measured serving.fleet.* histograms."""
+        return _fleet.fleet_slo_summary(qs=qs)
 
     # ------------------------------------------------------------- handoff
     def _export(self, eng: ServingEngine, req: Request) -> None:
@@ -264,12 +342,17 @@ class FleetRouter:
         ranked.sort(key=lambda t: t[:3])
         for _, _, _, name, eng in ranked:
             try:
-                eng.import_request(handoff)
+                req = eng.import_request(handoff)
             except _res.Overloaded:
                 continue
+            ent = self._slo.get(handoff.request_id)
+            if ent is not None:
+                ent[2] = req    # the importer's Request is live now
             t0 = self._export_t.pop(handoff.request_id, None)
             if t0 is not None:
-                self.handoff_seconds += time.monotonic() - t0
+                dt = time.monotonic() - t0
+                self.handoff_seconds += dt
+                _fleet.observe_handoff(dt)
             self.handoff_count += 1
             if _obs.enabled():
                 _ROUTED_HANDOFFS.inc()
@@ -293,7 +376,7 @@ class FleetRouter:
             _DRAINS.labels(replica=name).inc()
             _UP.set(len(self._live()))
         # results finished before the fault survive the drain
-        self._results.update(eng.collect())
+        self._absorb(eng.collect())
         moved = resubmitted = 0
         for req in list(eng.handoff_ready):
             self._export(eng, req)

@@ -196,7 +196,7 @@ class Scheduler:
             # the cross-replica story (routed → admit → prefill_chunk →
             # handoff_export → handoff_import → resumed) stays ONE trace
             # instead of the re-submit clobbering the earlier events
-            _TRACE.stamp(req.request_id, "enqueue", **meta)
+            _TRACE.stamp(req.request_id, "enqueue", resume=True, **meta)
         else:
             _TRACE.begin(req.request_id, prompt_len=int(req.prompt.size),
                          max_new_tokens=req.max_new_tokens, **meta)

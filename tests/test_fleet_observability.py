@@ -1,0 +1,504 @@
+"""Fleet observability plane (ISSUE 16): cross-replica trace stitching
+(one merged chrome trace, a handed-off request as a single flow across
+replica lanes), metric federation semantics, router-measured fleet SLO
+histograms (acceptance: percentiles agree with trace-derived TTFTs to
+within one histogram bucket), the single-timeline contract under
+replica-kill chaos, the seeded hostile-traffic workload harness with its
+perf_gate bands, and the metric-doc drift gate."""
+
+import bisect
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import DEFAULT_BUCKETS, Registry
+from paddle_tpu.observability import fleet as fleet_mod
+from paddle_tpu.observability import tracing as tracing_mod
+from paddle_tpu.serving import FleetRouter, ServingEngine
+from paddle_tpu.serving import workloads
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _obs_on():
+    """The plane under test assumes metrics + tracing are recording."""
+    pm, pt = obs.enabled(), tracing_mod.enabled()
+    obs.set_enabled(True)
+    tracing_mod.set_enabled(True)
+    yield
+    obs.set_enabled(pm)
+    tracing_mod.set_enabled(pt)
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    cfg = llama_tiny_config(num_hidden_layers=1)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _fleet_kw():
+    return dict(max_slots=2, page_size=4, prefill_chunk=4)
+
+
+def _ttft_snapshot():
+    snap = obs.snapshot()
+    e = snap.get("serving.fleet.ttft_seconds")
+    return e["series"][0] if e and e["series"] else None
+
+
+# ---------------------------------------------------------------------------
+# metric federation (pure unit tests — no model)
+# ---------------------------------------------------------------------------
+
+class TestFederation:
+    def _snap(self, build):
+        r = Registry()
+        build(r)
+        return r.snapshot()
+
+    def test_counters_summed_per_label_key(self):
+        def mk(n):
+            def build(r):
+                c = r.counter("req_total", "h", labels=("path",))
+                c.labels(path="gen").inc(n)
+                c.labels(path="chat").inc(1)
+            return build
+        roll = fleet_mod.federate({"a": self._snap(mk(2)),
+                                   "b": self._snap(mk(5))})
+        vals = obs.sample_values(reg=roll)
+        assert vals['req_total{path="gen"}'] == 7.0
+        assert vals['req_total{path="chat"}'] == 2.0
+
+    def test_gauges_gain_replica_label(self):
+        def mk(v):
+            return lambda r: r.gauge("kv_util", "h").set(v)
+        roll = fleet_mod.federate({"pf0": self._snap(mk(0.25)),
+                                   "dec0": self._snap(mk(0.75))})
+        vals = obs.sample_values(reg=roll)
+        assert vals['kv_util{replica="pf0"}'] == 0.25
+        assert vals['kv_util{replica="dec0"}'] == 0.75
+
+    def test_histograms_gain_replica_label(self):
+        def mk(xs):
+            def build(r):
+                h = r.histogram("lat_seconds", "h", buckets=(0.1, 1.0))
+                for x in xs:
+                    h.observe(x)
+            return build
+        roll = fleet_mod.federate({"a": self._snap(mk([0.05, 0.5])),
+                                   "b": self._snap(mk([5.0]))})
+        snap = roll.snapshot()["lat_seconds"]
+        assert snap["labels"] == ["replica"]
+        by = {s["labels"]["replica"]: s for s in snap["series"]}
+        assert by["a"]["count"] == 2 and by["a"]["counts"] == [1, 1, 0]
+        assert by["b"]["count"] == 1 and by["b"]["counts"] == [0, 0, 1]
+
+    def test_existing_replica_label_value_overridden(self):
+        # a family that already splits by replica keeps its label set;
+        # the value is stamped with the SCRAPING replica's name
+        def build(r):
+            g = r.gauge("pinned", "h", labels=("replica",))
+            g.labels(replica="stale").set(3.0)
+        roll = fleet_mod.federate({"dec1": self._snap(build)})
+        vals = obs.sample_values(reg=roll)
+        assert vals == {'pinned{replica="dec1"}': 3.0}
+
+    def test_rollup_is_a_plain_registry(self):
+        roll = fleet_mod.federate(
+            {"a": self._snap(lambda r: r.counter("c_total").inc(1))})
+        text = obs.to_prometheus(roll)
+        assert "c_total 1" in text
+        assert obs.parse_prometheus(text)["c_total"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# fleet SLO histograms + phase attribution (no model)
+# ---------------------------------------------------------------------------
+
+class TestFleetSLO:
+    def test_observes_gated_by_metrics_flag(self):
+        before = obs.snapshot()["serving.fleet.ttft_seconds"]
+        obs.set_enabled(False)
+        try:
+            fleet_mod.observe_ttft(0.2)
+            fleet_mod.observe_e2e(1.0)
+            fleet_mod.observe_handoff(0.01)
+        finally:
+            obs.set_enabled(True)
+        after = obs.snapshot()["serving.fleet.ttft_seconds"]
+        assert after["series"][0]["count"] == before["series"][0]["count"]
+
+    def test_summary_covers_the_three_metrics(self):
+        fleet_mod.observe_ttft(0.2)
+        s = fleet_mod.fleet_slo_summary()
+        assert set(s) == set(fleet_mod.FLEET_SLO_METRICS)
+        assert s["serving.fleet.ttft_seconds"]["count"] >= 1
+        for row in s.values():
+            assert {"count", "mean", "p50", "p90", "p99"} <= set(row)
+
+    def test_phase_attribution_from_a_synthetic_timeline(self):
+        rec = tracing_mod.TraceRecorder(capacity=4)
+        rid = "phase-demo"
+        # a drained-mid-decode shape: the handoff window falls between
+        # tokens, so decode excludes it
+        grid = (("enqueue", 0), ("admit", 1), ("handoff_ready", 2),
+                ("token", 3), ("handoff_export", 4),
+                ("handoff_import", 6), ("token", 9))
+        rec.begin(rid)
+        for name, _ in grid:
+            rec.stamp(rid, name)
+        tr = rec.live()[0]
+        t0 = tr.timeline()[0].t_us
+        for e, (_, ms) in zip(tr.timeline(), grid):
+            e.t_us = t0 + ms * 1000
+        out = fleet_mod.phase_attribution(tr)
+        assert out == pytest.approx({"router_queue": 1e-3,
+                                     "prefill": 1e-3,   # admit -> ready
+                                     "handoff": 2e-3,
+                                     "decode": 4e-3})   # 6ms minus handoff
+
+    def test_phase_attribution_handles_missing_events(self):
+        assert fleet_mod.phase_attribution(None) == {}
+        rec = tracing_mod.TraceRecorder(capacity=4)
+        rec.begin("lonely")
+        rec.stamp("lonely", "enqueue")
+        out = fleet_mod.phase_attribution(rec.live()[0])
+        assert out == {}  # no admit, no token, no handoff yet
+
+
+# ---------------------------------------------------------------------------
+# cross-replica stitching + router SLO acceptance (two-replica fleet)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def two_replica_run(model):
+    """A seeded prefill+decode fleet run: every request pays exactly one
+    handoff. Returns (router, results, ttft_series_before/after)."""
+    obs.set_enabled(True)
+    tracing_mod.set_enabled(True)
+    tracing_mod.recorder().clear()
+    before = _ttft_snapshot()
+    pf = ServingEngine(model, role="prefill", replica="pf0", **_fleet_kw())
+    dec = ServingEngine(model, role="decode", replica="dec0", **_fleet_kw())
+    router = FleetRouter({"pf0": pf, "dec0": dec})
+    rng = np.random.RandomState(7)
+    V = model.config.vocab_size
+    for i in range(4):
+        router.submit(rng.randint(1, V, rng.randint(5, 9)).astype(np.int32),
+                      int(rng.randint(3, 6)), request_id=f"fleet-{i}")
+    results = router.run_to_completion()
+    return router, results, before, _ttft_snapshot()
+
+
+class TestStitching:
+    def test_one_merged_trace_single_flow_across_lanes(
+            self, two_replica_run, tmp_path):
+        """Acceptance: ONE chrome trace, one process lane per replica, a
+        handed-off request drawn as a single flow crossing both lanes."""
+        router, results, _, _ = two_replica_run
+        assert len(results) == 4 and router.handoff_count >= 4
+        path = str(tmp_path / "fleet.json")
+        n = fleet_mod.stitch_chrome_trace(path)
+        data = json.load(open(path))
+        events = data["traceEvents"]
+        assert n == len(events)
+        lanes = {e["args"]["name"]: e["pid"] for e in events
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert {"replica:pf0", "replica:dec0", "fleet"} <= set(lanes)
+        assert lanes["fleet"] == 0
+        # flow arrows: every s has a matching f, at least one crosses pids
+        flows = {}
+        for e in events:
+            if e.get("name") == "kv_handoff":
+                flows.setdefault(e["id"], {})[e["ph"]] = e
+        assert flows
+        for pair in flows.values():
+            assert set(pair) == {"s", "f"}
+        crossing = [p for p in flows.values()
+                    if p["s"]["pid"] != p["f"]["pid"]]
+        assert crossing, "no handoff flow crossed replica lanes"
+        # the handed-off request is ONE timeline: same tid on both lanes
+        p = crossing[0]
+        assert p["s"]["tid"] == p["f"]["tid"]
+        assert p["s"]["pid"] == lanes["replica:pf0"]
+        assert p["f"]["pid"] == lanes["replica:dec0"]
+        # and its lifetime spans exist in both lanes under that tid
+        spans = [e for e in events if e["ph"] == "X"
+                 and e["tid"] == p["s"]["tid"]]
+        assert {e["pid"] for e in spans} == {lanes["replica:pf0"],
+                                             lanes["replica:dec0"]}
+
+    def test_router_ttft_within_one_bucket_of_traces(self, two_replica_run):
+        """Acceptance: the router-measured serving.fleet.ttft_seconds
+        distribution matches per-request trace-derived TTFTs to within
+        one histogram bucket."""
+        _, results, before, after = two_replica_run
+        counts = list(after["counts"])
+        total = after["count"]
+        if before is not None:
+            counts = [a - b for a, b in zip(counts, before["counts"])]
+            total -= before["count"]
+        fins = {t.request_id: t for t in tracing_mod.recorder().finished()}
+        ttfts = [fins[rid].ttft_s() for rid in results if rid in fins]
+        ttfts = [t for t in ttfts if t is not None]
+        assert len(ttfts) == len(results) == total
+        for t in ttfts:   # greedy match, each ttft consumes one delta
+            i = bisect.bisect_left(DEFAULT_BUCKETS, t)
+            for j in (i, i - 1, i + 1):
+                if 0 <= j < len(counts) and counts[j] > 0:
+                    counts[j] -= 1
+                    break
+            else:
+                raise AssertionError(
+                    f"trace ttft {t:.4f}s has no router observation "
+                    f"within one bucket (remaining deltas {counts})")
+
+    def test_router_scrape_federates_replica_truth(self, two_replica_run):
+        router, _, _, _ = two_replica_run
+        rollup = router.scrape()
+        vals = obs.sample_values(reg=rollup)
+        assert vals['serving.replica.info{replica="pf0",role="prefill"}'] \
+            == 1.0
+        assert vals['serving.replica.info{replica="dec0",role="decode"}'] \
+            == 1.0
+        # engine-local handoff truth, counters summed to the fleet total
+        assert vals['serving.replica.handoffs{direction="export"}'] >= 4
+        assert vals['serving.replica.handoffs{direction="import"}'] >= 4
+        # the fleet SLO histograms ride along in the rollup
+        s = fleet_mod.fleet_slo_summary(reg=rollup)
+        assert s["serving.fleet.ttft_seconds"]["count"] >= 4
+        assert s["serving.fleet.handoff_latency_seconds"]["count"] >= 4
+        assert router.slo_summary()["serving.fleet.e2e_seconds"]["count"] \
+            >= 4
+
+    def test_phase_attribution_reconstructs_handoff_path(
+            self, two_replica_run):
+        _, results, _, _ = two_replica_run
+        fins = {t.request_id: t for t in tracing_mod.recorder().finished()}
+        tr = fins[next(iter(results))]
+        out = fleet_mod.phase_attribution(tr)
+        assert set(out) == set(fleet_mod.FLEET_PHASES)
+        assert all(v >= 0.0 for v in out.values())
+        assert out["handoff"] > 0.0
+        # phases tile the e2e up to a small overlap: the prefill replica
+        # emits the first token just before it stamps handoff_ready
+        assert sum(out.values()) <= tr.e2e_s() + 0.01
+
+
+# ---------------------------------------------------------------------------
+# the single-timeline contract under chaos (satellite of ISSUE 16)
+# ---------------------------------------------------------------------------
+
+class TestChaosTimeline:
+    def test_drain_midstream_keeps_one_ordered_timeline(self, model):
+        """PR-15 contract under chaos: a request routed -> prefilled ->
+        handed off -> whose decode replica is then drained mid-stream ->
+        re-imported -> resumed keeps ONE trace whose events stay
+        monotonically ordered, with both handoffs paired and the lanes
+        changing across the second hop."""
+        tracing_mod.recorder().clear()
+        row = workloads.run_scenario("replica_kill", model)
+        assert row["zero_loss"] == 1
+        assert row["completed"] == row["requests"]
+        assert row["handoffs"] > row["requests"]  # the drain re-export
+        fins = [t for t in tracing_mod.recorder().finished()
+                if str(t.request_id).startswith("kill")]
+        assert len({t.request_id for t in fins}) == len(fins) \
+            == row["requests"]
+        moved = [t for t in fins
+                 if sum(e.name == "handoff_export"
+                        for e in t.timeline()) >= 2]
+        assert moved, "drain did not re-export an in-flight decode"
+        for tr in moved:
+            evs = tr.timeline()
+            names = [e.name for e in evs]
+            ts = [e.t_us for e in evs]
+            assert ts == sorted(ts)
+            exp = [i for i, n in enumerate(names) if n == "handoff_export"]
+            imp = [i for i, n in enumerate(names) if n == "handoff_import"]
+            assert len(exp) == len(imp) >= 2
+            assert names.index("routed") < names.index("admit") < exp[0]
+            for a, b in zip(exp, imp):
+                assert a < b              # every export pairs an import
+            assert any(i > imp[-1] for i, n in enumerate(names)
+                       if n == "resumed"), \
+                "request never resumed after the drain re-import"
+            assert names[-1] in ("finish", "token")
+            # the second hop changes replicas: export stamped on the
+            # drained source, import on the survivor
+            src = (evs[exp[-1]].meta or {}).get("replica")
+            dst = (evs[imp[-1]].meta or {}).get("replica")
+            assert src and dst and src != dst
+            assert tr.outcome == "finish"
+
+
+# ---------------------------------------------------------------------------
+# the hostile-traffic workload harness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def all_rows(model):
+    obs.set_enabled(True)
+    tracing_mod.set_enabled(True)
+    return workloads.run_all(model, seed=0)
+
+
+class TestWorkloads:
+    def test_all_scenarios_complete_with_zero_loss(self, all_rows):
+        assert list(all_rows) == list(workloads.SCENARIOS)
+        for name, row in all_rows.items():
+            assert row["zero_loss"] == 1, name
+            assert row["completed"] == row["requests"] > 0, name
+            assert row["output_checksum"] > 0, name
+            assert row["handoffs"] >= row["requests"], name
+            assert row["ttft_p50_ms"] is not None, name
+            assert row["e2e_p90_ms"] is not None, name
+
+    def test_shared_prefix_scenarios_skip_prefill(self, all_rows):
+        # agentic chains rebuild the whole conversation each turn — the
+        # radix trie must be turning that into prefill skips
+        assert all_rows["agentic"]["prefill_skip_rate"] > 0.2
+        # the good tenant survives the adversary's cache thrash
+        assert all_rows["thrash"]["prefill_skip_rate"] > 0.0
+
+    def test_deterministic_fields_replay_bit_exactly(self, model, all_rows):
+        again = workloads.run_scenario("burst", model, seed=0)
+        for f in workloads.ROW_DETERMINISTIC:
+            assert again[f] == all_rows["burst"][f], f
+
+    def test_rows_match_committed_artifact(self, all_rows):
+        """The replay gate fleetboard --selftest runs, as a tier-1 test:
+        this machine + seed 0 must reproduce docs/FLEET_BENCH.json on
+        every deterministic field."""
+        with open(os.path.join(REPO, "docs", "FLEET_BENCH.json")) as f:
+            art = json.load(f)
+        assert art["seed"] == 0
+        for name, row in all_rows.items():
+            ref = art["scenarios"][name]
+            for field in workloads.ROW_DETERMINISTIC:
+                assert row[field] == ref[field], f"{name}.{field}"
+
+    def test_rows_clear_perf_gate_bands(self, all_rows):
+        import perf_gate
+        bands = perf_gate.fleet_rows(REPO)
+        assert bands
+        cand = {f"fleet.{n}.{f}": float(r[f]) for n, r in all_rows.items()
+                for f in workloads.ROW_DETERMINISTIC}
+        judged = perf_gate.check_candidate(cand, bands)
+        bad = [r for r in judged if not r["ok"]]
+        assert not bad, bad
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError):
+            workloads.make_plan("nope")
+
+
+# ---------------------------------------------------------------------------
+# perf_gate fleet bands + the --check skip summary
+# ---------------------------------------------------------------------------
+
+class TestPerfGateFleet:
+    def test_deterministic_fields_pin_exact_bands(self):
+        import perf_gate
+        rows = {r["key"]: r for r in perf_gate.fleet_rows(REPO)}
+        for name in workloads.SCENARIOS:
+            r = rows[f"fleet.{name}.output_checksum"]
+            assert r["band"][0] == r["band"][1] == r["value"]
+            assert r["direction"] == "both"
+            lat = rows.get(f"fleet.{name}.handoff_latency_ms")
+            if lat is not None:
+                assert lat["direction"] == "lower"
+
+    def test_check_reports_per_artifact_skip_summary(self, capsys):
+        import perf_gate
+        assert perf_gate.main(["--repo", REPO]) == 0
+        out = capsys.readouterr().out
+        assert "docs/FLEET_BENCH.json" in out
+        assert "rows checked" in out
+        assert "predates_megadecode" in out   # skipped, and says why
+
+    def test_json_report_lists_skips(self, capsys):
+        import perf_gate
+        assert perf_gate.main(["--repo", REPO, "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert "skipped" in rep
+        assert all({"source", "key", "why"} <= set(s)
+                   for s in rep["skipped"])
+
+
+# ---------------------------------------------------------------------------
+# metric-doc drift gate (satellite of ISSUE 16)
+# ---------------------------------------------------------------------------
+
+class TestMetricDocDrift:
+    def test_every_live_family_is_documented(self):
+        """Import the WHOLE production package, then walk the live
+        default registry and require every metric family name to appear
+        literally in docs/OBSERVABILITY.md — new instrumentation must
+        land with its documentation. (Importing everything here makes
+        the gate independent of which other tests ran first.)"""
+        import importlib
+        import pkgutil
+        for mod in pkgutil.walk_packages(paddle.__path__,
+                                         prefix="paddle_tpu."):
+            if mod.name.endswith(("__main__", ".launch")):
+                continue    # CLI entry points parse argv at import
+            try:
+                importlib.import_module(mod.name)
+            except ImportError:
+                pass        # optional native extensions
+        prefixes = ("pt_", "serving.", "watchdog.", "resilience.")
+        names = [n for n in obs.snapshot()
+                 if n.startswith(prefixes)]
+        assert len(names) >= 80   # the plane is actually instrumented
+        with open(os.path.join(REPO, "docs", "OBSERVABILITY.md"),
+                  encoding="utf-8") as f:
+            text = f.read()
+        missing = sorted(n for n in names if n not in text)
+        assert not missing, (
+            f"{len(missing)} metric families missing from "
+            f"docs/OBSERVABILITY.md: {missing}")
+
+
+# ---------------------------------------------------------------------------
+# fleetboard units (the selftest itself is verify-recipe wiring)
+# ---------------------------------------------------------------------------
+
+class TestFleetboard:
+    def test_render_table(self):
+        import fleetboard
+        rows = {"burst": {"scenario": "burst", "requests": 12,
+                          "completed": 12, "zero_loss": 1, "handoffs": 12,
+                          "fleet_tokens_per_s": 123.456,
+                          "ttft_p50_ms": 10.0, "ttft_p90_ms": 20.0,
+                          "e2e_p90_ms": 99.0, "handoff_latency_ms": 1.5,
+                          "prefill_skip_rate": 0.25}}
+        table = fleetboard.render_table(rows)
+        lines = table.splitlines()
+        assert len(lines) == 2
+        assert "scenario" in lines[0] and "tok/s" in lines[0]
+        assert "burst" in lines[1] and "123.46" in lines[1]
+
+    def test_federate_files(self, tmp_path):
+        import fleetboard
+        r = Registry()
+        r.gauge("kv_util", "h").set(0.5)
+        for name in ("pf0", "dec0"):
+            with open(tmp_path / f"{name}.json", "w") as f:
+                json.dump(r.snapshot(), f)
+        text = fleetboard.federate_files(
+            [str(tmp_path / "pf0.json"), str(tmp_path / "dec0.json")])
+        vals = obs.parse_prometheus(text)
+        assert vals['kv_util{replica="pf0"}'] == 0.5
+        assert vals['kv_util{replica="dec0"}'] == 0.5

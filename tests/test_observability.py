@@ -413,6 +413,55 @@ class TestOverhead:
             f"disabled counter-track loop {min(ti):.4f}s vs plain "
             f"{min(tp):.4f}s (+{(min(ti) / min(tp) - 1) * 100:.1f}%)")
 
+    def test_disabled_fleet_paths_under_5pct(self):
+        # ISSUE 16: the fleet plane's hot-path hooks — the router's SLO
+        # observes and the per-stamp replica-context/handoff-context
+        # machinery — must also vanish under the off flags
+        from paddle_tpu.observability import fleet as fleet_mod
+        from paddle_tpu.observability import tracing as tr
+        rec = tr.TraceRecorder(capacity=8)
+        # four gated calls ride each iteration (vs three in the tests
+        # above), so give them a bigger work unit to hide under
+        a = np.random.RandomState(0).randn(256, 256).astype(np.float32)
+        n = 300
+
+        def plain():
+            t0 = time.perf_counter()
+            for _ in range(n):
+                a.dot(a)
+            return time.perf_counter() - t0
+
+        def instrumented():
+            t0 = time.perf_counter()
+            for i in range(n):
+                a.dot(a)
+                fleet_mod.observe_ttft(0.1)
+                fleet_mod.observe_handoff(0.01)
+                rec.set_replica_context("pf0")
+                rec.adopt(i, rec.export_context(i))
+            return time.perf_counter() - t0
+
+        before = obs.snapshot()["serving.fleet.ttft_seconds"]
+        obs.set_enabled(False)
+        tr.set_enabled(False)
+        try:
+            plain()
+            instrumented()
+            tp, ti = [], []
+            for _ in range(7):
+                tp.append(plain())
+                ti.append(instrumented())
+        finally:
+            obs.set_enabled(True)
+            tr.set_enabled(True)
+        after = obs.snapshot()["serving.fleet.ttft_seconds"]
+        assert after["series"][0]["count"] \
+            == before["series"][0]["count"]  # observes really gated
+        assert not rec.live() and not rec.finished()
+        assert min(ti) < min(tp) * 1.05, (
+            f"disabled fleet-path loop {min(ti):.4f}s vs plain "
+            f"{min(tp):.4f}s (+{(min(ti) / min(tp) - 1) * 100:.1f}%)")
+
 
 class TestReplicaPrefixMetrics:
     """ISSUE 15 satellite: the fleet router's locality signal is visible

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Fleetboard — watch a serving fleet as ONE system (ISSUE 16).
+
+Three things an operator (or CI) does with a fleet, in one tool:
+
+  - ``--selftest``   run the five seeded hostile-traffic scenarios
+                     (`paddle_tpu.serving.workloads`) against a tiny
+                     fleet, render the scenario table, export ONE
+                     stitched chrome trace covering every replica lane,
+                     and hold the run to the committed
+                     ``docs/FLEET_BENCH.json``: deterministic replay
+                     fields must match bit-exactly and the row must
+                     clear `tools/perf_gate.py` bands. Writes the
+                     artifact when missing (or with ``--write``); CI
+                     wires this next to paddlelint/perf_gate in the
+                     verify recipe.
+  - ``--federate``   offline metric federation: given per-replica
+                     registry snapshot JSONs (``{replica: snapshot}``
+                     mappings, or one snapshot per file named by its
+                     stem), print the fleet rollup in Prometheus text
+                     exposition — counters summed, gauges/histograms
+                     re-labeled ``replica=...``.
+  - ``--trace OUT``  with ``--selftest``: where to write the stitched
+                     chrome trace (default ``/tmp/fleet_trace.json``;
+                     open in Perfetto — one process lane per replica,
+                     handoffs drawn as flow arrows).
+
+Exit status: 0 = selftest replayed and gated clean, 1 = replay drift or
+band failure. Tier-1 runs this on CPU with tiny models in ~30 s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ARTIFACT = os.path.join(REPO, "docs", "FLEET_BENCH.json")
+
+_COLUMNS = (("scenario", "%-12s"), ("requests", "%8s"),
+            ("completed", "%9s"), ("zero_loss", "%9s"),
+            ("handoffs", "%8s"), ("fleet_tokens_per_s", "%9s"),
+            ("ttft_p50_ms", "%11s"), ("ttft_p90_ms", "%11s"),
+            ("e2e_p90_ms", "%10s"), ("handoff_latency_ms", "%10s"),
+            ("prefill_skip_rate", "%9s"))
+_HEADERS = ("scenario", "requests", "completed", "zero_loss", "handoffs",
+            "tok/s", "ttft p50ms", "ttft p90ms", "e2e p90ms",
+            "handoff ms", "skip rate")
+
+
+def render_table(rows: Dict[str, Dict[str, Any]]) -> str:
+    """The scenario table, one line per scenario in canonical order."""
+    lines = [" ".join(fmt % h for (_, fmt), h
+                      in zip(_COLUMNS, _HEADERS))]
+    for name in rows:
+        row = rows[name]
+        cells = []
+        for (key, fmt) in _COLUMNS:
+            v = row.get(key)
+            if isinstance(v, float):
+                v = f"{v:.2f}"
+            cells.append(fmt % (v if v is not None else "-"))
+        lines.append(" ".join(cells))
+    return "\n".join(lines)
+
+
+def _build_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    cfg = llama_tiny_config(num_hidden_layers=1)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def selftest(seed: int = 0, write: bool = False,
+             trace_path: str = "/tmp/fleet_trace.json") -> int:
+    import jax
+
+    from paddle_tpu.observability import fleet as _fleet
+    from paddle_tpu.serving import workloads
+
+    model = _build_model()
+    rows = workloads.run_all(model, seed=seed)
+    print(render_table(rows))
+    n_events = _fleet.stitch_chrome_trace(trace_path)
+    print(f"fleetboard: stitched trace -> {trace_path} "
+          f"({n_events} events)")
+
+    art = {"device": jax.devices()[0].device_kind, "seed": seed,
+           "note": "seeded hostile-traffic scenario suite "
+                   "(tools/fleetboard.py --selftest); deterministic "
+                   "fields replay bit-exactly from the seed, timing "
+                   "fields are machine-dependent",
+           "scenarios": rows}
+    failures: List[str] = []
+    committed = None
+    if os.path.exists(ARTIFACT) and not write:
+        with open(ARTIFACT, encoding="utf-8") as f:
+            committed = json.load(f)
+        if committed.get("seed") != seed:
+            committed = None      # different seed: nothing to replay
+    if committed is None:
+        os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
+        with open(ARTIFACT, "w", encoding="utf-8") as f:
+            json.dump(art, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"fleetboard: wrote {os.path.relpath(ARTIFACT, REPO)}")
+    else:
+        # the replayability gate: this machine, this seed, same story
+        want = committed.get("scenarios") or {}
+        for name, row in rows.items():
+            ref = want.get(name)
+            if ref is None:
+                failures.append(f"{name}: not in committed artifact "
+                                f"(rerun with --write)")
+                continue
+            for field in workloads.ROW_DETERMINISTIC:
+                if row.get(field) != ref.get(field):
+                    failures.append(
+                        f"{name}.{field}: replayed {row.get(field)!r} "
+                        f"vs committed {ref.get(field)!r}")
+        if not failures:
+            print(f"fleetboard: replay matches "
+                  f"{os.path.relpath(ARTIFACT, REPO)} on all "
+                  f"deterministic fields")
+    # band check through the same gate CI runs
+    from perf_gate import check_candidate, fleet_rows
+    bands = fleet_rows(REPO)
+    cand = {f"fleet.{name}.{field}": float(row[field])
+            for name, row in rows.items()
+            for field in workloads.ROW_DETERMINISTIC
+            if isinstance(row.get(field), (int, float))}
+    judged = check_candidate(cand, bands) if bands else []
+    for r in judged:
+        if not r["ok"]:
+            failures.append(f"perf_gate: {r['key']} "
+                            f"{r.get('why', 'failed')}")
+    if judged:
+        print(f"fleetboard: perf_gate accepted "
+              f"{sum(r['ok'] for r in judged)}/{len(judged)} "
+              f"deterministic rows")
+    if failures:
+        for f_ in failures:
+            print(f"fleetboard: FAIL {f_}", file=sys.stderr)
+        return 1
+    print("fleetboard: selftest ok")
+    return 0
+
+
+def federate_files(paths: List[str]) -> str:
+    """Offline federation: merge snapshot JSONs into the fleet rollup
+    and return Prometheus text exposition."""
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import fleet as _fleet
+    snaps: Dict[str, Dict[str, Any]] = {}
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            d = json.load(f)
+        if d and all(isinstance(v, dict) and "kind" in v
+                     for v in d.values()):
+            # one registry snapshot: replica named by the file stem
+            snaps[os.path.splitext(os.path.basename(path))[0]] = d
+        else:
+            snaps.update(d)
+    return obs.to_prometheus(_fleet.federate(snaps))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the seeded scenario suite against the "
+                         "committed docs/FLEET_BENCH.json")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate docs/FLEET_BENCH.json from this "
+                         "run instead of replay-checking against it")
+    ap.add_argument("--trace", default="/tmp/fleet_trace.json",
+                    help="stitched chrome-trace output path "
+                         "(with --selftest)")
+    ap.add_argument("--federate", nargs="+", metavar="SNAP.json",
+                    help="merge per-replica snapshot JSONs and print "
+                         "the Prometheus rollup")
+    args = ap.parse_args(argv)
+    if args.federate:
+        print(federate_files(args.federate), end="")
+        return 0
+    if args.selftest:
+        return selftest(seed=args.seed, write=args.write,
+                        trace_path=args.trace)
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

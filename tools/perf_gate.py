@@ -68,12 +68,24 @@ SERVING_FIELDS = ("decode_tokens_per_s_per_chip", "prefill_tokens_per_s",
                   "spec_tokens_per_s", "accepted_tokens_per_verify_step",
                   "mega_tokens_per_s", "split_tokens_per_s",
                   "disagg_tokens_per_s", "colocated_tokens_per_s",
-                  "prefill_skip_rate")
+                  "prefill_skip_rate", "fleet_tokens_per_s")
 
 # ISSUE 14 launch-accounting pins on the megadecode A/B row: exact and
 # two-sided — more launches means the fusion regressed, fewer means the
 # ledger itself broke. Each holds a {mode: count} dict in the artifact.
 SERVING_LAUNCH_FIELDS = ("launches_per_layer", "back_half_launches")
+
+# docs/FLEET_BENCH.json scenario rows (ISSUE 16 hostile-traffic
+# harness). The scenarios replay bit-exactly from their seed, so the
+# deterministic fields are pinned two-sided at exactly the committed
+# value — any drift means the replay contract broke. Timing fields are
+# machine-dependent: throughputs band like serving rows, latencies gate
+# one-sided (slower than band top = regression; faster is a rerate).
+FLEET_DETERMINISTIC_FIELDS = ("requests", "completed", "zero_loss",
+                              "output_checksum", "handoffs")
+FLEET_HIGHER_FIELDS = ("fleet_tokens_per_s", "prefill_skip_rate")
+FLEET_LOWER_FIELDS = ("ttft_p50_ms", "ttft_p90_ms", "e2e_p50_ms",
+                      "e2e_p90_ms", "handoff_latency_ms")
 
 # OBSERVATORY.json per-kernel fields gated per row (ISSUE 11). These are
 # two-sided: bytes or launches GROWING past the band means new HBM
@@ -143,11 +155,14 @@ def pretrain_rows(repo: str = REPO, margin: float = 0.01
              "ok": latest >= band_lo}]
 
 
-def serving_rows(repo: str = REPO, noise: float = 0.15
+def serving_rows(repo: str = REPO, noise: float = 0.15,
+                 skips: Optional[List[Dict[str, str]]] = None
                  ) -> List[Dict[str, Any]]:
     """One gate row per (SERVING_BENCH row, throughput field): committed
     value ± noise. Self-check is trivially green; the bands exist for
-    --check candidates."""
+    --check candidates. Rows excluded from gating are recorded on
+    `skips` (when given) so the CLI can report them instead of
+    dropping them silently."""
     path = os.path.join(repo, "docs", "SERVING_BENCH.json")
     bench = _load(path)
     if not bench:
@@ -162,6 +177,10 @@ def serving_rows(repo: str = REPO, noise: float = 0.15
             # exists, so banding fresh candidates against them would
             # misfire both ways — kept in the artifact for history, not
             # gated (remeasure on a chip to clear the flag)
+            if skips is not None:
+                skips.append({"source": "docs/SERVING_BENCH.json",
+                              "key": f"serving.{name}",
+                              "why": "predates_megadecode"})
             continue
         for field in SERVING_FIELDS:
             v = row.get(field)
@@ -185,6 +204,56 @@ def serving_rows(repo: str = REPO, noise: float = 0.15
                             "band": [v, v],
                             "source": "docs/SERVING_BENCH.json",
                             "ok": True})
+    return out
+
+
+def fleet_rows(repo: str = REPO, noise: float = 0.15,
+               skips: Optional[List[Dict[str, str]]] = None
+               ) -> List[Dict[str, Any]]:
+    """One gate row per (FLEET_BENCH scenario, field) — the ISSUE 16
+    hostile-traffic harness artifact written by `tools/fleetboard.py
+    --selftest`. Deterministic replay fields pin exactly; throughputs
+    band ± noise; latency percentiles gate one-sided against the band
+    top."""
+    path = os.path.join(repo, "docs", "FLEET_BENCH.json")
+    art = _load(path)
+    if not art:
+        return []
+    src = "docs/FLEET_BENCH.json"
+    out = []
+    for name, row in sorted((art.get("scenarios") or {}).items()):
+        if not isinstance(row, dict):
+            continue
+        if row.get("skip_gate"):
+            if skips is not None:
+                skips.append({"source": src, "key": f"fleet.{name}",
+                              "why": str(row["skip_gate"])})
+            continue
+        for field in FLEET_DETERMINISTIC_FIELDS:
+            v = row.get(field)
+            if not isinstance(v, (int, float)):
+                continue
+            v = float(v)
+            out.append({"key": f"fleet.{name}.{field}", "value": v,
+                        "direction": "both", "band": [v, v],
+                        "source": src, "ok": True})
+        for field in FLEET_HIGHER_FIELDS:
+            v = row.get(field)
+            if not isinstance(v, (int, float)) or v <= 0:
+                continue
+            v = float(v)
+            out.append({"key": f"fleet.{name}.{field}", "value": v,
+                        "band": [v * (1.0 - noise), v * (1.0 + noise)],
+                        "source": src, "ok": True})
+        for field in FLEET_LOWER_FIELDS:
+            v = row.get(field)
+            if not isinstance(v, (int, float)) or v <= 0:
+                continue
+            v = float(v)
+            out.append({"key": f"fleet.{name}.{field}", "value": v,
+                        "direction": "lower",
+                        "band": [v * (1.0 - noise), v * (1.0 + noise)],
+                        "source": src, "ok": True})
     return out
 
 
@@ -239,8 +308,12 @@ def observatory_rows(repo: str = REPO, noise: float = 0.15
 
 
 def gate_rows(repo: str = REPO, margin: float = 0.01,
-              noise: float = 0.15) -> List[Dict[str, Any]]:
-    return (pretrain_rows(repo, margin) + serving_rows(repo, noise)
+              noise: float = 0.15,
+              skips: Optional[List[Dict[str, str]]] = None
+              ) -> List[Dict[str, Any]]:
+    return (pretrain_rows(repo, margin)
+            + serving_rows(repo, noise, skips=skips)
+            + fleet_rows(repo, noise, skips=skips)
             + observatory_rows(repo, noise))
 
 
@@ -384,7 +457,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
 
-    rows = gate_rows(args.repo, args.margin, args.noise)
+    skips: List[Dict[str, str]] = []
+    rows = gate_rows(args.repo, args.margin, args.noise, skips=skips)
     if not rows:
         print("perf_gate: no bench artifacts found — nothing to gate "
               "(ok)")
@@ -410,7 +484,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 0
     failed = [r for r in rows if not r["ok"]]
     if args.json:
-        print(json.dumps({"rows": rows, "failed": len(failed)}, indent=1))
+        print(json.dumps({"rows": rows, "failed": len(failed),
+                          "skipped": skips}, indent=1))
     else:
         for r in rows:
             band = (f"[{r['band'][0]:.1f}, {r['band'][1]:.1f}]"
@@ -421,6 +496,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             line = f"{mark} {r['key']:<58} {val}  band {band}"
             if r.get("why"):
                 line += f"  ({r['why']})"
+            print(line)
+        # per-artifact accounting, skips included: a stale-band row
+        # dropped from gating must be VISIBLE, not silently green
+        for source in sorted({r["source"] for r in rows}
+                             | {s["source"] for s in skips}):
+            checked = sum(r["source"] == source for r in rows)
+            sk = [s for s in skips if s["source"] == source]
+            line = f"perf_gate: {source}: {checked} rows checked"
+            if sk:
+                reasons = sorted({s["why"] for s in sk})
+                counts = ", ".join(
+                    f"{sum(s['why'] == w for s in sk)} {w}"
+                    for w in reasons)
+                line += f", {len(sk)} skipped ({counts})"
             print(line)
         print(f"perf_gate: {len(rows) - len(failed)}/{len(rows)} rows "
               f"inside band")

@@ -1155,6 +1155,33 @@ def bench_disaggregated(n_tenants=8, sys_len=128, tail_len=16, new=32,
              "fleet). CPU-host numbers are not the record")
 
 
+def bench_fleet_workloads(seed=0, dtype="bfloat16"):
+    """Hostile-traffic scenario suite (ISSUE 16) on the real chip: the
+    five seeded `paddle_tpu.serving.workloads` scenarios — burst,
+    agentic multi-turn, long+short mix, cache-thrash, replica-kill
+    chaos — each driving a fresh multi-replica fleet through the
+    FleetRouter. The per-scenario rows land in the artifact verbatim
+    (the tier-1 replica of this suite lives in docs/FLEET_BENCH.json
+    via tools/fleetboard.py --selftest); the top-level aggregates are
+    the worst case across scenarios, which is what an SLO burns down
+    to."""
+    from paddle_tpu.serving import workloads
+    total = 1024
+    _log(f"fleet_workloads: init model seed={seed}")
+    cfg, model = _llama_bench_raw_model(total, dtype)
+    rows = workloads.run_all(model, seed=seed)
+    zero_loss = int(all(r["zero_loss"] for r in rows.values()))
+    return dict(
+        seed=seed, scenarios=rows,
+        fleet_tokens_per_s=round(min(r["fleet_tokens_per_s"]
+                                     for r in rows.values()), 2),
+        fleet_zero_loss=zero_loss,
+        fleet_handoffs=sum(r["handoffs"] for r in rows.values()),
+        note="worst-scenario fleet throughput; per-scenario detail in "
+             "'scenarios'. replica_kill asserts zero request loss and "
+             "exact greedy outputs through a mid-burst drain")
+
+
 def _paged_sweep_row():
     # the old single-shot paged_attention_op row is gone: it duplicated
     # sweep[0] and its pre-q-scaling-fix "bundled" number contradicted
@@ -1192,6 +1219,7 @@ ROWS = {
     "prefix_cache_multitenant": lambda: bench_prefix_cache_multitenant(),
     "spec_decode_b1": lambda: bench_spec_decode_b1(),
     "disaggregated": lambda: bench_disaggregated(),
+    "fleet_workloads": lambda: bench_fleet_workloads(),
     "_paged": _paged_sweep_row,
 }
 
