@@ -49,6 +49,7 @@ __all__ = [
     "FaultRule", "FaultPlan", "parse_fault_spec", "active_plan", "inject",
     "set_fault_spec", "clear_fault_spec",
     "InjectedFault", "CheckpointCorrupt", "DeadlineExceeded", "Overloaded",
+    "Shed",
     "Deadline", "TimeoutResult", "AdmissionGate",
     "atomic_write", "retry_io", "crc32_bytes", "crc32_file",
     "list_checkpoints", "metrics",
@@ -114,6 +115,18 @@ class DeadlineExceeded(TimeoutError):
 class Overloaded(RuntimeError):
     """Queue admission refused the request (backpressure, not failure):
     retry later or shed load upstream."""
+
+
+class Shed(Overloaded):
+    """The serving controller deliberately dropped this request to
+    protect the SLO of higher-priority traffic (graduated load
+    shedding). Subclasses `Overloaded` so every retry/backpressure
+    handler keeps working, but carries its own trace outcome (`shed`)
+    and the measurement that triggered the shed decision."""
+
+    def __init__(self, msg: str, measurement: Optional[dict] = None):
+        super().__init__(msg)
+        self.measurement = dict(measurement or {})
 
 
 @dataclasses.dataclass

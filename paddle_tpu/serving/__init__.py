@@ -24,8 +24,14 @@ Modules over the Pallas paged-decode kernel
     KV-page transfer between a prefill replica and a decode replica
     (bit-identical resume, no re-prefill);
   - `router`: `FleetRouter` spreading requests over N replicas by
-    radix-trie prefix overlap vs queue depth, brokering handoffs, and
-    draining/re-admitting replicas on `CollectiveTimeout` faults.
+    radix-trie prefix overlap vs queue depth (scaled by per-replica
+    placement weights), brokering handoffs, and draining/re-admitting
+    replicas on `CollectiveTimeout` faults;
+  - `controller`: the SLO autopilot — `SLOTargets` plus the
+    `EngineController` / `FleetController` feedback loops that actuate
+    chunk size, spec-decode k, prefix-cache admission, graduated load
+    shedding, placement weights and replica roles against declared
+    targets (see docs/SERVING.md "Autopilot").
 
 See docs/SERVING.md ("Continuous batching", "Disaggregated serving")
 for sizing and usage.
@@ -36,6 +42,7 @@ from typing import Any, Dict
 from .. import observability as _obs
 from ..observability import tracing as _tracing
 from .block_allocator import PageBlockAllocator
+from .controller import EngineController, FleetController, SLOTargets
 from .engine import ServingEngine
 from .handoff import KVPageHandoff
 from .prefix_cache import PrefixCache
@@ -43,8 +50,8 @@ from .router import FleetRouter
 from .scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "Request", "Scheduler", "PageBlockAllocator",
-           "PrefixCache", "KVPageHandoff", "FleetRouter", "metrics",
-           "slo"]
+           "PrefixCache", "KVPageHandoff", "FleetRouter", "SLOTargets",
+           "EngineController", "FleetController", "metrics", "slo"]
 
 
 def metrics() -> Dict[str, Any]:

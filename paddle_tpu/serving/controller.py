@@ -1,0 +1,464 @@
+"""Fleet SLO autopilot: feedback control of serving levers (ISSUE 18).
+
+Every lever the serving stack grew — priority classes, tenant budgets,
+preemption, chunked-prefill size, spec-decode k, prefix-cache admission,
+replica roles / placement weights / drain — is statically configured,
+so hostile traffic (a burst, a cache-thrash tenant, a replica kill)
+degrades latency until a human retunes. This module closes the loop at
+two scopes:
+
+  - `EngineController` — stepped from `ServingEngine.step()`. Reads the
+    engine's live, DETERMINISTIC signals (queue depth, pool
+    utilization, spec-decode draft/accept totals) against declared
+    `SLOTargets` and actuates: chunked-prefill size up/down (jit
+    program rebuild via `ServingEngine.reconfigure`), spec-decode k
+    down to off when acceptance collapses, prefix-cache insert
+    admission off under pool pressure, and graduated load shedding
+    (tighten the admission queue timeout, then refuse the lowest
+    priority class at the door with `resilience.Shed`). Hysteresis is
+    structural: escalation needs `patience` consecutive pressured
+    steps, release needs `2 * patience` calm ones, and every actuator
+    has a per-actuator cooldown — so a steady load cannot oscillate an
+    actuator (the convergence tests bound flip counts).
+
+  - `FleetController` — sits above `FleetRouter`. Rebalances placement
+    weights from the per-replica queue/utilization view (the same
+    numbers `ServingEngine.scrape()` federates), shifts prefill↔decode
+    role capacity when the token ratio drifts (pages-intact role flips
+    through the PR-15 drain/readmit path), and treats a
+    `CollectiveTimeout` drain as a capacity-loss event: survivors'
+    engine controllers are pre-emptively put under guard pressure
+    instead of waiting for their queues to blow out.
+
+Wall-clock SLO fields on `SLOTargets` (ttft_p90_ms, e2e_p90_ms) are
+declarative/reporting — actuation keys ONLY off step-indexed and
+count-based signals, so a seeded scenario replays bit-exactly with the
+controller on (the docs/FLEET_BENCH.json autopilot rows depend on it).
+
+Every decision emits a `serving.controller.*` /
+`serving.fleet.controller.*` metric and a one-event `kind="controller"`
+trace carrying the triggering measurement, so "why did the autopilot do
+that" is answerable from the trace ring. See docs/SERVING.md
+("Autopilot") for targets, actuators, and the override runbook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .. import observability as _obs
+from ..observability import tracing as _tracing
+
+__all__ = ["SLOTargets", "EngineController", "FleetController"]
+
+_TRACE = _tracing.recorder()
+
+# ------------------------------------------------------------------ metrics
+_DECISIONS = _obs.registry().counter(
+    "serving.controller.decisions",
+    "autopilot actuations by replica, actuator and direction",
+    labels=("replica", "actuator", "direction"))
+_G_CHUNK = _obs.registry().gauge(
+    "serving.controller.prefill_chunk",
+    "current controller-actuated prefill chunk", labels=("replica",))
+_G_SPECK = _obs.registry().gauge(
+    "serving.controller.spec_k",
+    "current controller-actuated speculative-decode k",
+    labels=("replica",))
+_G_SHED = _obs.registry().gauge(
+    "serving.controller.shed_level",
+    "graduated shed level (0 none, 1 tightened timeout, 2 refusing "
+    "lowest class)", labels=("replica",))
+_G_PRESSURE = _obs.registry().gauge(
+    "serving.controller.pressure",
+    "1 while the queue-depth signal exceeds its SLO target",
+    labels=("replica",))
+_F_DECISIONS = _obs.registry().counter(
+    "serving.fleet.controller.decisions",
+    "fleet-scope autopilot actions", labels=("action",))
+_F_WEIGHT = _obs.registry().gauge(
+    "serving.fleet.controller.placement_weight",
+    "router placement weight per replica [0, 1]", labels=("replica",))
+_F_ROLE_FLIPS = _obs.registry().counter(
+    "serving.fleet.controller.role_flips",
+    "prefill<->decode role capacity shifts")
+_F_GUARD = _obs.registry().gauge(
+    "serving.fleet.controller.capacity_guard",
+    "steps of pre-emptive admission tightening left after a drain")
+
+
+@dataclasses.dataclass
+class SLOTargets:
+    """What "holding the SLO" means for a workload.
+
+    The *_ms fields are the declared wall-clock targets (reporting /
+    dashboards; machine-dependent). The *_steps fields are the same
+    targets in router-step units — deterministic on a seeded replay,
+    which is what CI asserts. The remaining fields parameterize the
+    controller's deterministic sensors."""
+
+    # declarative wall-clock targets (recorded in bench rows)
+    ttft_p90_ms: Optional[float] = None
+    e2e_p90_ms: Optional[float] = None
+    # step-indexed targets: deterministic equivalents for seeded CI
+    ttft_p90_steps: Optional[int] = None
+    e2e_p90_steps: Optional[int] = None
+    # deterministic sensor thresholds
+    queue_depth: int = 4          # waiting requests before "pressure"
+    pool_high: float = 0.85       # gate prefix-cache inserts above...
+    pool_low: float = 0.60        # ...and re-admit them below (hysteresis)
+    spec_accept: float = 0.35     # acceptance floor before k is cut
+    # requests with priority < shed_priority are refused (`Shed`) at
+    # shed level 2; None disables the shedding actuator entirely
+    shed_priority: Optional[int] = 0
+
+    def as_row(self) -> Dict[str, Any]:
+        """JSON-ready dict for bench artifacts (stable key order)."""
+        return {k: v for k, v in sorted(
+            dataclasses.asdict(self).items()) if v is not None}
+
+
+class EngineController:
+    """Per-engine feedback loop, stepped once per `ServingEngine.step()`.
+
+    Escalation needs `patience` consecutive pressured steps; release
+    needs `2 * patience` consecutive calm ones; each actuator then
+    waits `cooldown` steps before it may move again. `flips` counts
+    actuations per actuator — the oscillation bound the convergence
+    tests assert."""
+
+    #: actuator names (the `flips` keys and decision-metric labels)
+    ACTUATORS = ("prefill_chunk", "spec_k", "prefix_admit", "shed")
+
+    def __init__(self, engine, targets: Optional[SLOTargets] = None,
+                 patience: int = 2, cooldown: int = 8,
+                 max_chunk_scale: int = 4, min_spec_sample: int = 8):
+        self.engine = engine
+        self.targets = targets or SLOTargets()
+        self.patience = max(1, int(patience))
+        self.cooldown = max(1, int(cooldown))
+        self.min_spec_sample = max(1, int(min_spec_sample))
+        self.base_chunk = int(engine.prefill_chunk)
+        self.max_chunk = self.base_chunk * max(1, int(max_chunk_scale))
+        self.shed_level = 0
+        self.flips: Dict[str, int] = {a: 0 for a in self.ACTUATORS}
+        self.decisions: deque = deque(maxlen=256)
+        self.frozen: set = set()      # runbook override: actuators held
+        self._step = 0
+        self._hot = 0                 # consecutive pressured steps
+        self._cold = 0                # consecutive calm steps
+        self._last_move: Dict[str, int] = {a: -10**9 for a in self.ACTUATORS}
+        self._spec_seen = (0, 0)      # (drafted, accepted) at last check
+        self._guard = 0               # external capacity-loss pressure
+        self._base_timeout = float(engine.scheduler.queue_timeout_s)
+        self._publish()
+
+    # ------------------------------------------------------------ plumbing
+    def _replica(self) -> str:
+        return self.engine.replica or "solo"
+
+    def _publish(self) -> None:
+        if not _obs.enabled():
+            return
+        r = self._replica()
+        _G_CHUNK.labels(replica=r).set(self.engine.prefill_chunk)
+        _G_SPECK.labels(replica=r).set(self.engine.spec_k)
+        _G_SHED.labels(replica=r).set(self.shed_level)
+
+    def _ready(self, actuator: str) -> bool:
+        return (actuator not in self.frozen
+                and self._step - self._last_move[actuator] >= self.cooldown)
+
+    def _decide(self, actuator: str, direction: str,
+                **measurement) -> None:
+        """Record one actuation: flip accounting, cooldown clock,
+        metric, and a one-event controller trace with the triggering
+        measurement."""
+        self._last_move[actuator] = self._step
+        self.flips[actuator] += 1
+        d = {"step": self._step, "actuator": actuator,
+             "direction": direction, **measurement}
+        self.decisions.append(d)
+        r = self._replica()
+        if _obs.enabled():
+            _DECISIONS.labels(replica=r, actuator=actuator,
+                              direction=direction).inc()
+        cid = f"ctl:{r}:{self._step}:{actuator}"
+        _TRACE.begin(cid, kind="controller", replica=r)
+        _TRACE.finish(cid, "decision", actuator=actuator,
+                      direction=direction, **measurement)
+        self._publish()
+
+    def guard(self, steps: int) -> None:
+        """Capacity-loss pre-tightening (FleetController on drain): act
+        as if under queue pressure for `steps` control steps."""
+        self._guard = max(self._guard, int(steps))
+
+    # ----------------------------------------------------------- main loop
+    def on_step(self, out: Optional[Dict[str, int]] = None) -> None:
+        """One control step, called from the tail of `engine.step()`.
+        All sensors are deterministic (counts, not clocks)."""
+        self._step += 1
+        eng = self.engine
+        queue = len(eng.scheduler.waiting)
+        util = float(eng.allocator.stats()["utilization"])
+        pressured = queue > self.targets.queue_depth or self._guard > 0
+        if self._guard > 0:
+            self._guard -= 1
+        if pressured:
+            self._hot += 1
+            self._cold = 0
+        else:
+            self._cold += 1
+            self._hot = 0
+        if _obs.enabled():
+            _G_PRESSURE.labels(replica=self._replica()).set(
+                1 if pressured else 0)
+        meas = {"queue_depth": queue, "utilization": round(util, 4)}
+        self._actuate_chunk(queue, meas)
+        self._actuate_spec(meas)
+        self._actuate_prefix(util, meas)
+        self._actuate_shed(queue, meas)
+
+    # ----------------------------------------------------------- actuators
+    def _actuate_chunk(self, queue: int, meas: Dict[str, Any]) -> None:
+        """Bigger chunks drain a saturated admission queue faster (each
+        prefill finishes in fewer steps — the arXiv 2604.15464 TTFT
+        lever); smaller chunks restore the TPOT-friendly default when
+        the queue is calm."""
+        eng = self.engine
+        if not self._ready("prefill_chunk"):
+            return
+        if self._hot >= self.patience and eng.prefill_chunk < self.max_chunk:
+            new = min(self.max_chunk, eng.prefill_chunk * 2)
+            eng.reconfigure(prefill_chunk=new)
+            self._decide("prefill_chunk", "up", **meas,
+                         prefill_chunk=new)
+        elif self._cold >= 2 * self.patience \
+                and eng.prefill_chunk > self.base_chunk:
+            new = max(self.base_chunk, eng.prefill_chunk // 2)
+            eng.reconfigure(prefill_chunk=new)
+            self._decide("prefill_chunk", "down", **meas,
+                         prefill_chunk=new)
+
+    def _actuate_spec(self, meas: Dict[str, Any]) -> None:
+        """Cut spec-decode k (halving, down to off) when the n-gram
+        drafter's acceptance collapses — rejected drafts are pure wasted
+        rows in the unified launch. Never re-raises k on its own: a
+        collapsed drafter says the traffic shape changed, and re-probing
+        under pressure is how controllers oscillate (runbook: operators
+        re-arm via `reconfigure(spec_decode=...)`)."""
+        eng = self.engine
+        if eng.spec_k <= 0 or not self._ready("spec_k"):
+            return
+        drafted, accepted = eng.spec_drafted, eng.spec_accepted
+        d = drafted - self._spec_seen[0]
+        a = accepted - self._spec_seen[1]
+        if d < self.min_spec_sample:
+            return
+        rate = a / d
+        if rate < self.targets.spec_accept:
+            new = eng.spec_k // 2
+            eng.reconfigure(spec_decode=new)
+            self._spec_seen = (drafted, accepted)
+            self._decide("spec_k", "down", **meas, spec_k=new,
+                         accept_rate=round(rate, 4), drafted=d)
+        else:
+            self._spec_seen = (drafted, accepted)
+
+    def _actuate_prefix(self, util: float, meas: Dict[str, Any]) -> None:
+        """Gate prefix-cache INSERTS under pool pressure: a thrash
+        tenant streaming never-repeating prompts evicts the well-behaved
+        tenant's shared prefix; refusing new inserts (lookups and adopts
+        stay live) keeps the warm prefix pinned. The pool_high/pool_low
+        gap is the hysteresis band."""
+        eng = self.engine
+        if eng.prefix_cache is None or not self._ready("prefix_admit"):
+            return
+        if eng.prefix_cache_admit and util > self.targets.pool_high:
+            eng.prefix_cache_admit = False
+            self._decide("prefix_admit", "down", **meas)
+        elif not eng.prefix_cache_admit and util < self.targets.pool_low:
+            eng.prefix_cache_admit = True
+            self._decide("prefix_admit", "up", **meas)
+
+    def _actuate_shed(self, queue: int, meas: Dict[str, Any]) -> None:
+        """Graduated shedding: level 1 halves the admission queue
+        timeout (queued requests expire sooner), level 2 refuses
+        `priority < targets.shed_priority` at the door with `Shed`.
+        De-escalates one level at a time once the queue stays calm."""
+        sched = self.engine.scheduler
+        if self.targets.shed_priority is None or not self._ready("shed"):
+            return
+        if self._hot >= 2 * self.patience and self.shed_level < 2:
+            self.shed_level += 1
+            if self.shed_level == 1:
+                if sched.backpressure and self._base_timeout > 0:
+                    sched.queue_timeout_s = self._base_timeout / 2
+            else:
+                sched.shed_below_priority = self.targets.shed_priority
+                sched.shed_measurement = dict(meas)
+            self._decide("shed", "up", **meas, shed_level=self.shed_level)
+        elif self._cold >= 2 * self.patience and self.shed_level > 0:
+            self.shed_level -= 1
+            if self.shed_level == 0:
+                sched.queue_timeout_s = self._base_timeout
+            else:
+                sched.shed_below_priority = None
+                sched.shed_measurement = {}
+            self._decide("shed", "down", **meas,
+                         shed_level=self.shed_level)
+
+
+class FleetController:
+    """Fleet-scope loop above `FleetRouter`, stepped from
+    `router.step()`. Three concerns:
+
+      - placement-weight rebalance: a replica whose queue runs well
+        past the fleet mean gets its weight discounted (the router's
+        score treats low weight as phantom load), recovering via the
+        router's per-step weight recovery;
+      - role capacity: when the pending-handoff backlog says decode
+        capacity is starved (or prefill queues say the reverse), an
+        idle surplus replica is flipped through the PR-15
+        drain/readmit path — pages intact, never the last replica of
+        either role;
+      - capacity loss: `on_capacity_loss` (wired from `router.drain`)
+        puts every survivor's `EngineController` under guard pressure
+        for `guard_steps`, tightening admission BEFORE queues blow out.
+    """
+
+    def __init__(self, router, targets: Optional[SLOTargets] = None,
+                 interval: int = 4, guard_steps: int = 8,
+                 weight_floor: float = 0.25,
+                 handoff_backlog: int = 4, role_patience: int = 3):
+        self.router = router
+        self.targets = targets or SLOTargets()
+        self.interval = max(1, int(interval))
+        self.guard_steps = max(1, int(guard_steps))
+        self.weight_floor = float(weight_floor)
+        self.handoff_backlog = int(handoff_backlog)
+        self.role_patience = max(1, int(role_patience))
+        self.flips: Dict[str, int] = {"weight": 0, "role": 0, "guard": 0}
+        self.decisions: deque = deque(maxlen=256)
+        self._step = 0
+        self._guard = 0
+        self._decode_starved = 0     # consecutive intervals backlogged
+        self._prefill_starved = 0
+        router.attach_controller(self)
+
+    def _decide(self, action: str, **measurement) -> None:
+        self.decisions.append({"step": self._step, "action": action,
+                               **measurement})
+        if _obs.enabled():
+            _F_DECISIONS.labels(action=action).inc()
+        cid = f"fleetctl:{self._step}:{action}"
+        _TRACE.begin(cid, kind="controller")
+        _TRACE.finish(cid, "decision", action=action, **measurement)
+
+    # ----------------------------------------------------------- main loop
+    def on_step(self, out: Optional[Dict[str, int]] = None) -> None:
+        self._step += 1
+        if self._guard > 0:
+            self._guard -= 1
+            if _obs.enabled():
+                _F_GUARD.set(self._guard)
+        if self._step % self.interval:
+            return
+        self._rebalance()
+        self._shift_roles()
+
+    def _loads(self) -> Dict[str, int]:
+        return {name: eng.scheduler.inflight + len(eng.scheduler.waiting)
+                for name, eng in self.router._live()}
+
+    def _rebalance(self) -> None:
+        """Discount the weight of replicas queued far past the fleet
+        mean. Recovery back to 1.0 is the router's per-step ramp, so a
+        single hot interval cannot permanently starve a replica."""
+        loads = self._loads()
+        if len(loads) < 2:
+            return
+        mean = sum(loads.values()) / len(loads)
+        for name, load in sorted(loads.items()):
+            if load > 2 * (mean + 1):
+                w = max(self.weight_floor,
+                        self.router.placement_weight[name] * 0.5)
+                if w < self.router.placement_weight[name]:
+                    self.router.placement_weight[name] = w
+                    self.flips["weight"] += 1
+                    if _obs.enabled():
+                        _F_WEIGHT.labels(replica=name).set(w)
+                    self._decide("rebalance", replica=name,
+                                 weight=round(w, 4), load=load,
+                                 fleet_mean=round(mean, 2))
+
+    def _role_census(self):
+        pf = [(n, e) for n, e in self.router._live()
+              if e.role == "prefill"]
+        dec = [(n, e) for n, e in self.router._live()
+               if e.role == "decode"]
+        return pf, dec
+
+    def _shift_roles(self) -> None:
+        """Flip surplus capacity between roles when the token ratio
+        drifts: a standing pending-handoff backlog means decode is the
+        bottleneck; prefill queues with idle decodes mean the reverse.
+        Only an idle replica flips (drain first otherwise), and never
+        the last replica of its role."""
+        router = self.router
+        pf, dec = self._role_census()
+        if not pf or not dec:
+            return
+        backlog = len(router._pending)
+        pf_queue = sum(len(e.scheduler.waiting) + e.scheduler.inflight
+                       for _, e in pf)
+        if backlog >= self.handoff_backlog:
+            self._decode_starved += 1
+            self._prefill_starved = 0
+        elif backlog == 0 and pf_queue > self.targets.queue_depth \
+                and any(not e.has_work() for _, e in dec):
+            self._prefill_starved += 1
+            self._decode_starved = 0
+        else:
+            self._decode_starved = self._prefill_starved = 0
+        if self._decode_starved >= self.role_patience and len(pf) > 1:
+            # quietest surplus prefill replica becomes a decoder
+            name = min(pf, key=lambda t: (t[1].scheduler.inflight
+                                          + len(t[1].scheduler.waiting),
+                                          t[0]))[0]
+            self._flip(name, "decode", backlog=backlog)
+            self._decode_starved = 0
+        elif self._prefill_starved >= self.role_patience and len(dec) > 1:
+            idle = [n for n, e in dec if not e.has_work()]
+            if idle:
+                self._flip(sorted(idle)[0], "prefill",
+                           prefill_queue=pf_queue)
+            self._prefill_starved = 0
+
+    def _flip(self, name: str, role: str, **measurement) -> None:
+        self.router.set_role(name, role)
+        self.flips["role"] += 1
+        if _obs.enabled():
+            _F_ROLE_FLIPS.inc()
+        self._decide("role_flip", replica=name, role=role, **measurement)
+
+    # -------------------------------------------------------- capacity loss
+    def on_capacity_loss(self, name: str) -> None:
+        """A drain just removed capacity: tighten every survivor's
+        admission pre-emptively instead of waiting for its queue to
+        cross the SLO threshold."""
+        self._guard = self.guard_steps
+        self.flips["guard"] += 1
+        if _obs.enabled():
+            _F_GUARD.set(self._guard)
+        guarded: List[str] = []
+        for rname, eng in self.router._live():
+            ctl = getattr(eng, "controller", None)
+            if ctl is not None:
+                ctl.guard(self.guard_steps)
+                guarded.append(rname)
+        self._decide("capacity_guard", lost=name,
+                     survivors=len(guarded), guard_steps=self.guard_steps)

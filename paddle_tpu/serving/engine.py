@@ -85,6 +85,10 @@ _ACTIVE = _obs.registry().gauge(
     "serving.engine.active_slots", "slots holding an in-flight request")
 _WAITING = _obs.registry().gauge(
     "serving.engine.waiting", "requests queued for admission")
+_REBUILDS = _obs.registry().counter(
+    "serving.controller.rebuilds",
+    "jit program rebuilds triggered by chunk/spec-k actuation "
+    "(ServingEngine.reconfigure)", labels=("replica",))
 _PREEMPTIONS = _obs.registry().counter(
     "serving.engine.preemptions",
     "low-priority decodes re-queued (pages intact) for a higher-"
@@ -200,7 +204,9 @@ class ServingEngine:
                  tenant_budgets: Optional[dict] = None,
                  megadecode: Optional[bool] = None,
                  role: str = "colocated",
-                 replica: Optional[str] = None):
+                 replica: Optional[str] = None,
+                 prefix_cache_admit: bool = True,
+                 slo_targets=None):
         if role not in ("prefill", "decode", "colocated"):
             raise ValueError(
                 f"role must be prefill/decode/colocated, got {role!r}")
@@ -254,6 +260,11 @@ class ServingEngine:
             enable_prefix_cache = getattr(config, "_prefix_cache", None)
         self.prefix_cache = PrefixCache(self.allocator, replica=replica) \
             if enable_prefix_cache in (None, True) else None
+        # prefix-cache INSERT admission (the autopilot's thrash lever):
+        # False stops new prompts entering the trie — lookups and
+        # adopts stay live, so a warm tenant's pinned prefix survives a
+        # never-repeating adversary instead of being churned out
+        self.prefix_cache_admit = bool(prefix_cache_admit)
         self.preemption = bool(preemption)
 
         # family geometry + device page pools
@@ -332,6 +343,28 @@ class ServingEngine:
 
         # the fixed-shape programs: built ONCE here, never in the step
         # loop (paddlelint PT002)
+        self._build_programs()
+        self.rebuilds = 0   # reconfigure()-triggered program rebuilds
+
+        # engine-local speculative-decode totals: the process-wide
+        # serving.spec_decode.* counters are shared by every in-process
+        # replica, so the controller's per-engine acceptance signal
+        # must come from here
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        # SLO autopilot (ISSUE 18): declaring targets attaches a
+        # feedback controller stepped from the tail of step()
+        if slo_targets is not None:
+            from .controller import EngineController
+            self.controller = EngineController(self, slo_targets)
+        else:
+            self.controller = None
+
+    def _build_programs(self) -> None:
+        """(Re)build the fixed-shape jitted programs for the CURRENT
+        max_slots/prefill_chunk/spec_k. Called once from __init__ and
+        again from `reconfigure()` — fresh `jax.jit` objects each time,
+        so `program_cache_sizes()` stays at 1 per program (PT002)."""
         if self.ragged:
             self._jit_unified = jax.jit(self._make_unified_body())
             self._programs = {"unified": self._jit_unified}
@@ -340,6 +373,34 @@ class ServingEngine:
             self._jit_prefill = jax.jit(self._make_prefill_body())
             self._programs = {"decode": self._jit_decode,
                               "prefill": self._jit_prefill}
+
+    def reconfigure(self, prefill_chunk: Optional[int] = None,
+                    spec_decode: Optional[int] = None) -> bool:
+        """Retune the shape-baked serving knobs on a LIVE engine — the
+        autopilot's chunk/spec-k actuator. Greedy-exactness is
+        preserved: chunk size only changes how many prompt tokens ride
+        each launch, and spec decoding is accept/rollback-exact at any
+        k, so in-flight requests continue bit-identically. Returns True
+        when the jitted programs were rebuilt (a recompile on next
+        step), False for a no-op."""
+        new_chunk = self.prefill_chunk if prefill_chunk is None \
+            else int(prefill_chunk)
+        if new_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        new_k = self.spec_k if spec_decode is None else int(spec_decode)
+        if new_k < 0:
+            raise ValueError("spec_decode must be >= 0")
+        if not self.ragged:
+            new_k = 0   # the split path has no multi-row slots
+        if (new_chunk, new_k) == (self.prefill_chunk, self.spec_k):
+            return False
+        self.prefill_chunk = new_chunk
+        self.spec_k = new_k
+        self._build_programs()
+        self.rebuilds += 1
+        if _obs.enabled():
+            _REBUILDS.labels(replica=self.replica or "solo").inc()
+        return True
 
     # ------------------------------------------------------------- public
     def add_request(self, prompt, max_new_tokens: int = 20,
@@ -370,6 +431,10 @@ class ServingEngine:
                 f"max_context {self.max_context}")
         try:
             self.scheduler.submit(req)
+        except _res.Shed:
+            if _obs.enabled():
+                _REQS.labels(outcome="shed").inc()
+            raise
         except _res.Overloaded:
             if _obs.enabled():
                 _REQS.labels(outcome="overloaded").inc()
@@ -427,6 +492,8 @@ class ServingEngine:
         if _obs.enabled():
             # counter tracks move in lockstep with the step spans
             _TRACE.sample_gauges(_COUNTER_GAUGES)
+        if self.controller is not None:
+            self.controller.on_step(out)
         return out
 
     # ------------------------------------------------- HBM accounting
@@ -698,7 +765,7 @@ class ServingEngine:
         # locality score sends the tenant's next request here. The
         # inserted full prompt pages are never rewritten: decode writes
         # land at positions >= kv_length >= prompt.size, past them.
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and self.prefix_cache_admit:
             self.prefix_cache.insert(req.prompt, pages)
         self._handoff_counts["import"] += 1
         if _obs.enabled():
@@ -876,7 +943,7 @@ class ServingEngine:
             req.state = DECODE
             # cache the full prompt pages BEFORE _emit can finish the
             # request and return its pages — trie pins keep them warm
-            if self.prefix_cache is not None:
+            if self.prefix_cache is not None and self.prefix_cache_admit:
                 self.prefix_cache.insert(
                     req.prompt, self.allocator.seq_pages(req.request_id))
             tok = int(np.argmax(np.asarray(logits[0])))
@@ -1038,7 +1105,8 @@ class ServingEngine:
                 # cache the full prompt pages BEFORE _emit can finish
                 # the request and return its pages — trie pins keep
                 # them warm for the next tenant
-                if self.prefix_cache is not None:
+                if self.prefix_cache is not None \
+                        and self.prefix_cache_admit:
                     self.prefix_cache.insert(
                         preq.prompt,
                         self.allocator.seq_pages(preq.request_id))
@@ -1072,6 +1140,8 @@ class ServingEngine:
                 # attention window) and is overwritten by later tokens
                 self.allocator.shrink(req.request_id, len(d) - m)
             record_verify(len(d), m)
+            self.spec_drafted += len(d)
+            self.spec_accepted += m
             _TRACE.stamp(req.request_id, "verify_accept",
                          drafted=len(d), accepted=m)
         if _obs.enabled() and decoded:

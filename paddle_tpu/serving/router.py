@@ -95,7 +95,10 @@ class FleetRouter:
                  locality_weight: float = 1.0,
                  queue_cost_tokens: float = 32.0,
                  elastic=None,
-                 node_ranks: Optional[Dict[str, int]] = None):
+                 node_ranks: Optional[Dict[str, int]] = None,
+                 readmit_warmup: float = 0.5,
+                 warmup_load: float = 2.0,
+                 weight_recovery: float = 0.25):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.replicas = dict(replicas)
@@ -111,12 +114,33 @@ class FleetRouter:
         self._results: Dict[object, object] = {}
         self.handoff_count = 0
         self.handoff_seconds = 0.0
+        # placement weights (ISSUE 18): [0, 1] per replica. A weight
+        # below 1 scales down the locality signal and charges
+        # `warmup_load` phantom queue entries, so a just-readmitted
+        # replica is neither dogpiled (its empty queue looks loaded)
+        # nor starved (the weight ramps back by `weight_recovery` per
+        # fleet step). `readmit()` seeds the weight from the last
+        # federated scrape when one was taken, else `readmit_warmup`.
+        self.placement_weight: Dict[str, float] = \
+            {name: 1.0 for name in self._order}
+        self.readmit_warmup = float(readmit_warmup)
+        self.warmup_load = float(warmup_load)
+        self.weight_recovery = float(weight_recovery)
+        self._last_scrape: Dict[str, Dict[str, float]] = {}
+        # optional fleet-scope SLO autopilot (serving.controller):
+        # attach_controller wires on_step / on_capacity_loss
+        self.controller = None
         # fleet-scope SLO tracking, router-measured: request_id ->
-        # [submit_t, first_token_seen, Request]. Populated only with
-        # metrics enabled; drain-resubmits keep the ORIGINAL submit
-        # time, so fleet TTFT/e2e include the retry cost a client of
-        # the fleet actually pays.
+        # [submit_t, first_token_seen, Request, submit_step].
+        # Drain-resubmits keep the ORIGINAL submit time/step, so fleet
+        # TTFT/e2e include the retry cost a client of the fleet
+        # actually pays. Step-indexed latencies (ttft_steps/e2e_steps,
+        # in router steps) are kept unconditionally — they are the
+        # deterministic SLO signal seeded CI replays bit-exactly.
         self._slo: Dict[object, list] = {}
+        self._step_idx = 0
+        self.ttft_steps: Dict[object, int] = {}
+        self.e2e_steps: Dict[object, int] = {}
         # optional ElasticManager heartbeat view: replica name -> node
         # rank (defaults to listing order)
         self._elastic = elastic
@@ -149,13 +173,23 @@ class FleetRouter:
                                   if self.handoff_count else 0.0),
         }
 
+    def attach_controller(self, controller) -> None:
+        """Wire a `FleetController`: `step()` calls its `on_step` and
+        `drain()` its `on_capacity_loss`."""
+        self.controller = controller
+
     # ----------------------------------------------------------- placement
+    def _weight(self, name: Optional[str]) -> float:
+        return self.placement_weight.get(name or "", 1.0)
+
     def _score(self, eng: ServingEngine, prompt) -> Tuple[float, int]:
         hit = eng.prefix_cache.match_length(prompt) \
             if eng.prefix_cache is not None else 0
         load = eng.scheduler.inflight + len(eng.scheduler.waiting)
-        return (self.locality_weight * hit
-                - self.queue_cost_tokens * load, hit)
+        w = self._weight(eng.replica)
+        return (self.locality_weight * hit * w
+                - self.queue_cost_tokens
+                * (load + (1.0 - w) * self.warmup_load), hit)
 
     def submit(self, prompt, max_new_tokens: int = 20, **kw) -> Request:
         """Place one fresh request on the best prefill-capable replica:
@@ -180,13 +214,13 @@ class FleetRouter:
             except _res.Overloaded as e:
                 err = e
                 continue
+            ent = self._slo.get(req.request_id)
+            if ent is None:
+                self._slo[req.request_id] = [time.monotonic(), False,
+                                             req, self._step_idx]
+            else:
+                ent[2] = req     # drain-resubmit: keep original t0/step
             if _obs.enabled():
-                ent = self._slo.get(req.request_id)
-                if ent is None:
-                    self._slo[req.request_id] = [time.monotonic(),
-                                                 False, req]
-                else:
-                    ent[2] = req     # drain-resubmit: keep original t0
                 _PLACED.labels(replica=name,
                                signal="prefix" if hit else "load").inc()
             _TRACE.stamp(req.request_id, "routed", replica=name,
@@ -217,6 +251,7 @@ class FleetRouter:
         on decode-capable replicas."""
         out = {"admitted": 0, "prefill_tokens": 0, "decoded": 0,
                "finished": 0, "handoffs": 0}
+        self._step_idx += 1
         for name in list(self._order):
             if name in self._down:
                 continue
@@ -236,6 +271,15 @@ class FleetRouter:
         pending, self._pending = self._pending, []
         for handoff in pending:
             out["handoffs"] += self._import(handoff)
+        # warmup ramp: discounted replicas recover toward full weight
+        for name in self._order:
+            if name not in self._down:
+                w = self.placement_weight[name]
+                if w < 1.0:
+                    self.placement_weight[name] = \
+                        min(1.0, w + self.weight_recovery)
+        if self.controller is not None:
+            self.controller.on_step(out)
         return out
 
     def collect(self) -> Dict[object, object]:
@@ -270,10 +314,12 @@ class FleetRouter:
         if not self._slo:
             return
         now = time.monotonic()
-        for ent in self._slo.values():
+        for rid, ent in self._slo.items():
             if not ent[1] and ent[2] is not None and ent[2].tokens:
                 ent[1] = True
-                _fleet.observe_ttft(now - ent[0])
+                self.ttft_steps[rid] = self._step_idx - ent[3]
+                if _obs.enabled():
+                    _fleet.observe_ttft(now - ent[0])
 
     def _absorb(self, done: Dict[object, object]) -> None:
         """Fold one engine's collected results into the fleet result
@@ -287,6 +333,9 @@ class FleetRouter:
         for rid, res in done.items():
             ent = self._slo.pop(rid, None)
             if ent is None or not isinstance(res, np.ndarray):
+                continue
+            self.e2e_steps[rid] = self._step_idx - ent[3]
+            if not _obs.enabled():
                 continue
             _fleet.observe_e2e(now - ent[0])
             if finished is None:
@@ -302,6 +351,16 @@ class FleetRouter:
         `rollup.snapshot()`. Returns an empty registry with metrics
         disabled."""
         snaps = {n: e.scrape() for n, e in self._live()}
+        # remember each replica's scraped queue view: `readmit()` seeds
+        # a healed replica's placement weight from its LAST known load
+        # instead of treating it as a brand-new cold replica
+        for n, e in self._live():
+            self._last_scrape[n] = {
+                "waiting": float(len(e.scheduler.waiting)),
+                "inflight": float(e.scheduler.inflight),
+                "utilization": float(
+                    e.allocator.stats()["utilization"]),
+            }
         rollup = _fleet.federate(
             {n: s for n, s in snaps.items() if s})
         snap = _obs.snapshot()
@@ -309,6 +368,8 @@ class FleetRouter:
             if not name.startswith("serving.fleet."):
                 continue
             e = snap[name]
+            if e.get("kind") != "histogram":
+                continue    # serving.fleet.controller.* counters/gauges
             m = rollup.histogram(name, e["help"], tuple(e["labels"]),
                                  buckets=tuple(e["buckets"]))
             for s in e["series"]:
@@ -323,6 +384,29 @@ class FleetRouter:
         router-measured serving.fleet.* histograms."""
         return _fleet.fleet_slo_summary(qs=qs)
 
+    @staticmethod
+    def _step_pct(vals: List[int], q: int) -> Optional[int]:
+        """Nearest-rank percentile over integer step counts —
+        deterministic on a seeded replay (no interpolation)."""
+        if not vals:
+            return None
+        s = sorted(vals)
+        return s[max(0, -(-q * len(s) // 100) - 1)]
+
+    def step_slo_summary(self, qs=(50, 90, 99)) -> Dict[str, object]:
+        """Step-indexed fleet SLOs: TTFT / e2e measured in ROUTER STEPS
+        from original submission (drain-resubmits keep their first
+        step). Wall-clock percentiles are machine-dependent; these
+        replay bit-exactly from a seed, so `SLOTargets.*_steps` targets
+        can be asserted in CI."""
+        out: Dict[str, object] = {}
+        for key, d in (("ttft", self.ttft_steps),
+                       ("e2e", self.e2e_steps)):
+            vals = list(d.values())
+            for q in qs:
+                out[f"{key}_p{q}_steps"] = self._step_pct(vals, q)
+        return out
+
     # ------------------------------------------------------------- handoff
     def _export(self, eng: ServingEngine, req: Request) -> None:
         self._export_t[req.request_id] = time.monotonic()
@@ -336,7 +420,9 @@ class FleetRouter:
         for idx, (name, eng) in enumerate(self._live()):
             if eng.role not in ("decode", "colocated"):
                 continue
-            load = eng.scheduler.inflight + len(eng.scheduler.waiting)
+            w = self._weight(name)
+            load = (eng.scheduler.inflight + len(eng.scheduler.waiting)
+                    + (1.0 - w) * self.warmup_load)
             ranked.append((load, -eng.allocator.available_pages, idx,
                            name, eng))
         ranked.sort(key=lambda t: t[:3])
@@ -361,7 +447,8 @@ class FleetRouter:
         return 0
 
     # ---------------------------------------------------------- resilience
-    def drain(self, name: str, err: Optional[BaseException] = None) -> int:
+    def drain(self, name: str, err: Optional[BaseException] = None,
+              notify: bool = True) -> int:
         """Take `name` out of rotation and move its work to survivors:
         requests with complete KV (running decodes, staged handoffs,
         preempted waiters) are exported pages-intact onto the pending
@@ -415,18 +502,60 @@ class FleetRouter:
         _TRACE.stamp(f"drain:{name}", "drain", moved=moved,
                      resubmitted=resubmitted,
                      reason=type(err).__name__ if err else "manual")
+        if notify and self.controller is not None:
+            # capacity-loss event: the fleet controller pre-emptively
+            # tightens the survivors' admission instead of waiting for
+            # their queues to cross the SLO threshold
+            self.controller.on_capacity_loss(name)
         return moved + resubmitted
 
-    def readmit(self, name: str) -> None:
+    def readmit(self, name: str,
+                weight: Optional[float] = None) -> None:
         """Put a healed replica back in rotation (its pool is empty —
-        drain exported or resubmitted everything)."""
+        drain exported or resubmitted everything). Its locality and
+        queue stats are COLD, so the placement weight is seeded below
+        1.0 — from the last federated scrape when one was taken (the
+        more loaded it went down, the deeper the discount), else the
+        `readmit_warmup` default — and ramps back to full weight by
+        `weight_recovery` per fleet step. That keeps the router from
+        dogpiling an empty-looking replica or starving a healed one."""
         if name not in self.replicas:
             raise KeyError(name)
         if name in self._down:
             self._down.discard(name)
+            if weight is None:
+                last = self._last_scrape.get(name)
+                if last is None:
+                    weight = self.readmit_warmup
+                else:
+                    gone_load = last.get("waiting", 0.0) \
+                        + last.get("inflight", 0.0)
+                    weight = self.readmit_warmup / (1.0 + gone_load)
+            self.placement_weight[name] = max(0.1, min(1.0, weight))
             if _obs.enabled():
                 _READMITS.labels(replica=name).inc()
                 _UP.set(len(self._live()))
+
+    def set_role(self, name: str, role: str) -> None:
+        """Shift `name` between prefill/decode duty through the PR-15
+        drain/handoff path: in-flight work leaves pages-intact (or is
+        resubmitted fresh), the role flips, and the replica re-enters
+        rotation at FULL weight — it was repurposed, not unhealthy.
+        Callers must leave at least one replica of each needed role
+        (the FleetController guards this)."""
+        if role not in ("prefill", "decode", "colocated"):
+            raise ValueError(
+                f"role must be prefill/decode/colocated, got {role!r}")
+        eng = self.replicas[name]
+        if eng.role == role:
+            return
+        was_down = name in self._down
+        if not was_down:
+            # not a capacity loss: survivors need no guard tightening
+            self.drain(name, notify=False)
+        eng.role = role
+        if not was_down:
+            self.readmit(name, weight=1.0)
 
     def poll_elastic(self) -> None:
         """Reconcile rotation with an `ElasticManager` membership view:

@@ -146,6 +146,13 @@ class Scheduler:
         # budget (progress guarantee — budgets shape, never starve).
         self.tenant_budgets = dict(tenant_budgets or {})
         self._tenant_tokens: dict = {}
+        # graduated load shedding (the SLO autopilot's level-2 gate):
+        # requests with priority < shed_below_priority are refused at
+        # the door with `resilience.Shed`; shed_measurement is the
+        # controller's triggering measurement, stamped on the terminal
+        # `shed` trace event so the timeline answers "why was I shed"
+        self.shed_below_priority: Optional[int] = None
+        self.shed_measurement: dict = {}
         self.waiting: deque = deque()
         self.slots: List[Optional[Request]] = [None] * self.max_slots
         self.finished: List[Request] = []
@@ -168,7 +175,25 @@ class Scheduler:
     def submit(self, req: Request) -> Request:
         """Enqueue FCFS. With backpressure and queue_timeout_s == 0, a
         request that cannot be admitted right now is refused with
-        `Overloaded` (the Predictor's non-blocking admission gate)."""
+        `Overloaded` (the Predictor's non-blocking admission gate).
+        With the controller's shed gate armed, a request below the
+        priority floor is refused with `Shed` — a DISTINCT terminal
+        trace outcome from `refused` (gate full) and `overloaded`
+        (queue timeout), carrying the triggering measurement."""
+        if self.shed_below_priority is not None \
+                and req.priority < self.shed_below_priority:
+            _TRACE.begin(req.request_id,
+                         prompt_len=int(req.prompt.size),
+                         max_new_tokens=req.max_new_tokens)
+            _TRACE.stamp(req.request_id, "enqueue")
+            _TRACE.finish(req.request_id, "shed",
+                          priority=req.priority,
+                          floor=self.shed_below_priority,
+                          **self.shed_measurement)
+            raise _res.Shed(
+                f"priority {req.priority} < shed floor "
+                f"{self.shed_below_priority}",
+                measurement=self.shed_measurement)
         if self.backpressure and self.queue_timeout_s <= 0 \
                 and self.inflight + len(self.waiting) >= self.max_inflight:
             # refused requests still get a (one-event) timeline so the

@@ -43,8 +43,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import observability as _obs
+from .. import resilience as _res
 from ..observability import fleet as _fleet
 from ..observability import tracing as _tracing
+from .controller import FleetController, SLOTargets
 from .engine import ServingEngine
 from .router import FleetRouter
 
@@ -59,11 +61,13 @@ SCENARIOS: Tuple[str, ...] = ("burst", "agentic", "mixed", "thrash",
 #: row fields that replay bit-exactly from the seed (perf_gate locks
 #: these with exact [v, v] bands; fleetboard --selftest re-checks them)
 ROW_DETERMINISTIC: Tuple[str, ...] = (
-    "requests", "completed", "zero_loss", "output_checksum", "handoffs")
+    "requests", "completed", "zero_loss", "output_checksum", "handoffs",
+    "shed", "ttft_p90_steps", "e2e_p90_steps")
 #: machine-dependent row fields (noise-banded, regenerated on-machine)
 ROW_TIMING: Tuple[str, ...] = (
-    "fleet_tokens_per_s", "ttft_p50_ms", "ttft_p90_ms", "e2e_p50_ms",
-    "e2e_p90_ms", "handoff_latency_ms", "wall_s")
+    "fleet_tokens_per_s", "ttft_p50_ms", "ttft_p90_ms", "ttft_p99_ms",
+    "e2e_p50_ms", "e2e_p90_ms", "e2e_p99_ms", "handoff_latency_ms",
+    "wall_s")
 
 
 @dataclass
@@ -105,6 +109,10 @@ class Plan:
     replica_kw: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     #: replica_kill compares every output against solo greedy decode
     check_exact: bool = False
+    #: declared SLO targets — what "holding the SLO" means for this
+    #: traffic shape; recorded in the emitted row, actuated by the
+    #: autopilot when `run_scenario(autopilot=True)`
+    slo: Optional[SLOTargets] = None
 
 
 def _prompt(rng: np.random.Generator, vocab: int, n: int) -> np.ndarray:
@@ -125,7 +133,10 @@ def make_plan(name: str, seed: int = 0, vocab: int = 128) -> Plan:
                                    _prompt(rng, vocab, int(rng.integers(5, 9))),
                                    int(rng.integers(3, 6)), at_step=step,
                                    tenant="burst"))
-        return Plan(name, seed, arr, two)
+        return Plan(name, seed, arr, two,
+                    slo=SLOTargets(ttft_p90_ms=500.0, e2e_p90_ms=2000.0,
+                                   ttft_p90_steps=12, e2e_p90_steps=18,
+                                   queue_depth=4))
     if name == "agentic":
         # 3 agents x 3 turns; turns 2..3 extend the previous turn
         for a in range(3):
@@ -136,7 +147,10 @@ def make_plan(name: str, seed: int = 0, vocab: int = 128) -> Plan:
                 arr.append(Arrival(
                     f"agent{a}-t{t}", _prompt(rng, vocab, 2), 3,
                     tenant=f"agent{a}", after=f"agent{a}-t{t - 1}"))
-        return Plan(name, seed, arr, two)
+        return Plan(name, seed, arr, two,
+                    slo=SLOTargets(ttft_p90_ms=500.0, e2e_p90_ms=3000.0,
+                                   ttft_p90_steps=8, e2e_p90_steps=10,
+                                   queue_depth=4))
     if name == "mixed":
         # two long-context jobs up front, six short chats trickling in
         for i in range(2):
@@ -147,7 +161,10 @@ def make_plan(name: str, seed: int = 0, vocab: int = 128) -> Plan:
                                _prompt(rng, vocab, int(rng.integers(4, 7))),
                                int(rng.integers(2, 5)), at_step=i,
                                tenant="chat"))
-        return Plan(name, seed, arr, two)
+        return Plan(name, seed, arr, two,
+                    slo=SLOTargets(ttft_p90_ms=800.0, e2e_p90_ms=3000.0,
+                                   ttft_p90_steps=13, e2e_p90_steps=15,
+                                   queue_depth=4))
     if name == "thrash":
         # a good tenant re-using one prefix vs an adversary streaming
         # unique prompts through a small pool (num_pages squeezed)
@@ -162,7 +179,11 @@ def make_plan(name: str, seed: int = 0, vocab: int = 128) -> Plan:
                                at_step=i, tenant="adversary",
                                priority=0))
         return Plan(name, seed, arr, two,
-                    replica_kw={"pf0": {"num_pages": 24}})
+                    replica_kw={"pf0": {"num_pages": 24}},
+                    slo=SLOTargets(ttft_p90_ms=800.0, e2e_p90_ms=3000.0,
+                                   ttft_p90_steps=15, e2e_p90_steps=16,
+                                   queue_depth=3, pool_high=0.7,
+                                   pool_low=0.4))
     if name == "replica_kill":
         roles = {"pf0": "prefill", "dec0": "decode", "dec1": "decode"}
         for i in range(8):
@@ -172,7 +193,10 @@ def make_plan(name: str, seed: int = 0, vocab: int = 128) -> Plan:
                                at_step=i // 2, tenant="burst"))
         return Plan(name, seed, arr, roles,
                     chaos=Chaos("dec0", at_step=6, readmit_after=4),
-                    check_exact=True)
+                    check_exact=True,
+                    slo=SLOTargets(ttft_p90_ms=800.0, e2e_p90_ms=4000.0,
+                                   ttft_p90_steps=10, e2e_p90_steps=14,
+                                   queue_depth=4))
     raise ValueError(f"unknown scenario {name!r} (one of {SCENARIOS})")
 
 
@@ -231,14 +255,28 @@ def _delta_pXX(before: Dict[str, Any], after: Dict[str, Any],
 
 def run_scenario(name: str, model, seed: int = 0,
                  vocab: Optional[int] = None,
-                 max_steps: int = 100000) -> Dict[str, Any]:
+                 max_steps: int = 100000,
+                 autopilot: bool = False) -> Dict[str, Any]:
     """Replay one scenario against a fresh fleet; return its
-    SERVING_BENCH row (see module docstring for the field split)."""
+    SERVING_BENCH row (see module docstring for the field split).
+
+    With `autopilot=True` the SAME traffic replays with the ISSUE-18
+    feedback controllers closed around the declared `Plan.slo` targets:
+    every replica gets an `EngineController` (via the engine's
+    `slo_targets` kwarg) and the router a `FleetController`. All
+    controller sensors are deterministic, so the autopilot rows replay
+    bit-exactly too — fleetboard commits them side by side with the
+    static rows."""
     if vocab is None:
         vocab = int(getattr(model.config, "vocab_size", 128))
     plan = make_plan(name, seed=seed, vocab=min(vocab, 128))
+    engine_kw = dict(plan.engine_kw)
+    if autopilot:
+        engine_kw["slo_targets"] = plan.slo
     router = build_fleet(model, plan.roles, replica_kw=plan.replica_kw,
-                         **plan.engine_kw)
+                         **engine_kw)
+    if autopilot:
+        FleetController(router, plan.slo)
     before = _fleet_hist_snapshot()
     pending = list(plan.arrivals)
     held = {a.request_id: a for a in pending if a.after}
@@ -246,6 +284,7 @@ def run_scenario(name: str, model, seed: int = 0,
     prompts: Dict[str, np.ndarray] = {}
     results: Dict[str, np.ndarray] = {}
     submitted: List[str] = []
+    shed: List[str] = []
     chaos_done = readmit_at = None
     t0 = time.perf_counter()
     step = 0
@@ -255,9 +294,21 @@ def run_scenario(name: str, model, seed: int = 0,
                                f"({router.stats()})")
         for a in [a for a in ready if a.at_step <= step]:
             ready.remove(a)
+            try:
+                router.submit(a.prompt, a.max_new,
+                              request_id=a.request_id,
+                              priority=a.priority, tenant=a.tenant)
+            except _res.Shed:
+                # the controller refused it at the door: a deliberate,
+                # traced outcome — NOT a lost request
+                shed.append(a.request_id)
+                continue
+            except _res.Overloaded:
+                # admission backpressure: retry the arrival next step
+                a.at_step = step + 1
+                ready.append(a)
+                continue
             prompts[a.request_id] = a.prompt
-            router.submit(a.prompt, a.max_new, request_id=a.request_id,
-                          priority=a.priority, tenant=a.tenant)
             submitted.append(a.request_id)
         if plan.chaos is not None and chaos_done is None \
                 and step >= plan.chaos.at_step:
@@ -302,10 +353,22 @@ def run_scenario(name: str, model, seed: int = 0,
                     f"solo greedy decode after chaos")
     new_tokens = int(sum(r.size for r in results.values()))
     prompt_tokens = int(sum(p.size for p in prompts.values()))
+    steps_slo = router.step_slo_summary()
     row: Dict[str, Any] = {
-        "scenario": name, "seed": seed,
+        "scenario": name + ("_autopilot" if autopilot else ""),
+        "seed": seed, "autopilot": int(autopilot),
         "requests": len(submitted), "completed": len(results),
         "zero_loss": zero_loss,
+        "shed": len(shed),
+        # step-indexed fleet latencies: deterministic on a seeded
+        # replay, so they live in ROW_DETERMINISTIC and pin the
+        # autopilot's latency win with exact perf_gate bands
+        "ttft_p90_steps": steps_slo["ttft_p90_steps"],
+        "e2e_p90_steps": steps_slo["e2e_p90_steps"],
+        "ttft_p50_steps": steps_slo["ttft_p50_steps"],
+        "e2e_p50_steps": steps_slo["e2e_p50_steps"],
+        # what "holding the SLO" meant for this traffic shape
+        "slo": plan.slo.as_row() if plan.slo is not None else {},
         "output_checksum": int(sum(int(t) for r in results.values()
                                    for t in r.tolist()) % 1_000_000_007),
         "handoffs": router.handoff_count,
@@ -322,13 +385,17 @@ def run_scenario(name: str, model, seed: int = 0,
     }
     for metric, key in (("serving.fleet.ttft_seconds", "ttft"),
                         ("serving.fleet.e2e_seconds", "e2e")):
-        for q in (50, 90):
+        for q in (50, 90, 99):
             v = _delta_pXX(before, after, metric, q)
             row[f"{key}_p{q}_ms"] = (v * 1e3) if v is not None else None
     return row
 
 
-def run_all(model, seed: int = 0) -> Dict[str, Dict[str, Any]]:
-    """All five scenarios, canonical order: {scenario: row}."""
-    return {name: run_scenario(name, model, seed=seed)
+def run_all(model, seed: int = 0,
+            autopilot: bool = False) -> Dict[str, Dict[str, Any]]:
+    """All five scenarios, canonical order: {scenario: row}. With
+    `autopilot=True` the rows are keyed ``<scenario>_autopilot``."""
+    suffix = "_autopilot" if autopilot else ""
+    return {name + suffix: run_scenario(name, model, seed=seed,
+                                        autopilot=autopilot)
             for name in SCENARIOS}

@@ -14,6 +14,11 @@ Three things an operator (or CI) does with a fleet, in one tool:
                      artifact when missing (or with ``--write``); CI
                      wires this next to paddlelint/perf_gate in the
                      verify recipe.
+  - ``--autopilot``  with ``--selftest``: replay every scenario twice —
+                     static config vs the SLO autopilot (ISSUE 18) —
+                     and emit paired ``<name>_autopilot`` rows so the
+                     gate holds controller-on latency/loss to bands the
+                     static run provably misses.
   - ``--federate``   offline metric federation: given per-replica
                      registry snapshot JSONs (``{replica: snapshot}``
                      mappings, or one snapshot per file named by its
@@ -42,15 +47,16 @@ sys.path.insert(0, REPO)
 
 ARTIFACT = os.path.join(REPO, "docs", "FLEET_BENCH.json")
 
-_COLUMNS = (("scenario", "%-12s"), ("requests", "%8s"),
-            ("completed", "%9s"), ("zero_loss", "%9s"),
+_COLUMNS = (("scenario", "%-22s"), ("requests", "%8s"),
+            ("completed", "%9s"), ("zero_loss", "%9s"), ("shed", "%4s"),
             ("handoffs", "%8s"), ("fleet_tokens_per_s", "%9s"),
-            ("ttft_p50_ms", "%11s"), ("ttft_p90_ms", "%11s"),
-            ("e2e_p90_ms", "%10s"), ("handoff_latency_ms", "%10s"),
+            ("ttft_p90_steps", "%8s"), ("e2e_p90_steps", "%8s"),
+            ("ttft_p90_ms", "%11s"), ("e2e_p90_ms", "%10s"),
+            ("handoff_latency_ms", "%10s"),
             ("prefill_skip_rate", "%9s"))
-_HEADERS = ("scenario", "requests", "completed", "zero_loss", "handoffs",
-            "tok/s", "ttft p50ms", "ttft p90ms", "e2e p90ms",
-            "handoff ms", "skip rate")
+_HEADERS = ("scenario", "requests", "completed", "zero_loss", "shed",
+            "handoffs", "tok/s", "ttft p90", "e2e p90", "ttft p90ms",
+            "e2e p90ms", "handoff ms", "skip rate")
 
 
 def render_table(rows: Dict[str, Dict[str, Any]]) -> str:
@@ -81,7 +87,8 @@ def _build_model():
 
 
 def selftest(seed: int = 0, write: bool = False,
-             trace_path: str = "/tmp/fleet_trace.json") -> int:
+             trace_path: str = "/tmp/fleet_trace.json",
+             autopilot: bool = False) -> int:
     import jax
 
     from paddle_tpu.observability import fleet as _fleet
@@ -89,6 +96,10 @@ def selftest(seed: int = 0, write: bool = False,
 
     model = _build_model()
     rows = workloads.run_all(model, seed=seed)
+    if autopilot:
+        # paired replay: same plans, SLO autopilot on — `_autopilot`
+        # rows land next to their static twins in table and artifact
+        rows.update(workloads.run_all(model, seed=seed, autopilot=True))
     print(render_table(rows))
     n_events = _fleet.stitch_chrome_trace(trace_path)
     print(f"fleetboard: stitched trace -> {trace_path} "
@@ -182,6 +193,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--write", action="store_true",
                     help="regenerate docs/FLEET_BENCH.json from this "
                          "run instead of replay-checking against it")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="with --selftest: also replay every scenario "
+                         "with the SLO autopilot on, emitting paired "
+                         "`<name>_autopilot` rows")
     ap.add_argument("--trace", default="/tmp/fleet_trace.json",
                     help="stitched chrome-trace output path "
                          "(with --selftest)")
@@ -194,7 +209,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.selftest:
         return selftest(seed=args.seed, write=args.write,
-                        trace_path=args.trace)
+                        trace_path=args.trace, autopilot=args.autopilot)
     ap.print_help()
     return 0
 

@@ -82,10 +82,12 @@ SERVING_LAUNCH_FIELDS = ("launches_per_layer", "back_half_launches")
 # machine-dependent: throughputs band like serving rows, latencies gate
 # one-sided (slower than band top = regression; faster is a rerate).
 FLEET_DETERMINISTIC_FIELDS = ("requests", "completed", "zero_loss",
-                              "output_checksum", "handoffs")
+                              "output_checksum", "handoffs", "shed",
+                              "ttft_p90_steps", "e2e_p90_steps")
 FLEET_HIGHER_FIELDS = ("fleet_tokens_per_s", "prefill_skip_rate")
-FLEET_LOWER_FIELDS = ("ttft_p50_ms", "ttft_p90_ms", "e2e_p50_ms",
-                      "e2e_p90_ms", "handoff_latency_ms")
+FLEET_LOWER_FIELDS = ("ttft_p50_ms", "ttft_p90_ms", "ttft_p99_ms",
+                      "e2e_p50_ms", "e2e_p90_ms", "e2e_p99_ms",
+                      "handoff_latency_ms")
 
 # OBSERVATORY.json per-kernel fields gated per row (ISSUE 11). These are
 # two-sided: bytes or launches GROWING past the band means new HBM
