@@ -22,7 +22,7 @@ from . import baseline as baseline_mod
 from .model import (FAMILIES, RULE_MODULES, RULE_SEVERITIES, RULES, Config,
                     rule_family)
 from .runner import (analyze_files, analyze_paths, discover,
-                     expand_changed_with_factories)
+                     expand_changed_with_fusion)
 
 #: bumped whenever the JSON layout changes shape (CI parsers key on it)
 SCHEMA_VERSION = 1
@@ -35,7 +35,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="paddlelint",
         description="TPU/JAX-aware static analysis for paddle_tpu "
-                    "(rule families PT/PK/PC/PS/PF; see docs/ANALYSIS.md)")
+                    "(rule families PT/PK/PC/PS/PF/PE; see "
+                    "docs/ANALYSIS.md)")
     p.add_argument("paths", nargs="*", default=["paddle_tpu"],
                    help="package dirs or files to analyze "
                         "(default: paddle_tpu)")
@@ -65,6 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "all look stale)")
     p.add_argument("--fail-stale", action="store_true",
                    help="exit 1 when baseline entries no longer match")
+    p.add_argument("--sarif", metavar="FILE",
+                   help="also write fresh findings as SARIF 2.1.0 to "
+                        "FILE (for PR-diff annotation in CI)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     return p
@@ -82,6 +86,45 @@ def _git_changed(ref: str) -> Optional[Set[str]]:
         return None
     return {os.path.abspath(line.strip())
             for line in proc.stdout.splitlines() if line.strip()}
+
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _sarif_doc(findings) -> dict:
+    """Fresh findings as a SARIF 2.1.0 run (one artifact per path,
+    rule metadata from the registry) — the format GitHub/GitLab code
+    scanning ingests to annotate PR diffs."""
+    rules_arr = [
+        {"id": rid,
+         "shortDescription": {"text": RULES[rid]},
+         "defaultConfiguration": {
+             "level": _SARIF_LEVEL.get(
+                 RULE_SEVERITIES.get(rid, "warning"), "warning")}}
+        for rid in sorted(RULES)]
+    results = []
+    for f in findings:
+        text = f.message + (f" (hint: {f.hint})" if f.hint else "")
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": text},
+            "partialFingerprints": {"paddlelintKey": f.baseline_key},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1}}}]})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "paddlelint",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": rules_arr}},
+            "results": results}],
+    }
 
 
 def _print_rule_table() -> None:
@@ -123,6 +166,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     paths = args.paths or ["paddle_tpu"]
     changed_rels: Optional[List[str]] = None
+    analyzed_files = None
     if args.changed_only is not None:
         changed = _git_changed(args.changed_only)
         if changed is None:
@@ -131,7 +175,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings = analyze_paths(paths, cfg)
         else:
             allfiles = [t for p_ in paths for t in discover(p_)]
-            files = expand_changed_with_factories(allfiles, changed)
+            files = expand_changed_with_fusion(allfiles, changed)
+            analyzed_files = files
             changed_rels = sorted(t[2] for t in files)
             findings = analyze_files(files, cfg)
     else:
@@ -164,6 +209,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # a restricted run produces a subset of findings — every entry
         # from an unanalyzed file would look stale
         stale = []
+
+    if args.sarif:
+        doc = _sarif_doc(sorted(fresh, key=lambda f: (f.path, f.line,
+                                                      f.col, f.rule)))
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2)
+            fh.write("\n")
 
     if args.as_json:
         families = {}
@@ -198,8 +250,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         # dict-ordering and pass-ordering changes so CI diffs are clean
         fresh_sorted = sorted(fresh,
                               key=lambda f: (f.rule, f.path, f.qualname))
+        # PE505 machine-readable fusion verdicts over the analyzed
+        # selection (every PF404 candidate + registered compositions)
+        try:
+            from . import effectsmodel
+            from .callgraph import PackageIndex
+            idx_files = (analyzed_files if analyzed_files is not None
+                         else [t for p_ in paths for t in discover(p_)])
+            verdicts = effectsmodel.compose_verdicts(
+                PackageIndex.from_files(idx_files))
+        except Exception:                 # degrade: verdicts are advisory
+            verdicts = []
         out = {
             "schema_version": SCHEMA_VERSION,
+            "pe505_verdicts": verdicts,
             "findings": [f.to_dict() for f in fresh_sorted],
             "baselined": len(findings) - len(fresh),
             "stale_baseline_keys": stale,

@@ -47,6 +47,9 @@ FAMILIES: Dict[str, str] = {
           "resharding)",
     "PF": "kernel memory lane (VMEM budgets, donation dataflow, dtype "
           "chains, fusion advisories, cost-model drift)",
+    "PE": "grid memory-effects lane (write-write races, donated-read "
+          "ordering, accumulator guards, scatter disjointness, fusion "
+          "legality, write-side cost drift)",
 }
 
 

@@ -1,0 +1,164 @@
+"""PE rule family — grid memory-effects lane (ISSUE 19).
+
+PE501  write-write overlap: an output block revisited along a grid axis
+       that is not declared "arbitrary" in dimension_semantics.
+PE502  read-after-donated-write: kernel re-reads a donated input after a
+       store to its input_output_aliases partner (same buffer on TPU).
+PE503  unguarded accumulator: a scratch/revisited-output ref read back
+       without a sound (first-step-guarded or preceding unconditional)
+       init store.
+PE504  in-kernel scatter overlap: a dynamic (pl.dslice) store whose
+       disjointness across grid steps cannot be proven — only the
+       width-1 per-step-table form (the paged-append contract) passes;
+       proven scatters surface as info under --strict.
+PE505  fusion legality: PF404 candidates and registered compositions
+       whose member effects compose without PE501-PE504 hazards get a
+       "legal" info verdict; a hazard (e.g. read/write inversion of the
+       leading index component) is an error naming the refs.
+PE506  write-side cost drift: effects-model write bytes vs the
+       costmodel's declared bytes_written, at the PF406 tolerance.
+
+All checks run on :mod:`effectsmodel`; sites whose structure does not
+resolve opt out (degrade to unknown, never guess).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import effectsmodel as em
+from . import kernelmodel as km
+from . import vmemmodel as vm
+from .callgraph import PackageIndex
+from .model import Config, Finding, register_rule
+
+register_rule(
+    "PE501",
+    "output block written by multiple grid steps without an "
+    "\"arbitrary\" dimension_semantics declaration (write-write race)",
+    severity="error", module=__name__)
+register_rule(
+    "PE502",
+    "kernel re-reads a donated (input_output_aliases) argument after "
+    "an aliased store — the read observes the in-place write",
+    severity="error", module=__name__)
+register_rule(
+    "PE503",
+    "accumulator on a revisiting grid axis lacks a sound init "
+    "(@pl.when(first-step) seed or preceding unconditional store)",
+    severity="error", module=__name__)
+register_rule(
+    "PE504",
+    "in-kernel dynamic scatter whose destination disjointness across "
+    "grid steps cannot be proven from the index expressions",
+    severity="error", module=__name__)
+register_rule(
+    "PE505",
+    "fusion-legality verdict for PF404 candidates and registered "
+    "compositions: member effects must compose without PE501-PE504 "
+    "hazards (legal verdicts are info; hazards are errors)",
+    severity="info", module=__name__)
+register_rule(
+    "PE506",
+    "effects-model write bytes drift vs costmodel bytes_written "
+    "(kernel writes blocks the cost model does not charge)",
+    severity="warning", module=__name__)
+
+_EFFECT_RULES = ("PE501", "PE502", "PE503", "PE504")
+
+
+def _finding(rule: str, eff: em.KernelEffects, h: dict,
+             severity: str) -> Finding:
+    site = eff.site
+    return Finding(
+        rule=rule, severity=severity, path=site.mi.rel,
+        line=h.get("line", site.line), col=h.get("col", 0),
+        qualname=site.qualname, message=h["message"],
+        hint=h.get("hint", ""), detail=h["detail"])
+
+
+def _pe505(index: PackageIndex) -> List[Finding]:
+    out: List[Finding] = []
+    sites = vm.canonical_sites(index)
+    for v in em.compose_verdicts(index):
+        # anchor at the producer/first-member site when it resolved
+        qn = vm._CHAIN_SITE.get(v.get("producer")
+                                or (v.get("members") or [""])[0])
+        site = sites.get(qn) if qn else None
+        path = site.mi.rel if site else "paddle_tpu/ops"
+        line = site.line if site else 0
+        qual = site.qualname if site else (qn or v["candidate"])
+        if v["verdict"] == "hazard":
+            out.append(Finding(
+                rule="PE505", severity="error", path=path, line=line,
+                col=0, qualname=qual,
+                message=f"fusion candidate {v['candidate']} is NOT "
+                        f"legal: " + "; ".join(v["hazards"]),
+                hint="fix the member hazard (or re-tile the seam) "
+                     "before fusing; see docs/ANALYSIS.md PE505",
+                detail=f"fusehazard:{v['candidate']}"))
+        elif v["verdict"] == "legal":
+            out.append(Finding(
+                rule="PE505", severity="info", path=path, line=line,
+                col=0, qualname=qual,
+                message=f"fusion candidate {v['candidate']} is legal: "
+                        + "; ".join(v["notes"]),
+                detail=f"fuselegal:{v['candidate']}"))
+        else:
+            out.append(Finding(
+                rule="PE505", severity="info", path=path, line=line,
+                col=0, qualname=qual,
+                message=f"fusion candidate {v['candidate']}: no "
+                        f"verdict — " + "; ".join(v["notes"]),
+                detail=f"fuseunknown:{v['candidate']}"))
+    return out
+
+
+def _pe506(index: PackageIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for rec in em.derive_write_bytes(index):
+        if rec.get("status") != "drift":
+            continue
+        out.append(Finding(
+            rule="PE506", severity="warning", path=rec["path"],
+            line=rec["line"], col=0, qualname=rec["qualname"],
+            message=f"effects-model write bytes for "
+                    f"`{rec['kernel']}` ({rec['derived']:,}) drift "
+                    f"{rec['rel_err']:.1%} from "
+                    f"costmodel.bytes_written ({rec['expected']:,}) "
+                    f"at the canonical shape",
+            hint="the kernel writes blocks the cost model does not "
+                 "charge (or vice versa); update "
+                 "observability/costmodel.py or the out_specs",
+            detail=f"wdrift:{rec['kernel']}"))
+    return out
+
+
+def run(index: PackageIndex, cfg: Config) -> List[Finding]:
+    wanted = [r for r in ("PE501", "PE502", "PE503", "PE504", "PE505",
+                          "PE506") if cfg.wants(r)]
+    if not wanted:
+        return []
+    findings: List[Finding] = []
+    if any(r in wanted for r in _EFFECT_RULES):
+        for eff in em.collect_effects(index):
+            if cfg.wants("PE501"):
+                for h in em.ww_hazards(eff):
+                    findings.append(_finding("PE501", eff, h, "error"))
+            if cfg.wants("PE502"):
+                for h in em.alias_read_hazards(eff):
+                    findings.append(_finding("PE502", eff, h, "error"))
+            if cfg.wants("PE503"):
+                for h in em.accumulator_hazards(eff):
+                    findings.append(_finding("PE503", eff, h, "error"))
+            if cfg.wants("PE504"):
+                errors, notes = em.scatter_hazards(eff)
+                for h in errors:
+                    findings.append(_finding("PE504", eff, h, "error"))
+                for h in notes:
+                    findings.append(_finding("PE504", eff, h, "info"))
+    if cfg.wants("PE505"):
+        findings.extend(_pe505(index))
+    if cfg.wants("PE506"):
+        findings.extend(_pe506(index))
+    return findings

@@ -7,14 +7,15 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Tuple
 
-from . import (rules_collective, rules_hostsync, rules_kernel,
-               rules_memory, rules_rng, rules_sharding, rules_threads,
-               rules_trace)
+from . import (rules_collective, rules_effects, rules_hostsync,
+               rules_kernel, rules_memory, rules_rng, rules_sharding,
+               rules_threads, rules_trace)
 from .callgraph import PackageIndex
 from .model import Config, Finding, is_suppressed
 
 _PASSES = (rules_trace, rules_hostsync, rules_rng, rules_threads,
-           rules_kernel, rules_collective, rules_sharding, rules_memory)
+           rules_kernel, rules_collective, rules_sharding, rules_memory,
+           rules_effects)
 
 
 def discover(root: str) -> List[Tuple[str, str, str]]:
@@ -46,7 +47,9 @@ def discover(root: str) -> List[Tuple[str, str, str]]:
 
 def expand_changed_with_factories(
         files: List[Tuple[str, str, str]],
-        changed_abs: set) -> List[Tuple[str, str, str]]:
+        changed_abs: set,
+        index: Optional[PackageIndex] = None
+) -> List[Tuple[str, str, str]]:
     """Grow a ``--changed-only`` file selection with kernel *call-site*
     files whose factory module changed.
 
@@ -61,7 +64,8 @@ def expand_changed_with_factories(
     if not picked or len(picked) == len(files):
         return picked
     from . import kernelmodel as km
-    index = PackageIndex.from_files(files)
+    if index is None:
+        index = PackageIndex.from_files(files)
     have = {os.path.abspath(t[1]) for t in picked}
     extras = []
     for site in km.collect_kernel_calls(index):
@@ -78,6 +82,47 @@ def expand_changed_with_factories(
         have.add(site_abs)
         extras.extend(t for t in files
                       if os.path.abspath(t[1]) == site_abs)
+    return picked + extras
+
+
+def expand_changed_with_fusion(
+        files: List[Tuple[str, str, str]],
+        changed_abs: set) -> List[Tuple[str, str, str]]:
+    """Factory expansion plus fusion-candidate dirtiness: when a changed
+    file hosts one member of a PF404 fusion candidate (or a registered
+    PE505 composition), pull in the files hosting the *other* members.
+
+    PE505's legality verdict is a property of the pair — retiling the
+    producer's out_specs can invert the seam ordering without touching
+    the consumer's file, so a selection that only re-analyzes the edited
+    side would re-certify a fusion it can no longer see both halves
+    of."""
+    picked = [t for t in files if os.path.abspath(t[1]) in changed_abs]
+    if not picked or len(picked) == len(files):
+        return picked
+    index = PackageIndex.from_files(files)
+    picked = expand_changed_with_factories(files, changed_abs, index)
+    from . import effectsmodel as em
+    from . import vmemmodel as vm
+    sites = vm.canonical_sites(index)
+    groups = [[c["producer"], c["consumer"]]
+              for c in vm.fusion_candidates(index)]
+    groups += [list(comp["members"]) for comp in em.COMPOSITIONS]
+    have = {os.path.abspath(t[1]) for t in picked}
+    extras = []
+    for group in groups:
+        member_paths = set()
+        for kernel in group:
+            qn = vm._CHAIN_SITE.get(kernel)
+            site = sites.get(qn) if qn else None
+            if site is not None:
+                member_paths.add(os.path.abspath(site.mi.path))
+        if not member_paths & changed_abs:
+            continue
+        for pth in sorted(member_paths - have):
+            have.add(pth)
+            extras.extend(t for t in files
+                          if os.path.abspath(t[1]) == pth)
     return picked + extras
 
 

@@ -405,7 +405,9 @@ def fused_rope_append(q, k, v, cos, sin, k_pages, v_pages,
     with the page pools donated through input_output_aliases (the HBM
     buffers update in place on TPU — callers must use the RETURNED
     pools, never re-read the donated arguments; paddlelint's PF402
-    checks that statically).
+    checks the caller side statically, and PE502 proves the kernel
+    itself only reads each donated input before its first aliased
+    write, so no defensive copy is ever needed here).
 
     Contract: tokens that share a page are ADJACENT in t (the engine's
     prefill chunk); non-adjacent revisits only happen on the trash page

@@ -43,7 +43,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flashmask_sdpa", "flashmask_block_kinds", "bands_from_startend"]
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 _NEG = -1e30
+
+# B/H/outer-block dims are independent; only the innermost dim carries
+# the online-softmax / accumulator state (paddlelint PE501: every
+# revisited output axis must be declared). Parallel outer dims let
+# Mosaic split them across TensorCores (megacore parts), same as flash.
+_CPARAMS = _CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
 def _interpret() -> bool:
@@ -305,6 +316,7 @@ def _flashmask_fwd_impl(q, k, v, s1, e1, s2, e2, scale, causal, bq, bk):
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32)],
+        compiler_params=_CPARAMS,
         interpret=_interpret(),
     )(kinds, s1, e1, s2, e2, q, k, v)
     return o, (lse, kinds)
@@ -337,6 +349,7 @@ def _flashmask_vjp_bwd(scale, causal, bq, bk, res, do):
         out_specs=pl.BlockSpec((1, 1, bq, D), qmap),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_CPARAMS,
         interpret=_interpret(),
     )(kinds, s1, e1, s2, e2, q, k, v, do, lse, di)
 
@@ -355,6 +368,7 @@ def _flashmask_vjp_bwd(scale, causal, bq, bk, res, do):
                    jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=_CPARAMS,
         interpret=_interpret(),
     )(kinds, s1, e1, s2, e2, q, k, v, do, lse, di)
     return dq, dk, dv, None, None, None, None

@@ -885,6 +885,8 @@ class TestPK103AliasHazards:
                 ),
                 out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
                 input_output_aliases={{1: 0}},
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("arbitrary",)),
             )(pg, x)
     """
 
@@ -916,6 +918,8 @@ class TestPK104SubF32Accumulator:
                 out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
                 out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
                 scratch_shapes=[pltpu.VMEM((128, 128), {acc})],
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("arbitrary",)),
             )(x)
     """
 
@@ -955,6 +959,8 @@ class TestPK104SubF32Accumulator:
                     out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
                     scratch_shapes=[pltpu.VMEM((128, 128),
                                                jnp.bfloat16)],
+                    compiler_params=pltpu.CompilerParams(
+                        dimension_semantics=("arbitrary",)),
                 )(x)
         """)
         assert fs == []
@@ -1215,7 +1221,8 @@ class TestRepoJsonGate:
         data = json.loads(capsys.readouterr().out)
         assert rc == 0
         assert data["schema_version"] == 1
-        assert set(data["families"]) == {"PT", "PK", "PC", "PS", "PF"}
+        assert set(data["families"]) == {"PT", "PK", "PC", "PS", "PF",
+                                         "PE"}
         for fam, info in sorted(data["families"].items()):
             assert info["fresh"] == 0, (fam, data["findings"])
             assert info["rules"], fam
@@ -1241,6 +1248,22 @@ class TestRepoJsonGate:
         assert pf["baselined"] == 0
         assert all(c == {"fresh": 0, "baselined": 0}
                    for c in pf["per_rule"].values())
+        # the effects lane ships with zero debt from day one: all six
+        # rules active, nothing fresh, nothing baselined
+        pe = data["families"]["PE"]
+        assert pe["rules"] == ["PE501", "PE502", "PE503", "PE504",
+                               "PE505", "PE506"]
+        assert pe["baselined"] == 0
+        assert all(c == {"fresh": 0, "baselined": 0}
+                   for c in pe["per_rule"].values())
+        # and the machine-readable PE505 verdicts certify every PF404
+        # candidate plus the registered front-half composition
+        verdicts = {v["candidate"]: v for v in data["pe505_verdicts"]}
+        comp = next(v for v in data["pe505_verdicts"]
+                    if v["composition"] == "front_half_qkv_rope_append")
+        assert comp["verdict"] == "legal"
+        assert verdicts["fused_oproj_norm->fused_ffn"]["verdict"] \
+            == "legal"
 
 
 # -------------------------------------- seeded kernel/collective defects
@@ -2080,7 +2103,7 @@ class TestRuleFamilyRegistry:
         out = capsys.readouterr().out
         headers = [ln for ln in out.splitlines() if ln.startswith("-- ")]
         assert [h.split()[1].rstrip(":") for h in headers] \
-            == ["PC", "PF", "PK", "PS", "PT"]
+            == ["PC", "PE", "PF", "PK", "PS", "PT"]
         # rules listed under their family header
         lines = out.splitlines()
         pf_at = lines.index(next(h for h in headers if "PF" in h))
@@ -2221,8 +2244,9 @@ class TestSeededMemoryDefects:
                 "lambda j: (0, j)),",
             new="out_specs=pl.BlockSpec((K2 * 2 + 256, bn), "
                 "lambda j: (0, j)),")
-        assert fresh and {f.rule for f in fresh} == {"PF406"}
-        assert fresh[0].detail == "drift:int4_dequantize"
+        # PE506 (ISSUE 19) attributes the same drift to the write side
+        assert fresh and {f.rule for f in fresh} == {"PF406", "PE506"}
+        assert any(f.detail == "drift:int4_dequantize" for f in fresh)
 
 
 # ------------------------------------------------------ DCN tier (PS3xx)
@@ -2300,3 +2324,316 @@ class TestDCNTierAxes:
                                  out_specs=P("dp"))(x)
         """)
         assert _rules(fs) == []
+
+
+# ---------------------------------------- seeded effects-lane defects
+
+class TestSeededEffectsDefects:
+    """ISSUE 19 acceptance: each PE rule catches exactly its seeded
+    hazard in a scratch copy of the real kernel modules (alias swap,
+    dropped accumulator guard, widened scatter, overlapping output
+    index_map, fused-pair read/write inversion, write-side cost edit),
+    and the pristine copies report zero fresh PE findings.  Copies are
+    analyzed statically — never imported."""
+
+    RAGGED = "paddle_tpu/ops/pallas_ragged.py"
+    FUSED = "paddle_tpu/ops/fused.py"
+    MEGADECODE = "paddle_tpu/ops/pallas_megadecode.py"
+    PAGED = "paddle_tpu/ops/pallas_paged.py"
+    FLASHMASK = "paddle_tpu/ops/pallas_flashmask.py"
+
+    def _analyze(self, tmp_path, rel, tag, old="", new="",
+                 strict=False):
+        src = open(os.path.join(REPO, rel)).read()
+        if old:
+            assert old in src, f"seed anchor vanished from {rel}: {old!r}"
+            src = src.replace(old, new, 1)
+        d = tmp_path / tag
+        d.mkdir(exist_ok=True)
+        p = d / os.path.basename(rel)
+        p.write_text(src)
+        return analyze_paths([str(p)], Config(strict=strict))
+
+    def _seed(self, tmp_path, rel, strict=False, **kw):
+        clean = self._analyze(tmp_path, rel, "clean", strict=strict)
+        seeded = self._analyze(tmp_path, rel, "seeded", strict=strict,
+                               **kw)
+        new_keys = ({f.baseline_key for f in seeded}
+                    - {f.baseline_key for f in clean})
+        return [f for f in seeded if f.baseline_key in new_keys]
+
+    def test_pristine_copies_are_pe_quiet(self, tmp_path):
+        for rel in (self.RAGGED, self.FUSED, self.MEGADECODE,
+                    self.PAGED, self.FLASHMASK):
+            fs = self._analyze(tmp_path, rel, "clean")
+            assert [f for f in fs if f.rule.startswith("PE")] == [], rel
+
+    def test_pe501_catches_overlapping_output_index_map(self, tmp_path):
+        # pin _rms_forward's output block to (0, 0): every grid step now
+        # writes the same block, with no dimension_semantics declaring
+        # the axis sequential
+        fresh = self._seed(
+            tmp_path, self.FUSED,
+            old="out_specs=pl.BlockSpec((bt, H), lambda i: (i, 0)),\n"
+                "        out_shape=jax.ShapeDtypeStruct((T, H), "
+                "x2.dtype),",
+            new="out_specs=pl.BlockSpec((bt, H), lambda i: (0, 0)),\n"
+                "        out_shape=jax.ShapeDtypeStruct((T, H), "
+                "x2.dtype),")
+        assert fresh and "PE501" in {f.rule for f in fresh}
+        pe = next(f for f in fresh if f.rule == "PE501")
+        assert pe.qualname == "_rms_forward"
+        assert pe.detail == "ww:o_ref:ax0"
+        # the poisoned member also flips the fusion verdict to hazard
+        assert any(f.rule == "PE505" and
+                   f.detail.startswith("fusehazard:") for f in fresh)
+
+    def test_pe502_catches_swapped_alias_indices(self, tmp_path):
+        # cross the donated page pools: vin_ref now aliases kp_ref,
+        # which the kernel seeds BEFORE vin_ref's read
+        fresh = self._seed(
+            tmp_path, self.FUSED,
+            old="input_output_aliases={7: 1, 8: 2}",
+            new="input_output_aliases={7: 2, 8: 1}")
+        assert fresh and "PE502" in {f.rule for f in fresh}
+        pe = next(f for f in fresh if f.rule == "PE502")
+        assert pe.detail == "radw:vin_ref->kp_ref"
+        assert pe.qualname == "fused_rope_append"
+
+    def test_pe503_catches_dropped_accumulator_guard(self, tmp_path):
+        # delete the @pl.when(j == 0) decorator: _init becomes dead
+        # code (never called), so the online-softmax state is read by
+        # the last-step emit with no first-step seed
+        fresh = self._seed(
+            tmp_path, self.RAGGED,
+            old="    @pl.when(j == 0)\n    def _init():",
+            new="    def _init():")
+        assert fresh and {f.rule for f in fresh} == {"PE503"}
+        assert {f.detail for f in fresh} \
+            == {"acc:acc_ref", "acc:m_ref", "acc:l_ref"}
+
+    def test_pe504_catches_widened_scatter(self, tmp_path):
+        # widen the paged-append row scatter to two rows: adjacent
+        # table offsets may differ by one, so step t and t+1 overlap
+        fresh = self._seed(
+            tmp_path, self.FUSED,
+            old="kp_ref[:, 0, pl.dslice(off, 1), :]",
+            new="kp_ref[:, 0, pl.dslice(off, 2), :]")
+        assert fresh and "PE504" in {f.rule for f in fresh}
+        pe = next(f for f in fresh if f.rule == "PE504")
+        assert pe.detail == "scatter:kp_ref:w2"
+        assert pe.severity == "error"
+
+    def test_pe504_contract_note_under_strict(self, tmp_path):
+        # the clean width-1 table scatter surfaces as an info note
+        # (proven under the append contract) only with --strict
+        fs = self._analyze(tmp_path, self.FUSED, "clean", strict=True)
+        details = {f.detail for f in fs if f.rule == "PE504"}
+        assert details == {"scatter-contract:kp_ref",
+                           "scatter-contract:vp_ref",
+                           "scatter-contract:po_ref"}
+        assert all(f.severity == "info" for f in fs
+                   if f.rule == "PE504")
+
+    def test_pe505_catches_read_write_inversion(self, tmp_path):
+        # shift fused_ffn's consumed-block index by one: the fused
+        # launch would read a block its producer has not written yet
+        fresh = self._seed(
+            tmp_path, self.MEGADECODE,
+            old="in_specs=[pl.BlockSpec((bt, H), lambda i: (i, 0)),",
+            new="in_specs=[pl.BlockSpec((bt, H), "
+                "lambda i: (i + 1, 0)),")
+        assert fresh and {f.rule for f in fresh} == {"PE505"}
+        pe = fresh[0]
+        assert pe.severity == "error"
+        assert pe.detail == "fusehazard:fused_oproj_norm->fused_ffn"
+        # the hazard names the refs on both sides of the seam
+        assert "xo_ref" in pe.message and "h_ref" in pe.message
+        assert "read/write inversion" in pe.message
+
+    def test_pe506_catches_write_side_drift(self, tmp_path):
+        # halve the rope output block's lane extent: written bytes
+        # drop 50% below costmodel.bytes_written (PF406 fires on the
+        # total too — PE506 is the write-side attribution)
+        fresh = self._seed(
+            tmp_path, self.FUSED,
+            old="out_specs=pl.BlockSpec((1, bs, H, D), "
+                "lambda b, i: (b, i, 0, 0)),",
+            new="out_specs=pl.BlockSpec((1, bs, H, D // 2), "
+                "lambda b, i: (b, i, 0, 0)),")
+        assert fresh and "PE506" in {f.rule for f in fresh}
+        pe = next(f for f in fresh if f.rule == "PE506")
+        assert pe.detail == "wdrift:fused_rope"
+        assert pe.qualname == "_rope_forward"
+
+    def test_pe503_accepts_dma_filled_scratch(self, tmp_path):
+        # paged v2's kbuf/vbuf double buffers are filled through
+        # buf.at[...] DMA handles the scanner cannot order — they must
+        # degrade to unknown, not fire PE503
+        fs = self._analyze(tmp_path, self.PAGED, "clean")
+        assert [f for f in fs if f.rule == "PE503"] == []
+
+    def test_pe501_flashmask_declares_revisited_axis(self, tmp_path):
+        # regression for the fix this PR ships: the flashmask launches
+        # now declare the innermost (revisited) axis "arbitrary"; strip
+        # the declaration and PE501 fires on the helper-built out specs
+        fresh = self._seed(
+            tmp_path, self.FLASHMASK,
+            old="        compiler_params=_CPARAMS,\n"
+                "        interpret=_interpret(),\n"
+                "    )(kinds, s1, e1, s2, e2, q, k, v)",
+            new="        interpret=_interpret(),\n"
+                "    )(kinds, s1, e1, s2, e2, q, k, v)")
+        assert fresh and "PE501" in {f.rule for f in fresh}
+        pe = [f for f in fresh if f.rule == "PE501"]
+        assert {f.detail for f in pe} == {"ww:o_ref:ax3",
+                                          "ww:lse_ref:ax3"}
+
+
+# --------------------------------- serving modules: no-clock regression
+
+class TestServingModulesLintClean:
+    """ISSUE 19 satellite: the PR 17-18 serving modules claim a no-clock
+    discipline (feedback control without host-time branches on the hot
+    path) — lock in zero fresh PT/PC findings so a future edit cannot
+    silently reintroduce host syncs or branch-divergent collectives."""
+
+    MODULES = ("paddle_tpu/serving/controller.py",
+               "paddle_tpu/serving/router.py")
+
+    def test_controller_and_router_have_no_pt_pc_findings(self):
+        for rel in self.MODULES:
+            fs = analyze_paths([os.path.join(REPO, rel)])
+            bad = [f for f in fs if f.rule.startswith(("PT", "PC"))]
+            assert bad == [], (rel, [(f.rule, f.detail) for f in bad])
+
+    def test_modules_are_clean_even_under_strict(self):
+        for rel in self.MODULES:
+            fs = analyze_paths([os.path.join(REPO, rel)],
+                               Config(strict=True))
+            assert fs == [], (rel, [(f.rule, f.detail) for f in fs])
+
+
+# --------------------------------------------------- SARIF CI output
+
+class TestSarifOutput:
+    def test_sarif_file_carries_findings_and_rules(self, tmp_path,
+                                                   capsys):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """))
+        sarif = tmp_path / "out.sarif"
+        assert lint_main([str(p), "--sarif", str(sarif)]) == 1
+        doc = json.loads(sarif.read_text())
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "paddlelint"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"PT001", "PK101", "PE501", "PE505"} <= rule_ids
+        res = run["results"]
+        assert res and res[0]["ruleId"] == "PT001"
+        assert res[0]["level"] == "error"
+        loc = res[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "mod.py"
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+        # baselining key rides along for CI dedup across pushes
+        assert "paddlelintKey" in res[0]["partialFingerprints"]
+
+    def test_clean_run_writes_empty_results(self, tmp_path, capsys):
+        p = tmp_path / "mod.py"
+        p.write_text("x = 1\n")
+        sarif = tmp_path / "out.sarif"
+        assert lint_main([str(p), "--sarif", str(sarif)]) == 0
+        doc = json.loads(sarif.read_text())
+        assert doc["runs"][0]["results"] == []
+
+
+# ------------------------------ changed-only fusion-candidate expansion
+
+class TestChangedOnlyFusionExpansion:
+    """ISSUE 19 satellite: PE505's legality verdict is a property of a
+    fusion PAIR — editing the producer's file must pull the consumer's
+    file into a --changed-only selection, or the restricted run would
+    re-certify a fusion it can only see half of."""
+
+    PROD = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _oproj_kernel(x_ref, xo_ref, h_ref):
+            xo_ref[:] = x_ref[:]
+            h_ref[:] = x_ref[:]
+
+        def _oproj_norm_forward(x):
+            T, H = x.shape
+            bt = 8
+            return pl.pallas_call(
+                _oproj_kernel,
+                grid=(T // bt,),
+                in_specs=[pl.BlockSpec((bt, H), lambda i: (i, 0))],
+                out_specs=[pl.BlockSpec((bt, H), lambda i: (i, 0)),
+                           pl.BlockSpec((bt, H), lambda i: (i, 0))],
+                out_shape=[jax.ShapeDtypeStruct(x.shape, x.dtype),
+                           jax.ShapeDtypeStruct(x.shape, x.dtype)],
+            )(x)
+    """
+    CONS = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _ffn_kernel(h_ref, o_ref):
+            o_ref[:] = h_ref[:]
+
+        def _ffn_forward(h2):
+            T, H = h2.shape
+            bt = 8
+            return pl.pallas_call(
+                _ffn_kernel,
+                grid=(T // bt,),
+                in_specs=[pl.BlockSpec((bt, H), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((bt, H), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(h2.shape, h2.dtype),
+            )(h2)
+    """
+
+    def _pkg(self, tmp_path):
+        from paddle_tpu.analysis.runner import discover
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "prod.py").write_text(textwrap.dedent(self.PROD))
+        (pkg / "cons.py").write_text(textwrap.dedent(self.CONS))
+        (pkg / "other.py").write_text("x = 1\n")
+        return pkg, discover(str(pkg))
+
+    def test_producer_change_pulls_in_consumer_file(self, tmp_path):
+        from paddle_tpu.analysis.runner import (
+            expand_changed_with_fusion)
+        pkg, files = self._pkg(tmp_path)
+        changed = {os.path.abspath(str(pkg / "prod.py"))}
+        sel = expand_changed_with_fusion(files, changed)
+        assert sorted(t[2] for t in sel) == ["pkg/cons.py",
+                                             "pkg/prod.py"]
+
+    def test_consumer_change_pulls_in_producer_file(self, tmp_path):
+        from paddle_tpu.analysis.runner import (
+            expand_changed_with_fusion)
+        pkg, files = self._pkg(tmp_path)
+        changed = {os.path.abspath(str(pkg / "cons.py"))}
+        sel = expand_changed_with_fusion(files, changed)
+        assert sorted(t[2] for t in sel) == ["pkg/cons.py",
+                                             "pkg/prod.py"]
+
+    def test_unrelated_change_stays_narrow(self, tmp_path):
+        from paddle_tpu.analysis.runner import (
+            expand_changed_with_fusion)
+        pkg, files = self._pkg(tmp_path)
+        changed = {os.path.abspath(str(pkg / "other.py"))}
+        sel = expand_changed_with_fusion(files, changed)
+        assert sorted(t[2] for t in sel) == ["pkg/other.py"]
