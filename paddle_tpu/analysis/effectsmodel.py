@@ -47,16 +47,22 @@ __all__ = [
 ]
 
 #: Registered fused-kernel compositions beyond the adjacent PF404 pairs.
-#: ROADMAP item 1's decode front half: fused_rms_norm -> qkv projection
-#: (XLA dots ride between the members) -> fused_rope_append.  PE505
+#: ISSUE 20 CONSUMED the old ``front_half_qkv_rope_append`` entry — the
+#: qkv projection + rope + paged-append now ship as one
+#: fused_qkv_rope_append launch — so the registered composition is the
+#: ROADMAP <=4-launch follow-on: the full decode layer body (ragged
+#: attention launches between the front and back halves).  PE505
 #: certifies the member effects compose without PE501-PE504 hazards.
 COMPOSITIONS: List[Dict[str, Any]] = [
     {
-        "name": "front_half_qkv_rope_append",
-        "members": ["fused_rms_norm", "fused_rope_append"],
-        "note": "ROADMAP item 1 front half: the qkv projection matmuls "
-                "sit between the members as XLA dots; fusing them into "
-                "one launch elides two [T, H]-class HBM round-trips",
+        "name": "decode_layer_le4",
+        "members": ["fused_rms_norm", "fused_qkv_rope_append",
+                    "fused_oproj_norm", "fused_ffn"],
+        "note": "ROADMAP <=4-launch follow-on: ragged attention "
+                "launches between fused_qkv_rope_append and "
+                "fused_oproj_norm; the remaining mechanical seam is "
+                "the norm's 8-row block vs the front's one-token sweep "
+                "(retile) and the deliberate oproj->ffn VMEM cut",
     },
 ]
 

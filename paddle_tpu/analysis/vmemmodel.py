@@ -329,31 +329,76 @@ CANONICAL: Dict[str, Dict[str, Any]] = {
         token_tiled=True,
         families={"llama": dict(H2=256, I2=896)},
     ),
+    # -- ops/pallas_megafront.py (ISSUE 20 mega-kernel front half) ---------
+    # 8-way shard hidden (H=512) against the FULL qkv out width
+    # N=(Hq+2KV)*D — out channels don't shard with the contraction; the
+    # concatenated slab is VMEM-resident (constant index_map, one fetch)
+    # while the token row, trig rows and page blocks sweep with t.
+    "_qkv_rope_append_fwd": dict(
+        kernel="fused_qkv_rope_append",
+        bindings=dict(T=8, H=512, N=6144, heads=32, KV=8, D=128,
+                      psz=32, d2=64),
+        in_widths=[2, 2, 4, 2, 2, 2, 2, 2], out_widths=[2, 2, 2],
+        cost_kwargs=dict(T=8, H=512, Hq=32, KV=8, D=128, page_size=32),
+        token_tiled=True,
+        families={"llama": dict(H=512, N=6144, heads=32, KV=8, D=128),
+                  "gpt": dict(KV=32, N=12288)},
+    ),
+    "_qkv_rope_append_int4": dict(
+        kernel="fused_qkv_rope_append",
+        bindings=dict(T=8, H2=256, N=6144, heads=32, KV=8, D=128,
+                      psz=32),
+        in_widths=[2, 2, 1, 4, 2, 2, 2, 2], out_widths=[2, 2, 2],
+        cost_kwargs=dict(T=8, H=512, Hq=32, KV=8, D=128, page_size=32,
+                         algo="weight_only_int4"),
+        token_tiled=True,
+        families={"llama": dict(H2=256, N=6144)},
+    ),
+    # MLA front: q [H, nh*(dn+dr)] and kv_a [H, r+dr] concatenate into
+    # one slab; the pool row is [latent | rope-key] (Dc = r + dr)
+    "_mla_qkv_rope_append_fwd": dict(
+        kernel="fused_qkv_rope_append",
+        bindings=dict(T=8, H=640, N=3648, r=512, dd2=32, heads=16,
+                      dh=192, psz=32, Dc=576),
+        in_widths=[2, 2, 4, 2, 2, 2, 2], out_widths=[2, 2],
+        cost_kwargs=dict(T=8, H=640, Hq=16, page_size=32,
+                         nope_dim=128, rope_dim=64, lora_rank=512),
+        token_tiled=True,
+        families={"mla": dict(H=640, N=3648, r=512, heads=16)},
+    ),
 }
 
 #: The decode-layer kernel chain in launch order (PF404 walks adjacent
 #: pairs).  ISSUE 14 collapsed the back half into the two megadecode
-#: launches — o-proj + residual + norm, then the whole FFN — so the old
-#: norm -> swiglu advisory is RESOLVED (the swiglu kernel stays
-#: registered for the standalone op).  The two advisories that remain
-#: standing are justified seams, not oversights:
-#:   - fused_rms_norm -> fused_rope_append 'retile': the qkv projection
-#:     matmuls sit between them, and their [T, H] x [H, (Hq+2KV)D]
-#:     weight slab plus the rope pair cannot co-reside in VMEM at the
-#:     family shapes;
+#: launches — o-proj + residual + norm, then the whole FFN — and ISSUE
+#: 20 consumed the front-half seam: the qkv projection matmuls, rope,
+#: and the paged K/V scatter now live in one fused_qkv_rope_append
+#: launch, so the old fused_rms_norm -> fused_rope_append advisory
+#: (whose only obstacle was the 8-rows-vs-1 retile) is RESOLVED — the
+#: fused kernel emits q at the attention consumer's one-token
+#: granularity, and fused_rope_append stays registered for the
+#: standalone op / fallback path.  The advisories that remain standing
+#: are justified seams, not oversights:
+#:   - fused_rms_norm -> fused_qkv_rope_append 'retile': the norm
+#:     still runs a bt=8 row block while the fused front sweeps one
+#:     token per step; folding the norm in is the registered seam for
+#:     the ROADMAP <=4-launch follow-on (a [T, H] x [H, (Hq+2KV)D]
+#:     slab plus the norm row block co-resides at the family shapes —
+#:     the obstacle is purely the 8-vs-1 retile);
 #:   - fused_oproj_norm -> fused_ffn 'aligned': the deliberate two-
 #:     kernel cut — the o-proj slab plus all three FFN slabs exceed the
 #:     16 MiB budget even 8-way sharded, so only the [T, H] residual +
 #:     normed pair crosses HBM between them (down from four
 #:     intermediates in the unfused chain).
 DECODE_CHAIN: List[str] = [
-    "fused_rms_norm", "fused_rope_append", "ragged_paged_attention",
+    "fused_rms_norm", "fused_qkv_rope_append", "ragged_paged_attention",
     "fused_oproj_norm", "fused_ffn",
 ]
 
 _CHAIN_SITE: Dict[str, str] = {
     "fused_rms_norm": "_rms_forward",
     "fused_rope_append": "fused_rope_append",
+    "fused_qkv_rope_append": "_qkv_rope_append_fwd",
     "ragged_paged_attention": "ragged_paged_attention",
     "fused_oproj_norm": "_oproj_norm_forward",
     "fused_ffn": "_ffn_forward",

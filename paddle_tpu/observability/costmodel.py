@@ -482,6 +482,50 @@ def _c_fused_ffn(*, T: int, H: int, I: int, algo: Optional[str] = None,
                    "rows": rows})
 
 
+@register_cost("fused_qkv_rope_append")
+def _c_fused_qkv_rope_append(*, T: int, H: int, Hq: int, KV: int = 0,
+                             D: int = 0, page_size: int,
+                             algo: Optional[str] = None,
+                             dtype_bytes: int = 2, nope_dim: int = 0,
+                             rope_dim: int = 0, lora_rank: int = 0
+                             ) -> CostEstimate:
+    """Front-half mega-kernel (ops/pallas_megafront.py): qkv projection
+    (in-kernel dequant) + rope + paged K/V row scatter in one launch,
+    grid (T,).  Reads the normed hidden rows, the concatenated qkv slab
+    in its deploy layout (+ f32 scale row + bias row), the trig rows
+    and the aliased page blocks; writes q at the attention consumer's
+    one-token granularity plus the page blocks.  ``lora_rank > 0``
+    models the MLA layout: the slab is [q | kv_a], the bias row becomes
+    the latent-norm weight, and one [lora_rank + rope_dim] pool row
+    lands per token."""
+    db = dtype_bytes
+    if lora_rank:
+        dh = nope_dim + rope_dim
+        nq = Hq * dh
+        N = nq + lora_rank + rope_dim
+        rows = lora_rank * db                # latent rms-norm weight
+        trig = T * rope_dim * db
+        pages = T * page_size * (lora_rank + rope_dim) * db
+        out_q = T * nq * db
+        flops = (2 * T * H * N + 3 * T * Hq * rope_dim
+                 + 3 * T * rope_dim + 8 * T * lora_rank)
+    else:
+        N = (Hq + 2 * KV) * D
+        rows = N * db                        # bias row
+        trig = T * D * db
+        pages = 2 * T * KV * page_size * D * db   # k_pages + v_pages
+        out_q = T * Hq * D * db
+        flops = 2 * T * H * N + 3 * T * (Hq + KV) * D
+    w = _quant_payload(H, N, algo, db) + N * 4    # slab + f32 scale
+    x = T * H * db
+    return CostEstimate(
+        bytes_read=x + w + rows + trig + pages,
+        bytes_written=out_q + pages, flops=flops,
+        breakdown={"weights": w,
+                   "activations": x + rows + trig + out_q,
+                   "kv": 2 * pages})
+
+
 # ---------------------------------------------------------------------------
 # composite budgets — the shared cost vocabulary
 # ---------------------------------------------------------------------------
@@ -549,36 +593,48 @@ def decode_layer_kernels(family: str = "llama", *, batch: int,
                          kv_dtype_bytes: int = 2,
                          weight_bytes_per_layer: int = 0,
                          quant_algo: Optional[str] = None,
-                         megadecode: bool = True) -> Dict[str, Any]:
+                         megadecode: bool = True,
+                         megafront: bool = True) -> Dict[str, Any]:
     """Per-kernel decomposition of one decode layer body:
     {kernel: (launches_per_layer, CostEstimate at this shape)}.
 
     ``megadecode=True`` (the engine default since ISSUE 14) models the
     mega-kernel back half: after attention only ``fused_oproj_norm``
     and ``fused_ffn`` launch (2 pallas_calls; their weight slabs are
-    carved out of ``weight_bytes_per_layer``, so only the qkv matmuls
-    remain under the projection pseudo-kernel).  ``megadecode=False``
-    models the pre-ISSUE-14 split chain (the ~6-kernel body ROADMAP
-    item 1 fused against: 2 norms + swiglu + 6 projection matmuls).
+    carved out of ``weight_bytes_per_layer``).  ``megafront=True``
+    (the engine default since ISSUE 20) models the mega-kernel front
+    half: the qkv matmuls, rope and paged K/V scatter collapse into
+    one ``fused_qkv_rope_append`` launch, so with both flags on NO
+    projection pseudo-kernel remains and the body is 5 launches
+    (norm + front + attention + oproj + ffn).  ``megadecode=False,
+    megafront=False`` models the pre-ISSUE-14 split chain (2 norms +
+    swiglu + 6 projection matmuls, 11 launches).
 
-    The projection matmuls route through `weight_only_linear` when
-    ``quant_algo`` is set; in bf16 they are XLA dots, reported under
-    the pseudo-kernel ``xla_projections`` so the layer's weight traffic
-    still lands in the ledger (pass ``weight_bytes_per_layer`` from the
-    real weight tree).
+    Projection matmuls left outside the fused kernels route through
+    `weight_only_linear` when ``quant_algo`` is set; in bf16 they are
+    XLA dots, reported under the pseudo-kernel ``xla_projections`` so
+    the layer's weight traffic still lands in the ledger (pass
+    ``weight_bytes_per_layer`` from the real weight tree).
     """
     B, D, KV, Hq = batch, head_dim, kv_heads, heads
     kernels: Dict[str, Any] = {
         "fused_rms_norm": (1 if megadecode else 2,
                            cost("fused_rms_norm", T=B, H=hidden)),
-        "fused_rope_append": (1, cost(
-            "fused_rope_append", T=B, Hq=Hq, KV=KV, D=D,
-            page_size=page_size, dtype_bytes=kv_dtype_bytes)),
-        "ragged_paged_attention": (1, cost(
-            "ragged_paged_attention", T=B, H=Hq, KV=KV, D=D, S=B,
-            pages_per_seq=_ceil_div(context, page_size),
-            page_size=page_size, dtype_bytes=kv_dtype_bytes)),
     }
+    if megafront:
+        front = cost("fused_qkv_rope_append", T=B, H=hidden, Hq=Hq,
+                     KV=KV, D=D, page_size=page_size, algo=quant_algo,
+                     dtype_bytes=kv_dtype_bytes)
+        kernels["fused_qkv_rope_append"] = (1, front)
+    else:
+        front = None
+        kernels["fused_rope_append"] = (1, cost(
+            "fused_rope_append", T=B, Hq=Hq, KV=KV, D=D,
+            page_size=page_size, dtype_bytes=kv_dtype_bytes))
+    kernels["ragged_paged_attention"] = (1, cost(
+        "ragged_paged_attention", T=B, H=Hq, KV=KV, D=D, S=B,
+        pages_per_seq=_ceil_div(context, page_size),
+        page_size=page_size, dtype_bytes=kv_dtype_bytes))
     if megadecode:
         oproj = cost("fused_oproj_norm", T=B, Ko=Hq * D, H=hidden,
                      algo=quant_algo)
@@ -587,31 +643,45 @@ def decode_layer_kernels(family: str = "llama", *, batch: int,
                    act="gelu" if family == "gpt" else "swiglu")
         kernels["fused_oproj_norm"] = (1, oproj)
         kernels["fused_ffn"] = (1, ffn)
-        # only the qkv matmuls remain outside the fused kernels; their
-        # weight bytes are whatever the layer tree holds beyond the
-        # fused slabs (both ledgers carve from the SAME real total)
+        # whatever matmuls remain outside the fused kernels carry the
+        # weight bytes the layer tree holds beyond the fused slabs
+        # (both ledgers carve from the SAME real total)
         fused_w = (oproj.breakdown["weights"]
                    + ffn.breakdown["weights"])
+        if front is not None:
+            fused_w += front.breakdown["weights"]
+            n_mats, mat_flops = 0, 0
+        else:
+            n_mats, mat_flops = 3, Hq * D + 2 * KV * D
         qkv_w = max(0, int(weight_bytes_per_layer) - fused_w)
-        n_mats, mat_flops = 3, Hq * D + 2 * KV * D
     else:
         kernels["swiglu"] = (1, cost("swiglu", T=B, H=intermediate))
-        qkv_w = int(weight_bytes_per_layer)
-        n_mats = 6
-        mat_flops = (Hq * D + 2 * KV * D + hidden + 3 * intermediate)
-    # per-LAUNCH projection traffic (consumers multiply by the launch
-    # count, so the n_mats dispatches still sum to the layer's full
-    # projection weight read — one crossing per step, never n_mats)
-    proj_flops = 2 * B * hidden * mat_flops // n_mats
-    act = B * hidden * 2                    # in/out rows of one matmul
-    proj = CostEstimate(
-        bytes_read=qkv_w // n_mats + act,
-        bytes_written=act, flops=proj_flops,
-        breakdown={"weights": qkv_w // n_mats, "activations": 2 * act})
-    if quant_algo is not None:
-        kernels["weight_only_linear"] = (n_mats, proj)
-    else:
-        kernels["xla_projections"] = (n_mats, proj)
+        if front is not None:
+            qkv_w = max(0, int(weight_bytes_per_layer)
+                        - front.breakdown["weights"])
+            n_mats = 3
+            mat_flops = hidden + 3 * intermediate
+        else:
+            qkv_w = int(weight_bytes_per_layer)
+            n_mats = 6
+            mat_flops = (Hq * D + 2 * KV * D + hidden
+                         + 3 * intermediate)
+    if n_mats:
+        # per-LAUNCH projection traffic (consumers multiply by the
+        # launch count, so the n_mats dispatches still sum to the
+        # layer's full projection weight read — one crossing per step,
+        # never n_mats)
+        proj_flops = 2 * B * hidden * mat_flops // n_mats
+        act = B * hidden * 2                # in/out rows of one matmul
+        proj = CostEstimate(
+            bytes_read=qkv_w // n_mats + act,
+            bytes_written=act, flops=proj_flops,
+            breakdown={"weights": qkv_w // n_mats,
+                       "activations": 2 * act})
+        if quant_algo is not None:
+            kernels["weight_only_linear"] = (n_mats, proj)
+        else:
+            kernels["xla_projections"] = (n_mats, proj)
     return {"family": family, "kernels": kernels,
             "launches_per_layer": sum(n for n, _ in kernels.values())}
 

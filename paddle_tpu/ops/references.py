@@ -18,7 +18,8 @@ __all__ = ["rms_norm_reference", "layer_norm_reference",
            "moe_dispatch_combine_reference", "rope_reference",
            "rope_append_reference", "append_rows_reference",
            "swiglu_reference", "mla_decode_reference", "gmm_reference",
-           "oproj_norm_reference", "megadecode_ffn_reference"]
+           "oproj_norm_reference", "megadecode_ffn_reference",
+           "qkv_rope_append_reference"]
 
 
 def rms_norm_reference(x, weight, eps: float = 1e-6):
@@ -158,6 +159,54 @@ def megadecode_ffn_reference(h, x, wg, sg=None, wu=None, su=None,
     if b2 is not None:
         d = d + b2.reshape(1, H).astype(jnp.float32)
     return (x2 + d).astype(x.dtype).reshape(shape)
+
+
+def qkv_rope_append_reference(h, w, scale, bias, cos, sin, k_pages,
+                              v_pages, page_idx, page_off, *,
+                              heads: int, kv_heads: int = 0,
+                              head_dim: int = 0, algo=None,
+                              norm_weight=None, eps: float = 1e-6,
+                              nope_dim: int = 0, rope_dim: int = 0,
+                              lora_rank: int = 0):
+    """fused_qkv_rope_append oracle: dense dequant + f32 qkv projection
+    + rotate-half rope + at[].set paged row scatter.  Standard layout
+    returns (q_roped, k_pages, v_pages); MLA (lora_rank > 0) returns
+    (q with its rope tail rotated, pool) with the latent rms-normed by
+    ``norm_weight`` before the [latent | rope-key] row lands."""
+    T = h.shape[0]
+    hf = h.astype(jnp.float32)
+    p = hf @ _dequant_ref(w, scale, algo)
+    c = cos.astype(jnp.float32)[:, None, :]           # [T, 1, d/2]
+    s = sin.astype(jnp.float32)[:, None, :]
+    if lora_rank:
+        dh = nope_dim + rope_dim
+        nq = heads * dh
+        q = p[:, :nq].reshape(T, heads, dh)
+        q = jnp.concatenate(
+            [q[..., :nope_dim], _rotate_half(q[..., nope_dim:], c, s)],
+            axis=-1)
+        lat = p[:, nq:nq + lora_rank]
+        var = jnp.mean(lat * lat, axis=-1, keepdims=True)
+        lat = lat * jax.lax.rsqrt(var + eps) \
+            * norm_weight.reshape(1, -1).astype(jnp.float32)
+        k_pe = _rotate_half(p[:, None, nq + lora_rank:], c, s)[:, 0]
+        rows = jnp.concatenate([lat, k_pe], axis=-1)[:, None, :]
+        pool = k_pages.at[:, page_idx, page_off, :].set(
+            rows.astype(k_pages.dtype).swapaxes(0, 1))
+        return q.astype(h.dtype), pool
+    if bias is not None:
+        p = p + bias.reshape(1, -1).astype(jnp.float32)
+    D = head_dim
+    q = p[:, :heads * D].reshape(T, heads, D)
+    k = p[:, heads * D:(heads + kv_heads) * D].reshape(T, kv_heads, D)
+    v = p[:, (heads + kv_heads) * D:].reshape(T, kv_heads, D)
+    qr = _rotate_half(q, c, s).astype(h.dtype)
+    kr = _rotate_half(k, c, s)
+    kp = k_pages.at[:, page_idx, page_off, :].set(
+        kr.astype(k_pages.dtype).swapaxes(0, 1))
+    vp = v_pages.at[:, page_idx, page_off, :].set(
+        v.astype(v_pages.dtype).swapaxes(0, 1))
+    return qr, kp, vp
 
 
 def mla_decode_reference(q_eff, q_pe, c_lat, c_pe, lengths, *,

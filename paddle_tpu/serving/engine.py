@@ -13,18 +13,24 @@ Two dispatch paths:
 - **ragged (default)**: ONE unified launch per step. Every decode
   slot's token and the oldest prefill request's chunk ride a single
   flat token buffer through a fused per-layer body
-  (fused_rms_norm → qkv → fused_rope_append → ragged_paged_attention →
-  fused_oproj_norm → fused_ffn), so a step that has both prefill and
-  decode work issues ONE device program instead of two
+  (fused_rms_norm → fused_qkv_rope_append → ragged_paged_attention →
+  fused_oproj_norm → fused_ffn, ≤5 launches), so a step that has both
+  prefill and decode work issues ONE device program instead of two
   (`serving.engine.launches` counts the difference). Per-sequence row
   tables (seq_start / num_tokens / kv_lengths / page table) make joins
-  and leaves pure data changes. The back half rides the ISSUE-14
+  and leaves pure data changes. The front half rides the ISSUE-20
+  mega-kernel — qkv projection (with in-kernel int4/int8 dequant),
+  rope and the paged K/V append in one pallas_call — when
+  `megafront_eligible` holds for the family geometry (`megafront=False`
+  or an ineligible tiling falls back to the split
+  qkv→fused_rope_append front, 5 launches instead of 2; MLA with
+  q-lora or int4 always splits). The back half rides the ISSUE-14
   mega-kernels — o-proj + residual + norm in one pallas_call, the
-  whole FFN in a second — when `megadecode_eligible` holds for the
-  family geometry (`megadecode=False` or an ineligible tiling falls
-  back to the split o-proj/norm/ffn chain; routed MoE layers always
-  keep the `_ffn_apply` combine — data-dependent routing can't fuse —
-  but still take the fused o-proj+norm kernel).
+  whole FFN in a second — when `megadecode_eligible` holds
+  (`megadecode=False` or an ineligible tiling falls back to the split
+  o-proj/norm/ffn chain; routed MoE layers always keep the
+  `_ffn_apply` combine — data-dependent routing can't fuse — but
+  still take the fused o-proj+norm kernel).
 - **split (legacy, `ragged=False`)**: the PR-5 alternating
   `_prefill_chunk` / `_decode` dispatches over
   `paged_attention`/`append_to_cache`. Kept as the reference path and
@@ -60,6 +66,8 @@ from ..ops.fused import (fused_append_rows, fused_layer_norm,
 from ..ops.paged_attention import append_to_cache, paged_attention
 from ..ops.pallas_megadecode import (fused_ffn, fused_oproj_norm,
                                      megadecode_eligible)
+from ..ops.pallas_megafront import (fused_qkv_rope_append,
+                                    megafront_eligible)
 from ..ops.pallas_ragged import (ragged_kernel_eligible,
                                  ragged_paged_attention)
 from .block_allocator import PageBlockAllocator
@@ -203,6 +211,7 @@ class ServingEngine:
                  preemption: bool = True,
                  tenant_budgets: Optional[dict] = None,
                  megadecode: Optional[bool] = None,
+                 megafront: Optional[bool] = None,
                  role: str = "colocated",
                  replica: Optional[str] = None,
                  prefix_cache_admit: bool = True,
@@ -319,6 +328,26 @@ class ServingEngine:
         #: pallas launches after attention, per layer per decode step —
         #: the bench A/B row reads this (2 fused vs the 6-stage chain)
         self.back_half_launches = 2 if self.megadecode else 6
+        # mega-kernel front half (ISSUE 20): the qkv projection matmuls,
+        # rope and the paged K/V append collapse to ONE pallas_call
+        # after the norm, so the decode layer body is <=5 launches with
+        # both mega flags on.  Default on, per-family fallback via the
+        # megafront_eligible tiling gate; MLA's two-stage q-lora
+        # projection and the (unpacked) MLA int4 layout keep the split
+        # front.  The gate rewrites the weight tree (per-projection
+        # slabs -> one concatenated slab per layer), so it must run
+        # BEFORE tree_bytes below.
+        self.megafront = bool(
+            (True if megafront is None else megafront)
+            and self.ragged
+            and self._megafront_family_ok(cfg, int4))
+        if self.megafront:
+            self._concat_qkv_weights()
+        #: pallas/XLA launches before attention, per layer per decode
+        #: step — the bench A/B row reads this (2 fused vs the split
+        #: norm / projection dots / rope-append front)
+        self.front_half_launches = 2 if self.megafront \
+            else self._split_front_launches()
         self.launches = 0      # device program launches by THIS engine
 
         # live HBM accounting (ISSUE 11): static residency is published
@@ -359,6 +388,77 @@ class ServingEngine:
             self.controller = EngineController(self, slo_targets)
         else:
             self.controller = None
+
+    def _megafront_family_ok(self, cfg, int4: bool) -> bool:
+        """Per-family tiling/layout gate for the fused front half."""
+        if self._family == "gpt":
+            # wqkv ships concatenated already; identity trig
+            return megafront_eligible(
+                cfg.hidden_size,
+                3 * cfg.num_attention_heads * cfg.head_dim,
+                cfg.head_dim)
+        if self._family == "mla":
+            if int4:
+                return False    # no packed-int4 MLA front site
+            if any("wqa" in L or "wqa_q" in L or "wqa_q4" in L
+                   for L in self._p["layers"]):
+                return False    # two-stage q-lora can't ride one slab
+            dh = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
+            n = (cfg.num_attention_heads * dh
+                 + cfg.kv_lora_rank + cfg.qk_rope_head_dim)
+            return megafront_eligible(cfg.hidden_size, n, dh)
+        n = (cfg.num_attention_heads
+             + 2 * cfg.num_key_value_heads) * cfg.head_dim
+        return megafront_eligible(cfg.hidden_size, n, cfg.head_dim,
+                                  int4=int4)
+
+    def _split_front_launches(self) -> int:
+        """Launches before attention on the SPLIT front path, per layer
+        (norm + projection dots + the rope/append kernel)."""
+        if self._family == "gpt":
+            return 3            # norm + wqkv dot + rope-append
+        if self._family == "mla":
+            qlora = any("wqa" in L or "wqa_q" in L or "wqa_q4" in L
+                        for L in self._p["layers"])
+            # norm + q dot(s, + q-lora norm) + kv_a dot + latent norm
+            # + row append
+            return 7 if qlora else 5
+        return 5                # norm + q/k/v dots + rope-append
+
+    def _concat_qkv_weights(self) -> None:
+        """Deploy-layout transform behind the megafront gate: replace
+        each layer's per-projection slabs with ONE concatenated
+        out-channel slab — the layout `fused_qkv_rope_append` reads.
+        Column-wise identical math (every output column depends only on
+        its own weight column; int4 packs along the contraction axis,
+        so out-channel concat is layout-safe), applied to payloads,
+        scales and biases alike.  llama/moe: wq|wk|wv -> wqkv; MLA:
+        wq|wkva -> wqkva; GPT already ships wqkv.  Consumed leaves are
+        popped so `tree_bytes` stays the honest residency total (concat
+        preserves bytes), which is safe because ragged engines only
+        ever build the unified program."""
+        if self._family == "gpt":
+            return
+        mla = self._family == "mla"
+        keys = ("wq", "wkva") if mla else ("wq", "wk", "wv")
+        new = "wqkva" if mla else "wqkv"
+        layers = []
+        for L in self._p["layers"]:
+            L = dict(L)
+            suffix = {"weight_only_int4": "_q4",
+                      "weight_only_int8": "_q"}.get(
+                          _walgo(L, keys[0]), "")
+            L[new + suffix] = jnp.concatenate(
+                [L.pop(k + suffix) for k in keys], axis=-1)
+            if suffix:
+                L[new + "_s"] = jnp.concatenate(
+                    [L.pop(k + "_s") for k in keys], axis=-1)
+            if "bq" in L:
+                L["bqkv"] = jnp.concatenate(
+                    [L.pop("bq"), L.pop("bk"), L.pop("bv")], axis=-1)
+            layers.append(L)
+        self._p = dict(self._p, layers=layers)
+        self._w = dict(self._w, layers=layers)
 
     def _build_programs(self) -> None:
         """(Re)build the fixed-shape jitted programs for the CURRENT
@@ -565,6 +665,18 @@ class ServingEngine:
             "bytes_per_token_model": (
                 self._ledger_model_bytes / self._ledger_tokens
                 if self._ledger_tokens else 0.0),
+            # launch decomposition of one decode layer body — the live
+            # A/B the bench reads.  The byte ledger above is fusion-
+            # INVARIANT by construction (weights cross once per launch
+            # and cache reads are page-granular on both paths; the
+            # fused front elides only intermediate activation
+            # crossings, which the ledger never counted), so the
+            # front-half win shows up here and in tokens/s, not as a
+            # measured-bytes discontinuity.
+            "front_half_launches": int(self.front_half_launches),
+            "back_half_launches": int(self.back_half_launches),
+            "layer_body_launches": int(self.front_half_launches + 1
+                                       + self.back_half_launches),
         }
 
     def program_cache_sizes(self) -> Dict[str, int]:
@@ -617,6 +729,12 @@ class ServingEngine:
                           self.prefix_cache.pages)
         reg.counter("serving.replica.launches",
                     "device program launches").inc(self.launches)
+        reg.gauge("serving.replica.front_half_launches",
+                  "per-layer launches before attention "
+                  "(2 = fused megafront)").set(self.front_half_launches)
+        reg.gauge("serving.replica.back_half_launches",
+                  "per-layer launches after attention "
+                  "(2 = fused megadecode)").set(self.back_half_launches)
         hc = reg.counter("serving.replica.handoffs",
                          "KV-page handoffs by direction",
                          labels=("direction",))
@@ -1092,7 +1210,9 @@ class ServingEngine:
         logits = np.asarray(logits)         # [S, vocab]; [T, vocab] K>0
         self.launches += 1
         if _obs.enabled():
-            _LAUNCHES.labels(path="unified").inc()
+            _LAUNCHES.labels(
+                path="unified_megafront" if self.megafront
+                else "unified").inc()
             _STEPS.labels(phase="unified").inc()
             if n:
                 _TOKENS.labels(phase="prefill").inc(n)
@@ -1217,12 +1337,15 @@ class ServingEngine:
     # flat token rows, S = max_slots + 1 sequences with BAKED seq_start
     # [0..B-1, B] (decode slot i owns row i; the prefill chunk owns rows
     # B..B+n-1). The per-layer body is the fused decode chain:
-    # fused_rms_norm -> qkv -> fused_rope_append (K/V row scatter rides
-    # the rope kernel) -> ragged_paged_attention -> fused_oproj_norm ->
-    # fused_ffn (the ISSUE-14 mega-kernel back half: o-proj + residual
-    # + norm emit from one f32 VMEM accumulator, the whole FFN from a
-    # second — `self.megadecode` False falls back to the split
-    # o-proj/norm/ffn chain, same math, more HBM round-trips).
+    # fused_rms_norm -> fused_qkv_rope_append (the ISSUE-20 mega-kernel
+    # front half: qkv projection with in-kernel dequant, rope, and the
+    # paged K/V scatter in one launch; `self.megafront` False falls
+    # back to the split qkv -> fused_rope_append front, same math) ->
+    # ragged_paged_attention -> fused_oproj_norm -> fused_ffn (the
+    # ISSUE-14 mega-kernel back half: o-proj + residual + norm emit
+    # from one f32 VMEM accumulator, the whole FFN from a second —
+    # `self.megadecode` False falls back to the split o-proj/norm/ffn
+    # chain, same math, more HBM round-trips).
     # No flags_guard: nothing in the chain is flag-routed.
 
     def _llama_unified_body(self):
@@ -1232,6 +1355,7 @@ class ServingEngine:
         eps = cfg.rms_norm_eps
         moe_static = self._p.get("moe_static")
         mega = self.megadecode
+        megafront = self.megafront
         B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
         R = 1 + K
         T = B * R + C
@@ -1250,13 +1374,24 @@ class ServingEngine:
             sts = moe_static or (None,) * len(w["layers"])
             for L, (kp, vp), st in zip(w["layers"], pools, sts):
                 h = fused_rms_norm(x, L["ln1"], eps)
-                q, k, v = (_mm_w(h, L, "wq"), _mm_w(h, L, "wk"),
-                           _mm_w(h, L, "wv"))
-                if "bq" in L:
-                    q, k, v = q + L["bq"], k + L["bk"], v + L["bv"]
-                q, kp, vp = fused_rope_append(
-                    q.reshape(T, Hh, D), k.reshape(T, KV, D),
-                    v.reshape(T, KV, D), c, s, kp, vp, tok_page, tok_off)
+                if megafront:
+                    # ISSUE 20 front half: qkv projection (in-kernel
+                    # dequant of the concatenated deploy slab), rope
+                    # and the paged K/V scatter in ONE launch
+                    wp, ws = _wq2(L, "wqkv")
+                    q, kp, vp = fused_qkv_rope_append(
+                        h[0], wp, ws, L.get("bqkv"), c, s, kp, vp,
+                        tok_page, tok_off, heads=Hh, kv_heads=KV,
+                        head_dim=D, algo=_walgo(L, "wqkv"))
+                else:
+                    q, k, v = (_mm_w(h, L, "wq"), _mm_w(h, L, "wk"),
+                               _mm_w(h, L, "wv"))
+                    if "bq" in L:
+                        q, k, v = q + L["bq"], k + L["bk"], v + L["bv"]
+                    q, kp, vp = fused_rope_append(
+                        q.reshape(T, Hh, D), k.reshape(T, KV, D),
+                        v.reshape(T, KV, D), c, s, kp, vp, tok_page,
+                        tok_off)
                 new_pools.append((kp, vp))
                 o = ragged_paged_attention(q, kp, vp, seq_start,
                                            num_tokens, kv_lengths,
@@ -1302,6 +1437,7 @@ class ServingEngine:
         nh, hd = cfg.num_attention_heads, cfg.head_dim
         eps = cfg.layer_norm_eps
         mega = self.megadecode
+        megafront = self.megafront
         B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
         R = 1 + K
         T = B * R + C
@@ -1321,12 +1457,21 @@ class ServingEngine:
             new_pools = []
             for L, (kp, vp) in zip(w["layers"], pools):
                 h = fused_layer_norm(x, L["ln1w"], L["ln1b"], eps)
-                qkv = h @ L["wqkv"] + L["bqkv"]
-                q, k, v = jnp.split(qkv, 3, axis=-1)
-                q, kp, vp = fused_rope_append(
-                    q.reshape(T, nh, hd), k.reshape(T, nh, hd),
-                    v.reshape(T, nh, hd), c, s, kp, vp,
-                    tok_page, tok_off)
+                if megafront:
+                    # the deploy wqkv slab is already the fused
+                    # kernel's [q | k | v] column layout; identity
+                    # trig makes rope a no-op on q/k
+                    q, kp, vp = fused_qkv_rope_append(
+                        h[0], L["wqkv"], None, L["bqkv"], c, s, kp,
+                        vp, tok_page, tok_off, heads=nh, kv_heads=nh,
+                        head_dim=hd)
+                else:
+                    qkv = h @ L["wqkv"] + L["bqkv"]
+                    q, k, v = jnp.split(qkv, 3, axis=-1)
+                    q, kp, vp = fused_rope_append(
+                        q.reshape(T, nh, hd), k.reshape(T, nh, hd),
+                        v.reshape(T, nh, hd), c, s, kp, vp,
+                        tok_page, tok_off)
                 new_pools.append((kp, vp))
                 o = ragged_paged_attention(q, kp, vp, seq_start,
                                            num_tokens, kv_lengths,
@@ -1370,6 +1515,7 @@ class ServingEngine:
         scale = 1.0 / float(math.sqrt(dn + dr))
         moe_static = self._p.get("moe_static")
         mega = self.megadecode
+        megafront = self.megafront
         B, C, K = self.max_slots, self.prefill_chunk, self.spec_k
         R = 1 + K
         T = B * R + C
@@ -1397,26 +1543,46 @@ class ServingEngine:
             sts = moe_static or (None,) * len(w["layers"])
             for L, pool, st in zip(w["layers"], pools, sts):
                 h = fused_rms_norm(x, L["ln1"], eps)
-                if "wqa" in L or "wqa_q" in L or "wqa_q4" in L:
-                    q = _mm_w(fused_rms_norm(_mm_w(h, L, "wqa"),
-                                             L["gq"], eps), L, "wqb")
-                else:
-                    q = _mm_w(h, L, "wq")
-                q = q.reshape(1, T, nh, dn + dr)
-                q_nope, q_pe = q[..., :dn], q[..., dn:]
-                # rope runs on the split q_pe/k_pe shapes (not D-halved
-                # cache rows), so the append is the row-scatter kernel
-                q_pe = rope(q_pe)
-                kv_a = _mm_w(h, L, "wkva")               # [1, T, r+dr]
-                lat = fused_rms_norm(kv_a[..., :r], L["gkv"], eps)
-                k_pe = rope(kv_a[..., r:][:, :, None, :])[:, :, 0]
-                rows = jnp.concatenate([lat, k_pe], -1)[0][:, None]
-                pool = fused_append_rows(pool, rows, tok_page, tok_off)
-                new_pools.append(pool)
                 wkb = _dq(L, "wkvb", x.dtype).reshape(r, nh, dn + dv)
                 w_k, w_v = wkb[..., :dn], wkb[..., dn:]
-                q_eff = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_k)
-                q_cat = jnp.concatenate([q_eff, q_pe], -1)[0]
+                if megafront:
+                    # ISSUE 20 front half: the [q | kv_a] slab
+                    # projects, the q tail and k_pe rope, the latent
+                    # rms-norms and the [latent | rope-key] pool row
+                    # lands — one launch, q already at the attention
+                    # granularity [T, nh, dn+dr]
+                    wp, ws = _wq2(L, "wqkva")
+                    q, pool = fused_qkv_rope_append(
+                        h[0], wp, ws, None, c, s, pool, None,
+                        tok_page, tok_off, heads=nh,
+                        algo=_walgo(L, "wqkva"), norm_weight=L["gkv"],
+                        eps=eps, nope_dim=dn, rope_dim=dr,
+                        lora_rank=r)
+                    q_eff = jnp.einsum("tnd,rnd->tnr", q[..., :dn],
+                                       w_k)
+                    q_cat = jnp.concatenate([q_eff, q[..., dn:]], -1)
+                else:
+                    if "wqa" in L or "wqa_q" in L or "wqa_q4" in L:
+                        q = _mm_w(fused_rms_norm(_mm_w(h, L, "wqa"),
+                                                 L["gq"], eps),
+                                  L, "wqb")
+                    else:
+                        q = _mm_w(h, L, "wq")
+                    q = q.reshape(1, T, nh, dn + dr)
+                    q_nope, q_pe = q[..., :dn], q[..., dn:]
+                    # rope runs on the split q_pe/k_pe shapes (not
+                    # D-halved cache rows), so the append is the
+                    # row-scatter kernel
+                    q_pe = rope(q_pe)
+                    kv_a = _mm_w(h, L, "wkva")           # [1, T, r+dr]
+                    lat = fused_rms_norm(kv_a[..., :r], L["gkv"], eps)
+                    k_pe = rope(kv_a[..., r:][:, :, None, :])[:, :, 0]
+                    rows = jnp.concatenate([lat, k_pe], -1)[0][:, None]
+                    pool = fused_append_rows(pool, rows, tok_page,
+                                             tok_off)
+                    q_eff = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_k)
+                    q_cat = jnp.concatenate([q_eff, q_pe], -1)[0]
+                new_pools.append(pool)
                 o_cat = ragged_paged_attention(q_cat, pool, pool,
                                                seq_start, num_tokens,
                                                kv_lengths, tables,

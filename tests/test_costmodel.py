@@ -57,6 +57,8 @@ SHAPES = {
     "weight_only_linear": dict(M=8, K=256, N=512),
     "fused_oproj_norm": dict(T=8, Ko=512, H=512),
     "fused_ffn": dict(T=8, H=512, I=1792),
+    "fused_qkv_rope_append": dict(T=8, H=512, Hq=32, KV=8, D=128,
+                                  page_size=32),
 }
 
 
@@ -65,8 +67,8 @@ class TestRegistryCoverage:
         # registration side effects                          # noqa: F401
         from paddle_tpu.ops import (fused, pallas_flash, pallas_flashmask,
                                     pallas_gmm, pallas_megadecode,
-                                    pallas_mla, pallas_paged,
-                                    pallas_ragged, quant)
+                                    pallas_megafront, pallas_mla,
+                                    pallas_paged, pallas_ragged, quant)
         from paddle_tpu.ops.oracles import oracles
         names = set(oracles())
         missing = names - set(cm.costs())

@@ -311,13 +311,17 @@ class TestWholeRepoInvariants:
             assert r["status"] == "ok", r
             assert r["derived"] == r["expected"], r
 
-    def test_front_half_composition_is_certified_legal(self):
+    def test_layer_body_composition_is_certified_legal(self):
+        # ISSUE 20 shipped the old front_half_qkv_rope_append
+        # composition as fused_qkv_rope_append; the registered
+        # follow-on is the <=4-launch whole-body chain
         verdicts = em.compose_verdicts(self._index())
         comp = next(v for v in verdicts
-                    if v["composition"] == "front_half_qkv_rope_append")
+                    if v["composition"] == "decode_layer_le4")
         assert comp["verdict"] == "legal"
         assert comp["members"] == ["fused_rms_norm",
-                                   "fused_rope_append"]
+                                   "fused_qkv_rope_append",
+                                   "fused_oproj_norm", "fused_ffn"]
         # every verdict is JSON-shaped: strings and lists only
         import json
         json.dumps(verdicts)

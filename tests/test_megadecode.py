@@ -5,8 +5,8 @@ oracles (ops/references.py) across the four family geometries — fp
 (bitwise), int8 and packed int4 (split-contraction reordering only) —
 plus the engine-level contracts: megadecode vs split-chain exactness,
 the eligibility gate's TPU tiling rules, int4-MoE end-to-end, and the
-costmodel launch accounting (8 launches/layer fused vs 11 split; 2
-pallas_calls after attention)."""
+costmodel launch accounting (5 launches/layer with both mega halves,
+8 with either alone, 11 split; 2 pallas_calls after attention)."""
 
 import numpy as np
 import pytest
@@ -309,10 +309,11 @@ class TestEngineMegadecode:
 
 
 class TestLaunchAccounting:
-    """costmodel.decode_layer_kernels megadecode mode: 8 launches per
-    layer (2 after attention) vs the 11-launch split chain, and the
-    dual-ledger claim — the fused path's modeled HBM bytes are strictly
-    below the split chain's at identical weights."""
+    """costmodel.decode_layer_kernels fused modes: 5 launches per layer
+    with both mega halves (the ISSUE 20 default), 8 with either half
+    alone, 11 for the fully split chain — and the dual-ledger claim:
+    the fused path's modeled HBM bytes are strictly below the split
+    chain's at identical weights."""
 
     KW = dict(batch=8, context=256, hidden=512, heads=4, kv_heads=1,
               head_dim=128, intermediate=1792, page_size=32,
@@ -325,26 +326,41 @@ class TestLaunchAccounting:
 
     def test_launch_counts(self):
         from paddle_tpu.observability import costmodel as cm
-        mega = cm.decode_layer_kernels(**self.KW)
-        old = cm.decode_layer_kernels(megadecode=False, **self.KW)
-        # fused: rms 1 + qkv 3 + rope 1 + ragged 1 + oproj_norm 1 +
-        # ffn 1 = 8; split chain: rms 2 + six projections + rope 1 +
-        # ragged 1 + swiglu 1 = 11
-        assert mega["launches_per_layer"] == 8
+        both = cm.decode_layer_kernels(**self.KW)
+        back = cm.decode_layer_kernels(megafront=False, **self.KW)
+        front = cm.decode_layer_kernels(megadecode=False, **self.KW)
+        old = cm.decode_layer_kernels(megadecode=False, megafront=False,
+                                      **self.KW)
+        # both halves: rms 1 + qkv_rope_append 1 + ragged 1 +
+        # oproj_norm 1 + ffn 1 = 5; back only: rms 1 + qkv 3 + rope 1
+        # + ragged 1 + oproj_norm 1 + ffn 1 = 8; front only: rms 2 +
+        # qkv_rope_append 1 + ragged 1 + swiglu 1 + three back mats =
+        # 8; split chain: rms 2 + six projections + rope 1 + ragged 1
+        # + swiglu 1 = 11
+        assert both["launches_per_layer"] == 5
+        assert back["launches_per_layer"] == 8
+        assert front["launches_per_layer"] == 8
         assert old["launches_per_layer"] == 11
-        back = {k: n for k, (n, _) in mega["kernels"].items()
-                if k in ("fused_oproj_norm", "fused_ffn")}
-        assert back == {"fused_oproj_norm": 1, "fused_ffn": 1}
-        assert "swiglu" not in mega["kernels"]
+        fused = {k: n for k, (n, _) in both["kernels"].items()
+                 if k in ("fused_qkv_rope_append", "fused_oproj_norm",
+                          "fused_ffn")}
+        assert fused == {"fused_qkv_rope_append": 1,
+                         "fused_oproj_norm": 1, "fused_ffn": 1}
+        assert "swiglu" not in both["kernels"]
+        assert "fused_rope_append" not in both["kernels"]
+        assert "fused_rope_append" in back["kernels"]
 
     def test_fused_path_removes_intermediate_bytes(self):
         from paddle_tpu.observability import costmodel as cm
-        mega = cm.decode_layer_kernels(**self.KW)
-        old = cm.decode_layer_kernels(megadecode=False, **self.KW)
-        # same real weight total crosses in both modes (the fused slabs
+        both = cm.decode_layer_kernels(**self.KW)
+        back = cm.decode_layer_kernels(megafront=False, **self.KW)
+        old = cm.decode_layer_kernels(megadecode=False, megafront=False,
+                                      **self.KW)
+        # same real weight total crosses in every mode (the fused slabs
         # are carved out of weight_bytes_per_layer, not double-counted);
         # everything saved is intermediate activation traffic
-        assert self._total_bytes(mega) < self._total_bytes(old)
+        assert self._total_bytes(both) < self._total_bytes(back)
+        assert self._total_bytes(back) < self._total_bytes(old)
 
     def test_quant_algo_shrinks_fused_weight_read(self):
         from paddle_tpu.observability import costmodel as cm

@@ -16,7 +16,8 @@ import numpy as np
 
 # registration side effects                                  # noqa: F401
 from paddle_tpu.ops import (fused, pallas_flash, pallas_flashmask,
-                            pallas_gmm, pallas_megadecode, pallas_mla,
+                            pallas_gmm, pallas_megadecode,
+                            pallas_megafront, pallas_mla,
                             pallas_paged, pallas_ragged, quant)
 from paddle_tpu.ops.oracles import oracles, resolve_reference
 
@@ -30,6 +31,7 @@ EXPECTED = {
     "weight_only_linear", "flash_sdpa", "flashmask_sdpa",
     "paged_decode_attention", "paged_decode_attention_v2",
     "ragged_paged_attention", "fused_oproj_norm", "fused_ffn",
+    "fused_qkv_rope_append",
 }
 
 

@@ -1257,10 +1257,12 @@ class TestRepoJsonGate:
         assert all(c == {"fresh": 0, "baselined": 0}
                    for c in pe["per_rule"].values())
         # and the machine-readable PE505 verdicts certify every PF404
-        # candidate plus the registered front-half composition
+        # candidate plus the registered <=4-launch layer-body
+        # composition (ISSUE 20 shipped the old front-half entry as
+        # fused_qkv_rope_append)
         verdicts = {v["candidate"]: v for v in data["pe505_verdicts"]}
         comp = next(v for v in data["pe505_verdicts"]
-                    if v["composition"] == "front_half_qkv_rope_append")
+                    if v["composition"] == "decode_layer_le4")
         assert comp["verdict"] == "legal"
         assert verdicts["fused_oproj_norm->fused_ffn"]["verdict"] \
             == "legal"
@@ -2128,6 +2130,7 @@ class TestSeededMemoryDefects:
     FUSED = "paddle_tpu/ops/fused.py"
     QUANT = "paddle_tpu/ops/quant.py"
     MEGADECODE = "paddle_tpu/ops/pallas_megadecode.py"
+    MEGAFRONT = "paddle_tpu/ops/pallas_megafront.py"
 
     def _analyze(self, tmp_path, rel, tag, old="", new="", append="",
                  strict=False):
@@ -2202,23 +2205,25 @@ class TestSeededMemoryDefects:
         assert fresh[0].qualname == "int4_dequantize"
 
     def test_pf404_emits_decode_chain_fusion_worklist(self, tmp_path):
-        # advisory, info severity: pristine copies of the two chain
+        # advisory, info severity: pristine copies of the three chain
         # modules are the fixture.  ISSUE 14 RESOLVED the old
-        # rms->swiglu advisory (that pair now lives inside
-        # fused_oproj_norm/fused_ffn); what remains is the rms->rope
-        # retile and the deliberate oproj->ffn seam the mega-kernels
-        # keep (VMEM weight budget — see DECODE_CHAIN's comment)
+        # rms->swiglu advisory and ISSUE 20 the rms->rope seam (those
+        # pairs now live inside the mega-kernels); what remains is the
+        # norm->front retile (the registered <=4-launch follow-on) and
+        # the deliberate oproj->ffn seam the mega-kernels keep (VMEM
+        # weight budget — see DECODE_CHAIN's comment)
         d = tmp_path / "chain"
         d.mkdir()
         paths = []
-        for rel in (self.FUSED, self.MEGADECODE):
+        for rel in (self.FUSED, self.MEGADECODE, self.MEGAFRONT):
             p = d / os.path.basename(rel)
             p.write_text(open(os.path.join(REPO, rel)).read())
             paths.append(str(p))
         fs = analyze_paths(paths, Config(strict=True))
         details = {f.detail for f in fs if f.rule == "PF404"}
-        assert details == {"fuse:fused_rms_norm->fused_rope_append",
-                           "fuse:fused_oproj_norm->fused_ffn"}
+        assert details == {
+            "fuse:fused_rms_norm->fused_qkv_rope_append",
+            "fuse:fused_oproj_norm->fused_ffn"}
         # ...and stays out of default (non-strict) runs
         fs = analyze_paths(paths, Config(strict=False))
         assert [f for f in fs if f.rule == "PF404"] == []
@@ -2339,11 +2344,12 @@ class TestSeededEffectsDefects:
     RAGGED = "paddle_tpu/ops/pallas_ragged.py"
     FUSED = "paddle_tpu/ops/fused.py"
     MEGADECODE = "paddle_tpu/ops/pallas_megadecode.py"
+    MEGAFRONT = "paddle_tpu/ops/pallas_megafront.py"
     PAGED = "paddle_tpu/ops/pallas_paged.py"
     FLASHMASK = "paddle_tpu/ops/pallas_flashmask.py"
 
     def _analyze(self, tmp_path, rel, tag, old="", new="",
-                 strict=False):
+                 strict=False, extra=()):
         src = open(os.path.join(REPO, rel)).read()
         if old:
             assert old in src, f"seed anchor vanished from {rel}: {old!r}"
@@ -2352,19 +2358,25 @@ class TestSeededEffectsDefects:
         d.mkdir(exist_ok=True)
         p = d / os.path.basename(rel)
         p.write_text(src)
-        return analyze_paths([str(p)], Config(strict=strict))
+        paths = [str(p)]
+        for x in extra:        # pristine companions (cross-module
+            q = d / os.path.basename(x)   # compositions need all sites)
+            q.write_text(open(os.path.join(REPO, x)).read())
+            paths.append(str(q))
+        return analyze_paths(paths, Config(strict=strict))
 
-    def _seed(self, tmp_path, rel, strict=False, **kw):
-        clean = self._analyze(tmp_path, rel, "clean", strict=strict)
+    def _seed(self, tmp_path, rel, strict=False, extra=(), **kw):
+        clean = self._analyze(tmp_path, rel, "clean", strict=strict,
+                              extra=extra)
         seeded = self._analyze(tmp_path, rel, "seeded", strict=strict,
-                               **kw)
+                               extra=extra, **kw)
         new_keys = ({f.baseline_key for f in seeded}
                     - {f.baseline_key for f in clean})
         return [f for f in seeded if f.baseline_key in new_keys]
 
     def test_pristine_copies_are_pe_quiet(self, tmp_path):
         for rel in (self.RAGGED, self.FUSED, self.MEGADECODE,
-                    self.PAGED, self.FLASHMASK):
+                    self.MEGAFRONT, self.PAGED, self.FLASHMASK):
             fs = self._analyze(tmp_path, rel, "clean")
             assert [f for f in fs if f.rule.startswith("PE")] == [], rel
 
@@ -2372,8 +2384,10 @@ class TestSeededEffectsDefects:
         # pin _rms_forward's output block to (0, 0): every grid step now
         # writes the same block, with no dimension_semantics declaring
         # the axis sequential
+        # megafront rides along pristine so the layer-body composition
+        # (whose members span both modules) resolves on both sides
         fresh = self._seed(
-            tmp_path, self.FUSED,
+            tmp_path, self.FUSED, extra=(self.MEGAFRONT,),
             old="out_specs=pl.BlockSpec((bt, H), lambda i: (i, 0)),\n"
                 "        out_shape=jax.ShapeDtypeStruct((T, H), "
                 "x2.dtype),",
@@ -2444,12 +2458,43 @@ class TestSeededEffectsDefects:
             new="in_specs=[pl.BlockSpec((bt, H), "
                 "lambda i: (i + 1, 0)),")
         assert fresh and {f.rule for f in fresh} == {"PE505"}
-        pe = fresh[0]
+        details = {f.detail for f in fresh}
+        # the pair candidate flips AND the layer-body composition that
+        # contains it inherits the hazard
+        assert "fusehazard:fused_oproj_norm->fused_ffn" in details
+        assert ("fusehazard:fused_rms_norm->fused_qkv_rope_append->"
+                "fused_oproj_norm->fused_ffn") in details
+        pe = next(f for f in fresh if f.detail
+                  == "fusehazard:fused_oproj_norm->fused_ffn")
         assert pe.severity == "error"
-        assert pe.detail == "fusehazard:fused_oproj_norm->fused_ffn"
         # the hazard names the refs on both sides of the seam
         assert "xo_ref" in pe.message and "h_ref" in pe.message
         assert "read/write inversion" in pe.message
+
+    def test_pe505_flips_illegal_on_retiled_megafront_out_spec(
+            self, tmp_path):
+        # ISSUE 20 acceptance: pin the fused front's q out-spec to
+        # block (0, 0, 0).  The kernel's own launch stays PE501-quiet
+        # (the token axis is declared arbitrary for the page scatter),
+        # but the q stream no longer tiles the way downstream members
+        # consume it, so the shipped layer-body composition's verdict
+        # must flip from legal to hazard
+        fresh = self._seed(
+            tmp_path, self.MEGAFRONT,
+            extra=(self.FUSED, self.MEGADECODE),
+            old="out_specs=[pl.BlockSpec((1, heads, D), "
+                "lambda t, pg, off: (t, 0, 0)),",
+            new="out_specs=[pl.BlockSpec((1, heads, D), "
+                "lambda t, pg, off: (0, 0, 0)),")
+        hazards = [f for f in fresh if f.rule == "PE505"
+                   and f.detail.startswith("fusehazard:")]
+        assert hazards
+        comp = next(f for f in hazards if f.detail ==
+                    "fusehazard:fused_rms_norm->fused_qkv_rope_append"
+                    "->fused_oproj_norm->fused_ffn")
+        assert comp.severity == "error"
+        assert "read/write inversion" in comp.message
+        assert "qo_ref" in comp.message
 
     def test_pe506_catches_write_side_drift(self, tmp_path):
         # halve the rope output block's lane extent: written bytes

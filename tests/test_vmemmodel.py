@@ -40,7 +40,7 @@ class TestCanonicalCoverage:
         registered = set(cm.costs())
         modeled = {e["kernel"] for e in vm.CANONICAL.values()}
         assert modeled == registered
-        assert len(registered) == 19
+        assert len(registered) == 20
 
     def test_every_entry_resolves_to_one_repo_site(self, sites):
         missing = sorted(set(vm.CANONICAL) - set(sites))
@@ -53,7 +53,7 @@ class TestCostAgreement:
 
     def test_all_canonical_sites_within_tolerance(self, index):
         recs = vm.derive_cost_bytes(index)
-        assert len(recs) == 21
+        assert len(recs) == 24
         bad = [(r["kernel"], r["status"], r.get("rel_err"))
                for r in recs if r["status"] != "ok"]
         assert bad == []
@@ -160,15 +160,19 @@ class TestFusionCandidates:
     def test_decode_chain_pairs_found(self, index):
         cands = vm.fusion_candidates(index)
         details = {c["detail"]: c for c in cands}
-        # the old rms->swiglu advisory is RESOLVED by ISSUE 14 (that
-        # pair lives inside the mega-kernels now); what remains is the
-        # deliberate two-kernel seam between them — aligned token
-        # tiling, justified in the DECODE_CHAIN comment (VMEM budget)
+        # the old rms->swiglu advisory is RESOLVED by ISSUE 14 and the
+        # rms->rope seam by ISSUE 20 (both pairs live inside the
+        # mega-kernels now); what remains is the deliberate two-kernel
+        # seam behind attention — aligned token tiling, justified in
+        # the DECODE_CHAIN comment (VMEM budget) — and the norm->front
+        # retile (8-row producer vs one-token consumer), the
+        # registered <=4-launch follow-on seam
         assert "fuse:fused_rms_norm->swiglu" not in details
+        assert "fuse:fused_rms_norm->fused_rope_append" not in details
         assert "fuse:fused_oproj_norm->fused_ffn" in details
         assert details["fuse:fused_oproj_norm->fused_ffn"]["class"] \
             == "aligned"
-        assert details["fuse:fused_rms_norm->fused_rope_append"][
+        assert details["fuse:fused_rms_norm->fused_qkv_rope_append"][
             "class"] == "retile"
 
     def test_candidates_carry_sites(self, index):

@@ -67,13 +67,17 @@ SERVING_FIELDS = ("decode_tokens_per_s_per_chip", "prefill_tokens_per_s",
                   "cache_on_tokens_per_s", "prefix_hit_rate",
                   "spec_tokens_per_s", "accepted_tokens_per_verify_step",
                   "mega_tokens_per_s", "split_tokens_per_s",
+                  "fused_tokens_per_s",
                   "disagg_tokens_per_s", "colocated_tokens_per_s",
                   "prefill_skip_rate", "fleet_tokens_per_s")
 
-# ISSUE 14 launch-accounting pins on the megadecode A/B row: exact and
-# two-sided — more launches means the fusion regressed, fewer means the
-# ledger itself broke. Each holds a {mode: count} dict in the artifact.
-SERVING_LAUNCH_FIELDS = ("launches_per_layer", "back_half_launches")
+# ISSUE 14/20 launch-accounting pins on the megadecode and front_half
+# A/B rows: exact and two-sided — more launches means the fusion
+# regressed, fewer means the ledger itself broke. Each holds a
+# {mode: count} dict in the artifact (front_half: 2 fused vs 5 split;
+# layer body: 5 with both mega halves, 8 with either alone).
+SERVING_LAUNCH_FIELDS = ("launches_per_layer", "back_half_launches",
+                         "front_half_launches", "layer_body_launches")
 
 # docs/FLEET_BENCH.json scenario rows (ISSUE 16 hostile-traffic
 # harness). The scenarios replay bit-exactly from their seed, so the
